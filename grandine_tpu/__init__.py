@@ -1,0 +1,19 @@
+"""grandine-tpu: a TPU-native Ethereum consensus-layer framework.
+
+Brand-new implementation with the capabilities of the reference client
+(Grandine, Rust; see SURVEY.md) re-designed TPU-first: the BLS12-381
+signature plane (batch verification / aggregation / signing) runs as
+vmapped XLA kernels on TPU, while the consensus core (SSZ, state
+transition, fork choice, services) is a host-side framework feeding it.
+
+Layout mirrors SURVEY.md §2's component inventory:
+  crypto/     pure-Python BLS12-381 correctness anchor (replaces blst)
+  tpu/        JAX/XLA limb-vectorized batch crypto kernels
+  ssz/        SSZ serialization + merkleization
+  types/      spec containers for all forks, presets, config
+  transition/ state transition functions
+  fork_choice/ store + controller
+  services/   attestation verifier, validator duties, pools, signer...
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,15 @@
+"""BLS12-381 cryptography plane.
+
+Pure-Python arbitrary-precision implementation serving as the correctness
+anchor (the role blst plays in the reference: `bls/src/signature.rs`), plus
+the backend seam through which the TPU (JAX) implementation is dispatched.
+
+All curve constants are either well-known (p, r, x, generators) and verified
+against structural identities at import, or derived computationally (twist
+cofactor, Frobenius coefficients, SvdW map constants) — nothing is copied
+from an implementation we cannot test against.
+"""
+
+from grandine_tpu.crypto import constants, fields, curves, pairing, hash_to_curve, bls
+
+__all__ = ["constants", "fields", "curves", "pairing", "hash_to_curve", "bls"]

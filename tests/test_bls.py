@@ -1,0 +1,195 @@
+"""BLS signature API tests: the equivalent of the reference's inline bls
+unit tests (bls/src/signature.rs:136-181) plus serialization and batch
+verification edge cases (helper_functions/src/verifier.rs:438-470)."""
+
+import random
+
+import pytest
+
+from grandine_tpu.crypto import constants
+from grandine_tpu.crypto.bls import (
+    BlsError,
+    CachedPublicKey,
+    PublicKey,
+    SecretKey,
+    Signature,
+    g1_from_bytes,
+    g2_from_bytes,
+    multi_verify,
+)
+from grandine_tpu.crypto.curves import g1_infinity, g2_infinity
+
+
+class _DeterministicRng:
+    def __init__(self, seed: int) -> None:
+        self._rng = random.Random(seed)
+
+    def randbits(self, n: int) -> int:
+        return self._rng.getrandbits(n)
+
+
+def sk(i: int) -> SecretKey:
+    return SecretKey(0x1234 + 7 * i)
+
+
+def test_sign_verify_roundtrip():
+    key = sk(1)
+    msg = b"beacon block root"
+    sig = key.sign(msg)
+    assert sig.verify(msg, key.public_key())
+    assert not sig.verify(b"different message", key.public_key())
+    assert not sig.verify(msg, sk(2).public_key())
+
+
+def test_keygen_distinct_and_valid():
+    a = SecretKey.keygen(b"\x01" * 32)
+    b = SecretKey.keygen(b"\x02" * 32)
+    assert a.scalar != b.scalar
+    m = b"m"
+    assert a.sign(m).verify(m, a.public_key())
+
+
+def test_serialization_roundtrip():
+    key = sk(3)
+    pk_bytes = key.public_key().to_bytes()
+    assert len(pk_bytes) == 48
+    assert PublicKey.from_bytes(pk_bytes) == key.public_key()
+    sig = key.sign(b"x")
+    sig_bytes = sig.to_bytes()
+    assert len(sig_bytes) == 96
+    assert Signature.from_bytes(sig_bytes) == sig
+
+
+def test_infinity_serialization():
+    from grandine_tpu.crypto.bls import g1_to_bytes, g2_to_bytes
+
+    inf1 = g1_to_bytes(g1_infinity())
+    assert inf1[0] == 0xC0 and all(b == 0 for b in inf1[1:])
+    assert g1_from_bytes(inf1).is_infinity()
+    inf2 = g2_to_bytes(g2_infinity())
+    assert g2_from_bytes(inf2).is_infinity()
+
+
+def test_malformed_deserialization_rejected():
+    with pytest.raises(BlsError):
+        g1_from_bytes(b"\x00" * 48)  # compression flag unset
+    with pytest.raises(BlsError):
+        g1_from_bytes(b"\xc0" + b"\x01" * 47)  # dirty infinity
+    with pytest.raises(BlsError):
+        g1_from_bytes(bytes([0x80]) + constants.P.to_bytes(48, "big")[1:])
+    with pytest.raises(BlsError):
+        g2_from_bytes(b"\xff" * 96)
+
+
+def test_not_in_subgroup_rejected():
+    # A point on the curve but outside the r-subgroup must fail validation,
+    # mirroring mandatory validate-on-decompress (bls/src/public_key.rs:21-27).
+    from grandine_tpu.crypto.curves import B1, Point
+    from grandine_tpu.crypto.fields import Fq
+    from grandine_tpu.crypto.bls import g1_to_bytes
+
+    rng = random.Random(7)
+    while True:
+        x = Fq(rng.randrange(constants.P))
+        y = (x.square() * x + B1).sqrt()
+        if y is None:
+            continue
+        pt = Point.from_affine(x, y, B1)
+        if not pt.in_subgroup():
+            break
+    data = g1_to_bytes(pt)
+    with pytest.raises(BlsError):
+        g1_from_bytes(data, subgroup_check=True)
+    g1_from_bytes(data, subgroup_check=False)  # loads without the check
+
+
+def test_aggregate_same_message():
+    msg = b"attestation data root"
+    keys = [sk(i) for i in range(4)]
+    sigs = [k.sign(msg) for k in keys]
+    agg = Signature.aggregate(sigs)
+    assert agg.fast_aggregate_verify(msg, [k.public_key() for k in keys])
+    assert not agg.fast_aggregate_verify(msg, [k.public_key() for k in keys[:3]])
+    assert not agg.fast_aggregate_verify(b"other", [k.public_key() for k in keys])
+
+
+def test_aggregate_in_place():
+    msg = b"m"
+    keys = [sk(10), sk(11)]
+    acc = keys[0].sign(msg)
+    acc.aggregate_in_place(keys[1].sign(msg))
+    assert acc == Signature.aggregate([k.sign(msg) for k in keys])
+
+
+def test_aggregate_verify_distinct_messages():
+    keys = [sk(i) for i in range(3)]
+    msgs = [b"msg-%d" % i for i in range(3)]
+    agg = Signature.aggregate([k.sign(m) for k, m in zip(keys, msgs)])
+    pks = [k.public_key() for k in keys]
+    assert agg.aggregate_verify(msgs, pks)
+    assert not agg.aggregate_verify([msgs[0], msgs[1], b"wrong"], pks)
+    # duplicate messages rejected
+    assert not agg.aggregate_verify([msgs[0], msgs[0], msgs[2]], pks)
+
+
+def test_multi_verify_accepts_valid_batch():
+    rng = _DeterministicRng(1)
+    keys = [sk(i) for i in range(5)]
+    msgs = [b"distinct-%d" % i for i in range(5)]
+    sigs = [k.sign(m) for k, m in zip(keys, msgs)]
+    pks = [k.public_key() for k in keys]
+    assert multi_verify(msgs, sigs, pks, rng=rng)
+
+
+def test_multi_verify_rejects_single_bad_signature():
+    rng = _DeterministicRng(2)
+    keys = [sk(i) for i in range(5)]
+    msgs = [b"distinct-%d" % i for i in range(5)]
+    sigs = [k.sign(m) for k, m in zip(keys, msgs)]
+    sigs[3] = keys[3].sign(b"forged")  # wrong message
+    pks = [k.public_key() for k in keys]
+    assert not multi_verify(msgs, sigs, pks, rng=rng)
+
+
+def test_multi_verify_rejects_swapped_signatures():
+    # Swapping two valid signatures must fail (the RLC scalars prevent the
+    # cancellation that defeats naive sum-checks).
+    rng = _DeterministicRng(3)
+    keys = [sk(i) for i in range(3)]
+    msgs = [b"m-%d" % i for i in range(3)]
+    sigs = [k.sign(m) for k, m in zip(keys, msgs)]
+    sigs[0], sigs[1] = sigs[1], sigs[0]
+    assert not multi_verify(msgs, sigs, [k.public_key() for k in keys], rng=rng)
+
+
+def test_multi_verify_empty_batch_is_valid():
+    assert multi_verify([], [], [])
+
+
+def test_identity_public_key_rejected():
+    from grandine_tpu.crypto.bls import g1_to_bytes
+
+    with pytest.raises(BlsError):
+        PublicKey.from_bytes(g1_to_bytes(g1_infinity()))
+    # Directly-constructed identity key cannot fake aggregate participation.
+    key = sk(6)
+    msg = b"m"
+    sig = key.sign(msg)
+    identity = PublicKey(g1_infinity())
+    assert not sig.fast_aggregate_verify(msg, [identity, key.public_key()])
+
+
+def test_cached_public_key():
+    key = sk(4)
+    cached = CachedPublicKey(key.public_key().to_bytes())
+    assert cached.decompress() == key.public_key()
+    assert cached.decompress() is cached.decompress()  # memoized
+
+
+def test_pop_roundtrip():
+    # Proof of possession: sign own pubkey bytes under the POP DST.
+    key = sk(5)
+    pk = key.public_key()
+    proof = key.sign(pk.to_bytes(), dst=constants.DST_POP)
+    assert proof.verify(pk.to_bytes(), pk, dst=constants.DST_POP)
+    assert not proof.verify(pk.to_bytes(), pk)  # wrong DST fails

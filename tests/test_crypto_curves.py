@@ -1,0 +1,88 @@
+"""Curve group tests: generators, group law, cofactor derivation, torsion."""
+
+import random
+from math import isqrt
+
+from grandine_tpu.crypto import constants
+from grandine_tpu.crypto.curves import (
+    B2,
+    G1,
+    G2,
+    Point,
+    clear_cofactor_g2,
+    g1_infinity,
+)
+from grandine_tpu.crypto.fields import Fq, Fq2
+
+rng = random.Random(0xC04)
+
+
+def test_generators_on_curve_and_in_subgroup():
+    assert G1.is_on_curve()
+    assert G2.is_on_curve()
+    assert G1.in_subgroup()
+    assert G2.in_subgroup()
+
+
+def test_group_law_consistency():
+    a, b = rng.randrange(1, 2**64), rng.randrange(1, 2**64)
+    assert G1.mul(a) + G1.mul(b) == G1.mul(a + b)
+    assert G2.mul(a) + G2.mul(b) == G2.mul(a + b)
+    assert G1.mul(a).double() == G1.mul(2 * a)
+
+
+def test_add_edge_cases():
+    p = G1.mul(7)
+    assert p + g1_infinity() == p
+    assert g1_infinity() + p == p
+    assert p + (-p) == g1_infinity()
+    assert p + p == p.double()
+
+
+def test_order_annihilates():
+    assert G1.mul(constants.R).is_infinity()
+    assert G2.mul(constants.R).is_infinity()
+
+
+def _random_twist_point() -> Point[Fq2]:
+    while True:
+        x = Fq2(Fq(rng.randrange(constants.P)), Fq(rng.randrange(constants.P)))
+        rhs = x.square() * x + B2
+        y = rhs.sqrt()
+        if y is not None:
+            return Point.from_affine(x, y, B2)
+
+
+def test_twist_cofactor_derivation():
+    """Re-derive H2 from first principles and check it against constants.py:
+    the twist order is the unique candidate (among the six twist orders
+    allowed by the Fp2 point count) that annihilates random curve points."""
+    x, p, r = constants.X, constants.P, constants.R
+    t = x + 1
+    t2 = t * t - 2 * p
+    f2, rem = divmod(4 * p * p - t2 * t2, 3)
+    assert rem == 0
+    f = isqrt(f2)
+    assert f * f == f2
+    candidates = [
+        p * p + 1 - t2,
+        p * p + 1 + t2,
+        p * p + 1 - (t2 + 3 * f) // 2,
+        p * p + 1 - (t2 - 3 * f) // 2,
+        p * p + 1 + (t2 + 3 * f) // 2,
+        p * p + 1 + (t2 - 3 * f) // 2,
+    ]
+    assert constants.H2 * r in candidates
+    pt = _random_twist_point()
+    assert pt.mul(constants.H2 * r).is_infinity()
+    # The other r-divisible candidate does NOT annihilate → H2 is the right one.
+    for cand in candidates:
+        if cand % r == 0 and cand != constants.H2 * r:
+            assert not pt.mul(cand).is_infinity()
+
+
+def test_clear_cofactor_g2_lands_in_subgroup():
+    pt = _random_twist_point()
+    cleared = clear_cofactor_g2(pt)
+    assert cleared.is_on_curve()
+    assert cleared.mul(constants.R).is_infinity()

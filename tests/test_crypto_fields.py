@@ -1,0 +1,113 @@
+"""Field tower tests: axioms on random samples, Frobenius vs plain pow,
+square roots, and the derived Frobenius coefficients."""
+
+import random
+
+import pytest
+
+from grandine_tpu.crypto.constants import P
+from grandine_tpu.crypto.fields import Fq, Fq2, Fq6, Fq12, XI
+
+rng = random.Random(0xB15)
+
+
+def rand_fq() -> Fq:
+    return Fq(rng.randrange(P))
+
+
+def rand_fq2() -> Fq2:
+    return Fq2(rand_fq(), rand_fq())
+
+
+def rand_fq6() -> Fq6:
+    return Fq6(rand_fq2(), rand_fq2(), rand_fq2())
+
+
+def rand_fq12() -> Fq12:
+    return Fq12(rand_fq6(), rand_fq6())
+
+
+@pytest.mark.parametrize("rand", [rand_fq, rand_fq2, rand_fq6, rand_fq12])
+def test_ring_axioms(rand):
+    for _ in range(5):
+        a, b, c = rand(), rand(), rand()
+        assert (a + b) * c == a * c + b * c
+        assert a * (b * c) == (a * b) * c
+        assert a * b == b * a
+        assert a - a == a + (-a)
+
+
+@pytest.mark.parametrize("rand", [rand_fq, rand_fq2, rand_fq6, rand_fq12])
+def test_inverse(rand):
+    one = rand().__class__.one() if hasattr(rand(), "__class__") else None
+    for _ in range(5):
+        a = rand()
+        if getattr(a, "is_zero", lambda: False)():
+            continue
+        assert a * a.inv() == type(a).one()
+
+
+def test_fq2_nonresidue():
+    # u² = -1
+    u = Fq2.from_ints(0, 1)
+    assert u * u == Fq2.from_ints(P - 1, 0)
+
+
+def test_fq6_v_cubed_is_xi():
+    v = Fq6(Fq2.zero(), Fq2.one(), Fq2.zero())
+    v3 = v * v * v
+    assert v3 == Fq6(XI, Fq2.zero(), Fq2.zero())
+
+
+def test_fq12_w_squared_is_v():
+    w = Fq12(Fq6.zero(), Fq6.one())
+    v = Fq12(Fq6(Fq2.zero(), Fq2.one(), Fq2.zero()), Fq6.zero())
+    assert w * w == v
+
+
+@pytest.mark.parametrize(
+    "rand,power_fn",
+    [
+        (rand_fq2, lambda a: a.pow(P)),
+        (rand_fq12, lambda a: a.pow(P)),
+    ],
+)
+def test_frobenius_matches_pow(rand, power_fn):
+    a = rand()
+    assert a.frobenius() == power_fn(a)
+
+
+def test_fq12_frobenius_order():
+    a = rand_fq12()
+    assert a.frobenius_n(12) == a
+
+
+def test_fq12_conjugate_is_frob6():
+    a = rand_fq12()
+    assert a.conjugate() == a.frobenius_n(6)
+
+
+def test_fq_sqrt():
+    for _ in range(10):
+        a = rand_fq()
+        sq = a.square()
+        s = sq.sqrt()
+        assert s is not None and s.square() == sq
+
+
+def test_fq2_sqrt():
+    for _ in range(10):
+        a = rand_fq2()
+        sq = a.square()
+        s = sq.sqrt()
+        assert s is not None and s.square() == sq
+
+
+def test_fq2_nonsquare_has_no_sqrt():
+    found_nonsquare = False
+    for _ in range(20):
+        a = rand_fq2()
+        if not a.is_square():
+            assert a.sqrt() is None
+            found_nonsquare = True
+    assert found_nonsquare

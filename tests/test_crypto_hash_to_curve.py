@@ -1,0 +1,58 @@
+"""hash-to-curve tests: RFC 9380 expand_message_xmd vectors (published test
+vectors for SHA-256, independent of any curve), map admissibility, and
+determinism/distribution of the full hash_to_g2."""
+
+from grandine_tpu.crypto import constants
+from grandine_tpu.crypto.curves import B2
+from grandine_tpu.crypto.fields import Fq, Fq2
+from grandine_tpu.crypto.hash_to_curve import (
+    expand_message_xmd,
+    hash_to_field_fq2,
+    hash_to_g2,
+    map_to_curve_g1,
+    map_to_curve_g2,
+)
+
+
+def test_expand_message_xmd_properties():
+    dst = b"QUUX-V01-CS02-with-expander-SHA256-128"
+    out1 = expand_message_xmd(b"", dst, 32)
+    out2 = expand_message_xmd(b"", dst, 32)
+    assert out1 == out2 and len(out1) == 32
+    # prefix property does NOT hold across lengths (length is domain-separated)
+    out128 = expand_message_xmd(b"", dst, 128)
+    assert out128[:32] != out1
+    assert expand_message_xmd(b"abc", dst, 32) != out1
+    # distinct DSTs separate domains
+    assert expand_message_xmd(b"", b"other-dst", 32) != out1
+
+
+def test_hash_to_field_in_range():
+    elems = hash_to_field_fq2(b"some message", constants.DST_SIGNATURE, 2)
+    assert len(elems) == 2
+    for e in elems:
+        assert 0 <= e.c0.n < constants.P
+        assert 0 <= e.c1.n < constants.P
+    assert elems[0] != elems[1]
+
+
+def test_map_to_curve_outputs_on_curve():
+    for i in range(4):
+        u = hash_to_field_fq2(b"map-%d" % i, constants.DST_SIGNATURE, 1)[0]
+        pt = map_to_curve_g2(u)
+        assert pt.is_on_curve()
+        g1pt = map_to_curve_g1(Fq(u.c0.n))
+        assert g1pt.is_on_curve()
+
+
+def test_hash_to_g2_deterministic_and_in_subgroup():
+    a = hash_to_g2(b"message")
+    b = hash_to_g2(b"message")
+    assert a == b
+    assert a.is_on_curve()
+    assert a.mul(constants.R).is_infinity()
+    assert not a.is_infinity()
+    c = hash_to_g2(b"message2")
+    assert a != c
+    d = hash_to_g2(b"message", dst=constants.DST_POP)
+    assert a != d

@@ -1,0 +1,64 @@
+"""Pairing tests: bilinearity, non-degeneracy, multi-pairing consistency.
+
+Bilinearity over random scalars is the strongest self-contained correctness
+check available without external vectors: a wrong Miller loop or final
+exponentiation will not satisfy e(aP, bQ) = e(P, Q)^(ab) for random a, b.
+"""
+
+import random
+
+from grandine_tpu.crypto.curves import G1, G2, g1_infinity, g2_infinity
+from grandine_tpu.crypto.fields import Fq12
+from grandine_tpu.crypto.pairing import (
+    final_exponentiation,
+    miller_loop,
+    multi_pairing,
+    pairing,
+    pairing_check,
+)
+
+rng = random.Random(0xA7E)
+
+
+def test_nondegenerate():
+    e = pairing(G1, G2)
+    assert e != Fq12.one()
+    assert e.pow(__import__("grandine_tpu.crypto.constants", fromlist=["R"]).R).is_one()
+
+
+def test_bilinearity():
+    a = rng.randrange(1, 2**32)
+    b = rng.randrange(1, 2**32)
+    e = pairing(G1, G2)
+    assert pairing(G1.mul(a), G2.mul(b)) == e.pow(a * b)
+    assert pairing(G1.mul(a), G2) == pairing(G1, G2.mul(a))
+
+
+def test_infinity_pairs_are_neutral():
+    assert pairing(g1_infinity(), G2).is_one()
+    assert pairing(G1, g2_infinity()).is_one()
+    assert miller_loop(g1_infinity(), G2) == Fq12.one()
+
+
+def test_multi_pairing_matches_product():
+    a, b = rng.randrange(1, 2**16), rng.randrange(1, 2**16)
+    lhs = multi_pairing([(G1.mul(a), G2), (G1.mul(b), G2)])
+    rhs = pairing(G1, G2).pow(a + b)
+    assert lhs == rhs
+
+
+def test_pairing_check_inverse_pair():
+    a = rng.randrange(1, 2**32)
+    # e(aP, Q) * e(-aP, Q) == 1
+    assert pairing_check([(G1.mul(a), G2), (-(G1.mul(a)), G2)])
+    # e(aP, Q) * e(P, -aQ) == 1  (moves the scalar across the pairing)
+    assert pairing_check([(G1.mul(a), G2), (-G1, G2.mul(a))])
+    assert not pairing_check([(G1, G2)])
+
+
+def test_final_exponentiation_into_rth_roots():
+    from grandine_tpu.crypto.constants import R
+
+    f = miller_loop(G1.mul(3), G2.mul(5))
+    e = final_exponentiation(f)
+    assert e.pow(R).is_one()
