@@ -1,0 +1,18 @@
+"""TPU (JAX/XLA) execution backend for the BLS12-381 signature plane.
+
+The compute strategy (SURVEY.md §7, BASELINE.md):
+  - 381-bit field elements are decomposed into 24 × 16-bit limbs held in
+    uint32 lanes (products of canonical limbs fit uint32; column sums stay
+    < 2³² without intermediate carries), in Montgomery form with R = 2³⁸⁴.
+  - All ops are batched over a leading axis and jit/vmap-friendly: fixed
+    trip counts, no data-dependent shapes, branchless edge-case handling
+    via select — exactly the XLA-compilation model the framework targets.
+  - Miller loops are vmapped across a signature batch; the final
+    exponentiation is shared per batch (the multi_verify structure of
+    bls/src/signature.rs:96-129 mapped onto the accelerator).
+  - Multi-chip: the batch axis is sharded over a jax.sharding.Mesh; the
+    pairing-product reduction is the only cross-device collective.
+
+Differential testing: every function here is tested against the
+pure-Python anchor in grandine_tpu/crypto/.
+"""

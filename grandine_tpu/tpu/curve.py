@@ -1,0 +1,267 @@
+"""Batched Jacobian curve arithmetic on device, generic over the coordinate
+field (G1 over Fp, G2 over Fp2 on the twist).
+
+Conventions:
+  - A point is a tuple (X, Y, Z) of field arrays; Z = 0 ⇒ infinity.
+  - Every formula groups its independent field multiplications into stacked
+    `mul_stack` calls (one montmul scan each) — see field.py.
+  - Branchless: degenerate cases are computed-and-selected, never branched.
+    Doubling is complete for our curves (no 2-torsion: both cofactors are
+    odd, so Y=0 never occurs on-curve and Z3=2YZ=0 only propagates infinity).
+  - Scalar multiplication is MSB-first double-and-add with an affine base,
+    which keeps every addition a mixed add and (for scalars < 2^255 < r)
+    provably avoids the T = ±Q degeneracies mid-loop.
+
+Differentially tested against grandine_tpu/crypto/curves.py.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from grandine_tpu.tpu import field as F
+from grandine_tpu.tpu import limbs as L
+
+
+@dataclass(frozen=True)
+class FieldOps:
+    """The field-op surface the curve formulas need."""
+
+    mul_stack: Callable  # (K, ..., elem), (K, ..., elem) -> (K, ..., elem)
+    add: Callable
+    sub: Callable
+    neg: Callable
+    select: Callable  # (cond_bool_batch, a, b) -> a where cond else b
+    is_zero: Callable  # elem -> bool batch
+    zeros_like: Callable
+    one_like: Callable
+
+
+def _fp_one_like(a):
+    return jnp.broadcast_to(jnp.asarray(L.ONE_MONT), a.shape).astype(jnp.uint32)
+
+
+def _fp2_one_like(a):
+    return F.fp2_one(a.shape[:-2])
+
+
+FP_OPS = FieldOps(
+    mul_stack=L.montmul,
+    add=L.add_mod,
+    sub=L.sub_mod,
+    neg=L.neg_mod,
+    select=L.select,
+    is_zero=L.is_zero,
+    zeros_like=jnp.zeros_like,
+    one_like=_fp_one_like,
+)
+
+FP2_OPS = FieldOps(
+    mul_stack=F.fp2_mul_many,
+    add=F.fp2_add,
+    sub=F.fp2_sub,
+    neg=F.fp2_neg,
+    select=F.fp2_select,
+    is_zero=F.fp2_is_zero,
+    zeros_like=jnp.zeros_like,
+    one_like=_fp2_one_like,
+)
+
+
+def point_infinity_like(x, ops: FieldOps):
+    one = ops.one_like(x)
+    return (one, one, ops.zeros_like(x))
+
+
+def point_double(p, ops: FieldOps):
+    """dbl-2009-l (a=0): complete on our curves (see module docstring)."""
+    X, Y, Z = p
+    m1 = ops.mul_stack(jnp.stack([X, Y, Y]), jnp.stack([X, Y, Z]))
+    A, Bq, YZ = m1[0], m1[1], m1[2]
+    XB = ops.add(X, Bq)
+    m2 = ops.mul_stack(jnp.stack([Bq, XB]), jnp.stack([Bq, XB]))
+    C, T1 = m2[0], m2[1]
+    D = ops.sub(T1, ops.add(A, C))
+    D = ops.add(D, D)  # 2((X+B)² - A - C)
+    E = ops.add(ops.add(A, A), A)
+    Fv = ops.mul_stack(E[None], E[None])[0]
+    X3 = ops.sub(Fv, ops.add(D, D))
+    t = ops.mul_stack(E[None], ops.sub(D, X3)[None])[0]
+    C2 = ops.add(C, C)
+    C4 = ops.add(C2, C2)
+    C8 = ops.add(C4, C4)
+    Y3 = ops.sub(t, C8)
+    Z3 = ops.add(YZ, YZ)
+    return (X3, Y3, Z3)
+
+
+def point_madd_unsafe(p, qx, qy, ops: FieldOps):
+    """Mixed add P(jacobian) + Q(affine) assuming P ≠ ±Q and P, Q ≠ ∞
+    (madd-2007-bl). Degeneracies must be selected away by the caller."""
+    X, Y, Z = p
+    Z2 = ops.mul_stack(Z[None], Z[None])[0]
+    m2 = ops.mul_stack(jnp.stack([qx, Z]), jnp.stack([Z2, Z2]))
+    U2, ZZZ = m2[0], m2[1]
+    H = ops.sub(U2, X)
+    m3 = ops.mul_stack(jnp.stack([qy, H]), jnp.stack([ZZZ, H]))
+    S2, HH = m3[0], m3[1]
+    I = ops.add(HH, HH)
+    I = ops.add(I, I)  # 4HH
+    r = ops.sub(S2, Y)
+    r = ops.add(r, r)
+    m4 = ops.mul_stack(jnp.stack([H, X, r]), jnp.stack([I, I, r]))
+    J, V, R2 = m4[0], m4[1], m4[2]
+    X3 = ops.sub(R2, ops.add(J, ops.add(V, V)))
+    ZH = ops.add(Z, H)
+    m5 = ops.mul_stack(jnp.stack([r, Y, ZH]), jnp.stack([ops.sub(V, X3), J, ZH]))
+    t, YJ, ZH2 = m5[0], m5[1], m5[2]
+    Y3 = ops.sub(t, ops.add(YJ, YJ))
+    Z3 = ops.sub(ZH2, ops.add(Z2, HH))
+    return (X3, Y3, Z3)
+
+
+def point_add_complete(p, q, ops: FieldOps):
+    """Full Jacobian addition handling ∞, P=Q (→ double) and P=-Q (→ ∞),
+    branchlessly (add-2007-bl + selects). For reduction trees over
+    adversary-influenced points."""
+    X1, Y1, Z1 = p
+    X2, Y2, Z2 = q
+    m1 = ops.mul_stack(jnp.stack([Z1, Z2]), jnp.stack([Z1, Z2]))
+    Z1Z1, Z2Z2 = m1[0], m1[1]
+    m2 = ops.mul_stack(
+        jnp.stack([X1, X2, Z2, Z1]), jnp.stack([Z2Z2, Z1Z1, Z2Z2, Z1Z1])
+    )
+    U1, U2, t1, t2 = m2[0], m2[1], m2[2], m2[3]
+    m3 = ops.mul_stack(jnp.stack([Y1, Y2]), jnp.stack([t1, t2]))
+    S1, S2 = m3[0], m3[1]
+    H = ops.sub(U2, U1)
+    H2 = ops.add(H, H)
+    m4 = ops.mul_stack(H2[None], H2[None])
+    I = m4[0]
+    r = ops.sub(S2, S1)
+    r = ops.add(r, r)
+    m5 = ops.mul_stack(jnp.stack([H, U1, r]), jnp.stack([I, I, r]))
+    J, V, R2 = m5[0], m5[1], m5[2]
+    X3 = ops.sub(R2, ops.add(J, ops.add(V, V)))
+    Z12 = ops.add(Z1, Z2)
+    m6 = ops.mul_stack(
+        jnp.stack([r, S1, Z12]), jnp.stack([ops.sub(V, X3), J, Z12])
+    )
+    t, S1J, Z12sq = m6[0], m6[1], m6[2]
+    Y3 = ops.sub(t, ops.add(S1J, S1J))
+    Zpre = ops.sub(Z12sq, ops.add(Z1Z1, Z2Z2))
+    Z3 = ops.mul_stack(Zpre[None], H[None])[0]
+
+    dbl = point_double(p, ops)
+    p_inf = ops.is_zero(Z1)
+    q_inf = ops.is_zero(Z2)
+    eq_x = ops.is_zero(H)
+    eq_y = ops.is_zero(r)
+    inf = point_infinity_like(X1, ops)
+
+    def sel3(cond, a, b):
+        return tuple(ops.select(cond, ai, bi) for ai, bi in zip(a, b))
+
+    out = (X3, Y3, Z3)
+    out = sel3(eq_x & jnp.logical_not(eq_y) & jnp.logical_not(p_inf) & jnp.logical_not(q_inf), inf, out)
+    out = sel3(eq_x & eq_y, dbl, out)
+    out = sel3(q_inf, p, out)
+    out = sel3(p_inf, q, out)
+    return out
+
+
+def scalar_mul(qx, qy, q_inf, bits_msb: jnp.ndarray, ops: FieldOps):
+    """[k]Q for affine Q (batched), k given as an MSB-first bit array
+    (..., nbits) uint32. Returns a Jacobian point. Scalars must be < r
+    (see module docstring for why mixed adds suffice)."""
+    one = ops.one_like(qx)
+    zero = ops.zeros_like(qx)
+    init = (one, one, zero)  # infinity
+
+    def step(st, bit):
+        st = point_double(st, ops)
+        added = point_madd_unsafe(st, qx, qy, ops)
+        was_inf = ops.is_zero(st[2])
+        bitb = bit.astype(bool)
+        # select: infinity + Q = Q (affine embed); else madd; else keep
+        X = ops.select(bitb, ops.select(was_inf, qx, added[0]), st[0])
+        Y = ops.select(bitb, ops.select(was_inf, qy, added[1]), st[1])
+        Z = ops.select(bitb, ops.select(was_inf, one, added[2]), st[2])
+        return (X, Y, Z), None
+
+    st, _ = lax.scan(step, init, jnp.moveaxis(bits_msb, -1, 0))
+    # [k]∞ = ∞
+    X = ops.select(q_inf, one, st[0])
+    Y = ops.select(q_inf, one, st[1])
+    Z = ops.select(q_inf, zero, st[2])
+    return (X, Y, Z)
+
+
+def sum_points(p, ops: FieldOps):
+    """Reduce a batch of Jacobian points (leading axis) to a single point by
+    a binary tree of complete additions. Batch size must be a power of two
+    (pad with infinity)."""
+    X, Y, Z = p
+    n = X.shape[0]
+    assert n & (n - 1) == 0, "sum_points requires power-of-two batch"
+    while n > 1:
+        h = n // 2
+        a = (X[:h], Y[:h], Z[:h])
+        b = (X[h:n], Y[h:n], Z[h:n])
+        X, Y, Z = point_add_complete(a, b, ops)
+        n = h
+    return (X[0], Y[0], Z[0])
+
+
+def scalars_to_bits_msb(scalars, nbits: int) -> np.ndarray:
+    """Host helper: int scalars → (len, nbits) uint32 MSB-first bit array."""
+    out = np.zeros((len(scalars), nbits), dtype=np.uint32)
+    for i, s in enumerate(scalars):
+        assert 0 <= s < (1 << nbits)
+        for j in range(nbits):
+            out[i, nbits - 1 - j] = (s >> j) & 1
+    return out
+
+
+# --- host conversions ------------------------------------------------------
+
+
+def g1_point_to_dev(pt) -> "tuple[np.ndarray, np.ndarray, np.ndarray]":
+    """Anchor G1 Point (affine view) → device affine (x, y, inf_flag)."""
+    aff = pt.to_affine()
+    if aff is None:
+        return L.ZERO.copy(), L.ZERO.copy(), np.array(True)
+    return L.to_mont(aff[0].n), L.to_mont(aff[1].n), np.array(False)
+
+
+def g2_point_to_dev(pt):
+    aff = pt.to_affine()
+    if aff is None:
+        z = np.zeros((2, L.NLIMBS), np.uint32)
+        return z, z.copy(), np.array(True)
+    return F.fq2_to_dev(aff[0]), F.fq2_to_dev(aff[1]), np.array(False)
+
+
+def dev_to_g1_point(X, Y, Z):
+    """Device Jacobian G1 → anchor Point."""
+    from grandine_tpu.crypto.curves import B1, Point, g1_infinity
+    from grandine_tpu.crypto.fields import Fq
+
+    x, y, z = (L.from_mont(np.asarray(c)) for c in (X, Y, Z))
+    if z == 0:
+        return g1_infinity()
+    return Point(Fq(x), Fq(y), Fq(z), B1)
+
+
+def dev_to_g2_point(X, Y, Z):
+    from grandine_tpu.crypto.curves import B2, Point, g2_infinity
+
+    zf = F.dev_to_fq2(np.asarray(Z))
+    if zf.is_zero():
+        return g2_infinity()
+    return Point(F.dev_to_fq2(np.asarray(X)), F.dev_to_fq2(np.asarray(Y)), zf, B2)

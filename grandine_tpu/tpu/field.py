@@ -1,0 +1,355 @@
+"""Device field tower on limb arrays.
+
+Shapes (always trailing; any leading batch shape broadcasts):
+  Fp   (..., 24)
+  Fp2  (..., 2, 24)          c0 + c1·u
+  Fp6  (..., 3, 2, 24)       over Fp2, v³ = ξ = 1+u
+  Fp12 (..., 2, 3, 2, 24)    over Fp6, w² = v
+
+Same tower and formulas as the anchor (grandine_tpu/crypto/fields.py); every
+function is differentially tested against it. Frobenius coefficients are
+imported from the anchor's derived values — a single source of truth.
+
+The `*_many` variants take a stacked leading axis of independent pairs and
+fold ALL their limb multiplications into a single wide montmul scan — one
+Fp12 multiplication is exactly one 54-wide montmul call. This is what keeps
+the Miller-loop XLA graph compilable and the VPU lanes full.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from grandine_tpu.crypto.fields import frobenius_coefficients
+from grandine_tpu.tpu import limbs as L
+
+NL = L.NLIMBS
+
+# --- Fp2 -------------------------------------------------------------------
+
+
+def fp2_add(a, b):
+    return L.add_mod(a, b)
+
+
+def fp2_sub(a, b):
+    return L.sub_mod(a, b)
+
+
+def fp2_neg(a):
+    return L.neg_mod(a)
+
+
+def fp2_mul_many(A, B):
+    """Multiply K independent Fp2 pairs: (K, ..., 2, 24) → (K, ..., 2, 24),
+    with all 3K limb products in one montmul call (Karatsuba)."""
+    a0, a1 = A[..., 0, :], A[..., 1, :]
+    b0, b1 = B[..., 0, :], B[..., 1, :]
+    sa = L.add_mod(a0, a1)
+    sb = L.add_mod(b0, b1)
+    s = jnp.concatenate([a0, a1, sa], axis=0)
+    t = jnp.concatenate([b0, b1, sb], axis=0)
+    r = L.montmul(s, t)
+    k = A.shape[0]
+    r0, r1, r2 = r[:k], r[k : 2 * k], r[2 * k :]
+    c0 = L.sub_mod(r0, r1)
+    c1 = L.sub_mod(r2, L.add_mod(r0, r1))
+    return jnp.stack([c0, c1], axis=-2)
+
+
+def fp2_mul(a, b):
+    a, b = jnp.broadcast_arrays(a, b)
+    return fp2_mul_many(a[None], b[None])[0]
+
+
+def fp2_sq_many(A):
+    """Square K independent Fp2 elements with 2K limb products in one call."""
+    a0, a1 = A[..., 0, :], A[..., 1, :]
+    s = jnp.concatenate([L.add_mod(a0, a1), a0], axis=0)
+    t = jnp.concatenate([L.sub_mod(a0, a1), a1], axis=0)
+    r = L.montmul(s, t)
+    k = A.shape[0]
+    c0 = r[:k]
+    c1 = r[k:]
+    return jnp.stack([c0, L.add_mod(c1, c1)], axis=-2)
+
+
+def fp2_sq(a):
+    return fp2_sq_many(a[None])[0]
+
+
+def fp2_scale(a, k):
+    """Multiply Fp2 by an Fp scalar (shape broadcastable to (..., 24))."""
+    kk = jnp.broadcast_to(k, a[..., 0, :].shape)
+    r = L.montmul(jnp.stack([a[..., 0, :], a[..., 1, :]]), jnp.stack([kk, kk]))
+    return jnp.stack([r[0], r[1]], axis=-2)
+
+
+def fp2_conj(a):
+    return jnp.stack([a[..., 0, :], L.neg_mod(a[..., 1, :])], axis=-2)
+
+
+def fp2_mul_by_xi(a):
+    """×(1+u): (c0 - c1, c0 + c1)."""
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    return jnp.stack([L.sub_mod(a0, a1), L.add_mod(a0, a1)], axis=-2)
+
+
+def fp2_inv(a):
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    sq = L.montmul(jnp.stack([a0, a1]), jnp.stack([a0, a1]))
+    norm = L.add_mod(sq[0], sq[1])
+    ninv = L.inv_mod(norm)
+    prod = L.montmul(jnp.stack([a0, L.neg_mod(a1)]), ninv[None])
+    return jnp.stack([prod[0], prod[1]], axis=-2)
+
+
+def fp2_is_zero(a):
+    return jnp.all(a == 0, axis=(-1, -2))
+
+
+def fp2_select(cond, a, b):
+    return jnp.where(cond[..., None, None], a, b)
+
+
+def fp2_zero(shape=()):
+    return jnp.zeros(shape + (2, NL), jnp.uint32)
+
+
+def fp2_one(shape=()):
+    one = jnp.asarray(np.stack([L.ONE_MONT, L.ZERO]))
+    return jnp.broadcast_to(one, shape + (2, NL)).astype(jnp.uint32)
+
+
+# --- Fp6 -------------------------------------------------------------------
+
+
+def fp6_add(a, b):
+    return L.add_mod(a, b)
+
+
+def fp6_sub(a, b):
+    return L.sub_mod(a, b)
+
+
+def fp6_neg(a):
+    return L.neg_mod(a)
+
+
+def fp6_mul_many(A, B):
+    """Multiply K independent Fp6 pairs: (K, ..., 3, 2, 24); all 18K limb
+    products in one montmul call."""
+    a0, a1, a2 = A[..., 0, :, :], A[..., 1, :, :], A[..., 2, :, :]
+    b0, b1, b2 = B[..., 0, :, :], B[..., 1, :, :], B[..., 2, :, :]
+    # the six Fp2 products per pair (schoolbook-Karatsuba hybrid)
+    sums_a = L.add_mod(
+        jnp.concatenate([a1, a0, a0], axis=0), jnp.concatenate([a2, a1, a2], axis=0)
+    )
+    sums_b = L.add_mod(
+        jnp.concatenate([b1, b0, b0], axis=0), jnp.concatenate([b2, b1, b2], axis=0)
+    )
+    X = jnp.concatenate([a0, a1, a2, sums_a], axis=0)  # (6K, ..., 2, 24)
+    Y = jnp.concatenate([b0, b1, b2, sums_b], axis=0)
+    T = fp2_mul_many(X, Y)
+    k = A.shape[0]
+    t0, t1, t2 = T[:k], T[k : 2 * k], T[2 * k : 3 * k]
+    t12, t01, t02 = T[3 * k : 4 * k], T[4 * k : 5 * k], T[5 * k :]
+    # c0 = t0 + ξ(t12 - t1 - t2); c1 = (t01 - t0 - t1) + ξ t2; c2 = (t02 - t0 - t2) + t1
+    d = L.sub_mod(
+        jnp.concatenate([t12, t01, t02], axis=0),
+        L.add_mod(
+            jnp.concatenate([t1, t0, t0], axis=0),
+            jnp.concatenate([t2, t1, t2], axis=0),
+        ),
+    )
+    d0, d1, d2 = d[:k], d[k : 2 * k], d[2 * k :]
+    xis = fp2_mul_by_xi(jnp.concatenate([d0, t2], axis=0))
+    xi_d0, xi_t2 = xis[:k], xis[k:]
+    c = L.add_mod(
+        jnp.concatenate([t0, d1, d2], axis=0),
+        jnp.concatenate([xi_d0, xi_t2, t1], axis=0),
+    )
+    return jnp.stack([c[:k], c[k : 2 * k], c[2 * k :]], axis=-3)
+
+
+def fp6_mul(a, b):
+    a, b = jnp.broadcast_arrays(a, b)
+    return fp6_mul_many(a[None], b[None])[0]
+
+
+def fp6_sq(a):
+    return fp6_mul(a, a)
+
+
+def fp6_mul_by_v(a):
+    return jnp.stack(
+        [fp2_mul_by_xi(a[..., 2, :, :]), a[..., 0, :, :], a[..., 1, :, :]], axis=-3
+    )
+
+
+def fp6_scale2(a, k):
+    """Multiply Fp6 by an Fp2 scalar."""
+    kk = jnp.broadcast_to(k, a[..., 0, :, :].shape)
+    stacked = fp2_mul_many(
+        jnp.stack([a[..., i, :, :] for i in range(3)]), jnp.stack([kk] * 3)
+    )
+    return jnp.stack([stacked[0], stacked[1], stacked[2]], axis=-3)
+
+
+def fp6_inv(a):
+    a0, a1, a2 = a[..., 0, :, :], a[..., 1, :, :], a[..., 2, :, :]
+    sqs = fp2_sq_many(jnp.stack([a0, a2, a1]))
+    prods = fp2_mul_many(jnp.stack([a1, a0, a0]), jnp.stack([a2, a1, a2]))
+    A = fp2_sub(sqs[0], fp2_mul_by_xi(prods[0]))
+    B = fp2_sub(fp2_mul_by_xi(sqs[1]), prods[1])
+    C = fp2_sub(sqs[2], prods[2])
+    inner = fp2_mul_many(jnp.stack([a0, a2, a1]), jnp.stack([A, B, C]))
+    F = fp2_add(inner[0], fp2_mul_by_xi(fp2_add(inner[1], inner[2])))
+    f_inv = fp2_inv(F)
+    outs = fp2_mul_many(jnp.stack([A, B, C]), jnp.stack([f_inv] * 3))
+    return jnp.stack([outs[0], outs[1], outs[2]], axis=-3)
+
+
+def fp6_zero(shape=()):
+    return jnp.zeros(shape + (3, 2, NL), jnp.uint32)
+
+
+def fp6_one(shape=()):
+    z = np.zeros((3, 2, NL), dtype=np.uint32)
+    z[0, 0] = L.ONE_MONT
+    return jnp.broadcast_to(jnp.asarray(z), shape + (3, 2, NL)).astype(jnp.uint32)
+
+
+# --- Fp12 ------------------------------------------------------------------
+
+
+def fp12_mul_many(A, B):
+    """K independent Fp12 products: (K, ..., 2, 3, 2, 24); all 54K limb
+    products in one montmul call (Karatsuba over Fp6)."""
+    a0, a1 = A[..., 0, :, :, :], A[..., 1, :, :, :]
+    b0, b1 = B[..., 0, :, :, :], B[..., 1, :, :, :]
+    sa = L.add_mod(a0, a1)
+    sb = L.add_mod(b0, b1)
+    T = fp6_mul_many(
+        jnp.concatenate([a0, a1, sa], axis=0), jnp.concatenate([b0, b1, sb], axis=0)
+    )
+    k = A.shape[0]
+    t0, t1, t2 = T[:k], T[k : 2 * k], T[2 * k :]
+    c0 = L.add_mod(t0, fp6_mul_by_v(t1))
+    c1 = L.sub_mod(t2, L.add_mod(t0, t1))
+    return jnp.stack([c0, c1], axis=-4)
+
+
+def fp12_mul(a, b):
+    a, b = jnp.broadcast_arrays(a, b)
+    return fp12_mul_many(a[None], b[None])[0]
+
+
+def fp12_sq(a):
+    return fp12_mul(a, a)
+
+
+def fp12_conj(a):
+    return jnp.stack([a[..., 0, :, :, :], fp6_neg(a[..., 1, :, :, :])], axis=-4)
+
+
+def fp12_inv(a):
+    a0, a1 = a[..., 0, :, :, :], a[..., 1, :, :, :]
+    sqs = fp6_mul_many(jnp.stack([a0, a1]), jnp.stack([a0, a1]))
+    denom = fp6_inv(fp6_sub(sqs[0], fp6_mul_by_v(sqs[1])))
+    outs = fp6_mul_many(jnp.stack([a0, fp6_neg(a1)]), jnp.stack([denom] * 2))
+    return jnp.stack([outs[0], outs[1]], axis=-4)
+
+
+def fp12_zero(shape=()):
+    return jnp.zeros(shape + (2, 3, 2, NL), jnp.uint32)
+
+
+def fp12_one(shape=()):
+    z = np.zeros((2, 3, 2, NL), dtype=np.uint32)
+    z[0, 0, 0] = L.ONE_MONT
+    return jnp.broadcast_to(jnp.asarray(z), shape + (2, 3, 2, NL)).astype(jnp.uint32)
+
+
+def fp12_select(cond, a, b):
+    return jnp.where(cond[..., None, None, None, None], a, b)
+
+
+def fp12_is_one(a):
+    return jnp.all(a == fp12_one(a.shape[:-4]), axis=(-1, -2, -3, -4))
+
+
+# --- Frobenius -------------------------------------------------------------
+
+_coeffs = frobenius_coefficients()
+
+
+def _fp2_const(pair) -> np.ndarray:
+    return np.stack([L.to_mont(pair[0]), L.to_mont(pair[1])])
+
+
+_G1_6 = jnp.asarray(_fp2_const(_coeffs["fq6_g1"]))
+_G2_6 = jnp.asarray(_fp2_const(_coeffs["fq6_g2"]))
+_GW_12 = jnp.asarray(_fp2_const(_coeffs["fq12_gw"]))
+
+
+def fp6_frobenius(a):
+    c0 = fp2_conj(a[..., 0, :, :])
+    rest = fp2_mul_many(
+        jnp.stack([fp2_conj(a[..., 1, :, :]), fp2_conj(a[..., 2, :, :])]),
+        jnp.stack([jnp.broadcast_to(_G1_6, a[..., 1, :, :].shape),
+                   jnp.broadcast_to(_G2_6, a[..., 2, :, :].shape)]),
+    )
+    return jnp.stack([c0, rest[0], rest[1]], axis=-3)
+
+
+def fp12_frobenius(a):
+    return jnp.stack(
+        [
+            fp6_frobenius(a[..., 0, :, :, :]),
+            fp6_scale2(fp6_frobenius(a[..., 1, :, :, :]), _GW_12),
+        ],
+        axis=-4,
+    )
+
+
+def fp12_frobenius_n(a, n: int):
+    for _ in range(n % 12):
+        a = fp12_frobenius(a)
+    return a
+
+
+# --- host conversion helpers ----------------------------------------------
+
+
+def fq2_to_dev(x) -> np.ndarray:
+    """Anchor Fq2 → Montgomery limb array (2, 24)."""
+    return np.stack([L.to_mont(x.c0.n), L.to_mont(x.c1.n)])
+
+
+def fq6_to_dev(x) -> np.ndarray:
+    return np.stack([fq2_to_dev(x.c0), fq2_to_dev(x.c1), fq2_to_dev(x.c2)])
+
+
+def fq12_to_dev(x) -> np.ndarray:
+    return np.stack([fq6_to_dev(x.c0), fq6_to_dev(x.c1)])
+
+
+def dev_to_fq2(a):
+    from grandine_tpu.crypto.fields import Fq2
+
+    a = np.asarray(a)
+    return Fq2.from_ints(L.from_mont(a[..., 0, :]), L.from_mont(a[..., 1, :]))
+
+
+def dev_to_fq6(a):
+    from grandine_tpu.crypto.fields import Fq6
+
+    return Fq6(*[dev_to_fq2(np.asarray(a)[..., i, :, :]) for i in range(3)])
+
+
+def dev_to_fq12(a):
+    from grandine_tpu.crypto.fields import Fq12
+
+    return Fq12(*[dev_to_fq6(np.asarray(a)[..., i, :, :, :]) for i in range(2)])

@@ -1,0 +1,220 @@
+"""Limb-decomposed Montgomery arithmetic for Fp (BLS12-381 base field) on TPU.
+
+Representation: little-endian 24 × 16-bit limbs in uint32, shape (..., 24),
+canonical (each limb < 2¹⁶, integer value < p), Montgomery form (value·R mod p,
+R = 2³⁸⁴) except where noted.
+
+Why 16-bit limbs in uint32: limb products (< 2³²) fit a uint32 exactly, and
+CIOS column accumulators stay < 2²⁴ ≪ 2³², so multiplication needs no wide
+accumulator — a direct fit for 32-bit integer vector lanes.
+
+Compilation model: every sequential dependency (CIOS iterations, carry and
+borrow ripples, square-and-multiply) is a `lax.scan`, so one field op costs
+O(1) HLO nodes regardless of limb count, and composite ops (Fp2/Fp6/Fp12 in
+field.py) stack their independent multiplications into a single wide montmul
+call. This keeps the traced Miller-loop graph small enough to compile while
+leaving the batch axis fully vectorized.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from grandine_tpu.crypto.constants import P
+
+LIMB_BITS = 16
+NLIMBS = 24
+MASK = (1 << LIMB_BITS) - 1
+R_MONT = 1 << (LIMB_BITS * NLIMBS)  # 2^384
+R_INV = pow(R_MONT, -1, P)
+R2 = R_MONT * R_MONT % P
+N0_INV = (-pow(P, -1, 1 << LIMB_BITS)) % (1 << LIMB_BITS)
+
+
+# --- host-side conversions -------------------------------------------------
+
+
+def int_to_limbs(v: int) -> np.ndarray:
+    """Plain (non-Montgomery) limb decomposition."""
+    assert 0 <= v < (1 << (LIMB_BITS * NLIMBS))
+    return np.array(
+        [(v >> (LIMB_BITS * i)) & MASK for i in range(NLIMBS)], dtype=np.uint32
+    )
+
+
+def limbs_to_int(a) -> int:
+    a = np.asarray(a)
+    return sum(int(a[..., i]) << (LIMB_BITS * i) for i in range(NLIMBS))
+
+
+def to_mont(v: int) -> np.ndarray:
+    """Host conversion into Montgomery-form limbs."""
+    return int_to_limbs(v * R_MONT % P)
+
+
+def from_mont(a) -> int:
+    """Host conversion out of Montgomery-form limbs."""
+    return limbs_to_int(a) * R_INV % P
+
+
+P_LIMBS = int_to_limbs(P)
+ZERO = np.zeros(NLIMBS, dtype=np.uint32)
+ONE_MONT = to_mont(1)
+
+
+# --- device primitives -----------------------------------------------------
+#
+# Scan axis convention: limb axis is moved to the front for lax.scan, batch
+# dims stay behind it.
+
+
+def _scan_limbs(f, init, t: jnp.ndarray):
+    """Scan f over the last (limb) axis of t; returns stacked outputs with
+    the limb axis back in last position."""
+    xs = jnp.moveaxis(t, -1, 0)
+    _, ys = lax.scan(f, init, xs)
+    return jnp.moveaxis(ys, 0, -1)
+
+
+def carry_propagate(t: jnp.ndarray) -> jnp.ndarray:
+    """Normalize accumulator columns to canonical 16-bit limbs (the final
+    carry out of the top limb must be zero — guaranteed by callers' bounds)."""
+
+    def step(c, v):
+        s = v + c
+        return s >> LIMB_BITS, s & MASK
+
+    zero_c = jnp.zeros(t.shape[:-1], jnp.uint32)
+    return _scan_limbs(step, zero_c, t)
+
+
+def _sub_limbs(a: jnp.ndarray, b: jnp.ndarray):
+    """(a - b) limbwise with borrow ripple; returns (diff, underflow_flag).
+    Inputs canonical; same trailing width."""
+
+    def step(borrow, ab):
+        av, bv = ab
+        d = av + np.uint32(MASK + 1) - bv - borrow
+        return jnp.uint32(1) - (d >> LIMB_BITS), d & MASK
+
+    xs = (jnp.moveaxis(a, -1, 0), jnp.moveaxis(b, -1, 0))
+    zero_b = jnp.zeros(a.shape[:-1], jnp.uint32)
+    borrow, ys = lax.scan(lambda c, x: step(c, x), zero_b, xs)
+    return jnp.moveaxis(ys, 0, -1), borrow.astype(bool)
+
+
+def _cond_sub_p(t: jnp.ndarray) -> jnp.ndarray:
+    """Given canonical limbs of a value < 2p (width NLIMBS or NLIMBS+1),
+    subtract p iff value ≥ p. Returns NLIMBS limbs."""
+    n = t.shape[-1]
+    p_ext = np.zeros(n, dtype=np.uint32)
+    p_ext[:NLIMBS] = P_LIMBS
+    p_arr = jnp.broadcast_to(jnp.asarray(p_ext), t.shape)
+    diff, under = _sub_limbs(t, p_arr)
+    out = jnp.where(under[..., None], t, diff)
+    return out[..., :NLIMBS]
+
+
+def add_mod(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    s = a + b  # limbwise, < 2^17
+    s = jnp.concatenate(
+        [s, jnp.zeros(jnp.broadcast_shapes(a.shape, b.shape)[:-1] + (1,), jnp.uint32)],
+        axis=-1,
+    )
+    return _cond_sub_p(carry_propagate(s))
+
+
+def sub_mod(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    # (a + p) - b, then conditional subtract p. a+p < 2^17 per limb.
+    s = a + P_LIMBS
+    s = jnp.concatenate(
+        [s, jnp.zeros(jnp.broadcast_shapes(a.shape, b.shape)[:-1] + (1,), jnp.uint32)],
+        axis=-1,
+    )
+    s = carry_propagate(s)
+    b_ext = jnp.concatenate(
+        [jnp.broadcast_to(b, s.shape[:-1] + (NLIMBS,)),
+         jnp.zeros(s.shape[:-1] + (1,), jnp.uint32)],
+        axis=-1,
+    )
+    diff, _ = _sub_limbs(s, b_ext)
+    return _cond_sub_p(diff)
+
+
+def neg_mod(a: jnp.ndarray) -> jnp.ndarray:
+    """-a mod p (maps 0 to 0)."""
+    p_arr = jnp.broadcast_to(jnp.asarray(P_LIMBS), a.shape)
+    diff, _ = _sub_limbs(p_arr, a)
+    is_zero_a = jnp.all(a == 0, axis=-1, keepdims=True)
+    return jnp.where(is_zero_a, jnp.zeros_like(a), diff)
+
+
+def montmul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Montgomery product a·b·R⁻¹ mod p (CIOS, lazy column carries, as a
+    scan over the 24 operand limbs).
+
+    Bound sketch: a column accumulates ≤ 4 halves (< 2¹⁶ each) per iteration
+    plus a shifted-in carry, over ≤ 24 live iterations ⇒ < 2²³ ≪ 2³².
+    """
+    p_limbs = jnp.asarray(P_LIMBS)
+    batch = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
+    b = jnp.broadcast_to(b, batch + (NLIMBS,))
+    a = jnp.broadcast_to(a, batch + (NLIMBS,))
+    t0 = jnp.zeros(batch + (NLIMBS + 2,), jnp.uint32)
+    zpad2 = jnp.zeros(batch + (2,), jnp.uint32)
+    zpad1 = jnp.zeros(batch + (1,), jnp.uint32)
+
+    def step(t, ai):
+        prod = ai[..., None] * b  # (..., 24) < 2^32 exact in uint32
+        t = t + jnp.concatenate([prod & MASK, zpad2], axis=-1)
+        t = t + jnp.concatenate([zpad1, prod >> LIMB_BITS, zpad1], axis=-1)
+        m = (t[..., 0] * N0_INV) & MASK
+        prod2 = m[..., None] * p_limbs
+        t = t + jnp.concatenate([prod2 & MASK, zpad2], axis=-1)
+        t = t + jnp.concatenate([zpad1, prod2 >> LIMB_BITS, zpad1], axis=-1)
+        # low limb ≡ 0 mod 2^16: shift down one limb, pushing its carry up
+        carry = t[..., 0] >> LIMB_BITS
+        t = jnp.concatenate([t[..., 1:], zpad1], axis=-1)
+        t = t + jnp.concatenate([carry[..., None], jnp.zeros_like(t[..., 1:])], axis=-1)
+        return t, None
+
+    t, _ = lax.scan(step, t0, jnp.moveaxis(a, -1, 0))
+    return _cond_sub_p(carry_propagate(t))
+
+
+def montsq(a: jnp.ndarray) -> jnp.ndarray:
+    return montmul(a, a)
+
+
+def pow_fixed(a: jnp.ndarray, exponent: int) -> jnp.ndarray:
+    """a^e for a host-known exponent, via lax.scan over its bits (LSB-first
+    square-and-multiply with branchless select)."""
+    nbits = max(exponent.bit_length(), 1)
+    bits = np.array([(exponent >> i) & 1 for i in range(nbits)], dtype=np.uint32)
+    one = jnp.broadcast_to(jnp.asarray(ONE_MONT), a.shape).astype(jnp.uint32)
+
+    def step(carry, bit):
+        result, base = carry
+        taken = montmul(result, base)
+        result = jnp.where(bit.astype(bool), taken, result)
+        base = montsq(base)
+        return (result, base), None
+
+    (result, _), _ = lax.scan(step, (one, a), jnp.asarray(bits))
+    return result
+
+
+def inv_mod(a: jnp.ndarray) -> jnp.ndarray:
+    """a⁻¹ (Montgomery form in, Montgomery form out) via Fermat."""
+    return pow_fixed(a, P - 2)
+
+
+def is_zero(a: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(a == 0, axis=-1)
+
+
+def select(cond: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """cond ? a : b, with cond shaped like the element's batch prefix."""
+    return jnp.where(cond[..., None], a, b)
