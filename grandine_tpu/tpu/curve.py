@@ -43,7 +43,7 @@ class FieldOps:
 
 
 def _fp_one_like(a):
-    return jnp.broadcast_to(jnp.asarray(L.ONE_MONT), a.shape).astype(jnp.uint32)
+    return jnp.broadcast_to(jnp.asarray(L.ONE_MONT), a.shape).astype(jnp.int32)
 
 
 def _fp2_one_like(a):
@@ -56,7 +56,7 @@ FP_OPS = FieldOps(
     sub=L.sub_mod,
     neg=L.neg_mod,
     select=L.select,
-    is_zero=L.is_zero,
+    is_zero=L.is_zero_val,
     zeros_like=jnp.zeros_like,
     one_like=_fp_one_like,
 )
@@ -181,20 +181,21 @@ def scalar_mul(qx, qy, q_inf, bits_msb: jnp.ndarray, ops: FieldOps):
     (see module docstring for why mixed adds suffice)."""
     one = ops.one_like(qx)
     zero = ops.zeros_like(qx)
-    init = (one, one, zero)  # infinity
+    started0 = jnp.zeros(bits_msb.shape[:-1], bool)
+    init = ((one, one, zero), started0)  # infinity, nothing accumulated yet
 
-    def step(st, bit):
+    def step(carry, bit):
+        st, started = carry
         st = point_double(st, ops)
         added = point_madd_unsafe(st, qx, qy, ops)
-        was_inf = ops.is_zero(st[2])
         bitb = bit.astype(bool)
-        # select: infinity + Q = Q (affine embed); else madd; else keep
-        X = ops.select(bitb, ops.select(was_inf, qx, added[0]), st[0])
-        Y = ops.select(bitb, ops.select(was_inf, qy, added[1]), st[1])
-        Z = ops.select(bitb, ops.select(was_inf, one, added[2]), st[2])
-        return (X, Y, Z), None
+        # first set bit embeds Q (∞ + Q = Q); later ones use the mixed add
+        X = ops.select(bitb, ops.select(started, added[0], qx), st[0])
+        Y = ops.select(bitb, ops.select(started, added[1], qy), st[1])
+        Z = ops.select(bitb, ops.select(started, added[2], one), st[2])
+        return ((X, Y, Z), jnp.logical_or(started, bitb)), None
 
-    st, _ = lax.scan(step, init, jnp.moveaxis(bits_msb, -1, 0))
+    (st, _), _ = lax.scan(step, init, jnp.moveaxis(bits_msb, -1, 0))
     # [k]∞ = ∞
     X = ops.select(q_inf, one, st[0])
     Y = ops.select(q_inf, one, st[1])
@@ -220,7 +221,7 @@ def sum_points(p, ops: FieldOps):
 
 def scalars_to_bits_msb(scalars, nbits: int) -> np.ndarray:
     """Host helper: int scalars → (len, nbits) uint32 MSB-first bit array."""
-    out = np.zeros((len(scalars), nbits), dtype=np.uint32)
+    out = np.zeros((len(scalars), nbits), dtype=np.int32)
     for i, s in enumerate(scalars):
         assert 0 <= s < (1 << nbits)
         for j in range(nbits):
@@ -242,7 +243,7 @@ def g1_point_to_dev(pt) -> "tuple[np.ndarray, np.ndarray, np.ndarray]":
 def g2_point_to_dev(pt):
     aff = pt.to_affine()
     if aff is None:
-        z = np.zeros((2, L.NLIMBS), np.uint32)
+        z = np.zeros((2, L.NLIMBS), np.int32)
         return z, z.copy(), np.array(True)
     return F.fq2_to_dev(aff[0]), F.fq2_to_dev(aff[1]), np.array(False)
 

@@ -106,7 +106,10 @@ def fp2_inv(a):
 
 
 def fp2_is_zero(a):
-    return jnp.all(a == 0, axis=(-1, -2))
+    """Value-level zero test (digits are redundant; |value| < 4p required)."""
+    return jnp.logical_and(
+        L.is_zero_val(a[..., 0, :]), L.is_zero_val(a[..., 1, :])
+    )
 
 
 def fp2_select(cond, a, b):
@@ -114,12 +117,12 @@ def fp2_select(cond, a, b):
 
 
 def fp2_zero(shape=()):
-    return jnp.zeros(shape + (2, NL), jnp.uint32)
+    return jnp.zeros(shape + (2, NL), jnp.int32)
 
 
 def fp2_one(shape=()):
     one = jnp.asarray(np.stack([L.ONE_MONT, L.ZERO]))
-    return jnp.broadcast_to(one, shape + (2, NL)).astype(jnp.uint32)
+    return jnp.broadcast_to(one, shape + (2, NL)).astype(jnp.int32)
 
 
 # --- Fp6 -------------------------------------------------------------------
@@ -212,13 +215,13 @@ def fp6_inv(a):
 
 
 def fp6_zero(shape=()):
-    return jnp.zeros(shape + (3, 2, NL), jnp.uint32)
+    return jnp.zeros(shape + (3, 2, NL), jnp.int32)
 
 
 def fp6_one(shape=()):
     z = np.zeros((3, 2, NL), dtype=np.uint32)
     z[0, 0] = L.ONE_MONT
-    return jnp.broadcast_to(jnp.asarray(z), shape + (3, 2, NL)).astype(jnp.uint32)
+    return jnp.broadcast_to(jnp.asarray(z), shape + (3, 2, NL)).astype(jnp.int32)
 
 
 # --- Fp12 ------------------------------------------------------------------
@@ -263,13 +266,13 @@ def fp12_inv(a):
 
 
 def fp12_zero(shape=()):
-    return jnp.zeros(shape + (2, 3, 2, NL), jnp.uint32)
+    return jnp.zeros(shape + (2, 3, 2, NL), jnp.int32)
 
 
 def fp12_one(shape=()):
     z = np.zeros((2, 3, 2, NL), dtype=np.uint32)
     z[0, 0, 0] = L.ONE_MONT
-    return jnp.broadcast_to(jnp.asarray(z), shape + (2, 3, 2, NL)).astype(jnp.uint32)
+    return jnp.broadcast_to(jnp.asarray(z), shape + (2, 3, 2, NL)).astype(jnp.int32)
 
 
 def fp12_select(cond, a, b):
@@ -277,7 +280,12 @@ def fp12_select(cond, a, b):
 
 
 def fp12_is_one(a):
-    return jnp.all(a == fp12_one(a.shape[:-4]), axis=(-1, -2, -3, -4))
+    """Value-level equality with 1 (shared canonicalization ripple over the
+    twelve Fp components)."""
+    flat = a.reshape(a.shape[:-4] + (12, L.NLIMBS))
+    one = fp12_one().reshape(12, L.NLIMBS)
+    comp_zero = L.is_zero_val(flat - one)
+    return jnp.all(comp_zero, axis=-1)
 
 
 # --- Frobenius -------------------------------------------------------------

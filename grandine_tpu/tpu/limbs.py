@@ -1,19 +1,24 @@
 """Limb-decomposed Montgomery arithmetic for Fp (BLS12-381 base field) on TPU.
 
-Representation: little-endian 24 × 16-bit limbs in uint32, shape (..., 24),
-canonical (each limb < 2¹⁶, integer value < p), Montgomery form (value·R mod p,
-R = 2³⁸⁴) except where noted.
+Representation ("relaxed signed digits"): little-endian 26 × 15-bit digits in
+int32, shape (..., 26), Montgomery form (value·R mod p, R = 2³⁹⁰). Digits are
+redundant and signed: |digit| ≤ LMAX = 2¹⁵ + 256; values are only canonical
+modulo p at explicit canonicalization points (equality tests, host export).
 
-Why 16-bit limbs in uint32: limb products (< 2³²) fit a uint32 exactly, and
-CIOS column accumulators stay < 2²⁴ ≪ 2³², so multiplication needs no wide
-accumulator — a direct fit for 32-bit integer vector lanes.
+Why this shape:
+  - products of two digits: ≤ LMAX² < 2³¹ — exact in int32;
+  - CIOS column accumulators stay |·| < 2²² — no wide accumulator needed;
+  - add/sub/neg are a plain limbwise op plus ONE flat carry-relaxation round
+    (arithmetic shift + mask): no borrow ripples, no scans, no conditional
+    subtracts. Signed digits are what make subtraction free.
+  - value bounds are tracked statically: every intermediate stays |v| < 20p,
+    montgomery products then stay < 2p (see montmul docstring), which keeps
+    the dropped top carry of the relaxation round provably zero.
 
-Compilation model: every sequential dependency (CIOS iterations, carry and
-borrow ripples, square-and-multiply) is a `lax.scan`, so one field op costs
-O(1) HLO nodes regardless of limb count, and composite ops (Fp2/Fp6/Fp12 in
-field.py) stack their independent multiplications into a single wide montmul
-call. This keeps the traced Miller-loop graph small enough to compile while
-leaving the batch axis fully vectorized.
+The only sequential structures left are the 26-step CIOS scan inside montmul,
+the bit scans of fixed-exponent powering, and the canonicalization ripple
+used by equality tests. Everything else is flat vector code — the shape XLA
+compiles and fuses well.
 """
 
 from __future__ import annotations
@@ -24,23 +29,26 @@ from jax import lax
 
 from grandine_tpu.crypto.constants import P
 
-LIMB_BITS = 16
-NLIMBS = 24
+LIMB_BITS = 15
+NLIMBS = 26
 MASK = (1 << LIMB_BITS) - 1
-R_MONT = 1 << (LIMB_BITS * NLIMBS)  # 2^384
+LMAX = (1 << LIMB_BITS) + 256  # relaxed digit bound (see module docstring)
+R_MONT = 1 << (LIMB_BITS * NLIMBS)  # 2^390
 R_INV = pow(R_MONT, -1, P)
 R2 = R_MONT * R_MONT % P
 N0_INV = (-pow(P, -1, 1 << LIMB_BITS)) % (1 << LIMB_BITS)
+
+_DT = jnp.int32
 
 
 # --- host-side conversions -------------------------------------------------
 
 
 def int_to_limbs(v: int) -> np.ndarray:
-    """Plain (non-Montgomery) limb decomposition."""
-    assert 0 <= v < (1 << (LIMB_BITS * NLIMBS))
+    """Canonical (non-Montgomery) digit decomposition."""
+    assert 0 <= v < R_MONT
     return np.array(
-        [(v >> (LIMB_BITS * i)) & MASK for i in range(NLIMBS)], dtype=np.uint32
+        [(v >> (LIMB_BITS * i)) & MASK for i in range(NLIMBS)], dtype=np.int32
     )
 
 
@@ -50,138 +58,88 @@ def limbs_to_int(a) -> int:
 
 
 def to_mont(v: int) -> np.ndarray:
-    """Host conversion into Montgomery-form limbs."""
     return int_to_limbs(v * R_MONT % P)
 
 
 def from_mont(a) -> int:
-    """Host conversion out of Montgomery-form limbs."""
+    """Host conversion out of Montgomery form (handles redundant/signed
+    digits and any value range via exact integer arithmetic)."""
     return limbs_to_int(a) * R_INV % P
 
 
 P_LIMBS = int_to_limbs(P)
-ZERO = np.zeros(NLIMBS, dtype=np.uint32)
+ZERO = np.zeros(NLIMBS, dtype=np.int32)
 ONE_MONT = to_mont(1)
+# R mod p as digits — folds the 27th result column of montmul back in.
+R_MOD_P = int_to_limbs(R_MONT % P)
+EIGHT_P = int_to_limbs(8 * P)
+# canonical digit patterns of k·p, k = 0..15 (for is_zero after a +8p offset)
+_KP_PATTERNS = np.stack([int_to_limbs(k * P) for k in range(16)])  # (16, 26)
 
 
-# --- device primitives -----------------------------------------------------
-#
-# Scan axis convention: limb axis is moved to the front for lax.scan, batch
-# dims stay behind it.
+# --- flat primitives -------------------------------------------------------
 
 
-def _scan_limbs(f, init, t: jnp.ndarray):
-    """Scan f over the last (limb) axis of t; returns stacked outputs with
-    the limb axis back in last position."""
-    xs = jnp.moveaxis(t, -1, 0)
-    _, ys = lax.scan(f, init, xs)
-    return jnp.moveaxis(ys, 0, -1)
-
-
-def carry_propagate(t: jnp.ndarray) -> jnp.ndarray:
-    """Normalize accumulator columns to canonical 16-bit limbs (the final
-    carry out of the top limb must be zero — guaranteed by callers' bounds)."""
-
-    def step(c, v):
-        s = v + c
-        return s >> LIMB_BITS, s & MASK
-
-    zero_c = jnp.zeros(t.shape[:-1], jnp.uint32)
-    return _scan_limbs(step, zero_c, t)
-
-
-def _sub_limbs(a: jnp.ndarray, b: jnp.ndarray):
-    """(a - b) limbwise with borrow ripple; returns (diff, underflow_flag).
-    Inputs canonical; same trailing width."""
-
-    def step(borrow, ab):
-        av, bv = ab
-        d = av + np.uint32(MASK + 1) - bv - borrow
-        return jnp.uint32(1) - (d >> LIMB_BITS), d & MASK
-
-    xs = (jnp.moveaxis(a, -1, 0), jnp.moveaxis(b, -1, 0))
-    zero_b = jnp.zeros(a.shape[:-1], jnp.uint32)
-    borrow, ys = lax.scan(lambda c, x: step(c, x), zero_b, xs)
-    return jnp.moveaxis(ys, 0, -1), borrow.astype(bool)
-
-
-def _cond_sub_p(t: jnp.ndarray) -> jnp.ndarray:
-    """Given canonical limbs of a value < 2p (width NLIMBS or NLIMBS+1),
-    subtract p iff value ≥ p. Returns NLIMBS limbs."""
-    n = t.shape[-1]
-    p_ext = np.zeros(n, dtype=np.uint32)
-    p_ext[:NLIMBS] = P_LIMBS
-    p_arr = jnp.broadcast_to(jnp.asarray(p_ext), t.shape)
-    diff, under = _sub_limbs(t, p_arr)
-    out = jnp.where(under[..., None], t, diff)
-    return out[..., :NLIMBS]
+def relax(s: jnp.ndarray) -> jnp.ndarray:
+    """One carry-relaxation round, exactly value-preserving: digits 0..24 go
+    to [0,2¹⁵) + a signed carry into the next digit; the TOP digit is left
+    unsplit (signed). Under the |value| < 20p invariant the top digit stays
+    |·| ≲ 2¹¹ (value/2³⁷⁵ plus ≤ 2 of lower-digit compensation), so products
+    involving it remain far below int32 overflow. No modular wrap ever
+    happens here — values are preserved as integers."""
+    hi = s >> LIMB_BITS  # arithmetic shift (floor division)
+    lo = s & MASK
+    low = lo[..., : NLIMBS - 1] + jnp.concatenate(
+        [jnp.zeros(s.shape[:-1] + (1,), _DT), hi[..., : NLIMBS - 2]], axis=-1
+    )
+    top = s[..., NLIMBS - 1 :] + hi[..., NLIMBS - 2 : NLIMBS - 1]
+    return jnp.concatenate([low, top], axis=-1)
 
 
 def add_mod(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    s = a + b  # limbwise, < 2^17
-    s = jnp.concatenate(
-        [s, jnp.zeros(jnp.broadcast_shapes(a.shape, b.shape)[:-1] + (1,), jnp.uint32)],
-        axis=-1,
-    )
-    return _cond_sub_p(carry_propagate(s))
+    return relax(a + b)
 
 
 def sub_mod(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    # (a + p) - b, then conditional subtract p. a+p < 2^17 per limb.
-    s = a + P_LIMBS
-    s = jnp.concatenate(
-        [s, jnp.zeros(jnp.broadcast_shapes(a.shape, b.shape)[:-1] + (1,), jnp.uint32)],
-        axis=-1,
-    )
-    s = carry_propagate(s)
-    b_ext = jnp.concatenate(
-        [jnp.broadcast_to(b, s.shape[:-1] + (NLIMBS,)),
-         jnp.zeros(s.shape[:-1] + (1,), jnp.uint32)],
-        axis=-1,
-    )
-    diff, _ = _sub_limbs(s, b_ext)
-    return _cond_sub_p(diff)
+    return relax(a - b)
 
 
 def neg_mod(a: jnp.ndarray) -> jnp.ndarray:
-    """-a mod p (maps 0 to 0)."""
-    p_arr = jnp.broadcast_to(jnp.asarray(P_LIMBS), a.shape)
-    diff, _ = _sub_limbs(p_arr, a)
-    is_zero_a = jnp.all(a == 0, axis=-1, keepdims=True)
-    return jnp.where(is_zero_a, jnp.zeros_like(a), diff)
+    return relax(-a)
 
 
 def montmul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """Montgomery product a·b·R⁻¹ mod p (CIOS, lazy column carries, as a
-    scan over the 24 operand limbs).
+    """Montgomery product a·b·R⁻¹ mod p (CIOS over signed digits).
 
-    Bound sketch: a column accumulates ≤ 4 halves (< 2¹⁶ each) per iteration
-    plus a shifted-in carry, over ≤ 24 live iterations ⇒ < 2²³ ≪ 2³².
+    Value bound: for |a|,|b| < 20p, |a·b| < 400p² ≲ R·p, so the reduced value
+    lies in (-0.1p, 2p) and the relaxed output digits are ≤ LMAX. Inputs are
+    digit-bounded by LMAX (products < 2³¹) and value-bounded by callers.
     """
     p_limbs = jnp.asarray(P_LIMBS)
     batch = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
-    b = jnp.broadcast_to(b, batch + (NLIMBS,))
-    a = jnp.broadcast_to(a, batch + (NLIMBS,))
-    t0 = jnp.zeros(batch + (NLIMBS + 2,), jnp.uint32)
-    zpad2 = jnp.zeros(batch + (2,), jnp.uint32)
-    zpad1 = jnp.zeros(batch + (1,), jnp.uint32)
+    b = jnp.broadcast_to(b, batch + (NLIMBS,)).astype(_DT)
+    a = jnp.broadcast_to(a, batch + (NLIMBS,)).astype(_DT)
+    t0 = jnp.zeros(batch + (NLIMBS + 1,), _DT)
+    zpad1 = jnp.zeros(batch + (1,), _DT)
+    zpadN = jnp.zeros(batch + (NLIMBS - 1,), _DT)
 
     def step(t, ai):
-        prod = ai[..., None] * b  # (..., 24) < 2^32 exact in uint32
-        t = t + jnp.concatenate([prod & MASK, zpad2], axis=-1)
-        t = t + jnp.concatenate([zpad1, prod >> LIMB_BITS, zpad1], axis=-1)
+        prod = ai[..., None] * b  # |·| < 2^31 exact
+        t = t + jnp.concatenate([prod & MASK, zpad1], axis=-1)
+        t = t + jnp.concatenate([zpad1, prod >> LIMB_BITS], axis=-1)
         m = (t[..., 0] * N0_INV) & MASK
         prod2 = m[..., None] * p_limbs
-        t = t + jnp.concatenate([prod2 & MASK, zpad2], axis=-1)
-        t = t + jnp.concatenate([zpad1, prod2 >> LIMB_BITS, zpad1], axis=-1)
-        # low limb ≡ 0 mod 2^16: shift down one limb, pushing its carry up
-        carry = t[..., 0] >> LIMB_BITS
+        t = t + jnp.concatenate([prod2 & MASK, zpad1], axis=-1)
+        t = t + jnp.concatenate([zpad1, prod2 >> LIMB_BITS], axis=-1)
+        carry = t[..., 0] >> LIMB_BITS  # exact: t[...,0] ≡ 0 mod 2^15
         t = jnp.concatenate([t[..., 1:], zpad1], axis=-1)
-        t = t + jnp.concatenate([carry[..., None], jnp.zeros_like(t[..., 1:])], axis=-1)
+        t = t + jnp.concatenate([carry[..., None], zpadN, zpad1], axis=-1)
         return t, None
 
     t, _ = lax.scan(step, t0, jnp.moveaxis(a, -1, 0))
-    return _cond_sub_p(carry_propagate(t))
+    # fold the 27th column (weight 2^390 = R) back in via R mod p
+    main = t[..., :NLIMBS] + t[..., NLIMBS : NLIMBS + 1] * jnp.asarray(R_MOD_P)
+    return relax(main)
 
 
 def montsq(a: jnp.ndarray) -> jnp.ndarray:
@@ -189,11 +147,10 @@ def montsq(a: jnp.ndarray) -> jnp.ndarray:
 
 
 def pow_fixed(a: jnp.ndarray, exponent: int) -> jnp.ndarray:
-    """a^e for a host-known exponent, via lax.scan over its bits (LSB-first
-    square-and-multiply with branchless select)."""
+    """a^e for a host-known exponent (LSB-first square-and-multiply scan)."""
     nbits = max(exponent.bit_length(), 1)
-    bits = np.array([(exponent >> i) & 1 for i in range(nbits)], dtype=np.uint32)
-    one = jnp.broadcast_to(jnp.asarray(ONE_MONT), a.shape).astype(jnp.uint32)
+    bits = np.array([(exponent >> i) & 1 for i in range(nbits)], dtype=np.int32)
+    one = jnp.broadcast_to(jnp.asarray(ONE_MONT), a.shape).astype(_DT)
 
     def step(carry, bit):
         result, base = carry
@@ -207,12 +164,39 @@ def pow_fixed(a: jnp.ndarray, exponent: int) -> jnp.ndarray:
 
 
 def inv_mod(a: jnp.ndarray) -> jnp.ndarray:
-    """a⁻¹ (Montgomery form in, Montgomery form out) via Fermat."""
+    """a⁻¹ via Fermat (Montgomery in/out). inv(0) = 0."""
     return pow_fixed(a, P - 2)
 
 
-def is_zero(a: jnp.ndarray) -> jnp.ndarray:
-    return jnp.all(a == 0, axis=-1)
+# --- canonicalization & predicates ----------------------------------------
+
+
+def canonical_digits(t: jnp.ndarray) -> jnp.ndarray:
+    """Full ripple to canonical digits in [0, 2¹⁵). Only correct for
+    non-negative values < 2³⁹⁰ — callers offset by +4p first."""
+
+    def step(c, v):
+        s = v + c
+        return s >> LIMB_BITS, s & MASK
+
+    xs = jnp.moveaxis(t, -1, 0)
+    _, ys = lax.scan(step, jnp.zeros(t.shape[:-1], _DT), xs)
+    return jnp.moveaxis(ys, 0, -1)
+
+
+def is_zero_val(a: jnp.ndarray) -> jnp.ndarray:
+    """value(a) ≡ 0 (mod p), for |value| < 8p (the widest bound any caller
+    reaches — mixed-add Z outputs are < 6p): canonicalize a+8p and compare
+    against the digit patterns of k·p, k = 0..15."""
+    canon = canonical_digits(a + jnp.asarray(EIGHT_P))
+    pats = jnp.asarray(_KP_PATTERNS)  # (16, 26)
+    eq = jnp.all(canon[..., None, :] == pats, axis=-1)  # (..., 16)
+    return jnp.any(eq, axis=-1)
+
+
+def is_one_mont(a: jnp.ndarray) -> jnp.ndarray:
+    """value(a) ≡ 1·R (mod p) — same bound discipline as is_zero_val."""
+    return is_zero_val(a - jnp.asarray(ONE_MONT))
 
 
 def select(cond: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:

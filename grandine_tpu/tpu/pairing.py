@@ -139,13 +139,14 @@ def _add_step(T, Q, g1c):
     return (X3, Y3, Z3), _line_to_fp12(l_a, l_b, l_c)
 
 
-def miller_loop(P_jac, Q_proj):
+def miller_loop(P_jac, Q_proj, inf_mask):
     """f_{|x|,Q}(P) conjugated (negative x), batched.
 
-    P_jac: G1 Jacobian (X, Y, Z) each (..., 24); infinity ⇒ Z = 0.
-    Q_proj: G2 homogeneous projective on the twist, (..., 2, 24) coords;
-            infinity ⇒ Z = 0.
-    Infinity in either slot yields f = 1.
+    P_jac: G1 Jacobian (X, Y, Z) each (..., 24).
+    Q_proj: G2 homogeneous projective on the twist, (..., 2, 24) coords.
+    inf_mask: bool (...,) — True where either input is the identity; those
+    slots yield f = 1 (neutral in the product). Passed explicitly by the
+    host (which knows the flags) so no value-level zero test is needed.
     """
     g1c = prepare_g1(P_jac)
     f0 = F.fp12_one(Q_proj[0].shape[:-2])
@@ -170,13 +171,12 @@ def miller_loop(P_jac, Q_proj):
     T, f = run_doubles(T, f, _TAIL_DOUBLES)
 
     f = F.fp12_conj(f)  # negative BLS parameter
-    inf = jnp.logical_or(L.is_zero(P_jac[2]), F.fp2_is_zero(Q_proj[2]))
-    return F.fp12_select(inf, F.fp12_one(f.shape[:-4]), f)
+    return F.fp12_select(inf_mask, F.fp12_one(f.shape[:-4]), f)
 
 
 _ABS_X_BITS_MSB = np.array(
     [(_ABS_X >> i) & 1 for i in range(_ABS_X.bit_length() - 1, -1, -1)],
-    dtype=np.uint32,
+    dtype=np.int32,
 )
 
 
@@ -210,10 +210,10 @@ def final_exponentiation(f):
     return mul(mul(mul(t4, F.fp12_frobenius_n(t3, 2)), conj(t3)), m3)
 
 
-def multi_pairing_check(P_jac, Q_proj):
+def multi_pairing_check(P_jac, Q_proj, inf_mask):
     """∏ e(Pᵢ, Qᵢ) == 1 over the batch (power-of-two length; pad with
     infinity pairs). One shared final exponentiation."""
-    f = miller_loop(P_jac, Q_proj)
+    f = miller_loop(P_jac, Q_proj, inf_mask)
     n = f.shape[0]
     assert n & (n - 1) == 0
     while n > 1:

@@ -1,0 +1,124 @@
+"""Differential tests: device limb/field arithmetic vs the pure-Python anchor.
+
+Runs on CPU (tests/conftest.py forces JAX_PLATFORMS=cpu with 8 virtual
+devices). Every op is compared against grandine_tpu/crypto/fields.py on
+random and worst-case inputs, including realistic op-chains that exercise
+the relaxed signed-digit representation's bound discipline.
+"""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from grandine_tpu.crypto.constants import P
+from grandine_tpu.crypto.fields import Fq, Fq2, Fq6, Fq12
+from grandine_tpu.tpu import field as F
+from grandine_tpu.tpu import limbs as L
+
+rng = random.Random(0xD1F)
+
+
+def rand_ints(n):
+    return [rng.randrange(P) for _ in range(n - 1)] + [P - 1]
+
+
+def rq2():
+    return Fq2.from_ints(rng.randrange(P), rng.randrange(P))
+
+
+def rq6():
+    return Fq6(rq2(), rq2(), rq2())
+
+
+def rq12():
+    return Fq12(rq6(), rq6())
+
+
+def test_limb_roundtrip_and_basic_ops():
+    xs, ys = rand_ints(4), rand_ints(4)
+    A = jnp.asarray(np.stack([L.to_mont(x) for x in xs]))
+    B = jnp.asarray(np.stack([L.to_mont(y) for y in ys]))
+    mm = jax.jit(L.montmul)(A, B)
+    for i in range(4):
+        assert L.from_mont(np.asarray(mm)[i]) == xs[i] * ys[i] % P
+        assert L.from_mont(np.asarray(L.add_mod(A, B))[i]) == (xs[i] + ys[i]) % P
+        assert L.from_mont(np.asarray(L.sub_mod(A, B))[i]) == (xs[i] - ys[i]) % P
+        assert L.from_mont(np.asarray(L.neg_mod(A))[i]) == (-xs[i]) % P
+
+
+def test_limb_inverse():
+    xs = rand_ints(3)
+    A = jnp.asarray(np.stack([L.to_mont(x) for x in xs]))
+    inv = jax.jit(L.inv_mod)(A)
+    for i, x in enumerate(xs):
+        assert L.from_mont(np.asarray(inv)[i]) == pow(x, P - 2, P)
+
+
+def test_realistic_op_chain_stays_exact():
+    # alternating adds and a reducing multiplication — the op pattern of the
+    # curve/pairing formulas (at most a few adds between montmuls)
+    x0, x1 = rng.randrange(P), rng.randrange(P)
+    acc = jnp.asarray(L.to_mont(x0))
+    b = jnp.asarray(L.to_mont(x1))
+    ref = x0
+    for _ in range(20):
+        acc = L.montmul(L.add_mod(L.add_mod(acc, acc), b), acc)
+        ref = (2 * ref + x1) * ref % P
+    assert L.from_mont(np.asarray(acc)) == ref
+
+
+def test_montmul_on_negative_representations():
+    xs = rand_ints(3)
+    A = jnp.asarray(np.stack([L.to_mont(x) for x in xs]))
+    neg = L.neg_mod(A)  # digits represent -x (signed)
+    sq = jax.jit(L.montmul)(neg, neg)
+    for i, x in enumerate(xs):
+        assert L.from_mont(np.asarray(sq)[i]) == x * x % P
+
+
+def test_value_predicates():
+    a = jnp.asarray(L.to_mont(rng.randrange(1, P)))
+    assert bool(L.is_zero_val(L.sub_mod(a, a)))
+    assert bool(L.is_zero_val(L.neg_mod(L.sub_mod(a, a))))
+    assert not bool(L.is_zero_val(a))
+    assert bool(L.is_one_mont(jnp.asarray(L.ONE_MONT)))
+    assert not bool(L.is_one_mont(a))
+
+
+def test_fp2_ops():
+    a, b = rq2(), rq2()
+    A, B = jnp.asarray(F.fq2_to_dev(a)), jnp.asarray(F.fq2_to_dev(b))
+    assert F.dev_to_fq2(jax.jit(F.fp2_mul)(A, B)) == a * b
+    assert F.dev_to_fq2(jax.jit(F.fp2_sq)(A)) == a.square()
+    assert F.dev_to_fq2(jax.jit(F.fp2_inv)(A)) == a.inv()
+    assert F.dev_to_fq2(F.fp2_mul_by_xi(A)) == a.mul_by_xi()
+    assert F.dev_to_fq2(F.fp2_conj(A)) == a.conjugate()
+    k = Fq(rng.randrange(P))
+    assert F.dev_to_fq2(jax.jit(F.fp2_scale)(A, jnp.asarray(L.to_mont(k.n)))) == a.scale(k)
+
+
+def test_fp6_ops():
+    a, b = rq6(), rq6()
+    A, B = jnp.asarray(F.fq6_to_dev(a)), jnp.asarray(F.fq6_to_dev(b))
+    assert F.dev_to_fq6(jax.jit(F.fp6_mul)(A, B)) == a * b
+    assert F.dev_to_fq6(jax.jit(F.fp6_inv)(A)) == a.inv()
+    assert F.dev_to_fq6(jax.jit(F.fp6_frobenius)(A)) == a.frobenius()
+    assert F.dev_to_fq6(F.fp6_mul_by_v(A)) == a.mul_by_v()
+
+
+def test_fp12_ops():
+    a, b = rq12(), rq12()
+    A, B = jnp.asarray(F.fq12_to_dev(a)), jnp.asarray(F.fq12_to_dev(b))
+    assert F.dev_to_fq12(jax.jit(F.fp12_mul)(A, B)) == a * b
+    assert F.dev_to_fq12(jax.jit(F.fp12_inv)(A)) == a.inv()
+    assert F.dev_to_fq12(jax.jit(F.fp12_frobenius)(A)) == a.frobenius()
+    assert (
+        F.dev_to_fq12(jax.jit(lambda x: F.fp12_frobenius_n(x, 2))(A))
+        == a.frobenius().frobenius()
+    )
+    assert F.dev_to_fq12(F.fp12_conj(A)) == a.conjugate()
+    assert bool(F.fp12_is_one(jnp.asarray(F.fq12_to_dev(Fq12.one()))))
+    assert not bool(F.fp12_is_one(A))

@@ -1,0 +1,89 @@
+"""Differential tests: device Miller loop / final exponentiation / batch
+pairing checks vs the anchor. The device computes FE(f)³ (x-chain), so the
+cross-check is anchor_FE(f)**3 — the chain identity itself is also asserted
+on integers."""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from grandine_tpu.crypto import pairing as AP
+from grandine_tpu.crypto.constants import P, R, X
+from grandine_tpu.crypto.curves import G1, G2, g1_infinity
+from grandine_tpu.tpu import curve as C
+from grandine_tpu.tpu import field as F
+from grandine_tpu.tpu import limbs as L
+from grandine_tpu.tpu import pairing as TP
+
+rng = random.Random(0xE4)
+
+
+def test_hard_part_chain_identity():
+    hard = (P**4 - P**2 + 1) // R
+    assert (X - 1) ** 2 * (X + P) * (X**2 + P**2 - 1) + 3 == 3 * hard
+
+
+def dev_pairs(p_list, q_list):
+    g1d = [C.g1_point_to_dev(p) for p in p_list]
+    g2d = [C.g2_point_to_dev(q) for q in q_list]
+    one = np.asarray(L.to_mont(1))
+    one2 = np.stack([L.to_mont(1), L.ZERO])
+    zero2 = np.zeros((2, L.NLIMBS), np.int32)
+    P_jac = (
+        jnp.asarray(np.stack([d[0] for d in g1d])),
+        jnp.asarray(np.stack([d[1] for d in g1d])),
+        jnp.asarray(np.stack([np.zeros(L.NLIMBS, np.int32) if d[2] else one for d in g1d])),
+    )
+    Q_proj = (
+        jnp.asarray(np.stack([d[0] for d in g2d])),
+        jnp.asarray(np.stack([d[1] for d in g2d])),
+        jnp.asarray(np.stack([zero2 if d[2] else one2 for d in g2d])),
+    )
+    inf = jnp.asarray(
+        np.array([bool(a[2]) or bool(b[2]) for a, b in zip(g1d, g2d)])
+    )
+    return P_jac, Q_proj, inf
+
+
+@pytest.fixture(scope="module")
+def jitted():
+    return (
+        jax.jit(TP.miller_loop),
+        jax.jit(TP.final_exponentiation),
+        jax.jit(TP.multi_pairing_check),
+    )
+
+
+def test_pairing_matches_anchor_and_is_bilinear(jitted):
+    ml, fe, _ = jitted
+    a = rng.randrange(1, 2**32)
+    Ps = [G1.mul(a), G1, G1.mul(3), g1_infinity()]
+    Qs = [G2, G2.mul(a), G2.mul(5), G2]
+    Pd, Qd, inf = dev_pairs(Ps, Qs)
+    e = fe(ml(Pd, Qd, inf))
+    for i in range(4):
+        anchor = AP.final_exponentiation(AP.miller_loop(Ps[i], Qs[i]))
+        assert F.dev_to_fq12(e[i]) == anchor.pow(3)
+    # bilinearity: e(aP, Q) == e(P, aQ)
+    assert F.dev_to_fq12(e[0]) == F.dev_to_fq12(e[1])
+    # infinity is neutral
+    from grandine_tpu.crypto.fields import Fq12
+
+    assert F.dev_to_fq12(e[3]) == Fq12.one()
+
+
+def test_multi_pairing_check(jitted):
+    _, _, chk = jitted
+    a = rng.randrange(1, 2**31)
+    good_p = [G1.mul(3), -(G1.mul(3)), g1_infinity(), g1_infinity()]
+    qs = [G2.mul(5), G2.mul(5), G2, G2]
+    assert bool(chk(*dev_pairs(good_p, qs)))
+    # moving the scalar across the pairing: e(aP,Q)·e(-P,aQ) == 1
+    cross_p = [G1.mul(a), -G1, g1_infinity(), g1_infinity()]
+    cross_q = [G2, G2.mul(a), G2, G2]
+    assert bool(chk(*dev_pairs(cross_p, cross_q)))
+    bad_p = [G1.mul(3), -(G1.mul(2)), g1_infinity(), g1_infinity()]
+    assert not bool(chk(*dev_pairs(bad_p, qs)))
