@@ -1,0 +1,418 @@
+"""Batched BLS signature-plane kernels on device + the host-facing backend.
+
+This is the TPU equivalent of the reference's `bls` crate hot surface
+(bls/src/signature.rs:96-129 `multi_verify`, :78-93 `fast_aggregate_verify`,
+bls/src/secret_key.rs:82-86 `sign`) re-designed for the accelerator:
+
+  - `multi_verify_kernel` — random-linear-combination batch verification:
+    N (message, signature, pubkey) triples are checked with N+1 vmapped
+    Miller loops, a log-depth Fp12 product tree, and ONE shared final
+    exponentiation:  e(g1, Σ rᵢ·sigᵢ) == ∏ e(rᵢ·pkᵢ, H(mᵢ)).
+  - `aggregate_fast_verify_kernel` — the gossip-attestation firehose shape:
+    M attestations × K committee members; pubkey aggregation is a log-depth
+    complete-addition tree over the K axis, then the RLC check above.
+  - `batch_sign_kernel` / `batch_pubkey_kernel` — G2/G1 fixed-base scalar
+    multiplications for multi-validator signing (signer/src/signer.rs:173-229).
+
+All kernels are shape-static (host pads to power-of-two buckets), branchless,
+and carry a leading batch axis — the jit/vmap/shard-map compilation model.
+Padding slots are all-infinity triples, which are algebraically neutral in
+every reduction. Host-side policy checks (identity pubkey rejection, empty
+batches, subgroup checks on decompression) happen in `TpuBlsBackend` before
+data reaches the device, mirroring where the reference enforces them.
+
+Multi-chip: the batch axis shards over a `jax.sharding.Mesh`; each chip
+reduces its local Fp12 product and the cross-chip product is a single
+all-gather of one Fp12 element per chip (see __graft_entry__.py).
+"""
+
+from __future__ import annotations
+
+import secrets
+from functools import partial
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from grandine_tpu.crypto import constants
+from grandine_tpu.crypto import bls as A
+from grandine_tpu.crypto.curves import G1
+from grandine_tpu.crypto.hash_to_curve import hash_to_g2
+from grandine_tpu.tpu import curve as C
+from grandine_tpu.tpu import field as F
+from grandine_tpu.tpu import limbs as L
+from grandine_tpu.tpu import pairing as TP
+
+# --- module constants (host, Montgomery limb form) -------------------------
+
+_NEG_G1_DEV = C.g1_point_to_dev(-G1)  # (x, y, inf=False)
+
+
+def _fp12_product_tree(f):
+    """Reduce a (N, …fp12) batch to one element by a log-depth product tree
+    (any N ≥ 1; an odd tail element rides along to the next level)."""
+    n = f.shape[0]
+    while n > 1:
+        h = n // 2
+        prod = F.fp12_mul_many(f[:h], f[h : 2 * h])
+        f = jnp.concatenate([prod, f[2 * h :]], axis=0) if n % 2 else prod
+        n = f.shape[0]
+    return f[0]
+
+
+def _rlc_finish(f, sig_acc_jac):
+    """Multiply the accumulated Fp12 product by the single e(−g1, Σ rᵢ·sigᵢ)
+    factor and run the shared final exponentiation. The one place (single-
+    and multi-chip) that evaluates the RLC product equation."""
+    sig_inf = F.fp2_is_zero(sig_acc_jac[2])
+    sig_h = TP.jacobian_to_homogeneous(sig_acc_jac)
+    neg_x = jnp.asarray(_NEG_G1_DEV[0]).astype(jnp.int32)[None]
+    neg_y = jnp.asarray(_NEG_G1_DEV[1]).astype(jnp.int32)[None]
+    neg_z = jnp.asarray(L.ONE_MONT).astype(jnp.int32)[None]
+    f_sig = TP.miller_loop(
+        (neg_x, neg_y, neg_z), tuple(c[None] for c in sig_h), sig_inf[None]
+    )
+    f_total = F.fp12_mul(f, f_sig[0])
+    return F.fp12_is_one(TP.final_exponentiation(f_total))
+
+
+def _rlc_pairing_check(rpk_jac, pair_inf, msg_x, msg_y, sig_acc_jac):
+    """Shared tail of both verify kernels: given rᵢ·pkᵢ (Jacobian G1), the
+    per-pair infinity mask, affine message points H(mᵢ) on the twist, and
+    Σ rᵢ·sigᵢ (Jacobian G2), evaluate
+
+        ∏ e(rᵢ·pkᵢ, H(mᵢ)) · e(−g1, Σ rᵢ·sigᵢ) == 1
+
+    with one shared final exponentiation."""
+    n = msg_x.shape[0]
+    # message points: affine → homogeneous projective on the twist
+    msg_q = (msg_x, msg_y, F.fp2_one((n,)))
+    f_msgs = TP.miller_loop(rpk_jac, msg_q, pair_inf)
+    return _rlc_finish(_fp12_product_tree(f_msgs), sig_acc_jac)
+
+
+def multi_verify_kernel(
+    pk_x, pk_y, pk_inf, sig_x, sig_y, sig_inf, msg_x, msg_y, msg_inf, r_bits
+):
+    """RLC batch verify of N (msg, sig, pk) triples. Shapes:
+    pk_x/pk_y (N, L); sig/msg coords (N, 2, L); inf masks (N,) bool;
+    r_bits (N, 64) MSB-first nonzero random scalars. N must be a power of
+    two; padding slots are all-infinity (neutral). Returns a scalar bool.
+
+    Algebraic twin of Signature::multi_verify (bls/src/signature.rs:96-129).
+    """
+    rpk = C.scalar_mul(pk_x, pk_y, pk_inf, r_bits, C.FP_OPS)
+    rsig = C.scalar_mul(sig_x, sig_y, sig_inf, r_bits, C.FP2_OPS)
+    sig_acc = C.sum_points(rsig, C.FP2_OPS)
+    pair_inf = pk_inf | msg_inf
+    return _rlc_pairing_check(rpk, pair_inf, msg_x, msg_y, sig_acc)
+
+
+def aggregate_fast_verify_kernel(
+    mem_x, mem_y, mem_inf, slot_pad,
+    sig_x, sig_y, sig_inf, msg_x, msg_y, msg_inf, r_bits,
+):
+    """Firehose kernel: M aggregates (gossip attestations), each signed by up
+    to K committee members over one message. Shapes: mem_x/mem_y (M, K, L)
+    affine member pubkeys with mem_inf (M, K) padding mask; slot_pad (M,)
+    marks batch-padding slots; sig/msg per aggregate as in
+    multi_verify_kernel; r_bits (M, 64).
+
+    Computes pkᵢ = Σₖ memᵢₖ (complete-add tree over K), then the RLC check.
+    A REAL slot whose members sum to the identity is rejected (matching the
+    anchor's fast_aggregate_verify: an adversary could pair a [P, −P]
+    committee with an infinity signature to fake participation); padding
+    slots stay algebraically neutral.
+    Reference shape: attestation_batch_triples + MultiVerifier::finish
+    (p2p/src/attestation_verifier.rs:431-457, helper_functions verifier.rs:302).
+    """
+    one = C.FP_OPS.one_like(mem_x)
+    zero = C.FP_OPS.zeros_like(mem_x)
+    mem_jac = (
+        C.FP_OPS.select(mem_inf, one, mem_x),
+        C.FP_OPS.select(mem_inf, one, mem_y),
+        C.FP_OPS.select(mem_inf, zero, one),
+    )
+    agg_pk = C.sum_points_axis1(mem_jac, C.FP_OPS)  # (M,) Jacobian G1
+    agg_inf = L.is_zero_val(agg_pk[2])
+    forged = jnp.any(jnp.logical_and(jnp.logical_not(slot_pad), agg_inf))
+    rpk = C.scalar_mul_jac(agg_pk, agg_inf, r_bits, C.FP_OPS)
+    rsig = C.scalar_mul(sig_x, sig_y, sig_inf, r_bits, C.FP2_OPS)
+    sig_acc = C.sum_points(rsig, C.FP2_OPS)
+    pair_inf = agg_inf | msg_inf
+    ok = _rlc_pairing_check(rpk, pair_inf, msg_x, msg_y, sig_acc)
+    return jnp.logical_and(ok, jnp.logical_not(forged))
+
+
+def batch_sign_kernel(msg_x, msg_y, msg_inf, sk_bits):
+    """N signatures: [skᵢ]·H(mᵢ) on the twist. sk_bits (N, 255) MSB-first.
+    Returns a Jacobian G2 batch (host normalizes/compresses).
+
+    NOTE: secret scalars live on the accelerator; the kernel is branchless
+    (fixed trip count, select-based) but NOT hardened against physical side
+    channels — acceptable for benching, keep hot production signing host-side
+    (SURVEY.md §7 risks)."""
+    return C.scalar_mul(msg_x, msg_y, msg_inf, sk_bits, C.FP2_OPS)
+
+
+def batch_pubkey_kernel(sk_bits):
+    """N public keys: [skᵢ]·g1. sk_bits (N, 255) MSB-first."""
+    gx, gy, _ = C.g1_point_to_dev(G1)
+    n = sk_bits.shape[0]
+    qx = jnp.broadcast_to(jnp.asarray(gx), (n,) + gx.shape).astype(jnp.int32)
+    qy = jnp.broadcast_to(jnp.asarray(gy), (n,) + gy.shape).astype(jnp.int32)
+    q_inf = jnp.zeros((n,), bool)
+    return C.scalar_mul(qx, qy, q_inf, sk_bits, C.FP_OPS)
+
+
+# --- multi-chip (SPMD over a device mesh) -----------------------------------
+
+
+def make_sharded_multi_verify(mesh, axis: str = "batch"):
+    """Build the multi-chip RLC batch verify: the batch axis is sharded over
+    `mesh`'s `axis`; each chip runs its local Miller loops, scalar muls, and
+    local Fp12 product / G2 partial sum; the only collectives are two
+    all-gathers of ONE Fp12 element and ONE Jacobian G2 point per chip (a few
+    KB over ICI). The final exponentiation runs replicated (it is per-batch,
+    not per-signature). Returns a jitted fn with the same signature as
+    `multi_verify_kernel`; per-chip batch must be a power of two.
+
+    This is the framework's scale-out plane (SURVEY.md §2.4): the pairing
+    product is the one cross-chip reduction the workload needs.
+    """
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    def local_step(
+        pk_x, pk_y, pk_inf, sig_x, sig_y, sig_inf, msg_x, msg_y, msg_inf, r_bits
+    ):
+        rpk = C.scalar_mul(pk_x, pk_y, pk_inf, r_bits, C.FP_OPS)
+        rsig = C.scalar_mul(sig_x, sig_y, sig_inf, r_bits, C.FP2_OPS)
+        sX, sY, sZ = C.sum_points(rsig, C.FP2_OPS)  # local G2 partial sum
+        n = msg_x.shape[0]
+        msg_q = (msg_x, msg_y, F.fp2_one((n,)))
+        f_local = _fp12_product_tree(
+            TP.miller_loop(rpk, msg_q, pk_inf | msg_inf)
+        )
+        # cross-chip: gather the per-chip partials (tiny), finish replicated
+        f_all = lax.all_gather(f_local, axis)  # (n_dev, …fp12)
+        sig_all = tuple(
+            lax.all_gather(c, axis) for c in (sX, sY, sZ)
+        )  # (n_dev,) G2 points
+        sig_acc = C.sum_points(sig_all, C.FP2_OPS)
+        return _rlc_finish(_fp12_product_tree(f_all), sig_acc)
+
+    batch = P(axis)
+    shardings = (
+        batch, batch, batch,  # pk x/y/inf
+        batch, batch, batch,  # sig
+        batch, batch, batch,  # msg
+        batch,                # r_bits
+    )
+    # check_vma=False: montmul's lax.scan carries start as replicated
+    # constants and become device-varying, which the VMA checker rejects
+    # (the computation is still correct SPMD — every collective is explicit).
+    fn = jax.shard_map(
+        local_step, mesh=mesh, in_specs=shardings, out_specs=P(), check_vma=False
+    )
+    return jax.jit(fn)
+
+
+# --- host-facing backend ----------------------------------------------------
+
+
+def _bucket(n: int, lo: int = 4, hi: int = 1 << 14) -> int:
+    b = lo
+    while b < n:
+        b <<= 1
+    if b > hi:
+        raise ValueError(f"batch of {n} exceeds max bucket {hi}")
+    return b
+
+
+_ZERO2 = np.zeros((2, L.NLIMBS), np.int32)
+
+
+class TpuBlsBackend:
+    """Host façade: anchor-typed in/out, device execution, bucket-padded jit.
+
+    The policy mirror of grandine_tpu/crypto/bls.py's multi_verify /
+    fast_aggregate_verify — same edge-case semantics (empty batch, identity
+    pubkeys), differential-tested against the anchor."""
+
+    def __init__(self) -> None:
+        self._jit_cache: dict = {}
+        self._h2c_cache: dict = {}
+
+    # -- conversions -------------------------------------------------------
+
+    def _hash_to_g2_dev(self, message: bytes, dst: bytes):
+        key = (message, dst)
+        hit = self._h2c_cache.get(key)
+        if hit is None:
+            hit = C.g2_point_to_dev(hash_to_g2(message, dst))
+            if len(self._h2c_cache) > 4096:
+                self._h2c_cache.clear()
+            self._h2c_cache[key] = hit
+        return hit
+
+    def _jitted(self, name: str, fn):
+        f = self._jit_cache.get(name)
+        if f is None:
+            f = jax.jit(fn)
+            self._jit_cache[name] = f
+        return f
+
+    # -- verification ------------------------------------------------------
+
+    def multi_verify(
+        self,
+        messages: Sequence[bytes],
+        signatures: Sequence["A.Signature"],
+        public_keys: Sequence["A.PublicKey"],
+        dst: bytes = constants.DST_SIGNATURE,
+        rng=secrets,
+    ) -> bool:
+        n = len(messages)
+        if not (n == len(signatures) == len(public_keys)):
+            return False
+        if n == 0:
+            return True
+        if any(pk.point.is_infinity() for pk in public_keys):
+            return False
+        b = _bucket(n)
+        pk_x = np.zeros((b, L.NLIMBS), np.int32)
+        pk_y = np.zeros((b, L.NLIMBS), np.int32)
+        pk_inf = np.ones((b,), bool)
+        sig_x = np.zeros((b, 2, L.NLIMBS), np.int32)
+        sig_y = np.zeros((b, 2, L.NLIMBS), np.int32)
+        sig_inf = np.ones((b,), bool)
+        msg_x = np.zeros((b, 2, L.NLIMBS), np.int32)
+        msg_y = np.zeros((b, 2, L.NLIMBS), np.int32)
+        msg_inf = np.ones((b,), bool)
+        for i in range(n):
+            x, y, inf = C.g1_point_to_dev(public_keys[i].point)
+            pk_x[i], pk_y[i], pk_inf[i] = x, y, inf
+            x, y, inf = C.g2_point_to_dev(signatures[i].point)
+            sig_x[i], sig_y[i], sig_inf[i] = x, y, inf
+            x, y, inf = self._hash_to_g2_dev(messages[i], dst)
+            msg_x[i], msg_y[i], msg_inf[i] = x, y, inf
+        scalars = [self._nonzero_u64(rng) for _ in range(n)] + [1] * (b - n)
+        r_bits = C.scalars_to_bits_msb(scalars, 64)
+        fn = self._jitted("multi_verify", multi_verify_kernel)
+        return bool(
+            fn(pk_x, pk_y, pk_inf, sig_x, sig_y, sig_inf, msg_x, msg_y, msg_inf, r_bits)
+        )
+
+    def verify(
+        self,
+        message: bytes,
+        signature: "A.Signature",
+        public_key: "A.PublicKey",
+        dst: bytes = constants.DST_SIGNATURE,
+    ) -> bool:
+        return self.multi_verify([message], [signature], [public_key], dst)
+
+    def fast_aggregate_verify_batch(
+        self,
+        messages: Sequence[bytes],
+        signatures: Sequence["A.Signature"],
+        member_keys: Sequence[Sequence["A.PublicKey"]],
+        dst: bytes = constants.DST_SIGNATURE,
+        rng=secrets,
+    ) -> bool:
+        """M aggregates, each over its own committee (the gossip firehose)."""
+        m = len(messages)
+        if not (m == len(signatures) == len(member_keys)):
+            return False
+        if m == 0:
+            return True
+        if any(not ks for ks in member_keys):
+            return False
+        if any(pk.point.is_infinity() for ks in member_keys for pk in ks):
+            return False
+        bm = _bucket(m)
+        bk = _bucket(max(len(ks) for ks in member_keys), lo=4)
+        mem_x = np.zeros((bm, bk, L.NLIMBS), np.int32)
+        mem_y = np.zeros((bm, bk, L.NLIMBS), np.int32)
+        mem_inf = np.ones((bm, bk), bool)
+        slot_pad = np.arange(bm) >= m
+        sig_x = np.zeros((bm, 2, L.NLIMBS), np.int32)
+        sig_y = np.zeros((bm, 2, L.NLIMBS), np.int32)
+        sig_inf = np.ones((bm,), bool)
+        msg_x = np.zeros((bm, 2, L.NLIMBS), np.int32)
+        msg_y = np.zeros((bm, 2, L.NLIMBS), np.int32)
+        msg_inf = np.ones((bm,), bool)
+        for i in range(m):
+            for j, pk in enumerate(member_keys[i]):
+                x, y, inf = C.g1_point_to_dev(pk.point)
+                mem_x[i, j], mem_y[i, j], mem_inf[i, j] = x, y, inf
+            x, y, inf = C.g2_point_to_dev(signatures[i].point)
+            sig_x[i], sig_y[i], sig_inf[i] = x, y, inf
+            x, y, inf = self._hash_to_g2_dev(messages[i], dst)
+            msg_x[i], msg_y[i], msg_inf[i] = x, y, inf
+        scalars = [self._nonzero_u64(rng) for _ in range(m)] + [1] * (bm - m)
+        r_bits = C.scalars_to_bits_msb(scalars, 64)
+        fn = self._jitted("agg_fast_verify", aggregate_fast_verify_kernel)
+        return bool(
+            fn(
+                mem_x, mem_y, mem_inf, slot_pad, sig_x, sig_y, sig_inf,
+                msg_x, msg_y, msg_inf, r_bits,
+            )
+        )
+
+    def fast_aggregate_verify(
+        self,
+        message: bytes,
+        signature: "A.Signature",
+        public_keys: Sequence["A.PublicKey"],
+        dst: bytes = constants.DST_SIGNATURE,
+    ) -> bool:
+        return self.fast_aggregate_verify_batch(
+            [message], [signature], [public_keys], dst
+        )
+
+    # -- signing -----------------------------------------------------------
+
+    def batch_sign(
+        self,
+        messages: Sequence[bytes],
+        secret_keys: Sequence["A.SecretKey"],
+        dst: bytes = constants.DST_SIGNATURE,
+    ) -> "list[A.Signature]":
+        """N signatures on device (signer/src/signer.rs:173-229 equivalent)."""
+        n = len(messages)
+        assert n == len(secret_keys)
+        if n == 0:
+            return []
+        b = _bucket(n)
+        msg_x = np.zeros((b, 2, L.NLIMBS), np.int32)
+        msg_y = np.zeros((b, 2, L.NLIMBS), np.int32)
+        msg_inf = np.ones((b,), bool)
+        for i in range(n):
+            x, y, inf = self._hash_to_g2_dev(messages[i], dst)
+            msg_x[i], msg_y[i], msg_inf[i] = x, y, inf
+        scalars = [sk.scalar for sk in secret_keys] + [1] * (b - n)
+        sk_bits = C.scalars_to_bits_msb(scalars, 255)
+        fn = self._jitted("batch_sign", batch_sign_kernel)
+        X, Y, Z = fn(msg_x, msg_y, msg_inf, sk_bits)
+        X, Y, Z = np.asarray(X), np.asarray(Y), np.asarray(Z)
+        return [A.Signature(C.dev_to_g2_point(X[i], Y[i], Z[i])) for i in range(n)]
+
+    @staticmethod
+    def _nonzero_u64(rng) -> int:
+        s = 0
+        while s == 0:
+            s = rng.randbits(64)
+        return s
+
+
+__all__ = [
+    "TpuBlsBackend",
+    "multi_verify_kernel",
+    "aggregate_fast_verify_kernel",
+    "batch_sign_kernel",
+    "batch_pubkey_kernel",
+]

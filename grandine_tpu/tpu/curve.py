@@ -203,20 +203,72 @@ def scalar_mul(qx, qy, q_inf, bits_msb: jnp.ndarray, ops: FieldOps):
     return (X, Y, Z)
 
 
+def scalar_mul_jac(q, q_inf, bits_msb: jnp.ndarray, ops: FieldOps):
+    """[k]Q for a Jacobian (possibly adversarial) base Q, batched. Uses
+    complete additions throughout, so no degeneracy preconditions: correct
+    for any k (including 0) and any Q (including infinity). Costlier than
+    `scalar_mul` (full add vs mixed add) — used where the base is an
+    accumulated point that is not affine, e.g. r·(Σ pkᵢ) in the aggregate
+    fast-verify kernel."""
+    one = ops.one_like(q[0])
+    zero = ops.zeros_like(q[0])
+    # mask an infinite base to the (valid) representation (1, 1, 0)
+    Q = (
+        ops.select(q_inf, one, q[0]),
+        ops.select(q_inf, one, q[1]),
+        ops.select(q_inf, zero, q[2]),
+    )
+    init = (one, one, zero)  # infinity
+
+    def step(st, bit):
+        st = point_double(st, ops)
+        added = point_add_complete(st, Q, ops)
+        bitb = bit.astype(bool)
+        st = tuple(ops.select(bitb, a, s) for a, s in zip(added, st))
+        return st, None
+
+    st, _ = lax.scan(step, init, jnp.moveaxis(bits_msb, -1, 0))
+    X = ops.select(q_inf, one, st[0])
+    Y = ops.select(q_inf, one, st[1])
+    Z = ops.select(q_inf, zero, st[2])
+    return (X, Y, Z)
+
+
 def sum_points(p, ops: FieldOps):
     """Reduce a batch of Jacobian points (leading axis) to a single point by
-    a binary tree of complete additions. Batch size must be a power of two
-    (pad with infinity)."""
+    a binary tree of complete additions (any batch size ≥ 1; an odd tail
+    element rides along to the next level)."""
     X, Y, Z = p
     n = X.shape[0]
-    assert n & (n - 1) == 0, "sum_points requires power-of-two batch"
     while n > 1:
         h = n // 2
         a = (X[:h], Y[:h], Z[:h])
-        b = (X[h:n], Y[h:n], Z[h:n])
-        X, Y, Z = point_add_complete(a, b, ops)
-        n = h
+        b = (X[h : 2 * h], Y[h : 2 * h], Z[h : 2 * h])
+        Xs, Ys, Zs = point_add_complete(a, b, ops)
+        if n % 2:
+            Xs = jnp.concatenate([Xs, X[2 * h :]], axis=0)
+            Ys = jnp.concatenate([Ys, Y[2 * h :]], axis=0)
+            Zs = jnp.concatenate([Zs, Z[2 * h :]], axis=0)
+        X, Y, Z = Xs, Ys, Zs
+        n = X.shape[0]
     return (X[0], Y[0], Z[0])
+
+
+def sum_points_axis1(p, ops: FieldOps):
+    """Reduce axis 1 of a (M, K, …) batch of Jacobian points to (M, …) by a
+    binary tree of complete additions. K must be a power of two (pad with
+    infinity). This is the committee-aggregation kernel: M attestations ×
+    K member public keys → M aggregate keys."""
+    X, Y, Z = p
+    k = X.shape[1]
+    assert k & (k - 1) == 0, "sum_points_axis1 requires power-of-two K"
+    while k > 1:
+        h = k // 2
+        a = (X[:, :h], Y[:, :h], Z[:, :h])
+        b = (X[:, h:k], Y[:, h:k], Z[:, h:k])
+        X, Y, Z = point_add_complete(a, b, ops)
+        k = h
+    return (X[:, 0], Y[:, 0], Z[:, 0])
 
 
 def scalars_to_bits_msb(scalars, nbits: int) -> np.ndarray:

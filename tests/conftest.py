@@ -1,15 +1,20 @@
 """Test configuration.
 
-Forces JAX onto a virtual 8-device CPU mesh (the multi-chip sharding tests
-run here; the driver separately dry-runs the real multi-chip path via
-__graft_entry__.dryrun_multichip). Must run before the first jax import.
+Forces JAX onto a virtual 8-device CPU mesh: multi-chip sharding tests run
+here, and unit tests stay off the (single) real TPU chip, which the driver
+uses for bench runs. The environment's sitecustomize registers the `axon`
+TPU platform programmatically, overriding the JAX_PLATFORMS env var — so the
+override must also be programmatic (jax.config), before any backend use.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,104 @@
+"""Differential tests: device BLS batch kernels vs the pure-Python anchor.
+
+Small batches only (the CPU-backend Miller loop is slow); the kernels are
+shape-generic, so correctness at N=4 covers the padded production shapes.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from grandine_tpu.crypto import bls as A
+from grandine_tpu.tpu.bls import TpuBlsBackend
+
+rng = random.Random(0xB15)
+
+
+def _rng_bytes(n: int) -> bytes:
+    return bytes(rng.randrange(256) for _ in range(n))
+
+
+@pytest.fixture(scope="module")
+def backend():
+    return TpuBlsBackend()
+
+
+@pytest.fixture(scope="module")
+def keys():
+    return [A.SecretKey.keygen(_rng_bytes(32)) for _ in range(4)]
+
+
+def test_multi_verify_roundtrip(backend, keys):
+    msgs = [b"triple-%d" % i for i in range(3)]
+    sks = keys[:3]
+    sigs = [sk.sign(m) for sk, m in zip(sks, msgs)]
+    pks = [sk.public_key() for sk in sks]
+    assert A.multi_verify(msgs, sigs, pks)  # anchor agrees
+    assert backend.multi_verify(msgs, sigs, pks)
+    # one wrong signature poisons the batch
+    bad = list(sigs)
+    bad[1] = sks[1].sign(b"wrong message")
+    assert not A.multi_verify(msgs, bad, pks)
+    assert not backend.multi_verify(msgs, bad, pks)
+    # swapped keys fail
+    assert not backend.multi_verify(msgs, sigs, [pks[1], pks[0], pks[2]])
+
+
+def test_multi_verify_edge_cases(backend, keys):
+    assert backend.multi_verify([], [], [])
+    assert not backend.multi_verify([b"m"], [], [])
+    # single triple (verify = multi_verify of 1)
+    sig = keys[0].sign(b"single")
+    assert backend.verify(b"single", sig, keys[0].public_key())
+    assert not backend.verify(b"other", sig, keys[0].public_key())
+
+
+def test_fast_aggregate_verify_batch(backend, keys):
+    # two aggregates with distinct committees/messages
+    msgs = [b"attestation-a", b"attestation-b"]
+    committees = [keys[:3], keys[1:4]]
+    sigs = [
+        A.Signature.aggregate([sk.sign(m) for sk in ks])
+        for m, ks in zip(msgs, committees)
+    ]
+    pk_lists = [[sk.public_key() for sk in ks] for ks in committees]
+    for m, s, ks in zip(msgs, sigs, pk_lists):
+        assert s.fast_aggregate_verify(m, ks)  # anchor agrees
+    assert backend.fast_aggregate_verify_batch(msgs, sigs, pk_lists)
+    # a missing participant breaks its aggregate
+    assert not backend.fast_aggregate_verify_batch(
+        msgs, sigs, [pk_lists[0][:2], pk_lists[1]]
+    )
+    # empty committee rejected
+    assert not backend.fast_aggregate_verify_batch(msgs, sigs, [pk_lists[0], []])
+
+
+def test_aggregate_identity_forgery_rejected(backend, keys):
+    """A [P, -P] committee with an infinity signature must NOT verify:
+    the aggregate pubkey is the identity and the anchor rejects it — the
+    device kernel must not mask it out as 'neutral'."""
+    from grandine_tpu.crypto.curves import g2_infinity
+
+    pk = keys[0].public_key()
+    neg_pk = A.PublicKey(-pk.point)
+    inf_sig = A.Signature(g2_infinity())
+    msg = b"forged participation"
+    assert not inf_sig.fast_aggregate_verify(msg, [pk, neg_pk])  # anchor
+    assert not backend.fast_aggregate_verify_batch([msg], [inf_sig], [[pk, neg_pk]])
+    # and a good aggregate in the same batch does not hide the forged one
+    good_msg = b"honest"
+    good_sig = A.Signature.aggregate([sk.sign(good_msg) for sk in keys[:2]])
+    good_pks = [sk.public_key() for sk in keys[:2]]
+    assert not backend.fast_aggregate_verify_batch(
+        [good_msg, msg], [good_sig, inf_sig], [good_pks, [pk, neg_pk]]
+    )
+
+
+def test_batch_sign_matches_anchor(backend, keys):
+    msgs = [b"duty-0", b"duty-1"]
+    sks = keys[:2]
+    out = backend.batch_sign(msgs, sks)
+    for sig, sk, m in zip(out, sks, msgs):
+        assert sig == sk.sign(m)
+        assert sig.verify(m, sk.public_key())
