@@ -1,0 +1,1 @@
+"""Core primitives: hashing, shuffling, math helpers (reference layer 0)."""

@@ -1,0 +1,114 @@
+"""SSZ hashing primitives — equivalent of the reference `hashing` crate
+(hashing/src/lib.rs:10-60: sha2-with-asm fast paths + precomputed
+`ZERO_HASHES` zero-subtree roots).
+
+Hot loops route to the C++ native extension (grandine_tpu.native, SHA-NI)
+when built; every function has a hashlib fallback so the framework runs
+anywhere.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from grandine_tpu import native
+
+H256 = bytes  # 32-byte root
+ZERO_H256 = b"\x00" * 32
+
+MAX_DEPTH = 64
+
+
+def _zero_hashes() -> list[bytes]:
+    out = [ZERO_H256]
+    for _ in range(MAX_DEPTH):
+        out.append(hashlib.sha256(out[-1] + out[-1]).digest())
+    return out
+
+
+#: ZERO_HASHES[i] = root of a depth-i subtree of zero chunks
+#: (reference: hashing/src/lib.rs ZERO_HASHES[41]; we precompute to 64).
+ZERO_HASHES: list[bytes] = _zero_hashes()
+
+
+def hash_bytes(data: bytes) -> bytes:
+    """Plain SHA-256."""
+    return hashlib.sha256(data).digest()
+
+
+def hash_pair(a: bytes, b: bytes) -> bytes:
+    """Parent node of two 32-byte children."""
+    return hashlib.sha256(a + b).digest()
+
+
+def hash_pairs(data: bytes | bytearray) -> bytes:
+    """N concatenated 64-byte pairs -> N concatenated 32-byte parents."""
+    n = len(data) // 64
+    if native.lib is not None and n >= 4:
+        out = native.out_buf(n * 32)
+        native.lib.gt_hash_pairs(bytes(data), n, out)
+        return out.raw[: n * 32]
+    sha = hashlib.sha256
+    return b"".join(
+        sha(data[64 * i : 64 * i + 64]).digest() for i in range(n)
+    )
+
+
+def merkleize_chunks(chunks: bytes | bytearray, limit: int | None = None) -> bytes:
+    """Merkleize 32-byte chunks (SSZ `merkleize`): pad virtually with zero
+    chunks to `limit` leaves (or next power of two of the chunk count) and
+    return the root."""
+    n = len(chunks) // 32
+    if limit is None:
+        limit = max(n, 1)
+    elif n > limit:
+        raise ValueError(f"{n} chunks exceed merkleization limit {limit}")
+    depth = (limit - 1).bit_length() if limit > 1 else 0
+    if n == 0:
+        return ZERO_HASHES[depth]
+    if native.lib is not None and n >= 2:
+        out = native.out_buf(32)
+        native.lib.gt_merkleize(bytes(chunks), n, depth, out)
+        return out.raw[:32]
+    return _merkleize_py(bytes(chunks), n, depth)
+
+
+def _merkleize_py(chunks: bytes, n: int, depth: int) -> bytes:
+    level = [chunks[32 * i : 32 * i + 32] for i in range(n)]
+    for d in range(depth):
+        if len(level) == 1:
+            level = [hash_pair(level[0], ZERO_HASHES[d])]
+            continue
+        if len(level) % 2:
+            level.append(ZERO_HASHES[d])
+        level = [
+            hash_pair(level[i], level[i + 1]) for i in range(0, len(level), 2)
+        ]
+    return level[0]
+
+
+def merkleize_many(chunks: bytes, n_items: int, chunks_per_item: int,
+                   depth: int) -> bytes:
+    """Batch-merkleize `n_items` independent fixed-shape subtrees laid out
+    contiguously (`chunks_per_item` 32-byte chunks each) to height `depth`.
+    Returns the concatenated 32-byte roots. This is the validator-registry
+    hot path: one native call per 50k-item registry."""
+    if native.lib is not None and n_items >= 2:
+        out = native.out_buf(n_items * 32)
+        native.lib.gt_merkleize_many(
+            chunks, n_items, chunks_per_item, depth, out)
+        return out.raw[: n_items * 32]
+    stride = chunks_per_item * 32
+    return b"".join(
+        _merkleize_py(chunks[i * stride : (i + 1) * stride],
+                      chunks_per_item, depth)
+        for i in range(n_items)
+    )
+
+
+def mix_in_length(root: bytes, length: int) -> bytes:
+    """hash(root ++ uint256_le(length)) — SSZ list length mixin."""
+    return hash_pair(root, length.to_bytes(32, "little"))
+
+
+mix_in_selector = mix_in_length  # SSZ union selector mixin, same shape

@@ -1,0 +1,67 @@
+"""Swap-or-not shuffle — equivalent of the reference `shuffling` crate
+(shuffling/src/lib.rs:14-50: whole-list optimized variant of the spec's
+`compute_shuffled_index`).
+
+The whole-list path is vectorized with numpy: per round, the pivot/flip/
+decision-bit computation for all n indices is array arithmetic plus
+ceil(n/256) SHA-256 calls, instead of the spec's per-index loop.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+
+def _round_bits(seed: bytes, round_: int, n: int) -> np.ndarray:
+    """Decision bit for every position 0..n-1 in one round."""
+    n_sources = (n + 255) // 256
+    buf = bytearray()
+    rb = bytes([round_])
+    for j in range(n_sources):
+        buf += hashlib.sha256(seed + rb + j.to_bytes(4, "little")).digest()
+    bits = np.unpackbits(np.frombuffer(bytes(buf), np.uint8),
+                         bitorder="little")
+    return bits[:n]
+
+
+def shuffled_indices(seed: bytes, n: int, rounds: int = 90) -> np.ndarray:
+    """sigma such that shuffled[pos] = items[sigma[pos]] matches the spec's
+    `indices[compute_shuffled_index(pos)]` committee selection."""
+    cur = np.arange(n, dtype=np.int64)
+    if n <= 1:
+        return cur
+    for r in range(rounds):
+        pivot = int.from_bytes(
+            hashlib.sha256(seed + bytes([r])).digest()[:8], "little") % n
+        bits = _round_bits(seed, r, n)
+        flip = (pivot - cur) % n
+        pos = np.maximum(cur, flip)
+        cur = np.where(bits[pos] == 1, flip, cur)
+    return cur
+
+
+def shuffle_list(items: np.ndarray, seed: bytes, rounds: int = 90) -> np.ndarray:
+    """Return the shuffled copy of `items`."""
+    return np.asarray(items)[shuffled_indices(seed, len(items), rounds)]
+
+
+def compute_shuffled_index(index: int, n: int, seed: bytes,
+                           rounds: int = 90) -> int:
+    """Spec-literal single-index variant (consensus-specs
+    `compute_shuffled_index`), kept as the correctness anchor for the
+    vectorized path."""
+    assert 0 <= index < n
+    for r in range(rounds):
+        pivot = int.from_bytes(
+            hashlib.sha256(seed + bytes([r])).digest()[:8], "little") % n
+        flip = (pivot + n - index) % n
+        position = max(index, flip)
+        source = hashlib.sha256(
+            seed + bytes([r]) + (position // 256).to_bytes(4, "little")
+        ).digest()
+        byte = source[(position % 256) // 8]
+        if (byte >> (position % 8)) & 1:
+            index = flip
+    return index

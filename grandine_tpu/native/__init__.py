@@ -1,0 +1,81 @@
+"""Loader for the C++ native runtime kernels (`gtnative.cpp`).
+
+Compiles the shared library on first import (g++, cached next to the
+source), then binds it via ctypes. If no toolchain is available the
+package still works: `lib` is None and callers (grandine_tpu.core.hashing)
+fall back to hashlib-based pure-Python paths.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "src", "gtnative.cpp")
+_SO = os.path.join(_DIR, "_gtnative.so")
+
+_lock = threading.Lock()
+lib = None
+shani = False
+
+
+def _build() -> bool:
+    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        return True
+    tmp = f"{_SO}.{os.getpid()}.tmp"  # per-process name: parallel first
+    # imports must not interleave writes into one file
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SRC]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO)
+    except (subprocess.SubprocessError, FileNotFoundError, OSError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return os.path.exists(_SO)
+    return True
+
+
+def _bind():
+    global lib, shani
+    if lib is not None:
+        return lib
+    with _lock:
+        if lib is not None:
+            return lib
+        if not _build():
+            return None
+        try:
+            L = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        # c_char_p lets a Python bytes object pass zero-copy; outputs are
+        # writable create_string_buffer()s (also c_char_p compatible).
+        cp = ctypes.c_char_p
+        L.gt_init.restype = ctypes.c_int
+        L.gt_sha256.argtypes = [cp, ctypes.c_uint64, cp]
+        L.gt_hash_pairs.argtypes = [cp, ctypes.c_uint64, cp]
+        L.gt_merkleize.argtypes = [cp, ctypes.c_uint64, ctypes.c_int, cp]
+        L.gt_merkleize_many.argtypes = [
+            cp, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int, cp]
+        L.gt_mix_in_length.argtypes = [cp, ctypes.c_uint64, cp]
+        L.gt_zero_hash.argtypes = [ctypes.c_int, cp]
+        shani = bool(L.gt_init())
+        lib = L
+        return lib
+
+
+_bind()
+
+
+def out_buf(n: int) -> ctypes.Array:
+    """Writable output buffer for a gt_* call; read result via `.raw`."""
+    return ctypes.create_string_buffer(n)
+
+
+def available() -> bool:
+    return lib is not None

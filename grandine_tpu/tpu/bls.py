@@ -223,13 +223,31 @@ def make_sharded_multi_verify(mesh, axis: str = "batch"):
 # --- host-facing backend ----------------------------------------------------
 
 
-def _bucket(n: int, lo: int = 4, hi: int = 1 << 14) -> int:
+#: Largest device bucket; bigger host batches are split into chunks of this
+#: size (each chunk is one RLC check — all chunks must pass).
+MAX_BUCKET = 1 << 14
+
+
+def _bucket(n: int, lo: int = 4, hi: int = MAX_BUCKET) -> int:
     b = lo
     while b < n:
         b <<= 1
     if b > hi:
         raise ValueError(f"batch of {n} exceeds max bucket {hi}")
     return b
+
+
+# jax.jit caches per wrapper object — keep one wrapper per kernel for the
+# whole process so every TpuBlsBackend instance shares compilations.
+_JITTED: dict = {}
+
+
+def _jitted_global(name: str, fn):
+    f = _JITTED.get(name)
+    if f is None:
+        f = jax.jit(fn)
+        _JITTED[name] = f
+    return f
 
 
 _ZERO2 = np.zeros((2, L.NLIMBS), np.int32)
@@ -243,7 +261,6 @@ class TpuBlsBackend:
     pubkeys), differential-tested against the anchor."""
 
     def __init__(self) -> None:
-        self._jit_cache: dict = {}
         self._h2c_cache: dict = {}
 
     # -- conversions -------------------------------------------------------
@@ -259,11 +276,7 @@ class TpuBlsBackend:
         return hit
 
     def _jitted(self, name: str, fn):
-        f = self._jit_cache.get(name)
-        if f is None:
-            f = jax.jit(fn)
-            self._jit_cache[name] = f
-        return f
+        return _jitted_global(name, fn)
 
     # -- verification ------------------------------------------------------
 
@@ -280,6 +293,17 @@ class TpuBlsBackend:
             return False
         if n == 0:
             return True
+        if n > MAX_BUCKET:
+            return all(
+                self.multi_verify(
+                    messages[i : i + MAX_BUCKET],
+                    signatures[i : i + MAX_BUCKET],
+                    public_keys[i : i + MAX_BUCKET],
+                    dst,
+                    rng,
+                )
+                for i in range(0, n, MAX_BUCKET)
+            )
         if any(pk.point.is_infinity() for pk in public_keys):
             return False
         b = _bucket(n)
@@ -331,8 +355,26 @@ class TpuBlsBackend:
             return True
         if any(not ks for ks in member_keys):
             return False
+        if m > MAX_BUCKET:
+            return all(
+                self.fast_aggregate_verify_batch(
+                    messages[i : i + MAX_BUCKET],
+                    signatures[i : i + MAX_BUCKET],
+                    member_keys[i : i + MAX_BUCKET],
+                    dst,
+                    rng,
+                )
+                for i in range(0, m, MAX_BUCKET)
+            )
         if any(pk.point.is_infinity() for ks in member_keys for pk in ks):
             return False
+        if max(len(ks) for ks in member_keys) > MAX_BUCKET:
+            # committee wider than a device bucket: host-aggregate those
+            # committees to a single key (same check: e(agg_pk, H(m)))
+            member_keys = [
+                ks if len(ks) <= MAX_BUCKET else [A.PublicKey.aggregate(ks)]
+                for ks in member_keys
+            ]
         bm = _bucket(m)
         bk = _bucket(max(len(ks) for ks in member_keys), lo=4)
         mem_x = np.zeros((bm, bk, L.NLIMBS), np.int32)
@@ -387,6 +429,17 @@ class TpuBlsBackend:
         assert n == len(secret_keys)
         if n == 0:
             return []
+        if n > MAX_BUCKET:
+            out: list = []
+            for i in range(0, n, MAX_BUCKET):
+                out.extend(
+                    self.batch_sign(
+                        messages[i : i + MAX_BUCKET],
+                        secret_keys[i : i + MAX_BUCKET],
+                        dst,
+                    )
+                )
+            return out
         b = _bucket(n)
         msg_x = np.zeros((b, 2, L.NLIMBS), np.int32)
         msg_y = np.zeros((b, 2, L.NLIMBS), np.int32)

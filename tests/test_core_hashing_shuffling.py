@@ -1,0 +1,83 @@
+"""Layer-0 primitives: native/fallback hashing agreement, zero hashes,
+merkleization shapes, and vectorized-vs-spec shuffle agreement."""
+
+import hashlib
+import os
+
+import numpy as np
+import pytest
+
+from grandine_tpu.core import hashing as H
+from grandine_tpu.core import shuffling as S
+
+
+def test_zero_hashes_chain():
+    assert H.ZERO_HASHES[0] == b"\x00" * 32
+    for i in range(1, 10):
+        assert H.ZERO_HASHES[i] == hashlib.sha256(
+            H.ZERO_HASHES[i - 1] * 2).digest()
+
+
+def test_hash_pairs_matches_hashlib():
+    data = os.urandom(64 * 9)
+    out = H.hash_pairs(data)
+    for i in range(9):
+        assert out[32 * i: 32 * i + 32] == hashlib.sha256(
+            data[64 * i: 64 * i + 64]).digest()
+
+
+@pytest.mark.parametrize("n", [0, 1, 2, 3, 5, 8, 13, 33])
+@pytest.mark.parametrize("limit", [None, 64])
+def test_merkleize_matches_reference_model(n, limit):
+    chunks = os.urandom(32 * n)
+    got = H.merkleize_chunks(chunks, limit)
+    # independent model: full padded binary tree via hashlib
+    cap = limit if limit is not None else max(n, 1)
+    depth = (cap - 1).bit_length() if cap > 1 else 0
+    level = [chunks[32 * i: 32 * i + 32] for i in range(n)]
+    level += [b"\x00" * 32] * ((1 << depth) - n)
+    if not level:
+        level = [b"\x00" * 32]
+    while len(level) > 1:
+        level = [hashlib.sha256(level[i] + level[i + 1]).digest()
+                 for i in range(0, len(level), 2)]
+    assert got == level[0]
+
+
+def test_merkleize_many_matches_single():
+    n_items, cpi, depth = 7, 8, 3
+    chunks = os.urandom(32 * cpi * n_items)
+    batch = H.merkleize_many(chunks, n_items, cpi, depth)
+    for i in range(n_items):
+        one = H.merkleize_chunks(
+            chunks[i * cpi * 32: (i + 1) * cpi * 32], 1 << depth)
+        assert batch[32 * i: 32 * i + 32] == one
+
+
+def test_merkleize_rejects_over_limit():
+    with pytest.raises(ValueError):
+        H.merkleize_chunks(os.urandom(32 * 5), limit=4)
+
+
+def test_mix_in_length():
+    root = os.urandom(32)
+    assert H.mix_in_length(root, 5) == hashlib.sha256(
+        root + (5).to_bytes(32, "little")).digest()
+
+
+@pytest.mark.parametrize("n", [1, 2, 10, 100, 333])
+def test_vectorized_shuffle_matches_spec_single_index(n):
+    seed = hashlib.sha256(b"shuffle-seed-%d" % n).digest()
+    sigma = S.shuffled_indices(seed, n, rounds=10)
+    for pos in range(0, n, max(1, n // 17)):
+        assert sigma[pos] == S.compute_shuffled_index(pos, n, seed, rounds=10)
+    # permutation property
+    assert sorted(sigma.tolist()) == list(range(n))
+
+
+def test_shuffle_list_gather():
+    seed = b"\x42" * 32
+    items = np.arange(100, 150)
+    out = S.shuffle_list(items, seed, rounds=10)
+    sigma = S.shuffled_indices(seed, 50, rounds=10)
+    assert (out == items[sigma]).all()
