@@ -93,6 +93,11 @@ def merkleize_many(chunks: bytes, n_items: int, chunks_per_item: int,
     contiguously (`chunks_per_item` 32-byte chunks each) to height `depth`.
     Returns the concatenated 32-byte roots. This is the validator-registry
     hot path: one native call per 50k-item registry."""
+    if len(chunks) != n_items * chunks_per_item * 32:
+        raise ValueError(
+            f"chunks length {len(chunks)} != {n_items}*{chunks_per_item}*32")
+    if chunks_per_item > (1 << depth):
+        raise ValueError(f"{chunks_per_item} chunks do not fit depth {depth}")
     if native.lib is not None and n_items >= 2:
         out = native.out_buf(n_items * 32)
         native.lib.gt_merkleize_many(

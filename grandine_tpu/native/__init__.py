@@ -51,20 +51,21 @@ def _bind():
             return None
         try:
             L = ctypes.CDLL(_SO)
-        except OSError:
+            # c_char_p lets a Python bytes object pass zero-copy; outputs
+            # are writable create_string_buffer()s (c_char_p compatible).
+            cp = ctypes.c_char_p
+            L.gt_init.restype = ctypes.c_int
+            L.gt_sha256.argtypes = [cp, ctypes.c_uint64, cp]
+            L.gt_hash_pairs.argtypes = [cp, ctypes.c_uint64, cp]
+            L.gt_merkleize.argtypes = [cp, ctypes.c_uint64, ctypes.c_int, cp]
+            L.gt_merkleize_many.argtypes = [
+                cp, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int, cp]
+            L.gt_mix_in_length.argtypes = [cp, ctypes.c_uint64, cp]
+            L.gt_zero_hash.argtypes = [ctypes.c_int, cp]
+            shani = bool(L.gt_init())
+        except (OSError, AttributeError):
+            # missing/stale-ABI cached .so: degrade to hashlib fallback
             return None
-        # c_char_p lets a Python bytes object pass zero-copy; outputs are
-        # writable create_string_buffer()s (also c_char_p compatible).
-        cp = ctypes.c_char_p
-        L.gt_init.restype = ctypes.c_int
-        L.gt_sha256.argtypes = [cp, ctypes.c_uint64, cp]
-        L.gt_hash_pairs.argtypes = [cp, ctypes.c_uint64, cp]
-        L.gt_merkleize.argtypes = [cp, ctypes.c_uint64, ctypes.c_int, cp]
-        L.gt_merkleize_many.argtypes = [
-            cp, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int, cp]
-        L.gt_mix_in_length.argtypes = [cp, ctypes.c_uint64, cp]
-        L.gt_zero_hash.argtypes = [ctypes.c_int, cp]
-        shani = bool(L.gt_init())
         lib = L
         return lib
 

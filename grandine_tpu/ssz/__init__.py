@@ -1,0 +1,57 @@
+"""SSZ: SimpleSerialize codec + Merkleization — equivalent of the reference
+`ssz` + `ssz_derive` crates (ssz/src/lib.rs:21 `SszRead/SszWrite/SszHash`,
+ssz/src/merkle_tree.rs, ssz_derive's `#[derive(Ssz)]`).
+
+Design (TPU-era host layer, not a translation):
+  * SSZ *types* are descriptor objects (`uint64`, `List(Validator, N)`, ...)
+    with `serialize / deserialize / hash_tree_root / default / chunk_count`.
+  * SSZ *values* are plain Python data — int, bool, bytes — plus three thin
+    wrappers: `Bits` (numpy-bool bitfields), `SszList`/`SszVector`
+    (tuple- or numpy-backed sequences with cached roots), and `Container`
+    (declared via class annotations; immutable, cached hash-tree-root —
+    the reference's `Hc<_>` hash-caching wrapper, ssz/src/hc.rs, is
+    subsumed by caching on every composite value).
+  * The merkleization hot loop runs in the native SHA-NI extension
+    (grandine_tpu.core.hashing); uint lists are numpy-backed so leaf-chunk
+    packing is `ndarray.tobytes()`.
+
+Public names mirror what the reference's `types` crate imports from `ssz`.
+"""
+
+from grandine_tpu.ssz.base import (
+    Bits,
+    Bitlist,
+    Bitvector,
+    ByteList,
+    ByteVector,
+    Container,
+    List,
+    SszError,
+    SszList,
+    SszType,
+    SszVector,
+    Vector,
+    boolean,
+    byte,
+    uint8,
+    uint16,
+    uint32,
+    uint64,
+    uint128,
+    uint256,
+)
+from grandine_tpu.ssz.merkle import MerkleTree, verify_merkle_proof
+
+Bytes4 = ByteVector(4)
+Bytes20 = ByteVector(20)
+Bytes32 = ByteVector(32)
+Bytes48 = ByteVector(48)
+Bytes96 = ByteVector(96)
+
+__all__ = [
+    "Bits", "Bitlist", "Bitvector", "ByteList", "ByteVector", "Container",
+    "List", "SszError", "SszList", "SszType", "SszVector", "Vector",
+    "boolean", "byte", "uint8", "uint16", "uint32", "uint64", "uint128",
+    "uint256", "Bytes4", "Bytes20", "Bytes32", "Bytes48", "Bytes96",
+    "MerkleTree", "verify_merkle_proof",
+]

@@ -1,0 +1,888 @@
+"""SSZ type descriptors and value wrappers.
+
+Every SSZ type is a *descriptor object* exposing:
+    is_fixed()        -> bool
+    fixed_size()      -> int           (fixed-size types only)
+    serialize(v)      -> bytes
+    deserialize(data) -> value         (strict: rejects trailing bytes,
+                                        bad offsets, bad bitfield padding)
+    hash_tree_root(v) -> bytes32
+    default()         -> value
+    coerce(v)         -> value         (accept convenient Python inputs)
+
+Reference parity: ssz/src/lib.rs (SszRead/SszWrite/SszHash, ContiguousList/
+Vector, BitList/BitVector, Uint256), ssz/src/hc.rs (hash caching — here a
+`_htr` cache on every composite value), ssz_derive (here: Container class
+annotations scanned by a metaclass).
+"""
+
+import struct
+from typing import Any, Iterable, Optional, Sequence
+
+import numpy as np
+
+from grandine_tpu.core import hashing
+
+OFFSET_SIZE = 4
+_U32 = struct.Struct("<I")
+
+
+class SszError(ValueError):
+    pass
+
+
+def _pad_chunks(data: bytes) -> bytes:
+    rem = len(data) % 32
+    return data if rem == 0 else data + b"\x00" * (32 - rem)
+
+
+class SszType:
+    def is_fixed(self) -> bool:
+        raise NotImplementedError
+
+    def fixed_size(self) -> int:
+        raise SszError(f"{self} is variable-size")
+
+    def serialize(self, v) -> bytes:
+        raise NotImplementedError
+
+    def deserialize(self, data) -> Any:
+        raise NotImplementedError
+
+    def hash_tree_root(self, v) -> bytes:
+        raise NotImplementedError
+
+    def default(self):
+        raise NotImplementedError
+
+    def coerce(self, v):
+        return v
+
+    # numpy dtype for packed basic types, else None
+    np_dtype = None
+
+
+# --------------------------------------------------------------- basic types
+
+
+class UInt(SszType):
+    __slots__ = ("bits", "size", "np_dtype")
+
+    def __init__(self, bits: int):
+        self.bits = bits
+        self.size = bits // 8
+        self.np_dtype = {8: np.uint8, 16: np.uint16, 32: np.uint32,
+                         64: np.uint64}.get(bits)
+
+    def __repr__(self):
+        return f"uint{self.bits}"
+
+    def is_fixed(self):
+        return True
+
+    def fixed_size(self):
+        return self.size
+
+    def serialize(self, v) -> bytes:
+        return int(v).to_bytes(self.size, "little")
+
+    def deserialize(self, data) -> int:
+        data = bytes(data)
+        if len(data) != self.size:
+            raise SszError(f"uint{self.bits}: got {len(data)} bytes")
+        return int.from_bytes(data, "little")
+
+    def hash_tree_root(self, v) -> bytes:
+        return int(v).to_bytes(self.size, "little").ljust(32, b"\x00")
+
+    def default(self):
+        return 0
+
+    def coerce(self, v):
+        v = int(v)
+        if not 0 <= v < (1 << self.bits):
+            raise SszError(f"uint{self.bits} out of range: {v}")
+        return v
+
+
+class Boolean(SszType):
+    np_dtype = np.uint8
+
+    def __repr__(self):
+        return "boolean"
+
+    def is_fixed(self):
+        return True
+
+    def fixed_size(self):
+        return 1
+
+    def serialize(self, v) -> bytes:
+        return b"\x01" if v else b"\x00"
+
+    def deserialize(self, data) -> bool:
+        data = bytes(data)
+        if data == b"\x00":
+            return False
+        if data == b"\x01":
+            return True
+        raise SszError(f"boolean: invalid byte {data!r}")
+
+    def hash_tree_root(self, v) -> bytes:
+        return (b"\x01" if v else b"\x00").ljust(32, b"\x00")
+
+    def default(self):
+        return False
+
+    def coerce(self, v):
+        return bool(v)
+
+
+uint8 = UInt(8)
+uint16 = UInt(16)
+uint32 = UInt(32)
+uint64 = UInt(64)
+uint128 = UInt(128)
+uint256 = UInt(256)
+byte = uint8
+boolean = Boolean()
+
+
+# --------------------------------------------------------------- byte arrays
+
+
+class ByteVector(SszType):
+    __slots__ = ("length",)
+    _cache: dict = {}
+
+    def __new__(cls, length: int):
+        hit = cls._cache.get(length)
+        if hit is None:
+            hit = super().__new__(cls)
+            hit.length = length
+            cls._cache[length] = hit
+        return hit
+
+    def __repr__(self):
+        return f"ByteVector[{self.length}]"
+
+    def is_fixed(self):
+        return True
+
+    def fixed_size(self):
+        return self.length
+
+    def serialize(self, v) -> bytes:
+        return bytes(v)
+
+    def deserialize(self, data) -> bytes:
+        data = bytes(data)
+        if len(data) != self.length:
+            raise SszError(f"{self}: got {len(data)} bytes")
+        return data
+
+    def hash_tree_root(self, v) -> bytes:
+        if self.length <= 32:
+            return bytes(v).ljust(32, b"\x00")
+        return hashing.merkleize_chunks(_pad_chunks(bytes(v)))
+
+    def default(self):
+        return b"\x00" * self.length
+
+    def coerce(self, v):
+        v = bytes(v)
+        if len(v) != self.length:
+            raise SszError(f"{self}: got {len(v)} bytes")
+        return v
+
+
+class ByteList(SszType):
+    __slots__ = ("limit",)
+    _cache: dict = {}
+
+    def __new__(cls, limit: int):
+        hit = cls._cache.get(limit)
+        if hit is None:
+            hit = super().__new__(cls)
+            hit.limit = limit
+            cls._cache[limit] = hit
+        return hit
+
+    def __repr__(self):
+        return f"ByteList[{self.limit}]"
+
+    def is_fixed(self):
+        return False
+
+    def serialize(self, v) -> bytes:
+        return bytes(v)
+
+    def deserialize(self, data) -> bytes:
+        data = bytes(data)
+        if len(data) > self.limit:
+            raise SszError(f"{self}: {len(data)} bytes over limit")
+        return data
+
+    def hash_tree_root(self, v) -> bytes:
+        v = bytes(v)
+        root = hashing.merkleize_chunks(
+            _pad_chunks(v), (self.limit + 31) // 32)
+        return hashing.mix_in_length(root, len(v))
+
+    def default(self):
+        return b""
+
+    def coerce(self, v):
+        v = bytes(v)
+        if len(v) > self.limit:
+            raise SszError(f"{self}: {len(v)} bytes over limit")
+        return v
+
+
+# ---------------------------------------------------------------- bitfields
+
+
+class Bits:
+    """Bitfield value: numpy bool array with SSZ byte packing."""
+
+    __slots__ = ("array",)
+
+    def __init__(self, array):
+        a = np.array(array, dtype=bool)  # owning copy: frozen below without
+        a.setflags(write=False)          # freezing the caller's buffer
+        object.__setattr__(self, "array", a)
+
+    @classmethod
+    def zeros(cls, n: int) -> "Bits":
+        return cls(np.zeros(n, dtype=bool))
+
+    def __len__(self):
+        return len(self.array)
+
+    def __getitem__(self, i):
+        out = self.array[i]
+        return Bits(out) if isinstance(i, slice) else bool(out)
+
+    def __iter__(self):
+        return iter(bool(b) for b in self.array)
+
+    def __eq__(self, other):
+        return isinstance(other, Bits) and np.array_equal(
+            self.array, other.array)
+
+    def __hash__(self):
+        return hash(self.to_bytes())
+
+    def __repr__(self):
+        return f"Bits({''.join('1' if b else '0' for b in self.array)})"
+
+    def set(self, i: int, v: bool = True) -> "Bits":
+        a = self.array.copy()
+        a[i] = v
+        return Bits(a)
+
+    def count(self) -> int:
+        return int(np.count_nonzero(self.array))
+
+    def any(self) -> bool:
+        return bool(self.array.any())
+
+    def nonzero_indices(self) -> np.ndarray:
+        return np.nonzero(self.array)[0]
+
+    def union(self, other: "Bits") -> "Bits":
+        return Bits(self.array | other.array)
+
+    def intersects(self, other: "Bits") -> bool:
+        return bool((self.array & other.array).any())
+
+    def covers(self, other: "Bits") -> bool:
+        """self is a superset of other's set bits."""
+        return bool((other.array & ~self.array).sum() == 0)
+
+    def to_bytes(self) -> bytes:
+        return np.packbits(self.array, bitorder="little").tobytes()
+
+    @classmethod
+    def from_bytes(cls, data: bytes, n: int) -> "Bits":
+        bits = np.unpackbits(np.frombuffer(data, np.uint8),
+                             bitorder="little")
+        return cls(bits[:n])
+
+
+class Bitvector(SszType):
+    __slots__ = ("length",)
+    _cache: dict = {}
+
+    def __new__(cls, length: int):
+        hit = cls._cache.get(length)
+        if hit is None:
+            hit = super().__new__(cls)
+            hit.length = length
+            cls._cache[length] = hit
+        return hit
+
+    def __repr__(self):
+        return f"Bitvector[{self.length}]"
+
+    def is_fixed(self):
+        return True
+
+    def fixed_size(self):
+        return (self.length + 7) // 8
+
+    def serialize(self, v: Bits) -> bytes:
+        return v.to_bytes()
+
+    def deserialize(self, data) -> Bits:
+        data = bytes(data)
+        if len(data) != self.fixed_size():
+            raise SszError(f"{self}: got {len(data)} bytes")
+        bits = np.unpackbits(np.frombuffer(data, np.uint8),
+                             bitorder="little")
+        if bits[self.length:].any():
+            raise SszError(f"{self}: nonzero padding bits")
+        return Bits(bits[: self.length])
+
+    def hash_tree_root(self, v: Bits) -> bytes:
+        return hashing.merkleize_chunks(
+            _pad_chunks(v.to_bytes()), (self.length + 255) // 256)
+
+    def default(self):
+        return Bits.zeros(self.length)
+
+    def coerce(self, v):
+        if not isinstance(v, Bits):
+            v = Bits(v)
+        if len(v) != self.length:
+            raise SszError(f"{self}: got {len(v)} bits")
+        return v
+
+
+class Bitlist(SszType):
+    __slots__ = ("limit",)
+    _cache: dict = {}
+
+    def __new__(cls, limit: int):
+        hit = cls._cache.get(limit)
+        if hit is None:
+            hit = super().__new__(cls)
+            hit.limit = limit
+            cls._cache[limit] = hit
+        return hit
+
+    def __repr__(self):
+        return f"Bitlist[{self.limit}]"
+
+    def is_fixed(self):
+        return False
+
+    def serialize(self, v: Bits) -> bytes:
+        a = np.append(v.array, True)  # delimiter bit
+        return np.packbits(a, bitorder="little").tobytes()
+
+    def deserialize(self, data) -> Bits:
+        data = bytes(data)
+        if not data:
+            raise SszError(f"{self}: empty payload (delimiter missing)")
+        if data[-1] == 0:
+            raise SszError(f"{self}: last byte zero (delimiter missing)")
+        bits = np.unpackbits(np.frombuffer(data, np.uint8),
+                             bitorder="little")
+        n = len(bits) - 1 - int(np.argmax(bits[::-1]))  # last set bit
+        if n > self.limit:
+            raise SszError(f"{self}: {n} bits over limit")
+        if len(data) != (n + 8) // 8:
+            raise SszError(f"{self}: length/delimiter mismatch")
+        return Bits(bits[:n])
+
+    def hash_tree_root(self, v: Bits) -> bytes:
+        root = hashing.merkleize_chunks(
+            _pad_chunks(v.to_bytes()), (self.limit + 255) // 256)
+        return hashing.mix_in_length(root, len(v))
+
+    def default(self):
+        return Bits.zeros(0)
+
+    def coerce(self, v):
+        if not isinstance(v, Bits):
+            v = Bits(v)
+        if len(v) > self.limit:
+            raise SszError(f"{self}: {len(v)} bits over limit")
+        return v
+
+
+# ------------------------------------------------------- homogeneous series
+
+
+class _Series:
+    """Shared value wrapper for Vector/List: tuple-backed for composite
+    elements, numpy-backed for packed basic elements. Immutable; caches
+    hash-tree-root and per-element roots."""
+
+    __slots__ = ("typ", "items", "_htr")
+
+    def __init__(self, typ, items):
+        if isinstance(items, np.ndarray):
+            items.setflags(write=False)  # constructors pass owned copies;
+            # freezing keeps .array mutation from invalidating cached roots
+        object.__setattr__(self, "typ", typ)
+        object.__setattr__(self, "items", items)
+        object.__setattr__(self, "_htr", None)
+
+    def __setattr__(self, *_):
+        raise AttributeError(f"{type(self).__name__} is immutable")
+
+    def __len__(self):
+        return len(self.items)
+
+    def __getitem__(self, i):
+        v = self.items[i]
+        if isinstance(i, slice):
+            return list(v)
+        return v.item() if isinstance(v, np.generic) else v
+
+    def __iter__(self):
+        if isinstance(self.items, np.ndarray):
+            return iter(self.items.tolist())
+        return iter(self.items)
+
+    def __eq__(self, other):
+        if not isinstance(other, _Series) or self.typ is not other.typ:
+            return NotImplemented
+        if isinstance(self.items, np.ndarray):
+            return np.array_equal(self.items, other.items)
+        return self.items == other.items
+
+    def __hash__(self):
+        return hash(self.typ.hash_tree_root(self))
+
+    def __repr__(self):
+        inner = ", ".join(repr(x) for x in list(self)[:4])
+        more = f", …×{len(self) - 4}" if len(self) > 4 else ""
+        return f"{self.typ}[{inner}{more}]"
+
+    @property
+    def array(self) -> np.ndarray:
+        """numpy view for vectorized paths (basic element types only)."""
+        return self.items
+
+    def set(self, i: int, v) -> "_Series":
+        v = self.typ.elem.coerce(v)
+        if isinstance(self.items, np.ndarray):
+            a = self.items.copy()
+            a[i] = v
+            return type(self)(self.typ, a)
+        items = list(self.items)
+        items[i] = v
+        return type(self)(self.typ, tuple(items))
+
+    def hash_tree_root(self) -> bytes:
+        r = self._htr
+        if r is None:
+            r = self.typ.hash_tree_root(self)
+            object.__setattr__(self, "_htr", r)
+        return r
+
+
+class SszVector(_Series):
+    __slots__ = ()
+
+
+class SszList(_Series):
+    __slots__ = ()
+
+    def append(self, v) -> "SszList":
+        typ = self.typ
+        if len(self) >= typ.limit:
+            raise SszError(f"{typ}: append over limit")
+        v = typ.elem.coerce(v)
+        if isinstance(self.items, np.ndarray):
+            return SszList(
+                typ,
+                np.append(self.items,
+                          np.asarray(v, dtype=self.items.dtype)))
+        return SszList(typ, self.items + (v,))
+
+
+def _elem_is_packed(elem: SszType) -> bool:
+    return isinstance(elem, (UInt, Boolean))
+
+
+class _SeriesType(SszType):
+    __slots__ = ("elem", "value_cls")
+
+    def _pack_chunks(self, v: _Series) -> bytes:
+        elem = self.elem
+        if isinstance(v.items, np.ndarray):
+            return _pad_chunks(v.items.tobytes())
+        return _pad_chunks(b"".join(elem.serialize(x) for x in v.items))
+
+    def _elem_roots(self, v: _Series) -> bytes:
+        elem = self.elem
+        return b"".join(elem.hash_tree_root(x) for x in v.items)
+
+    def _serialize_items(self, v: _Series) -> bytes:
+        elem = self.elem
+        if isinstance(v.items, np.ndarray):
+            return v.items.tobytes()
+        if elem.is_fixed():
+            return b"".join(elem.serialize(x) for x in v.items)
+        parts = [elem.serialize(x) for x in v.items]
+        offset = OFFSET_SIZE * len(parts)
+        head = bytearray()
+        for p in parts:
+            head += _U32.pack(offset)
+            offset += len(p)
+        return bytes(head) + b"".join(parts)
+
+    def _deserialize_items(self, data, count_limit: int,
+                           exact_count: Optional[int] = None) -> tuple:
+        elem = self.elem
+        data = bytes(data)
+        if elem.is_fixed():
+            size = elem.fixed_size()
+            if exact_count is not None:
+                if len(data) != size * exact_count:
+                    raise SszError(
+                        f"{self}: expected {size * exact_count} bytes, "
+                        f"got {len(data)}")
+                n = exact_count
+            else:
+                if len(data) % size:
+                    raise SszError(f"{self}: length not a multiple of {size}")
+                n = len(data) // size
+                if n > count_limit:
+                    raise SszError(f"{self}: {n} elements over limit")
+            if elem.np_dtype is not None:
+                arr = np.frombuffer(data, elem.np_dtype)
+                if isinstance(elem, Boolean) and not np.isin(
+                        arr, (0, 1)).all():
+                    raise SszError(f"{self}: invalid boolean")
+                return arr.copy()
+            return tuple(
+                elem.deserialize(data[size * i: size * (i + 1)])
+                for i in range(n))
+        # variable-size elements: offset table
+        if not data:
+            if exact_count not in (None, 0):
+                raise SszError(f"{self}: empty data for {exact_count} items")
+            return ()
+        if len(data) < OFFSET_SIZE:
+            raise SszError(f"{self}: truncated offset table")
+        first = _U32.unpack_from(data, 0)[0]
+        if first % OFFSET_SIZE or first == 0:
+            raise SszError(f"{self}: bad first offset {first}")
+        n = first // OFFSET_SIZE
+        if n > count_limit or (exact_count is not None and n != exact_count):
+            raise SszError(f"{self}: bad element count {n}")
+        if len(data) < first:
+            raise SszError(f"{self}: truncated offsets")
+        offsets = list(struct.unpack_from(f"<{n}I", data, 0)) + [len(data)]
+        out = []
+        for i in range(n):
+            if not first <= offsets[i] <= offsets[i + 1] <= len(data):
+                raise SszError(f"{self}: non-monotonic offsets")
+            out.append(elem.deserialize(data[offsets[i]: offsets[i + 1]]))
+        return tuple(out)
+
+    def _coerce_items(self, items) -> Any:
+        elem = self.elem
+        if isinstance(items, _Series):
+            items = items.items
+        if _elem_is_packed(elem) and elem.np_dtype is not None:
+            if isinstance(items, np.ndarray) and items.dtype == elem.np_dtype:
+                return items.copy()
+            return np.array([elem.coerce(x) for x in items],
+                            dtype=elem.np_dtype)
+        return tuple(elem.coerce(x) for x in items)
+
+
+class _VectorType(_SeriesType):
+    __slots__ = ("length",)
+    _cache: dict = {}
+
+    def __new__(cls, elem: SszType, length: int):
+        key = (id(elem), length)
+        hit = cls._cache.get(key)
+        if hit is None:
+            hit = object.__new__(cls)
+            hit.elem = elem
+            hit.length = length
+            cls._cache[key] = hit
+        return hit
+
+    def __repr__(self):
+        return f"Vector[{self.elem}, {self.length}]"
+
+    def is_fixed(self):
+        return self.elem.is_fixed()
+
+    def fixed_size(self):
+        return self.elem.fixed_size() * self.length
+
+    def serialize(self, v) -> bytes:
+        return self._serialize_items(v)
+
+    def deserialize(self, data) -> SszVector:
+        items = self._deserialize_items(data, self.length, self.length)
+        return SszVector(self, items)
+
+    def hash_tree_root(self, v) -> bytes:
+        if isinstance(v, _Series) and v._htr is not None:
+            return v._htr
+        if _elem_is_packed(self.elem):
+            size = self.elem.fixed_size()
+            limit = (self.length * size + 31) // 32
+            root = hashing.merkleize_chunks(self._pack_chunks(v), limit)
+        else:
+            root = hashing.merkleize_chunks(
+                self._elem_roots(v), self.length)
+        if isinstance(v, _Series):
+            object.__setattr__(v, "_htr", root)
+        return root
+
+    def default(self) -> SszVector:
+        elem = self.elem
+        if _elem_is_packed(elem) and elem.np_dtype is not None:
+            return SszVector(self, np.zeros(self.length, elem.np_dtype))
+        return SszVector(
+            self, tuple(elem.default() for _ in range(self.length)))
+
+    def coerce(self, v) -> SszVector:
+        if isinstance(v, SszVector) and v.typ is self:
+            return v
+        items = self._coerce_items(v)
+        if len(items) != self.length:
+            raise SszError(f"{self}: got {len(items)} elements")
+        return SszVector(self, items)
+
+
+class _ListType(_SeriesType):
+    __slots__ = ("limit",)
+    _cache: dict = {}
+
+    def __new__(cls, elem: SszType, limit: int):
+        key = (id(elem), limit)
+        hit = cls._cache.get(key)
+        if hit is None:
+            hit = object.__new__(cls)
+            hit.elem = elem
+            hit.limit = limit
+            cls._cache[key] = hit
+        return hit
+
+    def __repr__(self):
+        return f"List[{self.elem}, {self.limit}]"
+
+    def is_fixed(self):
+        return False
+
+    def serialize(self, v) -> bytes:
+        return self._serialize_items(v)
+
+    def deserialize(self, data) -> SszList:
+        items = self._deserialize_items(data, self.limit)
+        return SszList(self, items)
+
+    def hash_tree_root(self, v) -> bytes:
+        if isinstance(v, _Series) and v._htr is not None:
+            return v._htr
+        if _elem_is_packed(self.elem):
+            size = self.elem.fixed_size()
+            limit = (self.limit * size + 31) // 32
+            body = hashing.merkleize_chunks(self._pack_chunks(v), limit)
+        else:
+            body = hashing.merkleize_chunks(self._elem_roots(v), self.limit)
+        root = hashing.mix_in_length(body, len(v))
+        if isinstance(v, _Series):
+            object.__setattr__(v, "_htr", root)
+        return root
+
+    def default(self) -> SszList:
+        elem = self.elem
+        if _elem_is_packed(elem) and elem.np_dtype is not None:
+            return SszList(self, np.zeros(0, elem.np_dtype))
+        return SszList(self, ())
+
+    def coerce(self, v) -> SszList:
+        if isinstance(v, SszList) and v.typ is self:
+            return v
+        items = self._coerce_items(v)
+        if len(items) > self.limit:
+            raise SszError(f"{self}: {len(items)} elements over limit")
+        return SszList(self, items)
+
+
+def Vector(elem: SszType, length: int) -> _VectorType:
+    return _VectorType(elem, length)
+
+
+def List(elem: SszType, limit: int) -> _ListType:
+    return _ListType(elem, limit)
+
+
+# ----------------------------------------------------------------- container
+
+
+class ContainerMeta(type):
+    """Makes each Container subclass double as its own SSZ type descriptor.
+
+    NOTE on lookup: names defined in the Container class body (serialize,
+    hash_tree_root — called generically as `typ.op(value)` with the value as
+    sole argument) shadow the metaclass; descriptor ops with no instance-
+    level counterpart (is_fixed, deserialize, default, coerce) live here.
+    """
+
+    def __new__(mcs, name, bases, ns):
+        fields = []
+        for base in bases:
+            fields += getattr(base, "FIELDS", [])
+        own = ns.get("__annotations__", {})
+        own_fields = [
+            (fname, ftyp) for fname, ftyp in own.items()
+            if isinstance(ftyp, (SszType, ContainerMeta))]
+        ns["FIELDS"] = tuple(fields + own_fields)
+        ns["__slots__"] = tuple(ns.get("__slots__", ())) + tuple(
+            fname for fname, _ in own_fields)
+        return super().__new__(mcs, name, bases, ns)
+
+    def is_fixed(cls):
+        return all(t.is_fixed() for _, t in cls.FIELDS)
+
+    def fixed_size(cls):
+        return sum(t.fixed_size() for _, t in cls.FIELDS)
+
+    def deserialize(cls, data):
+        data = bytes(data)
+        kwargs = {}
+        var_fields = []
+        offsets = []
+        pos = 0
+        fixed_len = sum(
+            t.fixed_size() if t.is_fixed() else OFFSET_SIZE
+            for _, t in cls.FIELDS)
+        if len(data) < fixed_len:
+            raise SszError(f"{cls.__name__}: truncated ({len(data)} bytes)")
+        for fname, ftyp in cls.FIELDS:
+            if ftyp.is_fixed():
+                size = ftyp.fixed_size()
+                kwargs[fname] = ftyp.deserialize(data[pos: pos + size])
+                pos += size
+            else:
+                offsets.append(_U32.unpack_from(data, pos)[0])
+                var_fields.append((fname, ftyp))
+                pos += OFFSET_SIZE
+        if var_fields:
+            if offsets[0] != fixed_len:
+                raise SszError(f"{cls.__name__}: bad first offset")
+            offsets.append(len(data))
+            for i, (fname, ftyp) in enumerate(var_fields):
+                if not offsets[i] <= offsets[i + 1] <= len(data):
+                    raise SszError(f"{cls.__name__}: non-monotonic offsets")
+                kwargs[fname] = ftyp.deserialize(
+                    data[offsets[i]: offsets[i + 1]])
+        elif len(data) != fixed_len:
+            raise SszError(f"{cls.__name__}: trailing bytes")
+        return cls(**kwargs)
+
+    def default(cls):
+        return cls()
+
+    def coerce(cls, v):
+        if isinstance(v, cls):
+            return v
+        raise SszError(f"expected {cls.__name__}, got {type(v).__name__}")
+
+    @property
+    def np_dtype(cls):
+        return None
+
+
+class Container(metaclass=ContainerMeta):
+    """Base for SSZ containers. Fields are class annotations whose values
+    are SSZ type descriptors (or Container subclasses). Instances are
+    immutable; `replace()` derives modified copies; hash-tree-root is
+    computed once and cached."""
+
+    __slots__ = ("_htr",)
+
+    def __init__(self, **kwargs):
+        cls = type(self)
+        for fname, ftyp in cls.FIELDS:
+            if fname in kwargs:
+                val = ftyp.coerce(kwargs.pop(fname))
+            else:
+                val = ftyp.default()
+            object.__setattr__(self, fname, val)
+        if kwargs:
+            raise SszError(
+                f"{cls.__name__}: unknown fields {sorted(kwargs)}")
+        object.__setattr__(self, "_htr", None)
+
+    def __setattr__(self, *_):
+        raise AttributeError(f"{type(self).__name__} is immutable")
+
+    def __eq__(self, other):
+        if type(other) is not type(self):
+            return NotImplemented
+        return all(
+            _veq(getattr(self, f), getattr(other, f))
+            for f, _ in type(self).FIELDS)
+
+    def __hash__(self):
+        return hash(self.hash_tree_root())
+
+    def __repr__(self):
+        cls = type(self)
+        inner = ", ".join(
+            f"{f}={getattr(self, f)!r}" for f, _ in cls.FIELDS[:3])
+        more = ", …" if len(cls.FIELDS) > 3 else ""
+        return f"{cls.__name__}({inner}{more})"
+
+    def replace(self, **kwargs) -> "Container":
+        cls = type(self)
+        new = object.__new__(cls)
+        for fname, ftyp in cls.FIELDS:
+            if fname in kwargs:
+                val = ftyp.coerce(kwargs.pop(fname))
+            else:
+                val = getattr(self, fname)
+            object.__setattr__(new, fname, val)
+        if kwargs:
+            raise SszError(f"{cls.__name__}: unknown fields {sorted(kwargs)}")
+        object.__setattr__(new, "_htr", None)
+        return new
+
+    def hash_tree_root(self) -> bytes:
+        r = self._htr
+        if r is None:
+            cls = type(self)
+            roots = b"".join(
+                ftyp.hash_tree_root(getattr(self, fname))
+                for fname, ftyp in cls.FIELDS)
+            r = hashing.merkleize_chunks(roots, len(cls.FIELDS))
+            object.__setattr__(self, "_htr", r)
+        return r
+
+    def serialize(self) -> bytes:
+        cls = type(self)
+        head = bytearray()
+        tail = bytearray()
+        fixed_len = sum(
+            t.fixed_size() if t.is_fixed() else OFFSET_SIZE
+            for _, t in cls.FIELDS)
+        for fname, ftyp in cls.FIELDS:
+            val = getattr(self, fname)
+            if ftyp.is_fixed():
+                head += ftyp.serialize(val)
+            else:
+                head += _U32.pack(fixed_len + len(tail))
+                tail += ftyp.serialize(val)
+        return bytes(head + tail)
+
+
+def _veq(a, b) -> bool:
+    if isinstance(a, np.ndarray) or isinstance(b, np.ndarray):
+        return np.array_equal(a, b)
+    return a == b

@@ -1,0 +1,103 @@
+"""Merkle proofs and the incremental left-filled tree.
+
+Reference parity: ssz/src/merkle_tree.rs (proof construction) and the
+`deposit_tree` crate (incremental deposit Merkle tree, depth 32 with a
+length mixin — deposit_tree/src/lib.rs).
+"""
+
+from typing import Sequence
+
+from grandine_tpu.core import hashing
+
+
+def verify_merkle_proof(leaf: bytes, branch: Sequence[bytes], depth: int,
+                        index: int, root: bytes) -> bool:
+    """Spec `is_valid_merkle_branch`."""
+    if len(branch) < depth:
+        return False
+    node = leaf
+    for i in range(depth):
+        if (index >> i) & 1:
+            node = hashing.hash_pair(branch[i], node)
+        else:
+            node = hashing.hash_pair(node, branch[i])
+    return node == root
+
+
+class MerkleTree:
+    """Incremental left-filled binary Merkle tree of fixed depth.
+
+    Only O(depth) state is kept (the left-edge frontier), like the
+    reference's deposit tree: appending leaf i updates the frontier and the
+    proof for any *past* leaf can be produced if `track` retained it.
+    """
+
+    __slots__ = ("depth", "count", "_frontier", "_full_root", "_leaves")
+
+    def __init__(self, depth: int, track_leaves: bool = False):
+        self.depth = depth
+        self.count = 0
+        self._frontier: list = [None] * depth
+        self._full_root: bytes | None = None
+        self._leaves: list | None = [] if track_leaves else None
+
+    def push(self, leaf: bytes) -> None:
+        if self.count >= (1 << self.depth):
+            raise ValueError("tree full")
+        if self._leaves is not None:
+            self._leaves.append(leaf)
+        node = leaf
+        index = self.count
+        for i in range(self.depth):
+            if (index >> i) & 1:
+                node = hashing.hash_pair(self._frontier[i], node)
+            else:
+                self._frontier[i] = node
+                break
+        else:
+            # every index bit was 1: the tree just became full and `node`
+            # is the finished root — the frontier has nowhere to hold it
+            self._full_root = node
+        self.count += 1
+
+    def root(self) -> bytes:
+        if self.count == (1 << self.depth):
+            return self._full_root
+        node = hashing.ZERO_HASHES[0]
+        index = self.count
+        for i in range(self.depth):
+            if (index >> i) & 1:
+                node = hashing.hash_pair(self._frontier[i], node)
+            else:
+                node = hashing.hash_pair(node, hashing.ZERO_HASHES[i])
+        return node
+
+    def root_with_length(self) -> bytes:
+        """Deposit-contract style: hash(root ++ le_count) mixin."""
+        return hashing.mix_in_length(self.root(), self.count)
+
+    def proof(self, index: int) -> list:
+        """Branch for leaf `index` against the current root (requires
+        track_leaves=True; rebuilds the path — O(n) but proof generation
+        is a cold path: deposits, API queries)."""
+        if self._leaves is None:
+            raise ValueError("leaf tracking disabled")
+        if not 0 <= index < self.count:
+            raise IndexError(index)
+        level = list(self._leaves)
+        branch = []
+        idx = index
+        for d in range(self.depth):
+            sibling = idx ^ 1
+            if sibling < len(level):
+                branch.append(level[sibling])
+            else:
+                branch.append(hashing.ZERO_HASHES[d])
+            if len(level) % 2:
+                level.append(hashing.ZERO_HASHES[d])
+            level = [
+                hashing.hash_pair(level[i], level[i + 1])
+                for i in range(0, len(level), 2)
+            ]
+            idx >>= 1
+        return branch

@@ -1,0 +1,298 @@
+"""SSZ codec + merkleization tests: roundtrips, strict-decode rejection,
+hand-derived known answers, and an independent naive-hashlib HTR model."""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from grandine_tpu import ssz
+from grandine_tpu.ssz import (
+    Bitlist, Bits, Bitvector, ByteList, ByteVector, Container, List,
+    MerkleTree, SszError, Vector, boolean, uint8, uint16, uint64, uint256,
+    verify_merkle_proof,
+)
+
+Bytes32 = ssz.Bytes32
+
+
+# independent model ---------------------------------------------------------
+
+def naive_merkleize(chunks, limit=None):
+    n = len(chunks)
+    cap = limit if limit is not None else max(n, 1)
+    depth = (cap - 1).bit_length() if cap > 1 else 0
+    level = list(chunks) + [b"\x00" * 32] * ((1 << depth) - n)
+    if not level:
+        level = [b"\x00" * 32]
+    while len(level) > 1:
+        level = [hashlib.sha256(level[i] + level[i + 1]).digest()
+                 for i in range(0, len(level), 2)]
+    return level[0]
+
+
+def mix_len(root, n):
+    return hashlib.sha256(root + n.to_bytes(32, "little")).digest()
+
+
+# basic types ---------------------------------------------------------------
+
+def test_uint_roundtrip_and_htr():
+    assert uint64.serialize(0x0123456789ABCDEF) == bytes.fromhex(
+        "efcdab8967452301")
+    assert uint64.deserialize(b"\x01" + b"\x00" * 7) == 1
+    assert uint64.hash_tree_root(5) == (5).to_bytes(8, "little") + b"\x00" * 24
+    with pytest.raises(SszError):
+        uint8.coerce(256)
+    with pytest.raises(SszError):
+        uint64.deserialize(b"\x00" * 7)
+    assert uint256.serialize(1) == b"\x01" + b"\x00" * 31
+
+
+def test_boolean_strict():
+    assert boolean.deserialize(b"\x01") is True
+    with pytest.raises(SszError):
+        boolean.deserialize(b"\x02")
+
+
+def test_bytevector_bytelist():
+    assert Bytes32.hash_tree_root(b"\xaa" * 32) == b"\xaa" * 32
+    bv48 = ByteVector(48)
+    assert bv48.hash_tree_root(b"\x11" * 48) == hashlib.sha256(
+        b"\x11" * 48 + b"\x00" * 16).digest()
+    bl = ByteList(100)
+    data = b"hello"
+    assert bl.deserialize(bl.serialize(data)) == data
+    assert bl.hash_tree_root(data) == mix_len(
+        naive_merkleize([data.ljust(32, b"\x00")], 4), 5)
+    with pytest.raises(SszError):
+        bl.deserialize(b"\x00" * 101)
+
+
+# bitfields -----------------------------------------------------------------
+
+def test_bitlist_known_bytes():
+    bl8 = Bitlist(8)
+    v = Bits([1, 0, 1])
+    assert bl8.serialize(v) == bytes([0b1101])
+    assert bl8.deserialize(bytes([0b1101])) == v
+    # empty bitlist = just the delimiter
+    assert bl8.serialize(Bits.zeros(0)) == b"\x01"
+    assert len(bl8.deserialize(b"\x01")) == 0
+    with pytest.raises(SszError):
+        bl8.deserialize(b"")  # no delimiter
+    with pytest.raises(SszError):
+        bl8.deserialize(b"\x05\x00")  # trailing zero byte
+    with pytest.raises(SszError):
+        Bitlist(2).deserialize(bytes([0b1101]))  # over limit
+
+
+def test_bitlist_htr():
+    bl = Bitlist(2048)
+    v = Bits([1] * 100)
+    packed = np.packbits(np.ones(100, bool), bitorder="little").tobytes()
+    assert bl.hash_tree_root(v) == mix_len(
+        naive_merkleize([packed.ljust(32, b"\x00")], 8), 100)
+
+
+def test_bitvector():
+    bv = Bitvector(10)
+    v = Bits([1, 0, 0, 0, 0, 0, 0, 0, 1, 1])
+    assert bv.serialize(v) == bytes([0x01, 0x03])
+    assert bv.deserialize(bytes([0x01, 0x03])) == v
+    with pytest.raises(SszError):
+        bv.deserialize(bytes([0x01, 0x0C]))  # padding bits set
+    assert bv.hash_tree_root(v) == bytes([0x01, 0x03]) + b"\x00" * 30
+
+
+def test_bits_ops():
+    a = Bits([1, 0, 1, 0])
+    b = Bits([0, 0, 1, 1])
+    assert a.count() == 2
+    assert a.union(b) == Bits([1, 0, 1, 1])
+    assert a.intersects(b)
+    assert a.union(b).covers(a)
+    assert not a.covers(b)
+    assert list(a.nonzero_indices()) == [0, 2]
+    assert a.set(1) == Bits([1, 1, 1, 0])
+    assert a == Bits([1, 0, 1, 0])  # set() did not mutate
+
+
+# vectors & lists -----------------------------------------------------------
+
+def test_uint64_list_numpy_backed():
+    L = List(uint64, 1024)
+    v = L.coerce([1, 2, 3])
+    assert isinstance(v.items, np.ndarray)
+    assert v.array.dtype == np.uint64
+    assert L.serialize(v) == b"".join(
+        x.to_bytes(8, "little") for x in (1, 2, 3))
+    got = L.deserialize(L.serialize(v))
+    assert got == v
+    packed = b"".join(x.to_bytes(8, "little") for x in (1, 2, 3))
+    assert L.hash_tree_root(v) == mix_len(
+        naive_merkleize([packed.ljust(32, b"\x00")], 256), 3)
+    # set/append are persistent
+    v2 = v.set(0, 99)
+    assert v[0] == 1 and v2[0] == 99
+    v3 = v.append(4)
+    assert len(v3) == 4 and len(v) == 3
+    assert v3[3] == 4 and v3.array.dtype == np.uint64
+    assert L.deserialize(L.serialize(v3)) == v3
+    assert L.serialize(v3)[-8:] == (4).to_bytes(8, "little")
+    with pytest.raises(SszError):
+        L.coerce([1] * 1025)
+    # frozen buffer: the numpy view must not be writable
+    with pytest.raises(ValueError):
+        v.array[0] = 99
+
+
+def test_uint64_vector():
+    V = Vector(uint64, 4)
+    v = V.coerce([5, 6, 7, 8])
+    assert V.fixed_size() == 32
+    assert V.deserialize(V.serialize(v)) == v
+    packed = b"".join(x.to_bytes(8, "little") for x in (5, 6, 7, 8))
+    assert V.hash_tree_root(v) == packed
+    with pytest.raises(SszError):
+        V.coerce([1, 2, 3])
+
+
+def test_composite_vector_htr():
+    V = Vector(Bytes32, 4)
+    roots = [bytes([i]) * 32 for i in range(4)]
+    v = V.coerce(roots)
+    assert V.hash_tree_root(v) == naive_merkleize(roots)
+
+
+# containers ----------------------------------------------------------------
+
+class Checkpoint(Container):
+    epoch: uint64
+    root: Bytes32
+
+
+class Wrapper(Container):
+    a: uint16
+    items: List(uint64, 32)
+    b: Checkpoint
+    blob: ByteList(64)
+
+
+def test_container_fixed_roundtrip_and_htr():
+    cp = Checkpoint(epoch=7, root=b"\x22" * 32)
+    data = cp.serialize()
+    assert data == (7).to_bytes(8, "little") + b"\x22" * 32
+    assert Checkpoint.deserialize(data) == cp
+    assert cp.hash_tree_root() == hashlib.sha256(
+        (7).to_bytes(8, "little") + b"\x00" * 24 + b"\x22" * 32).digest()
+    assert Checkpoint.is_fixed() and Checkpoint.fixed_size() == 40
+
+
+def test_container_variable_roundtrip():
+    w = Wrapper(a=3, items=[10, 20], b=Checkpoint(epoch=1), blob=b"xyz")
+    data = w.serialize()
+    got = Wrapper.deserialize(data)
+    assert got == w
+    assert got.items[1] == 20
+    assert got.b.epoch == 1
+    # naive HTR model
+    expect = naive_merkleize([
+        uint16.hash_tree_root(3),
+        mix_len(naive_merkleize(
+            [(10).to_bytes(8, "little") + (20).to_bytes(8, "little")
+             + b"\x00" * 16], 8), 2),
+        w.b.hash_tree_root(),
+        mix_len(naive_merkleize([b"xyz".ljust(32, b"\x00")], 2), 3),
+    ])
+    assert w.hash_tree_root() == expect
+
+
+def test_container_strict_decode():
+    cp = Checkpoint(epoch=7)
+    with pytest.raises(SszError):
+        Checkpoint.deserialize(cp.serialize() + b"\x00")  # trailing
+    with pytest.raises(SszError):
+        Checkpoint.deserialize(cp.serialize()[:-1])  # truncated
+    w = Wrapper()
+    data = bytearray(w.serialize())
+    data[2] = 0xFF  # corrupt first offset
+    with pytest.raises(SszError):
+        Wrapper.deserialize(bytes(data))
+
+
+def test_container_immutability_and_replace():
+    cp = Checkpoint(epoch=7, root=b"\x22" * 32)
+    with pytest.raises(AttributeError):
+        cp.epoch = 8
+    r0 = cp.hash_tree_root()
+    cp2 = cp.replace(epoch=8)
+    assert cp.epoch == 7 and cp2.epoch == 8
+    assert cp.hash_tree_root() == r0 != cp2.hash_tree_root()
+    with pytest.raises(SszError):
+        cp.replace(bogus=1)
+    with pytest.raises(SszError):
+        Checkpoint(bogus=1)
+
+
+def test_list_of_containers():
+    LC = List(Checkpoint, 8)
+    v = LC.coerce([Checkpoint(epoch=i) for i in range(3)])
+    data = LC.serialize(v)
+    assert LC.deserialize(data) == v
+    assert LC.hash_tree_root(v) == mix_len(
+        naive_merkleize([c.hash_tree_root() for c in v], 8), 3)
+
+
+def test_list_of_variable_elements():
+    LV = List(ByteList(16), 4)
+    v = LV.coerce([b"a", b"", b"abc"])
+    data = LV.serialize(v)
+    assert list(LV.deserialize(data)) == [b"a", b"", b"abc"]
+    # corrupt offset table
+    bad = bytearray(data)
+    bad[0] = 0xFF
+    with pytest.raises(SszError):
+        LV.deserialize(bytes(bad))
+    assert list(LV.deserialize(b"")) == []
+
+
+def test_nested_default():
+    w = Wrapper.default()
+    assert w.a == 0 and len(w.items) == 0 and w.b.epoch == 0
+    assert Wrapper.deserialize(w.serialize()) == w
+
+
+# merkle tree ---------------------------------------------------------------
+
+def test_incremental_merkle_tree():
+    t = MerkleTree(depth=5, track_leaves=True)
+    leaves = [hashlib.sha256(bytes([i])).digest() for i in range(9)]
+    for leaf in leaves:
+        t.push(leaf)
+    assert t.root() == naive_merkleize(leaves, 32)
+    for i in range(9):
+        branch = t.proof(i)
+        assert verify_merkle_proof(leaves[i], branch, 5, i, t.root())
+    assert not verify_merkle_proof(leaves[0], t.proof(1), 5, 0, t.root())
+    assert t.root_with_length() == mix_len(t.root(), 9)
+
+
+def test_merkle_tree_exactly_full():
+    t = MerkleTree(depth=2, track_leaves=True)
+    leaves = [hashlib.sha256(bytes([i])).digest() for i in range(4)]
+    for leaf in leaves:
+        t.push(leaf)
+    assert t.root() == naive_merkleize(leaves, 4)
+    for i in range(4):
+        assert verify_merkle_proof(leaves[i], t.proof(i), 2, i, t.root())
+    with pytest.raises(ValueError):
+        t.push(leaves[0])
+
+
+def test_merkleize_many_validates_length():
+    from grandine_tpu.core import hashing as H
+    with pytest.raises(ValueError):
+        H.merkleize_many(b"", 4, 8, 3)
+    with pytest.raises(ValueError):
+        H.merkleize_many(b"\x00" * (32 * 8 * 4), 4, 8, 2)  # 8 chunks, depth 2
