@@ -1,0 +1,43 @@
+"""Spec types: presets, chain config, and per-fork SSZ containers.
+
+Equivalent of the reference `types` crate (types/src/{preset.rs,config.rs,
+phase0,altair,bellatrix,capella,deneb,combined.rs}).
+
+Usage:
+    from grandine_tpu.types import MAINNET, MINIMAL, spec_types, Phase
+    T = spec_types(MAINNET)          # container classes for every fork
+    state = T.phase0.BeaconState(...)
+    block = T.deneb.SignedBeaconBlock(...)
+"""
+
+from grandine_tpu.types.preset import MAINNET, MINIMAL, Preset
+from grandine_tpu.types.config import Config
+from grandine_tpu.types.primitives import (
+    DOMAIN_AGGREGATE_AND_PROOF,
+    DOMAIN_APPLICATION_MASK,
+    DOMAIN_BEACON_ATTESTER,
+    DOMAIN_BEACON_PROPOSER,
+    DOMAIN_BLS_TO_EXECUTION_CHANGE,
+    DOMAIN_CONTRIBUTION_AND_PROOF,
+    DOMAIN_DEPOSIT,
+    DOMAIN_RANDAO,
+    DOMAIN_SELECTION_PROOF,
+    DOMAIN_SYNC_COMMITTEE,
+    DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF,
+    DOMAIN_VOLUNTARY_EXIT,
+    FAR_FUTURE_EPOCH,
+    GENESIS_EPOCH,
+    GENESIS_SLOT,
+    Phase,
+)
+from grandine_tpu.types.containers import spec_types
+
+__all__ = [
+    "MAINNET", "MINIMAL", "Preset", "Config", "Phase", "spec_types",
+    "FAR_FUTURE_EPOCH", "GENESIS_EPOCH", "GENESIS_SLOT",
+    "DOMAIN_BEACON_PROPOSER", "DOMAIN_BEACON_ATTESTER", "DOMAIN_RANDAO",
+    "DOMAIN_DEPOSIT", "DOMAIN_VOLUNTARY_EXIT", "DOMAIN_SELECTION_PROOF",
+    "DOMAIN_AGGREGATE_AND_PROOF", "DOMAIN_SYNC_COMMITTEE",
+    "DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF", "DOMAIN_CONTRIBUTION_AND_PROOF",
+    "DOMAIN_BLS_TO_EXECUTION_CHANGE", "DOMAIN_APPLICATION_MASK",
+]

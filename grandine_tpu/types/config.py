@@ -1,0 +1,163 @@
+"""Runtime chain configuration (reference: types/src/config.rs — fork
+versions/epochs and network-level parameters, YAML-loadable for custom
+networks)."""
+
+from dataclasses import dataclass, field, fields
+
+from grandine_tpu.types.preset import MAINNET, MINIMAL, Preset, by_name
+from grandine_tpu.types.primitives import FAR_FUTURE_EPOCH, Phase
+
+
+@dataclass(frozen=True)
+class Config:
+    config_name: str = "mainnet"
+    preset_base: str = "mainnet"
+
+    # genesis
+    min_genesis_active_validator_count: int = 16384
+    min_genesis_time: int = 1606824000
+    genesis_fork_version: bytes = bytes.fromhex("00000000")
+    genesis_delay: int = 604800
+
+    # forks
+    altair_fork_version: bytes = bytes.fromhex("01000000")
+    altair_fork_epoch: int = 74240
+    bellatrix_fork_version: bytes = bytes.fromhex("02000000")
+    bellatrix_fork_epoch: int = 144896
+    capella_fork_version: bytes = bytes.fromhex("03000000")
+    capella_fork_epoch: int = 194048
+    deneb_fork_version: bytes = bytes.fromhex("04000000")
+    deneb_fork_epoch: int = 269568
+
+    # time
+    seconds_per_slot: int = 12
+    seconds_per_eth1_block: int = 14
+    min_validator_withdrawability_delay: int = 256
+    shard_committee_period: int = 256
+    eth1_follow_distance: int = 2048
+
+    # validator cycle
+    inactivity_score_bias: int = 4
+    inactivity_score_recovery_rate: int = 16
+    ejection_balance: int = 16 * 10**9
+    min_per_epoch_churn_limit: int = 4
+    churn_limit_quotient: int = 65536
+    max_per_epoch_activation_churn_limit: int = 8
+
+    # transition
+    terminal_total_difficulty: int = 58750000000000000000000
+    terminal_block_hash: bytes = b"\x00" * 32
+    terminal_block_hash_activation_epoch: int = FAR_FUTURE_EPOCH
+
+    # deposit contract
+    deposit_chain_id: int = 1
+    deposit_network_id: int = 1
+    deposit_contract_address: bytes = bytes.fromhex(
+        "00000000219ab540356cbb839cbe05303d7705fa")
+
+    # networking (subset used by services)
+    gossip_max_size: int = 10 * 2**20
+    max_request_blocks: int = 1024
+    max_request_blocks_deneb: int = 128
+    max_request_blob_sidecars: int = 768
+    min_epochs_for_block_requests: int = 33024
+    min_epochs_for_blob_sidecars_requests: int = 4096
+    attestation_subnet_count: int = 64
+    sync_committee_subnet_count: int = 4
+    target_aggregators_per_committee: int = 16
+    epochs_per_subnet_subscription: int = 256
+    attestation_propagation_slot_range: int = 32
+    maximum_gossip_clock_disparity_ms: int = 500
+    blob_sidecar_subnet_count: int = 6
+
+    @property
+    def preset(self) -> Preset:
+        return by_name(self.preset_base)
+
+    # -- fork schedule ------------------------------------------------------
+
+    def fork_epoch(self, phase: Phase) -> int:
+        return {
+            Phase.PHASE0: 0,
+            Phase.ALTAIR: self.altair_fork_epoch,
+            Phase.BELLATRIX: self.bellatrix_fork_epoch,
+            Phase.CAPELLA: self.capella_fork_epoch,
+            Phase.DENEB: self.deneb_fork_epoch,
+        }[phase]
+
+    def fork_version(self, phase: Phase) -> bytes:
+        return {
+            Phase.PHASE0: self.genesis_fork_version,
+            Phase.ALTAIR: self.altair_fork_version,
+            Phase.BELLATRIX: self.bellatrix_fork_version,
+            Phase.CAPELLA: self.capella_fork_version,
+            Phase.DENEB: self.deneb_fork_version,
+        }[phase]
+
+    def phase_at_epoch(self, epoch: int) -> Phase:
+        phase = Phase.PHASE0
+        for p in Phase:
+            if self.fork_epoch(p) <= epoch:
+                phase = p
+        return phase
+
+    def phase_at_slot(self, slot: int) -> Phase:
+        return self.phase_at_epoch(slot // self.preset.SLOTS_PER_EPOCH)
+
+    # -- construction -------------------------------------------------------
+
+    @classmethod
+    def mainnet(cls) -> "Config":
+        return cls()
+
+    @classmethod
+    def minimal(cls) -> "Config":
+        """Minimal-preset interop config with all forks at genesis."""
+        return cls(
+            config_name="minimal",
+            preset_base="minimal",
+            min_genesis_active_validator_count=64,
+            genesis_fork_version=bytes.fromhex("00000001"),
+            altair_fork_version=bytes.fromhex("01000001"),
+            altair_fork_epoch=0,
+            bellatrix_fork_version=bytes.fromhex("02000001"),
+            bellatrix_fork_epoch=0,
+            capella_fork_version=bytes.fromhex("03000001"),
+            capella_fork_epoch=0,
+            deneb_fork_version=bytes.fromhex("04000001"),
+            deneb_fork_epoch=0,
+            seconds_per_slot=6,
+            eth1_follow_distance=16,
+            min_validator_withdrawability_delay=256,
+            shard_committee_period=64,
+            churn_limit_quotient=32,
+            max_per_epoch_activation_churn_limit=4,
+            deposit_chain_id=5,
+            deposit_network_id=5,
+        )
+
+    @classmethod
+    def from_dict(cls, raw: dict) -> "Config":
+        """Load from a consensus-specs-style config mapping (UPPER_SNAKE
+        keys, 0x-hex for bytes), ignoring unknown keys."""
+        known = {f.name: f for f in fields(cls)}
+        kwargs = {}
+        for key, value in raw.items():
+            name = key.lower()
+            if name not in known:
+                continue
+            typ = known[name].type
+            if typ is bytes or known[name].default.__class__ is bytes:
+                if isinstance(value, str):
+                    value = bytes.fromhex(value.removeprefix("0x"))
+            elif isinstance(value, str) and value.isdigit():
+                value = int(value)
+            kwargs[name] = value
+        return cls(**kwargs)
+
+    @classmethod
+    def from_yaml(cls, path: str) -> "Config":
+        import yaml
+
+        with open(path) as f:
+            return cls.from_dict(yaml.safe_load(f))

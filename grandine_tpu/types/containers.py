@@ -1,0 +1,469 @@
+"""Per-fork SSZ containers, built per preset.
+
+Reference parity: types/src/{phase0,altair,bellatrix,capella,deneb}/
+containers.rs. The reference makes containers generic over a `Preset` type
+parameter; here a cached factory builds concrete container classes per
+preset, with later forks composing earlier forks' field dicts (field order
+is the spec's — altair *replaces* the pending-attestation state fields,
+later forks append).
+
+Access through `spec_types(preset)`:
+    T = spec_types(MAINNET)
+    T.phase0.BeaconState, T.deneb.SignedBeaconBlock, T.capella.Withdrawal...
+"""
+
+from types import SimpleNamespace
+
+from grandine_tpu.ssz import (
+    Bitlist,
+    Bitvector,
+    ByteList,
+    ByteVector,
+    Bytes4,
+    Bytes20,
+    Bytes32,
+    Bytes48,
+    Bytes96,
+    Container,
+    List,
+    Vector,
+    boolean,
+    uint8,
+    uint64,
+    uint256,
+)
+from grandine_tpu.ssz.base import ContainerMeta
+from grandine_tpu.types.preset import Preset
+from grandine_tpu.types.primitives import (
+    DEPOSIT_CONTRACT_TREE_DEPTH,
+    JUSTIFICATION_BITS_LENGTH,
+)
+
+BYTES_PER_FIELD_ELEMENT = 32
+
+
+def _container(name: str, fields: dict) -> ContainerMeta:
+    return ContainerMeta(name, (Container,), {"__annotations__": dict(fields)})
+
+
+def _build(p: Preset) -> SimpleNamespace:
+    ns = SimpleNamespace(preset=p)
+
+    # ---------------------------------------------------------------- phase0
+    Fork = _container("Fork", dict(
+        previous_version=Bytes4, current_version=Bytes4, epoch=uint64))
+    ForkData = _container("ForkData", dict(
+        current_version=Bytes4, genesis_validators_root=Bytes32))
+    Checkpoint = _container("Checkpoint", dict(epoch=uint64, root=Bytes32))
+    Validator = _container("Validator", dict(
+        pubkey=Bytes48,
+        withdrawal_credentials=Bytes32,
+        effective_balance=uint64,
+        slashed=boolean,
+        activation_eligibility_epoch=uint64,
+        activation_epoch=uint64,
+        exit_epoch=uint64,
+        withdrawable_epoch=uint64,
+    ))
+    AttestationData = _container("AttestationData", dict(
+        slot=uint64,
+        index=uint64,
+        beacon_block_root=Bytes32,
+        source=Checkpoint,
+        target=Checkpoint,
+    ))
+    IndexedAttestation = _container("IndexedAttestation", dict(
+        attesting_indices=List(uint64, p.MAX_VALIDATORS_PER_COMMITTEE),
+        data=AttestationData,
+        signature=Bytes96,
+    ))
+    PendingAttestation = _container("PendingAttestation", dict(
+        aggregation_bits=Bitlist(p.MAX_VALIDATORS_PER_COMMITTEE),
+        data=AttestationData,
+        inclusion_delay=uint64,
+        proposer_index=uint64,
+    ))
+    Eth1Data = _container("Eth1Data", dict(
+        deposit_root=Bytes32, deposit_count=uint64, block_hash=Bytes32))
+    HistoricalBatch = _container("HistoricalBatch", dict(
+        block_roots=Vector(Bytes32, p.SLOTS_PER_HISTORICAL_ROOT),
+        state_roots=Vector(Bytes32, p.SLOTS_PER_HISTORICAL_ROOT),
+    ))
+    DepositMessage = _container("DepositMessage", dict(
+        pubkey=Bytes48, withdrawal_credentials=Bytes32, amount=uint64))
+    DepositData = _container("DepositData", dict(
+        pubkey=Bytes48,
+        withdrawal_credentials=Bytes32,
+        amount=uint64,
+        signature=Bytes96,
+    ))
+    BeaconBlockHeader = _container("BeaconBlockHeader", dict(
+        slot=uint64,
+        proposer_index=uint64,
+        parent_root=Bytes32,
+        state_root=Bytes32,
+        body_root=Bytes32,
+    ))
+    SigningData = _container("SigningData", dict(
+        object_root=Bytes32, domain=Bytes32))
+    SignedBeaconBlockHeader = _container("SignedBeaconBlockHeader", dict(
+        message=BeaconBlockHeader, signature=Bytes96))
+    ProposerSlashing = _container("ProposerSlashing", dict(
+        signed_header_1=SignedBeaconBlockHeader,
+        signed_header_2=SignedBeaconBlockHeader,
+    ))
+    AttesterSlashing = _container("AttesterSlashing", dict(
+        attestation_1=IndexedAttestation, attestation_2=IndexedAttestation))
+    Attestation = _container("Attestation", dict(
+        aggregation_bits=Bitlist(p.MAX_VALIDATORS_PER_COMMITTEE),
+        data=AttestationData,
+        signature=Bytes96,
+    ))
+    Deposit = _container("Deposit", dict(
+        proof=Vector(Bytes32, DEPOSIT_CONTRACT_TREE_DEPTH + 1),
+        data=DepositData,
+    ))
+    VoluntaryExit = _container("VoluntaryExit", dict(
+        epoch=uint64, validator_index=uint64))
+    SignedVoluntaryExit = _container("SignedVoluntaryExit", dict(
+        message=VoluntaryExit, signature=Bytes96))
+    AggregateAndProof = _container("AggregateAndProof", dict(
+        aggregator_index=uint64,
+        aggregate=Attestation,
+        selection_proof=Bytes96,
+    ))
+    SignedAggregateAndProof = _container("SignedAggregateAndProof", dict(
+        message=AggregateAndProof, signature=Bytes96))
+
+    _phase0_body_fields = dict(
+        randao_reveal=Bytes96,
+        eth1_data=Eth1Data,
+        graffiti=Bytes32,
+        proposer_slashings=List(ProposerSlashing, p.MAX_PROPOSER_SLASHINGS),
+        attester_slashings=List(AttesterSlashing, p.MAX_ATTESTER_SLASHINGS),
+        attestations=List(Attestation, p.MAX_ATTESTATIONS),
+        deposits=List(Deposit, p.MAX_DEPOSITS),
+        voluntary_exits=List(SignedVoluntaryExit, p.MAX_VOLUNTARY_EXITS),
+    )
+
+    _state_prefix = lambda: dict(  # noqa: E731 — shared leading fields
+        genesis_time=uint64,
+        genesis_validators_root=Bytes32,
+        slot=uint64,
+        fork=Fork,
+        latest_block_header=BeaconBlockHeader,
+        block_roots=Vector(Bytes32, p.SLOTS_PER_HISTORICAL_ROOT),
+        state_roots=Vector(Bytes32, p.SLOTS_PER_HISTORICAL_ROOT),
+        historical_roots=List(Bytes32, p.HISTORICAL_ROOTS_LIMIT),
+        eth1_data=Eth1Data,
+        eth1_data_votes=List(
+            Eth1Data, p.EPOCHS_PER_ETH1_VOTING_PERIOD * p.SLOTS_PER_EPOCH),
+        eth1_deposit_index=uint64,
+        validators=List(Validator, p.VALIDATOR_REGISTRY_LIMIT),
+        balances=List(uint64, p.VALIDATOR_REGISTRY_LIMIT),
+        randao_mixes=Vector(Bytes32, p.EPOCHS_PER_HISTORICAL_VECTOR),
+        slashings=Vector(uint64, p.EPOCHS_PER_SLASHINGS_VECTOR),
+    )
+    _justice_suffix = dict(
+        justification_bits=Bitvector(JUSTIFICATION_BITS_LENGTH),
+        previous_justified_checkpoint=Checkpoint,
+        current_justified_checkpoint=Checkpoint,
+        finalized_checkpoint=Checkpoint,
+    )
+
+    def _block_types(body_cls, prefix=""):
+        block = _container(prefix + "BeaconBlock", dict(
+            slot=uint64,
+            proposer_index=uint64,
+            parent_root=Bytes32,
+            state_root=Bytes32,
+            body=body_cls,
+        ))
+        signed = _container("Signed" + prefix + "BeaconBlock", dict(
+            message=block, signature=Bytes96))
+        return block, signed
+
+    ph = SimpleNamespace(
+        Fork=Fork, ForkData=ForkData, Checkpoint=Checkpoint,
+        Validator=Validator, AttestationData=AttestationData,
+        IndexedAttestation=IndexedAttestation,
+        PendingAttestation=PendingAttestation, Eth1Data=Eth1Data,
+        HistoricalBatch=HistoricalBatch, DepositMessage=DepositMessage,
+        DepositData=DepositData, BeaconBlockHeader=BeaconBlockHeader,
+        SigningData=SigningData,
+        SignedBeaconBlockHeader=SignedBeaconBlockHeader,
+        ProposerSlashing=ProposerSlashing, AttesterSlashing=AttesterSlashing,
+        Attestation=Attestation, Deposit=Deposit,
+        VoluntaryExit=VoluntaryExit, SignedVoluntaryExit=SignedVoluntaryExit,
+        AggregateAndProof=AggregateAndProof,
+        SignedAggregateAndProof=SignedAggregateAndProof,
+    )
+    ph.BeaconBlockBody = _container("BeaconBlockBody", _phase0_body_fields)
+    ph.BeaconBlock, ph.SignedBeaconBlock = _block_types(ph.BeaconBlockBody)
+    ph.BeaconState = _container("BeaconState", {
+        **_state_prefix(),
+        "previous_epoch_attestations": List(
+            PendingAttestation, p.MAX_ATTESTATIONS * p.SLOTS_PER_EPOCH),
+        "current_epoch_attestations": List(
+            PendingAttestation, p.MAX_ATTESTATIONS * p.SLOTS_PER_EPOCH),
+        **_justice_suffix,
+    })
+    ns.phase0 = ph
+
+    # ---------------------------------------------------------------- altair
+    SyncCommittee = _container("SyncCommittee", dict(
+        pubkeys=Vector(Bytes48, p.SYNC_COMMITTEE_SIZE),
+        aggregate_pubkey=Bytes48,
+    ))
+    SyncAggregate = _container("SyncAggregate", dict(
+        sync_committee_bits=Bitvector(p.SYNC_COMMITTEE_SIZE),
+        sync_committee_signature=Bytes96,
+    ))
+    SyncCommitteeMessage = _container("SyncCommitteeMessage", dict(
+        slot=uint64,
+        beacon_block_root=Bytes32,
+        validator_index=uint64,
+        signature=Bytes96,
+    ))
+    SyncCommitteeContribution = _container("SyncCommitteeContribution", dict(
+        slot=uint64,
+        beacon_block_root=Bytes32,
+        subcommittee_index=uint64,
+        aggregation_bits=Bitvector(p.SYNC_COMMITTEE_SIZE // 4),
+        signature=Bytes96,
+    ))
+    ContributionAndProof = _container("ContributionAndProof", dict(
+        aggregator_index=uint64,
+        contribution=SyncCommitteeContribution,
+        selection_proof=Bytes96,
+    ))
+    SignedContributionAndProof = _container(
+        "SignedContributionAndProof", dict(
+            message=ContributionAndProof, signature=Bytes96))
+    SyncAggregatorSelectionData = _container(
+        "SyncAggregatorSelectionData", dict(
+            slot=uint64, subcommittee_index=uint64))
+
+    _altair_body_fields = dict(
+        **_phase0_body_fields, sync_aggregate=SyncAggregate)
+    _participation = dict(
+        previous_epoch_participation=List(
+            uint8, p.VALIDATOR_REGISTRY_LIMIT),
+        current_epoch_participation=List(uint8, p.VALIDATOR_REGISTRY_LIMIT),
+    )
+    _altair_state_suffix = dict(
+        inactivity_scores=List(uint64, p.VALIDATOR_REGISTRY_LIMIT),
+        current_sync_committee=SyncCommittee,
+        next_sync_committee=SyncCommittee,
+    )
+
+    al = SimpleNamespace(
+        **vars(ph),
+        SyncCommittee=SyncCommittee,
+        SyncAggregate=SyncAggregate,
+        SyncCommitteeMessage=SyncCommitteeMessage,
+        SyncCommitteeContribution=SyncCommitteeContribution,
+        ContributionAndProof=ContributionAndProof,
+        SignedContributionAndProof=SignedContributionAndProof,
+        SyncAggregatorSelectionData=SyncAggregatorSelectionData,
+    )
+    al.BeaconBlockBody = _container("BeaconBlockBody", _altair_body_fields)
+    al.BeaconBlock, al.SignedBeaconBlock = _block_types(al.BeaconBlockBody)
+    al.BeaconState = _container("BeaconState", {
+        **_state_prefix(), **_participation, **_justice_suffix,
+        **_altair_state_suffix,
+    })
+    ns.altair = al
+
+    # ------------------------------------------------------------- bellatrix
+    Transaction = ByteList(p.MAX_BYTES_PER_TRANSACTION)
+    _payload_prefix = dict(
+        parent_hash=Bytes32,
+        fee_recipient=Bytes20,
+        state_root=Bytes32,
+        receipts_root=Bytes32,
+        logs_bloom=ByteVector(p.BYTES_PER_LOGS_BLOOM),
+        prev_randao=Bytes32,
+        block_number=uint64,
+        gas_limit=uint64,
+        gas_used=uint64,
+        timestamp=uint64,
+        extra_data=ByteList(p.MAX_EXTRA_DATA_BYTES),
+        base_fee_per_gas=uint256,
+        block_hash=Bytes32,
+    )
+    ExecutionPayload = _container("ExecutionPayload", {
+        **_payload_prefix,
+        "transactions": List(Transaction, p.MAX_TRANSACTIONS_PER_PAYLOAD),
+    })
+    ExecutionPayloadHeader = _container("ExecutionPayloadHeader", {
+        **_payload_prefix, "transactions_root": Bytes32})
+    PowBlock = _container("PowBlock", dict(
+        block_hash=Bytes32, parent_hash=Bytes32,
+        total_difficulty=uint256))
+
+    be = SimpleNamespace(
+        **vars(al),
+        Transaction=Transaction,
+        ExecutionPayload=ExecutionPayload,
+        ExecutionPayloadHeader=ExecutionPayloadHeader,
+        PowBlock=PowBlock,
+    )
+    be.BeaconBlockBody = _container("BeaconBlockBody", dict(
+        **_altair_body_fields, execution_payload=ExecutionPayload))
+    be.BlindedBeaconBlockBody = _container("BlindedBeaconBlockBody", dict(
+        **_altair_body_fields, execution_payload_header=ExecutionPayloadHeader))
+    be.BeaconBlock, be.SignedBeaconBlock = _block_types(be.BeaconBlockBody)
+    be.BlindedBeaconBlock, be.SignedBlindedBeaconBlock = _block_types(
+        be.BlindedBeaconBlockBody, "Blinded")
+    be.BeaconState = _container("BeaconState", {
+        **_state_prefix(), **_participation, **_justice_suffix,
+        **_altair_state_suffix,
+        "latest_execution_payload_header": ExecutionPayloadHeader,
+    })
+    ns.bellatrix = be
+
+    # --------------------------------------------------------------- capella
+    Withdrawal = _container("Withdrawal", dict(
+        index=uint64, validator_index=uint64, address=Bytes20, amount=uint64))
+    BLSToExecutionChange = _container("BLSToExecutionChange", dict(
+        validator_index=uint64,
+        from_bls_pubkey=Bytes48,
+        to_execution_address=Bytes20,
+    ))
+    SignedBLSToExecutionChange = _container(
+        "SignedBLSToExecutionChange", dict(
+            message=BLSToExecutionChange, signature=Bytes96))
+    HistoricalSummary = _container("HistoricalSummary", dict(
+        block_summary_root=Bytes32, state_summary_root=Bytes32))
+
+    CapellaExecutionPayload = _container("ExecutionPayload", {
+        **_payload_prefix,
+        "transactions": List(Transaction, p.MAX_TRANSACTIONS_PER_PAYLOAD),
+        "withdrawals": List(Withdrawal, p.MAX_WITHDRAWALS_PER_PAYLOAD),
+    })
+    CapellaExecutionPayloadHeader = _container("ExecutionPayloadHeader", {
+        **_payload_prefix,
+        "transactions_root": Bytes32,
+        "withdrawals_root": Bytes32,
+    })
+
+    ca = SimpleNamespace(
+        **vars(be),
+        Withdrawal=Withdrawal,
+        BLSToExecutionChange=BLSToExecutionChange,
+        SignedBLSToExecutionChange=SignedBLSToExecutionChange,
+        HistoricalSummary=HistoricalSummary,
+    )
+    ca.ExecutionPayload = CapellaExecutionPayload
+    ca.ExecutionPayloadHeader = CapellaExecutionPayloadHeader
+    _capella_body_fields = dict(
+        **_altair_body_fields,
+        execution_payload=CapellaExecutionPayload,
+        bls_to_execution_changes=List(
+            SignedBLSToExecutionChange, p.MAX_BLS_TO_EXECUTION_CHANGES),
+    )
+    _capella_blinded_fields = dict(
+        **_altair_body_fields,
+        execution_payload_header=CapellaExecutionPayloadHeader,
+        bls_to_execution_changes=List(
+            SignedBLSToExecutionChange, p.MAX_BLS_TO_EXECUTION_CHANGES),
+    )
+    ca.BeaconBlockBody = _container("BeaconBlockBody", _capella_body_fields)
+    ca.BlindedBeaconBlockBody = _container(
+        "BlindedBeaconBlockBody", _capella_blinded_fields)
+    ca.BeaconBlock, ca.SignedBeaconBlock = _block_types(ca.BeaconBlockBody)
+    ca.BlindedBeaconBlock, ca.SignedBlindedBeaconBlock = _block_types(
+        ca.BlindedBeaconBlockBody, "Blinded")
+    _capella_state_suffix = dict(
+        next_withdrawal_index=uint64,
+        next_withdrawal_validator_index=uint64,
+        historical_summaries=List(
+            HistoricalSummary, p.HISTORICAL_ROOTS_LIMIT),
+    )
+    ca.BeaconState = _container("BeaconState", {
+        **_state_prefix(), **_participation, **_justice_suffix,
+        **_altair_state_suffix,
+        "latest_execution_payload_header": CapellaExecutionPayloadHeader,
+        **_capella_state_suffix,
+    })
+    ns.capella = ca
+
+    # ----------------------------------------------------------------- deneb
+    KZGCommitment = Bytes48
+    KZGProof = Bytes48
+    Blob = ByteVector(BYTES_PER_FIELD_ELEMENT * p.FIELD_ELEMENTS_PER_BLOB)
+
+    DenebExecutionPayload = _container("ExecutionPayload", {
+        **_payload_prefix,
+        "transactions": List(Transaction, p.MAX_TRANSACTIONS_PER_PAYLOAD),
+        "withdrawals": List(Withdrawal, p.MAX_WITHDRAWALS_PER_PAYLOAD),
+        "blob_gas_used": uint64,
+        "excess_blob_gas": uint64,
+    })
+    DenebExecutionPayloadHeader = _container("ExecutionPayloadHeader", {
+        **_payload_prefix,
+        "transactions_root": Bytes32,
+        "withdrawals_root": Bytes32,
+        "blob_gas_used": uint64,
+        "excess_blob_gas": uint64,
+    })
+
+    de = SimpleNamespace(**vars(ca))
+    de.KZGCommitment = KZGCommitment
+    de.KZGProof = KZGProof
+    de.Blob = Blob
+    de.ExecutionPayload = DenebExecutionPayload
+    de.ExecutionPayloadHeader = DenebExecutionPayloadHeader
+    _deneb_common = dict(
+        bls_to_execution_changes=List(
+            SignedBLSToExecutionChange, p.MAX_BLS_TO_EXECUTION_CHANGES),
+        blob_kzg_commitments=List(
+            KZGCommitment, p.MAX_BLOB_COMMITMENTS_PER_BLOCK),
+    )
+    de.BeaconBlockBody = _container("BeaconBlockBody", dict(
+        **_altair_body_fields,
+        execution_payload=DenebExecutionPayload,
+        **_deneb_common,
+    ))
+    de.BlindedBeaconBlockBody = _container("BlindedBeaconBlockBody", dict(
+        **_altair_body_fields,
+        execution_payload_header=DenebExecutionPayloadHeader,
+        **_deneb_common,
+    ))
+    de.BeaconBlock, de.SignedBeaconBlock = _block_types(de.BeaconBlockBody)
+    de.BlindedBeaconBlock, de.SignedBlindedBeaconBlock = _block_types(
+        de.BlindedBeaconBlockBody, "Blinded")
+    de.BeaconState = _container("BeaconState", {
+        **_state_prefix(), **_participation, **_justice_suffix,
+        **_altair_state_suffix,
+        "latest_execution_payload_header": DenebExecutionPayloadHeader,
+        **_capella_state_suffix,
+    })
+    de.BlobIdentifier = _container("BlobIdentifier", dict(
+        block_root=Bytes32, index=uint64))
+    de.BlobSidecar = _container("BlobSidecar", dict(
+        index=uint64,
+        blob=Blob,
+        kzg_commitment=KZGCommitment,
+        kzg_proof=KZGProof,
+        signed_block_header=SignedBeaconBlockHeader,
+        kzg_commitment_inclusion_proof=Vector(
+            Bytes32, p.KZG_COMMITMENT_INCLUSION_PROOF_DEPTH),
+    ))
+    ns.deneb = de
+
+    return ns
+
+
+_CACHE: dict = {}
+
+
+def spec_types(preset: Preset) -> SimpleNamespace:
+    """All fork namespaces for `preset` (cached — container classes are
+    identity-compared by the SSZ layer)."""
+    hit = _CACHE.get(preset.name)
+    if hit is None:
+        hit = _build(preset)
+        _CACHE[preset.name] = hit
+    return hit

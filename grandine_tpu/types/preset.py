@@ -1,0 +1,118 @@
+"""Compile-time-style preset constants (reference: types/src/preset.rs:44 —
+`Preset` trait with `Mainnet`/`Minimal` impls of type-level constants).
+
+Here a frozen dataclass: one instance per preset, hashable, passed to the
+container factory and spec functions.
+"""
+
+from dataclasses import dataclass, replace
+
+
+@dataclass(frozen=True)
+class Preset:
+    name: str
+
+    # misc
+    MAX_COMMITTEES_PER_SLOT: int = 64
+    TARGET_COMMITTEE_SIZE: int = 128
+    MAX_VALIDATORS_PER_COMMITTEE: int = 2048
+    SHUFFLE_ROUND_COUNT: int = 90
+    HYSTERESIS_QUOTIENT: int = 4
+    HYSTERESIS_DOWNWARD_MULTIPLIER: int = 1
+    HYSTERESIS_UPWARD_MULTIPLIER: int = 5
+
+    # gwei values
+    MIN_DEPOSIT_AMOUNT: int = 10**9
+    MAX_EFFECTIVE_BALANCE: int = 32 * 10**9
+    EFFECTIVE_BALANCE_INCREMENT: int = 10**9
+
+    # time parameters (slots/epochs)
+    MIN_ATTESTATION_INCLUSION_DELAY: int = 1
+    SLOTS_PER_EPOCH: int = 32
+    MIN_SEED_LOOKAHEAD: int = 1
+    MAX_SEED_LOOKAHEAD: int = 4
+    EPOCHS_PER_ETH1_VOTING_PERIOD: int = 64
+    SLOTS_PER_HISTORICAL_ROOT: int = 8192
+    MIN_EPOCHS_TO_INACTIVITY_PENALTY: int = 4
+
+    # state list lengths
+    EPOCHS_PER_HISTORICAL_VECTOR: int = 65536
+    EPOCHS_PER_SLASHINGS_VECTOR: int = 8192
+    HISTORICAL_ROOTS_LIMIT: int = 2**24
+    VALIDATOR_REGISTRY_LIMIT: int = 2**40
+
+    # rewards & penalties (phase0)
+    BASE_REWARD_FACTOR: int = 64
+    WHISTLEBLOWER_REWARD_QUOTIENT: int = 512
+    PROPOSER_REWARD_QUOTIENT: int = 8
+    INACTIVITY_PENALTY_QUOTIENT: int = 2**26
+    MIN_SLASHING_PENALTY_QUOTIENT: int = 128
+    PROPORTIONAL_SLASHING_MULTIPLIER: int = 1
+
+    # max operations per block
+    MAX_PROPOSER_SLASHINGS: int = 16
+    MAX_ATTESTER_SLASHINGS: int = 2
+    MAX_ATTESTATIONS: int = 128
+    MAX_DEPOSITS: int = 16
+    MAX_VOLUNTARY_EXITS: int = 16
+
+    # altair
+    INACTIVITY_PENALTY_QUOTIENT_ALTAIR: int = 3 * 2**24
+    MIN_SLASHING_PENALTY_QUOTIENT_ALTAIR: int = 64
+    PROPORTIONAL_SLASHING_MULTIPLIER_ALTAIR: int = 2
+    SYNC_COMMITTEE_SIZE: int = 512
+    EPOCHS_PER_SYNC_COMMITTEE_PERIOD: int = 256
+    MIN_SYNC_COMMITTEE_PARTICIPANTS: int = 1
+
+    # bellatrix
+    INACTIVITY_PENALTY_QUOTIENT_BELLATRIX: int = 2**24
+    MIN_SLASHING_PENALTY_QUOTIENT_BELLATRIX: int = 32
+    PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX: int = 3
+    MAX_BYTES_PER_TRANSACTION: int = 2**30
+    MAX_TRANSACTIONS_PER_PAYLOAD: int = 2**20
+    BYTES_PER_LOGS_BLOOM: int = 256
+    MAX_EXTRA_DATA_BYTES: int = 32
+
+    # capella
+    MAX_BLS_TO_EXECUTION_CHANGES: int = 16
+    MAX_WITHDRAWALS_PER_PAYLOAD: int = 16
+    MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP: int = 16384
+
+    # deneb
+    MAX_BLOB_COMMITMENTS_PER_BLOCK: int = 4096
+    MAX_BLOBS_PER_BLOCK: int = 6
+    FIELD_ELEMENTS_PER_BLOB: int = 4096
+    KZG_COMMITMENT_INCLUSION_PROOF_DEPTH: int = 17
+
+
+MAINNET = Preset(name="mainnet")
+
+MINIMAL = Preset(
+    name="minimal",
+    MAX_COMMITTEES_PER_SLOT=4,
+    TARGET_COMMITTEE_SIZE=4,
+    SHUFFLE_ROUND_COUNT=10,
+    INACTIVITY_PENALTY_QUOTIENT=2**25,
+    MIN_SLASHING_PENALTY_QUOTIENT=64,
+    PROPORTIONAL_SLASHING_MULTIPLIER=2,
+    KZG_COMMITMENT_INCLUSION_PROOF_DEPTH=9,
+    SLOTS_PER_EPOCH=8,
+    EPOCHS_PER_ETH1_VOTING_PERIOD=4,
+    SLOTS_PER_HISTORICAL_ROOT=64,
+    EPOCHS_PER_HISTORICAL_VECTOR=64,
+    EPOCHS_PER_SLASHINGS_VECTOR=64,
+    SYNC_COMMITTEE_SIZE=32,
+    EPOCHS_PER_SYNC_COMMITTEE_PERIOD=8,
+    MAX_WITHDRAWALS_PER_PAYLOAD=4,
+    MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP=16,
+    MAX_BLOB_COMMITMENTS_PER_BLOCK=16,
+    FIELD_ELEMENTS_PER_BLOB=4096,
+)
+
+
+def by_name(name: str) -> Preset:
+    presets = {"mainnet": MAINNET, "minimal": MINIMAL}
+    try:
+        return presets[name]
+    except KeyError:
+        raise ValueError(f"unknown preset {name!r}") from None

@@ -1,0 +1,74 @@
+"""Primitive aliases, fork enum, and spec constants.
+
+Reference parity: types/src/primitives.rs (Slot/Epoch/Gwei/... aliases) and
+the domain-type constants used by helper_functions/src/signing.rs.
+"""
+
+import enum
+
+# SSZ-level aliases (values are plain ints/bytes; these names document
+# intent at call sites, mirroring types/src/primitives.rs)
+Slot = int
+Epoch = int
+CommitteeIndex = int
+ValidatorIndex = int
+Gwei = int
+Root = bytes       # 32
+Hash32 = bytes     # 32
+BLSPubkey = bytes  # 48 compressed
+BLSSignature = bytes  # 96 compressed
+DomainType = bytes  # 4
+Domain = bytes     # 32
+Version = bytes    # 4
+
+GENESIS_SLOT = 0
+GENESIS_EPOCH = 0
+FAR_FUTURE_EPOCH = 2**64 - 1
+
+DEPOSIT_CONTRACT_TREE_DEPTH = 32
+JUSTIFICATION_BITS_LENGTH = 4
+ENDIANNESS = "little"
+
+BLS_WITHDRAWAL_PREFIX = b"\x00"
+ETH1_ADDRESS_WITHDRAWAL_PREFIX = b"\x01"
+
+DOMAIN_BEACON_PROPOSER = bytes.fromhex("00000000")
+DOMAIN_BEACON_ATTESTER = bytes.fromhex("01000000")
+DOMAIN_RANDAO = bytes.fromhex("02000000")
+DOMAIN_DEPOSIT = bytes.fromhex("03000000")
+DOMAIN_VOLUNTARY_EXIT = bytes.fromhex("04000000")
+DOMAIN_SELECTION_PROOF = bytes.fromhex("05000000")
+DOMAIN_AGGREGATE_AND_PROOF = bytes.fromhex("06000000")
+DOMAIN_SYNC_COMMITTEE = bytes.fromhex("07000000")
+DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF = bytes.fromhex("08000000")
+DOMAIN_CONTRIBUTION_AND_PROOF = bytes.fromhex("09000000")
+DOMAIN_BLS_TO_EXECUTION_CHANGE = bytes.fromhex("0A000000")
+DOMAIN_APPLICATION_MASK = bytes.fromhex("00000001")
+
+# altair participation flag indices
+TIMELY_SOURCE_FLAG_INDEX = 0
+TIMELY_TARGET_FLAG_INDEX = 1
+TIMELY_HEAD_FLAG_INDEX = 2
+TIMELY_SOURCE_WEIGHT = 14
+TIMELY_TARGET_WEIGHT = 26
+TIMELY_HEAD_WEIGHT = 14
+SYNC_REWARD_WEIGHT = 2
+PROPOSER_WEIGHT = 8
+WEIGHT_DENOMINATOR = 64
+
+PARTICIPATION_FLAG_WEIGHTS = (
+    TIMELY_SOURCE_WEIGHT, TIMELY_TARGET_WEIGHT, TIMELY_HEAD_WEIGHT)
+
+
+class Phase(enum.IntEnum):
+    """Fork phases, ordered (types/src/combined.rs fork enums)."""
+
+    PHASE0 = 0
+    ALTAIR = 1
+    BELLATRIX = 2
+    CAPELLA = 3
+    DENEB = 4
+
+    @property
+    def key(self) -> str:
+        return self.name.lower()

@@ -1,0 +1,158 @@
+"""Spec-type structure tests: per-fork field layouts, roundtrips, preset
+parameterization, config fork schedule."""
+
+import pytest
+
+from grandine_tpu.ssz import Bits
+from grandine_tpu.types import (
+    Config, MAINNET, MINIMAL, Phase, spec_types)
+
+
+T = spec_types(MAINNET)
+TM = spec_types(MINIMAL)
+
+
+def field_names(cls):
+    return [f for f, _ in cls.FIELDS]
+
+
+def test_state_field_counts_per_fork():
+    # spec-known container widths (consensus-specs deneb/beacon-chain.md)
+    assert len(T.phase0.BeaconState.FIELDS) == 21
+    assert len(T.altair.BeaconState.FIELDS) == 24
+    assert len(T.bellatrix.BeaconState.FIELDS) == 25
+    assert len(T.capella.BeaconState.FIELDS) == 28
+    assert len(T.deneb.BeaconState.FIELDS) == 28
+
+
+def test_body_field_counts_per_fork():
+    assert len(T.phase0.BeaconBlockBody.FIELDS) == 8
+    assert len(T.altair.BeaconBlockBody.FIELDS) == 9
+    assert len(T.bellatrix.BeaconBlockBody.FIELDS) == 10
+    assert len(T.capella.BeaconBlockBody.FIELDS) == 11
+    assert len(T.deneb.BeaconBlockBody.FIELDS) == 12
+    assert field_names(T.deneb.BeaconBlockBody)[-2:] == [
+        "bls_to_execution_changes", "blob_kzg_commitments"]
+
+
+def test_altair_replaces_pending_attestations():
+    p0 = field_names(T.phase0.BeaconState)
+    al = field_names(T.altair.BeaconState)
+    i = p0.index("previous_epoch_attestations")
+    assert al[i] == "previous_epoch_participation"
+    assert al[i + 1] == "current_epoch_participation"
+    assert "previous_epoch_attestations" not in al
+
+
+def test_execution_payload_evolution():
+    be = field_names(T.bellatrix.ExecutionPayload)
+    ca = field_names(T.capella.ExecutionPayload)
+    de = field_names(T.deneb.ExecutionPayload)
+    assert be[-1] == "transactions"
+    assert ca[-2:] == ["transactions", "withdrawals"]
+    assert de[-2:] == ["blob_gas_used", "excess_blob_gas"]
+    # headers mirror with roots
+    assert field_names(T.deneb.ExecutionPayloadHeader)[-4:] == [
+        "transactions_root", "withdrawals_root", "blob_gas_used",
+        "excess_blob_gas"]
+
+
+def test_preset_parameterization():
+    att_m = T.phase0.Attestation
+    att_n = TM.phase0.Attestation
+    assert att_m is not att_n
+    assert att_m.FIELDS[0][1].limit == 2048
+    assert att_n.FIELDS[0][1].limit == 2048  # MVPC same in minimal
+    assert TM.altair.SyncAggregate.FIELDS[0][1].length == 32
+    assert T.altair.SyncAggregate.FIELDS[0][1].length == 512
+    assert spec_types(MAINNET) is T  # cached
+
+
+def test_block_roundtrip_each_fork():
+    for fork in ("phase0", "altair", "bellatrix", "capella", "deneb"):
+        ns = getattr(T, fork)
+        blk = ns.SignedBeaconBlock.default()
+        assert ns.SignedBeaconBlock.deserialize(blk.serialize()) == blk
+        root = blk.message.hash_tree_root()
+        assert len(root) == 32
+
+
+def test_nontrivial_block_roundtrip():
+    ns = T.deneb
+    att = ns.Attestation(
+        aggregation_bits=Bits([1, 0, 1]),
+        data=ns.AttestationData(
+            slot=9, index=1, beacon_block_root=b"\x01" * 32,
+            source=ns.Checkpoint(epoch=1, root=b"\x02" * 32),
+            target=ns.Checkpoint(epoch=2, root=b"\x03" * 32)),
+        signature=b"\x05" * 96)
+    body = ns.BeaconBlockBody(
+        randao_reveal=b"\x06" * 96,
+        attestations=[att],
+        execution_payload=ns.ExecutionPayload(
+            transactions=[b"\xaa\xbb", b""],
+            withdrawals=[ns.Withdrawal(index=3, validator_index=7,
+                                       address=b"\x01" * 20, amount=12)],
+            blob_gas_used=5),
+        blob_kzg_commitments=[b"\x09" * 48])
+    blk = ns.SignedBeaconBlock(
+        message=ns.BeaconBlock(slot=9, proposer_index=4, body=body),
+        signature=b"\x0a" * 96)
+    back = ns.SignedBeaconBlock.deserialize(blk.serialize())
+    assert back == blk
+    assert back.message.body.attestations[0].data.target.epoch == 2
+    assert list(back.message.body.execution_payload.transactions) == [
+        b"\xaa\xbb", b""]
+
+
+def test_blinded_blocks():
+    ns = T.deneb
+    bb = ns.SignedBlindedBeaconBlock.default()
+    assert "execution_payload_header" in field_names(ns.BlindedBeaconBlockBody)
+    assert ns.SignedBlindedBeaconBlock.deserialize(bb.serialize()) == bb
+
+
+def test_config_fork_schedule():
+    cfg = Config.mainnet()
+    assert cfg.phase_at_epoch(0) == Phase.PHASE0
+    assert cfg.phase_at_epoch(74239) == Phase.PHASE0
+    assert cfg.phase_at_epoch(74240) == Phase.ALTAIR
+    assert cfg.phase_at_epoch(269568) == Phase.DENEB
+    assert cfg.fork_version(Phase.CAPELLA) == bytes.fromhex("03000000")
+    assert cfg.phase_at_slot(74240 * 32) == Phase.ALTAIR
+    mini = Config.minimal()
+    assert mini.phase_at_epoch(0) == Phase.DENEB
+    assert mini.preset is MINIMAL
+
+
+def test_config_from_dict():
+    cfg = Config.from_dict({
+        "CONFIG_NAME": "custom",
+        "PRESET_BASE": "minimal",
+        "ALTAIR_FORK_EPOCH": "5",
+        "ALTAIR_FORK_VERSION": "0x01000099",
+        "UNKNOWN_KEY": "ignored",
+    })
+    assert cfg.config_name == "custom"
+    assert cfg.altair_fork_epoch == 5
+    assert cfg.altair_fork_version == bytes.fromhex("01000099")
+    assert cfg.phase_at_epoch(4) == Phase.PHASE0
+
+
+def test_state_roundtrip_with_validators():
+    import numpy as np
+    ns = T.deneb
+    vals = [ns.Validator(pubkey=bytes([i]) * 48,
+                         effective_balance=32 * 10**9,
+                         exit_epoch=2**64 - 1) for i in range(5)]
+    st = ns.BeaconState(
+        slot=17,
+        validators=vals,
+        balances=np.full(5, 32 * 10**9, np.uint64),
+        justification_bits=Bits([1, 0, 1, 0]),
+    )
+    back = ns.BeaconState.deserialize(st.serialize())
+    assert back == st
+    assert back.validators[3].pubkey == bytes([3]) * 48
+    assert back.balances[4] == 32 * 10**9
+    assert back.hash_tree_root() == st.hash_tree_root()
