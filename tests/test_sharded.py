@@ -1,0 +1,80 @@
+"""Multi-chip sharded batch-verify tests on the virtual 8-device CPU mesh.
+
+Exercises `make_sharded_multi_verify` (grandine_tpu/tpu/bls.py) — the
+framework's scale-out plane (SURVEY.md §2.4): batch axis sharded over a
+`jax.sharding.Mesh`, per-chip Miller loops + local reductions, one
+all-gather of Fp12/G2 partials, replicated final exponentiation.
+
+Reference shape: Signature::multi_verify (bls/src/signature.rs:96-129)
+scaled across devices instead of rayon threads.
+"""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from __graft_entry__ import _example_batch
+from grandine_tpu.tpu.bls import make_sharded_multi_verify, multi_verify_kernel
+
+N_DEV = 8
+BUCKET = 16  # 2 triples per chip
+
+
+def _batch(n_real: int, bucket: int = BUCKET):
+    """n_real valid triples padded to `bucket` with neutral infinity slots."""
+    return list(_example_batch(n_real, bucket))
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    devices = jax.devices()[:N_DEV]
+    assert len(devices) == N_DEV, "conftest must provide an 8-device CPU mesh"
+    return Mesh(np.array(devices), ("batch",))
+
+
+@pytest.fixture(scope="module")
+def sharded_fn(mesh):
+    return make_sharded_multi_verify(mesh, axis="batch")
+
+
+@pytest.fixture(scope="module")
+def valid_batch():
+    return _batch(n_real=5)
+
+
+def _put(mesh, args):
+    sharding = NamedSharding(mesh, P("batch"))
+    return tuple(jax.device_put(a, sharding) for a in args)
+
+
+def test_sharded_accepts_valid_batch(mesh, sharded_fn, valid_batch):
+    ok = sharded_fn(*_put(mesh, valid_batch))
+    assert bool(jax.device_get(ok))
+
+
+def test_sharded_rejects_bad_signature(mesh, sharded_fn, valid_batch):
+    bad = [np.copy(a) for a in valid_batch]
+    # corrupt one real signature's x-coordinate limb (slot 3 of 5 real)
+    bad[3][3, 0, 0] ^= 1
+    ok = sharded_fn(*_put(mesh, bad))
+    assert not bool(jax.device_get(ok))
+
+
+def test_sharded_rejects_swapped_messages(mesh, sharded_fn, valid_batch):
+    bad = [np.copy(a) for a in valid_batch]
+    # swap two real message points: each sig no longer matches its msg
+    for a in (bad[6], bad[7]):
+        a[[0, 1]] = a[[1, 0]]
+    ok = sharded_fn(*_put(mesh, bad))
+    assert not bool(jax.device_get(ok))
+
+
+def test_sharded_matches_single_device(mesh, sharded_fn, valid_batch):
+    single = jax.jit(multi_verify_kernel)
+    bad = [np.copy(a) for a in valid_batch]
+    bad[3][2, 0, 0] ^= 1  # corrupt a real sig
+    for args in (valid_batch, bad):
+        expect = bool(single(*args))
+        got = bool(jax.device_get(sharded_fn(*_put(mesh, args))))
+        assert got == expect
