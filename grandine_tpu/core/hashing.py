@@ -43,6 +43,8 @@ def hash_pair(a: bytes, b: bytes) -> bytes:
 
 def hash_pairs(data: bytes | bytearray) -> bytes:
     """N concatenated 64-byte pairs -> N concatenated 32-byte parents."""
+    if len(data) % 64:
+        raise ValueError(f"hash_pairs input must be 64-byte pairs, got {len(data)}")
     n = len(data) // 64
     if native.lib is not None and n >= 4:
         out = native.out_buf(n * 32)
@@ -58,6 +60,8 @@ def merkleize_chunks(chunks: bytes | bytearray, limit: int | None = None) -> byt
     """Merkleize 32-byte chunks (SSZ `merkleize`): pad virtually with zero
     chunks to `limit` leaves (or next power of two of the chunk count) and
     return the root."""
+    if len(chunks) % 32:
+        raise ValueError(f"chunks must be 32-byte aligned, got {len(chunks)}")
     n = len(chunks) // 32
     if limit is None:
         limit = max(n, 1)
@@ -68,8 +72,8 @@ def merkleize_chunks(chunks: bytes | bytearray, limit: int | None = None) -> byt
         return ZERO_HASHES[depth]
     if native.lib is not None and n >= 2:
         out = native.out_buf(32)
-        native.lib.gt_merkleize(bytes(chunks), n, depth, out)
-        return out.raw[:32]
+        if native.lib.gt_merkleize(bytes(chunks), n, depth, out):
+            return out.raw[:32]
     return _merkleize_py(bytes(chunks), n, depth)
 
 
@@ -100,9 +104,9 @@ def merkleize_many(chunks: bytes, n_items: int, chunks_per_item: int,
         raise ValueError(f"{chunks_per_item} chunks do not fit depth {depth}")
     if native.lib is not None and n_items >= 2:
         out = native.out_buf(n_items * 32)
-        native.lib.gt_merkleize_many(
-            chunks, n_items, chunks_per_item, depth, out)
-        return out.raw[: n_items * 32]
+        if native.lib.gt_merkleize_many(
+                chunks, n_items, chunks_per_item, depth, out):
+            return out.raw[: n_items * 32]
     stride = chunks_per_item * 32
     return b"".join(
         _merkleize_py(chunks[i * stride : (i + 1) * stride],

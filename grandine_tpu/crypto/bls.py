@@ -12,8 +12,10 @@ in G2) — the equivalent of the reference's `bls` crate public surface:
 Point serialization is the ZCash/Ethereum compressed encoding (flag bits in
 the top three bits of the first byte; Fp2 x-coordinate serialized c1 ‖ c0).
 
-This module is backend-agnostic at the API level: the TPU batch paths plug
-in behind `multi_verify`/`fast_aggregate_verify` via grandine_tpu.crypto.backend.
+This module is the pure-Python correctness anchor. The TPU batch backend
+(`grandine_tpu.tpu.bls.TpuBlsBackend`) mirrors its policy semantics; the
+consensus layer chooses between them at its Verifier seam (the equivalent
+of the reference's `helper_functions/src/verifier.rs:16-69`).
 """
 
 from __future__ import annotations

@@ -6,7 +6,7 @@ Jacobian-coordinate point arithmetic generic over the coordinate field
 Reference equivalents: blst's G1/G2 ops wrapped by `bls/src/public_key.rs`
 (aggregation :35-55, subgroup validate :21-27) and `bls/src/secret_key.rs:82-86`
 (signing = G2 scalar-mul). The TPU batched versions live in
-grandine_tpu/tpu/curve_ops.py and are differentially tested against this file.
+grandine_tpu/tpu/curve.py and are differentially tested against this file.
 """
 
 from __future__ import annotations

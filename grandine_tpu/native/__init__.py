@@ -9,6 +9,7 @@ fall back to hashlib-based pure-Python paths.
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import threading
@@ -16,14 +17,33 @@ import threading
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "src", "gtnative.cpp")
 _SO = os.path.join(_DIR, "_gtnative.so")
+_STAMP = _SO + ".srchash"  # content hash of the source the .so was built from
 
 _lock = threading.Lock()
 lib = None
 shani = False
 
 
+def _read(path: str) -> bytes | None:
+    try:
+        with open(path, "rb") as f:
+            return f.read()
+    except OSError:
+        return None
+
+
 def _build() -> bool:
-    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+    """(Re)build the .so whenever the stamped source hash doesn't match.
+
+    Keyed on a content hash, not mtimes: on a fresh clone git gives the
+    source near-identical mtimes to any stray binary, and a stale or
+    foreign-platform .so must never silently serve the consensus-critical
+    hashing path. A missing source degrades to the hashlib fallback."""
+    src = _read(_SRC)
+    if src is None:
+        return False
+    src_hash = hashlib.sha256(src).hexdigest().encode()
+    if os.path.exists(_SO) and _read(_STAMP) == src_hash:
         return True
     tmp = f"{_SO}.{os.getpid()}.tmp"  # per-process name: parallel first
     # imports must not interleave writes into one file
@@ -31,12 +51,16 @@ def _build() -> bool:
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(tmp, _SO)
+        with open(f"{_STAMP}.{os.getpid()}.tmp", "wb") as f:
+            f.write(src_hash)
+        os.replace(f"{_STAMP}.{os.getpid()}.tmp", _STAMP)
     except (subprocess.SubprocessError, FileNotFoundError, OSError):
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        return os.path.exists(_SO)
+        for leftover in (tmp, f"{_STAMP}.{os.getpid()}.tmp"):
+            try:
+                os.unlink(leftover)
+            except OSError:
+                pass
+        return os.path.exists(_SO) and _read(_STAMP) == src_hash
     return True
 
 
@@ -58,8 +82,10 @@ def _bind():
             L.gt_sha256.argtypes = [cp, ctypes.c_uint64, cp]
             L.gt_hash_pairs.argtypes = [cp, ctypes.c_uint64, cp]
             L.gt_merkleize.argtypes = [cp, ctypes.c_uint64, ctypes.c_int, cp]
+            L.gt_merkleize.restype = ctypes.c_int
             L.gt_merkleize_many.argtypes = [
                 cp, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int, cp]
+            L.gt_merkleize_many.restype = ctypes.c_int
             L.gt_mix_in_length.argtypes = [cp, ctypes.c_uint64, cp]
             L.gt_zero_hash.argtypes = [ctypes.c_int, cp]
             shani = bool(L.gt_init())

@@ -292,23 +292,30 @@ static void merkleize_into(const uint8_t* chunks, uint64_t n_chunks, int depth,
   std::memcpy(out32, scratch, 32);
 }
 
-void gt_merkleize(const uint8_t* chunks, uint64_t n_chunks, int depth,
-                  uint8_t* out32) {
+// Returns 1 on success, 0 on allocation failure (caller falls back to the
+// hashlib path).
+int gt_merkleize(const uint8_t* chunks, uint64_t n_chunks, int depth,
+                 uint8_t* out32) {
   uint8_t* scratch =
       (uint8_t*)std::malloc((n_chunks ? n_chunks : 1) * 32 + 32);
+  if (!scratch) return 0;
   merkleize_into(chunks, n_chunks, depth, out32, scratch);
   std::free(scratch);
+  return 1;
 }
 
 // Batch: n_items independent subtrees, each `cpi` chunks wide, each
 // merkleized to height `depth`. The 50k-validator registry path: one call
-// hashes every validator's 8-field subtree.
-void gt_merkleize_many(const uint8_t* chunks, uint64_t n_items, uint64_t cpi,
-                       int depth, uint8_t* out) {
+// hashes every validator's 8-field subtree. Returns 1 on success, 0 on
+// allocation failure.
+int gt_merkleize_many(const uint8_t* chunks, uint64_t n_items, uint64_t cpi,
+                      int depth, uint8_t* out) {
   uint8_t* scratch = (uint8_t*)std::malloc((cpi ? cpi : 1) * 32 + 32);
+  if (!scratch) return 0;
   for (uint64_t i = 0; i < n_items; i++)
     merkleize_into(chunks + i * cpi * 32, cpi, depth, out + 32 * i, scratch);
   std::free(scratch);
+  return 1;
 }
 
 // mix_in_length / mix_in_selector: hash(root ++ le64(value) ++ zeros24)

@@ -67,12 +67,22 @@ class SszType:
 
 class UInt(SszType):
     __slots__ = ("bits", "size", "np_dtype")
+    _cache: dict = {}
 
-    def __init__(self, bits: int):
-        self.bits = bits
-        self.size = bits // 8
-        self.np_dtype = {8: np.uint8, 16: np.uint16, 32: np.uint32,
-                         64: np.uint64}.get(bits)
+    # Interned by width (like ByteVector/Bitlist) so UInt(64) IS uint64:
+    # composite types key their caches on element identity, and separately
+    # constructed-but-equal descriptors must not yield distinct List/Vector
+    # types whose values never compare equal.
+    def __new__(cls, bits: int):
+        hit = cls._cache.get(bits)
+        if hit is None:
+            hit = super().__new__(cls)
+            hit.bits = bits
+            hit.size = bits // 8
+            hit.np_dtype = {8: np.uint8, 16: np.uint16, 32: np.uint32,
+                            64: np.uint64}.get(bits)
+            cls._cache[bits] = hit
+        return hit
 
     def __repr__(self):
         return f"uint{self.bits}"
@@ -392,8 +402,9 @@ class Bitlist(SszType):
         n = len(bits) - 1 - int(np.argmax(bits[::-1]))  # last set bit
         if n > self.limit:
             raise SszError(f"{self}: {n} bits over limit")
-        if len(data) != (n + 8) // 8:
-            raise SszError(f"{self}: length/delimiter mismatch")
+        # Invariant (no further check needed): n is the index of the last set
+        # bit and data[-1] != 0 is enforced above, so the delimiter always
+        # lies in the final byte and len(data) == (n + 8) // 8 holds.
         return Bits(bits[:n])
 
     def hash_tree_root(self, v: Bits) -> bytes:
