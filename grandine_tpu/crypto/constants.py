@@ -60,21 +60,70 @@ H2 = 0x5D543A95414E7F1091D50792876A202CD91DE4547085ABAA68A205B2E5A7DDFA628F1CB4D
 
 # --- domain separation tags (IETF BLS signature suite / Ethereum 2.0) ------
 
-# NOTE on conformance: the DSTs are the standard Ethereum values, but our
-# map_to_curve is the derivable Shallue–van de Woestijne map rather than the
-# SSWU+3-isogeny fast suite (whose isogeny constants cannot be derived from
-# first principles without the published tables, unavailable in this
-# environment). The scheme is internally consistent (sign/verify/aggregate
-# interoperate within this framework); swapping in SSWU constants later
-# changes only hash_to_curve.map_to_curve_g2.
 DST_SIGNATURE = b"BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_"
 DST_POP = b"BLS_POP_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_"
 
-# SvdW map constants (derived by search over small field elements satisfying
-# the RFC 9380 §6.6.1 admissibility conditions; derivation in
-# tests/test_crypto_hash_to_curve.py).
+# SvdW map constants (RFC 9380 §6.6.1 admissibility; used for G1, whose
+# hash-to-curve is unused by the Ethereum min_pk suite — see the G1 note in
+# hash_to_curve.py. G2 uses the canonical SSWU+3-isogeny below.)
 SVDW_Z_G1 = -3 % P
-SVDW_Z_G2 = (-1 % P, -1 % P)  # -(1+u)
+
+# --- G2 SSWU + 3-isogeny (the BLS12381G2_XMD:SHA-256_SSWU_RO_ suite) -------
+#
+# Published parameters from RFC 9380 §8.8.2 and Appendix E.3. Transcription
+# errors are self-detecting: tests check (a) the isogeny maps E' points onto
+# E (y² = x³ + 4(1+u)), (b) h_eff·P lands in the r-torsion, and (c) the
+# end-to-end Appendix J.10.1 known-answer vectors.
+#
+# E'/Fp2 : y² = x³ + A'x + B' — the 3-isogenous curve SSWU targets.
+SSWU_A_G2 = (0, 240)  # 240·u
+SSWU_B_G2 = (1012, 1012)  # 1012·(1+u)
+SSWU_Z_G2 = (-2 % P, -1 % P)  # -(2+u)
+
+# 3-isogeny E' → E rational map coefficients (Fq2 as (c0, c1) ints).
+# x = x_num/x_den, y = y'·y_num/y_den with
+#   x_num = Σ K1[i]·x'^i   (deg 3)     x_den = x'² + K2[1]·x' + K2[0]
+#   y_num = Σ K3[i]·x'^i   (deg 3)     y_den = x'³ + K4[2]·x'² + K4[1]·x' + K4[0]
+ISO3_K1 = (
+    (0x5C759507E8E333EBB5B7A9A47D7ED8532C52D39FD3A042A88B58423C50AE15D5C2638E343D9C71C6238AAAAAAAA97D6,
+     0x5C759507E8E333EBB5B7A9A47D7ED8532C52D39FD3A042A88B58423C50AE15D5C2638E343D9C71C6238AAAAAAAA97D6),
+    (0,
+     0x11560BF17BAA99BC32126FCED787C88F984F87ADF7AE0C7F9A208C6B4F20A4181472AAA9CB8D555526A9FFFFFFFFC71A),
+    (0x11560BF17BAA99BC32126FCED787C88F984F87ADF7AE0C7F9A208C6B4F20A4181472AAA9CB8D555526A9FFFFFFFFC71E,
+     0x8AB05F8BDD54CDE190937E76BC3E447CC27C3D6FBD7063FCD104635A790520C0A395554E5C6AAAA9354FFFFFFFFE38D),
+    (0x171D6541FA38CCFAED6DEA691F5FB614CB14B4E7F4E810AA22D6108F142B85757098E38D0F671C7188E2AAAAAAAA5ED1,
+     0),
+)
+ISO3_K2 = (
+    (0,
+     0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAA63),
+    (0xC,
+     0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAA9F),
+)
+ISO3_K3 = (
+    (0x1530477C7AB4113B59A4C18B076D11930F7DA5D4A07F649BF54439D87D27E500FC8C25EBF8C92F6812CFC71C71C6D706,
+     0x1530477C7AB4113B59A4C18B076D11930F7DA5D4A07F649BF54439D87D27E500FC8C25EBF8C92F6812CFC71C71C6D706),
+    (0,
+     0x5C759507E8E333EBB5B7A9A47D7ED8532C52D39FD3A042A88B58423C50AE15D5C2638E343D9C71C6238AAAAAAAA97BE),
+    (0x11560BF17BAA99BC32126FCED787C88F984F87ADF7AE0C7F9A208C6B4F20A4181472AAA9CB8D555526A9FFFFFFFFC71C,
+     0x8AB05F8BDD54CDE190937E76BC3E447CC27C3D6FBD7063FCD104635A790520C0A395554E5C6AAAA9354FFFFFFFFE38F),
+    (0x124C9AD43B6CF79BFBF7043DE3811AD0761B0F37A1E26286B0E977C69AA274524E79097A56DC4BD9E1B371C71C718B10,
+     0),
+)
+ISO3_K4 = (
+    (0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFA8FB,
+     0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFA8FB),
+    (0,
+     0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFA9D3),
+    (0x12,
+     0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAA99),
+)
+
+# Effective G2 cofactor (RFC 9380 §8.8.2): clear_cofactor(P) = h_eff·P.
+# NOT the full twist cofactor h2 — every interoperable implementation uses
+# h_eff, so the mapped point differs from h2·P by a scalar and only the
+# h_eff choice matches the published suite vectors.
+H_EFF_G2 = 0xBC69F08F2EE75B3584C6A0EA91B352888E2A8E9145AD7689986FF031508FFE1329C2F178731DB956D82BF015D1212B02EC0EC69D7477C1AE954CBC06689F6A359894C0ADEBBF6B4E8020005AAA95551
 
 # --- structural identity checks (cheap; heavyweight checks live in tests) --
 

@@ -176,4 +176,9 @@ def clear_cofactor_g1(p: Point[Fq]) -> Point[Fq]:
 
 
 def clear_cofactor_g2(p: Point[Fq2]) -> Point[Fq2]:
-    return p.mul(constants.H2)
+    """h_eff·P per RFC 9380 §8.8.2 — NOT the full twist cofactor h2.
+
+    Both land in G2, but interoperable implementations (blst included) use
+    h_eff, and only that choice reproduces the published suite vectors.
+    """
+    return p.mul(constants.H_EFF_G2)

@@ -2,12 +2,16 @@
 
     hash_to_field (expand_message_xmd/SHA-256) → map_to_curve → clear_cofactor
 
-map_to_curve is the Shallue–van de Woestijne map (RFC 9380 §6.6.1), whose
-constants are fully derivable from the curve equation — see the conformance
-note in constants.py: the canonical Ethereum suite uses SSWU+isogeny, whose
-isogeny tables are not derivable offline; SvdW keeps the scheme internally
-consistent and swappable later. expand_message_xmd and hash_to_field are
-implemented exactly per RFC and are reusable unchanged under SSWU.
+G2 implements the canonical Ethereum suite BLS12381G2_XMD:SHA-256_SSWU_RO_
+exactly: simplified SWU on the 3-isogenous curve E' (RFC 9380 §6.6.3,
+constants §8.8.2 / Appendix E.3) followed by the published 3-isogeny back to
+E and h_eff cofactor clearing. Known-answer conformance vectors:
+tests/test_rfc9380_vectors.py (Appendix J.10.1 / K.1).
+
+G1 keeps the derivable Shallue–van de Woestijne map (§6.6.1): the min_pk
+ciphersuite never hashes to G1 (messages → G2, keys live unhashed in G1),
+so G1 hashing is internal-only; the 11-isogeny SSWU tables can be slotted
+in later without touching callers.
 
 Reference equivalent: blst's hash-to-G2 invoked by `SecretKey::sign`
 (bls/src/secret_key.rs:82-86) and by all verify paths.
@@ -101,7 +105,6 @@ class _SvdwConstants:
         assert g(self.c2).is_square() or gz.is_square()
 
 
-_SVDW_G2 = _SvdwConstants(B2, Fq2.from_ints(*constants.SVDW_Z_G2))
 _SVDW_G1 = _SvdwConstants(B1, Fq(constants.SVDW_Z_G1))
 
 
@@ -138,8 +141,75 @@ def _map_to_curve_svdw(u: FieldElem, k: _SvdwConstants) -> "tuple[FieldElem, Fie
     return x, y
 
 
+# --- G2: simplified SWU on E' + 3-isogeny (RFC 9380 §6.6.2/§6.6.3) --------
+
+_SSWU_A = Fq2.from_ints(*constants.SSWU_A_G2)
+_SSWU_B = Fq2.from_ints(*constants.SSWU_B_G2)
+_SSWU_Z = Fq2.from_ints(*constants.SSWU_Z_G2)
+_ISO3_K1 = tuple(Fq2.from_ints(*k) for k in constants.ISO3_K1)
+_ISO3_K2 = tuple(Fq2.from_ints(*k) for k in constants.ISO3_K2)
+_ISO3_K3 = tuple(Fq2.from_ints(*k) for k in constants.ISO3_K3)
+_ISO3_K4 = tuple(Fq2.from_ints(*k) for k in constants.ISO3_K4)
+
+
+def _map_to_curve_sswu_g2(u: Fq2) -> "tuple[Fq2, Fq2]":
+    """RFC 9380 §6.6.2 simplified SWU onto E': y² = x³ + A'x + B'."""
+    a, b, z = _SSWU_A, _SSWU_B, _SSWU_Z
+    u2 = u.square()
+    tv1 = z * u2
+    tv2 = tv1.square() + tv1
+    x1_num = b * (tv2 + Fq2.one())
+    if tv2.is_zero():
+        x1_den = a * z
+    else:
+        x1_den = -(a * tv2)
+    # g(x) = x³ + a·x + b evaluated as fraction num/den³ to avoid inversions
+    # is overkill for the anchor: invert directly (anchor favors clarity).
+    x1 = x1_num * x1_den.inv()
+    gx1 = x1.square() * x1 + a * x1 + b
+    y = gx1.sqrt()
+    if y is not None:
+        x = x1
+    else:
+        x2 = tv1 * x1
+        gx2 = x2.square() * x2 + a * x2 + b
+        x, y = x2, gx2.sqrt()
+    assert y is not None
+    if u.sgn0() != y.sgn0():
+        y = -y
+    return x, y
+
+
+def _horner(coeffs: "tuple[Fq2, ...]", x: Fq2) -> Fq2:
+    acc = coeffs[-1]
+    for c in reversed(coeffs[:-1]):
+        acc = acc * x + c
+    return acc
+
+
+def _iso3_map(x: Fq2, y: Fq2) -> "tuple[Fq2, Fq2] | None":
+    """The published 3-isogeny E' → E (RFC 9380 Appendix E.3).
+
+    Returns None for inputs in the isogeny kernel (x_den/y_den = 0), which
+    map to the identity — unreachable via hash_to_g2 (it would require
+    inverting SHA-256) but map_to_curve_g2 accepts arbitrary field elements.
+    """
+    x_den = _horner(_ISO3_K2 + (Fq2.one(),), x)
+    y_den = _horner(_ISO3_K4 + (Fq2.one(),), x)
+    if x_den.is_zero() or y_den.is_zero():
+        return None
+    x_num = _horner(_ISO3_K1, x)
+    y_num = _horner(_ISO3_K3, x)
+    return x_num * x_den.inv(), y * y_num * y_den.inv()
+
+
 def map_to_curve_g2(u: Fq2) -> Point[Fq2]:
-    x, y = _map_to_curve_svdw(u, _SVDW_G2)
+    """SSWU + 3-isogeny — the BLS12381G2_XMD:SHA-256_SSWU_RO_ map."""
+    xp, yp = _map_to_curve_sswu_g2(u)
+    image = _iso3_map(xp, yp)
+    if image is None:
+        return Point.infinity(B2)
+    x, y = image
     return Point.from_affine(x, y, B2)
 
 

@@ -1,0 +1,142 @@
+"""RFC 9380 known-answer conformance vectors (external anchoring).
+
+Embeds the published Appendix K.1 (expand_message_xmd, SHA-256) and
+Appendix J.10.1 (BLS12381G2_XMD:SHA-256_SSWU_RO_) test vectors and checks
+the anchor implementation reproduces them bit-exactly. This is the external
+correctness anchor for the whole G2 hash pipeline — expand_message_xmd,
+hash_to_field, simplified-SWU, the 3-isogeny, and h_eff cofactor clearing
+all have to be right for even one of these to match.
+
+Structural self-checks below additionally make any transcription error in
+the embedded isogeny/h_eff constants detectable without the vectors.
+
+Reference equivalent: blst's hash-to-G2 backing `SecretKey::sign`
+(bls/src/secret_key.rs:82-86); spec suite binding in
+helper_functions/src/spec_tests.rs.
+"""
+
+import pytest
+
+from grandine_tpu.crypto import constants
+from grandine_tpu.crypto.curves import B2, Point
+from grandine_tpu.crypto.fields import Fq2
+from grandine_tpu.crypto.hash_to_curve import (
+    _iso3_map,
+    _map_to_curve_sswu_g2,
+    expand_message_xmd,
+    hash_to_g2,
+)
+
+# --- Appendix K.1: expand_message_xmd(SHA-256) ----------------------------
+
+XMD_DST = b"QUUX-V01-CS02-with-expander-SHA256-128"
+
+# (msg, len_in_bytes, uniform_bytes hex)
+XMD_VECTORS = [
+    (b"", 0x20,
+     "68a985b87eb6b46952128911f2a4412bbc302a9d759667f87f7a21d803f07235"),
+    (b"abc", 0x20,
+     "d8ccab23b5985ccea865c6c97b6e5b8350e794e603b4b97902f53a8a0d605615"),
+    (b"abcdef0123456789", 0x20,
+     "eff31487c770a893cfb36f912fbfcbff40d5661771ca4b2cb4eafe524333f5c1"),
+    (b"q128_" + b"q" * 128, 0x20,
+     "b23a1d2b4d97b2ef7785562a7e8bac7eed54ed6e97e29aa51bfe3f12ddad1ff9"),
+    (b"a512_" + b"a" * 512, 0x20,
+     "4623227bcc01293b8c130bf771da8c298dede7383243dc0993d2d94823958c4c"),
+    (b"", 0x80,
+     "af84c27ccfd45d41914fdff5df25293e221afc53d8ad2ac06d5e3e29485dadbe"
+     "e0d121587713a3e0dd4d5e69e93eb7cd4f5df4cd103e188cf60cb02edc3edf18"
+     "eda8576c412b18ffb658e3dd6ec849469b979d444cf7b26911a08e63cf31f9dc"
+     "c541708d3491184472c2c29bb749d4286b004ceb5ee6b9a7fa5b646c993f0ced"),
+    (b"abc", 0x80,
+     "abba86a6129e366fc877aab32fc4ffc70120d8996c88aee2fe4b32d6c7b6437a"
+     "647e6c3163d40b76a73cf6a5674ef1d890f95b664ee0afa5359a5c4e07985635"
+     "bbecbac65d747d3d2da7ec2b8221b17b0ca9dc8a1ac1c07ea6a1e60583e2cb00"
+     "058e77b7b72a298425cd1b941ad4ec65e8afc50303a22c0f99b0509b4c895f40"),
+]
+
+
+@pytest.mark.parametrize("msg,n,expected", XMD_VECTORS, ids=lambda v: str(v)[:16])
+def test_expand_message_xmd_k1(msg, n, expected):
+    assert expand_message_xmd(msg, XMD_DST, n).hex() == expected
+
+
+# --- Appendix J.10.1: BLS12381G2_XMD:SHA-256_SSWU_RO_ ---------------------
+
+G2_DST = b"QUUX-V01-CS02-with-BLS12381G2_XMD:SHA-256_SSWU_RO_"
+
+# (msg, P.x c0, P.x c1, P.y c0, P.y c1)
+G2_RO_VECTORS = [
+    (b"",
+     0x0141EBFBDCA40EB85B87142E130AB689C673CF60F1A3E98D69335266F30D9B8D4AC44C1038E9DCDD5393FAF5C41FB78A,
+     0x05CB8437535E20ECFFAEF7752BADDF98034139C38452458BAEEFAB379BA13DFF5BF5DD71B72418717047F5B0F37DA03D,
+     0x0503921D7F6A12805E72940B963C0CF3471C7B2A524950CA195D11062EE75EC076DAF2D4BC358C4B190C0C98064FDD92,
+     0x12424AC32561493F3FE3C260708A12B7C620E7BE00099A974E259DDC7D1F6395C3C811CDD19F1E8DBF3E9ECFDCBAB8D6),
+    (b"abc",
+     0x02C2D18E033B960562AAE3CAB37A27CE00D80CCD5BA4B7FE0E7A210245129DBEC7780CCC7954725F4168AFF2787776E6,
+     0x139CDDBCCDC5E91B9623EFD38C49F81A6F83F175E80B06FC374DE9EB4B41DFE4CA3A230ED250FBE3A2ACF73A41177FD8,
+     0x1787327B68159716A37440985269CF584BCB1E621D3A7202BE6EA05C4CFE244AEB197642555A0645FB87BF7466B2BA48,
+     0x00AA65DAE3C8D732D10ECD2C50F8A1BAF3001578F71C694E03866E9F3D49AC1E1CE70DD94A733534F106D4CEC0EDDD16),
+    (b"abcdef0123456789",
+     0x121982811D2491FDE9BA7ED31EF9CA474F0E1501297F68C298E9F4C0028ADD35AEA8BB83D53C08CFC007C1E005723CD0,
+     0x190D119345B94FBD15497BCBA94ECF7DB2CBFD1E1FE7DA034D26CBBA169FB3968288B3FAFB265F9EBD380512A71C3F2C,
+     0x05571A0F8D3C08D094576981F4A3B8EDA0A8E771FCDCC8ECCEAF1356A6ACF17574518ACB506E435B639353C2E14827C8,
+     0x0BB5E7572275C567462D91807DE765611490205A941A5A6AF3B1691BFE596C31225D3AABDF15FAFF860CB4EF17C7C3BE),
+    (b"q128_" + b"q" * 128,
+     0x19A84DD7248A1066F737CC34502EE5555BD3C19F2ECDB3C7D9E24DC65D4E25E50D83F0F77105E955D78F4762D33C17DA,
+     0x0934ABA516A52D8AE479939A91998299C76D39CC0C035CD18813BEC433F587E2D7A4FEF038260EEF0CEF4D02AAE3EB91,
+     0x14F81CD421617428BC3B9FE25AFBB751D934A00493524BC4E065635B0555084DD54679DF1536101B2C979C0152D09192,
+     0x09BCCCFA036B4847C9950780733633F13619994394C23FF0B32FA6B795844F4A0673E20282D07BC69641CEE04F5E5662),
+    (b"a512_" + b"a" * 512,
+     0x01A6BA2F9A11FA5598B2D8ACE0FBE0A0EACB65DECEB476FBBCB64FD24557C2F4B18ECFC5663E54AE16A84F5AB7F62534,
+     0x11FCA2FF525572795A801EED17EB12785887C7B63FB77A42BE46CE4A34131D71F7A73E95FEE3F812AEA3DE78B4D01569,
+     0x0B6798718C8AED24BC19CB27F866F1C9EFFCDBF92397AD6448B5C9DB90D2B9DA6CBABF48ADC1ADF59A1A28344E79D57E,
+     0x03A47F8E6D1763BA0CAD63D6114C0ACCBEF65707825A511B251A660A9B3994249AE4E63FAC38B23DA0C398689EE2AB52),
+]
+
+
+@pytest.mark.parametrize(
+    "msg,x0,x1,y0,y1", G2_RO_VECTORS, ids=lambda v: str(v)[:16]
+)
+def test_hash_to_g2_j10_1(msg, x0, x1, y0, y1):
+    aff = hash_to_g2(msg, G2_DST).to_affine()
+    assert aff is not None
+    x, y = aff
+    assert (x.c0.n, x.c1.n, y.c0.n, y.c1.n) == (x0, x1, y0, y1)
+
+
+# --- structural self-checks on the embedded constants ---------------------
+
+
+def test_sswu_lands_on_iso_curve_and_iso_lands_on_e():
+    """Any transcription error in A'/B'/Z or the isogeny tables breaks this."""
+    a = Fq2.from_ints(*constants.SSWU_A_G2)
+    b = Fq2.from_ints(*constants.SSWU_B_G2)
+    for i in range(8):
+        u = Fq2.from_ints(0xDEAD0000 + i, 0xBEEF0000 + 31 * i)
+        xp, yp = _map_to_curve_sswu_g2(u)
+        assert yp.square() == xp.square() * xp + a * xp + b
+        x, y = _iso3_map(xp, yp)
+        assert y.square() == x.square() * x + B2
+
+
+def test_h_eff_output_is_r_torsion():
+    """h_eff·P must land in G2 for arbitrary curve points P ∈ E'(Fp2)...
+
+    ...here exercised through the full map (whose pre-clearing point is a
+    practically-random E point). A wrong h_eff leaves an r-coprime factor
+    alive with overwhelming probability.
+    """
+    p = hash_to_g2(b"h_eff structural check", G2_DST)
+    assert not p.is_infinity()
+    assert p.mul(constants.R).is_infinity()
+
+
+def test_sswu_exceptional_case_tv2_zero():
+    """u = 0 drives Z²u⁴+Zu² = 0 — the inv0 branch of SSWU."""
+    xp, yp = _map_to_curve_sswu_g2(Fq2.zero())
+    a = Fq2.from_ints(*constants.SSWU_A_G2)
+    b = Fq2.from_ints(*constants.SSWU_B_G2)
+    assert yp.square() == xp.square() * xp + a * xp + b
+    x, y = _iso3_map(xp, yp)
+    assert y.square() == x.square() * x + B2
