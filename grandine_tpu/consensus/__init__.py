@@ -1,0 +1,16 @@
+"""Spec helper functions — the equivalent of the reference's
+`helper_functions` crate (accessors, predicates, misc, mutators, signing
+domains, and the Verifier batch-verification seam).
+
+Layer 3 of SURVEY.md §1: sits on types (layer 1) and crypto (layer 2);
+consumed by transition functions, fork choice, pools, and the validator.
+"""
+
+from grandine_tpu.consensus import (  # noqa: F401
+    accessors,
+    misc,
+    mutators,
+    predicates,
+    signing,
+    verifier,
+)

@@ -1,0 +1,56 @@
+"""Process-wide public-key decompression cache.
+
+Reference parity: bls/src/cached_public_key.rs (lazy decompress) +
+validator_key_cache (persistent decompressed-key reuse). Decompressing a
+48-byte G1 key costs a field sqrt + subgroup check; a 50k-validator registry
+re-verifies the same keys constantly, so the cache is global and unbounded
+(50k entries ≈ a few MB of Fq ints — the reference holds the same data in
+`CachedPublicKey` fields inside the state).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+from grandine_tpu.crypto import bls as A
+
+_CACHE: dict = {}
+
+
+def decompress_pubkey(pubkey_bytes: bytes) -> "A.PublicKey":
+    """Decompressed, subgroup-checked, non-identity public key.
+    Raises BlsError on invalid encodings (never cached)."""
+    key = bytes(pubkey_bytes)
+    hit = _CACHE.get(key)
+    if hit is None:
+        hit = A.PublicKey.from_bytes(key)
+        _CACHE[key] = hit
+    return hit
+
+
+def try_decompress_pubkey(pubkey_bytes: bytes) -> "Optional[A.PublicKey]":
+    try:
+        return decompress_pubkey(pubkey_bytes)
+    except A.BlsError:
+        return None
+
+
+def aggregate_pubkeys(pubkeys: "Iterable[bytes]") -> "A.PublicKey":
+    """eth_aggregate_pubkeys: aggregate of decompressed keys (all must be
+    valid; empty input is an error per the spec)."""
+    keys = [decompress_pubkey(pk) for pk in pubkeys]
+    if not keys:
+        raise A.BlsError("eth_aggregate_pubkeys of empty list")
+    return A.PublicKey.aggregate(keys)
+
+
+def aggregate_pubkey_bytes(pubkeys: "Iterable[bytes]") -> bytes:
+    return aggregate_pubkeys(pubkeys).to_bytes()
+
+
+__all__ = [
+    "decompress_pubkey",
+    "try_decompress_pubkey",
+    "aggregate_pubkeys",
+    "aggregate_pubkey_bytes",
+]

@@ -1,0 +1,247 @@
+"""Spec `misc`/time/domain math — reference: helper_functions/src/misc.rs
+(`compute_signing_root` misc.rs:122, `compute_domain`, epoch/slot/committee
+arithmetic) over the framework's own SSZ containers.
+
+All functions are pure; anything that needs registry-wide data takes numpy
+arrays so callers (accessors.EpochCache) stay vectorized.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+from grandine_tpu.core.shuffling import shuffled_indices
+from grandine_tpu.ssz import Bytes4, Bytes32, Container, uint64
+from grandine_tpu.ssz.base import ContainerMeta
+from grandine_tpu.types.preset import Preset
+from grandine_tpu.types.primitives import (
+    DOMAIN_BEACON_PROPOSER,
+    FAR_FUTURE_EPOCH,
+    GENESIS_EPOCH,
+)
+
+
+def _container(name: str, fields: dict) -> ContainerMeta:
+    return ContainerMeta(name, (Container,), {"__annotations__": dict(fields)})
+
+
+# Preset-independent signing containers. Structurally identical to the
+# per-preset `spec_types(...)` versions (same field layout ⇒ same roots);
+# defined locally so domain math has no preset dependency.
+ForkData = _container(
+    "ForkData", dict(current_version=Bytes4, genesis_validators_root=Bytes32)
+)
+SigningData = _container("SigningData", dict(object_root=Bytes32, domain=Bytes32))
+
+
+def sha256(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()
+
+
+def uint_to_bytes(n: int, size: int = 8) -> bytes:
+    return int(n).to_bytes(size, "little")
+
+
+def bytes_to_uint64(data: bytes) -> int:
+    return int.from_bytes(data[:8], "little")
+
+
+def integer_squareroot(n: int) -> int:
+    # math.isqrt is exact for arbitrary ints (spec integer_squareroot)
+    import math
+
+    return math.isqrt(int(n))
+
+
+def xor(a: bytes, b: bytes) -> bytes:
+    return bytes(x ^ y for x, y in zip(a, b))
+
+
+# --- time ------------------------------------------------------------------
+
+
+def compute_epoch_at_slot(slot: int, p: Preset) -> int:
+    return slot // p.SLOTS_PER_EPOCH
+
+
+def compute_start_slot_at_epoch(epoch: int, p: Preset) -> int:
+    return epoch * p.SLOTS_PER_EPOCH
+
+
+def compute_activation_exit_epoch(epoch: int, p: Preset) -> int:
+    return epoch + 1 + p.MAX_SEED_LOOKAHEAD
+
+
+# --- committees ------------------------------------------------------------
+
+
+def committee_count_per_slot(active_count: int, p: Preset) -> int:
+    return max(
+        1,
+        min(
+            p.MAX_COMMITTEES_PER_SLOT,
+            active_count // p.SLOTS_PER_EPOCH // p.TARGET_COMMITTEE_SIZE,
+        ),
+    )
+
+
+def compute_committee_partition(
+    active_indices: np.ndarray, seed: bytes, p: Preset
+) -> "list[np.ndarray]":
+    """All committees of one epoch in order: the whole-list shuffle applied
+    once, then sliced into SLOTS_PER_EPOCH × committees_per_slot pieces (the
+    spec's `compute_committee` for every (slot, index) pair).
+
+    Committee k (k = (slot % SLOTS_PER_EPOCH) * count + index) is
+    `active[sigma[n*k//total : n*(k+1)//total]]`.
+    """
+    n = len(active_indices)
+    sigma = shuffled_indices(seed, n, p.SHUFFLE_ROUND_COUNT)
+    shuffled = np.asarray(active_indices)[sigma]
+    count = committee_count_per_slot(n, p) * p.SLOTS_PER_EPOCH
+    return [
+        shuffled[n * k // count : n * (k + 1) // count] for k in range(count)
+    ]
+
+
+def compute_proposer_index(
+    effective_balances: np.ndarray,
+    active_indices: np.ndarray,
+    seed: bytes,
+    p: Preset,
+) -> int:
+    """Spec `compute_proposer_index` (effective-balance-weighted rejection
+    sampling). `effective_balances` is the whole-registry column in Gwei.
+
+    Uses the single-index shuffle per candidate: the proposer seed is
+    per-slot, so a whole-list shuffle could never be reused — a handful of
+    90-hash walks beats an O(n) shuffle every slot."""
+    from grandine_tpu.core.shuffling import compute_shuffled_index
+
+    total = len(active_indices)
+    if total == 0:
+        raise ValueError("empty active validator set")
+    max_eb = p.MAX_EFFECTIVE_BALANCE
+    i = 0
+    while True:
+        pos = compute_shuffled_index(i % total, total, seed, p.SHUFFLE_ROUND_COUNT)
+        candidate = int(active_indices[pos])
+        random_byte = sha256(seed + uint_to_bytes(i // 32))[i % 32]
+        if int(effective_balances[candidate]) * 0xFF >= max_eb * random_byte:
+            return candidate
+        i += 1
+
+
+# --- forks / domains / signing roots ---------------------------------------
+
+
+def compute_fork_data_root(
+    current_version: bytes, genesis_validators_root: bytes
+) -> bytes:
+    return ForkData(
+        current_version=current_version,
+        genesis_validators_root=genesis_validators_root,
+    ).hash_tree_root()
+
+
+def compute_fork_digest(
+    current_version: bytes, genesis_validators_root: bytes
+) -> bytes:
+    return compute_fork_data_root(current_version, genesis_validators_root)[:4]
+
+
+def compute_domain(
+    domain_type: bytes,
+    fork_version: bytes = b"\x00" * 4,
+    genesis_validators_root: bytes = b"\x00" * 32,
+) -> bytes:
+    root = compute_fork_data_root(fork_version, genesis_validators_root)
+    return domain_type + root[:28]
+
+
+def get_domain(state, domain_type: bytes, epoch: "int | None", p: Preset) -> bytes:
+    """Spec `get_domain` over a BeaconState: picks previous/current fork
+    version by epoch (helper_functions/src/accessors.rs get_domain)."""
+    if epoch is None:
+        epoch = compute_epoch_at_slot(int(state.slot), p)
+    fork = state.fork
+    version = (
+        bytes(fork.previous_version)
+        if epoch < int(fork.epoch)
+        else bytes(fork.current_version)
+    )
+    return compute_domain(domain_type, version, bytes(state.genesis_validators_root))
+
+
+def compute_signing_root(obj, domain: bytes) -> bytes:
+    """Spec `compute_signing_root` (helper_functions/src/misc.rs:122).
+    `obj` is a Container (its hash_tree_root is taken) or a 32-byte root."""
+    root = obj if isinstance(obj, bytes) else obj.hash_tree_root()
+    return SigningData(object_root=root, domain=domain).hash_tree_root()
+
+
+# --- seeds -----------------------------------------------------------------
+
+
+def get_randao_mix(state, epoch: int, p: Preset) -> bytes:
+    return bytes(state.randao_mixes[epoch % p.EPOCHS_PER_HISTORICAL_VECTOR])
+
+
+def get_seed(state, epoch: int, domain_type: bytes, p: Preset) -> bytes:
+    mix = get_randao_mix(
+        state, epoch + p.EPOCHS_PER_HISTORICAL_VECTOR - p.MIN_SEED_LOOKAHEAD - 1, p
+    )
+    return sha256(domain_type + uint_to_bytes(epoch) + mix)
+
+
+def proposer_seed(state, slot: int, p: Preset) -> bytes:
+    epoch = compute_epoch_at_slot(slot, p)
+    return sha256(
+        get_seed(state, epoch, DOMAIN_BEACON_PROPOSER, p) + uint_to_bytes(slot)
+    )
+
+
+# --- misc registry math ----------------------------------------------------
+
+
+def get_validator_churn_limit(active_count: int, cfg) -> int:
+    return max(
+        cfg.min_per_epoch_churn_limit, active_count // cfg.churn_limit_quotient
+    )
+
+
+def get_validator_activation_churn_limit(active_count: int, cfg) -> int:
+    """Deneb caps the activation churn (EIP-7514)."""
+    return min(
+        cfg.max_per_epoch_activation_churn_limit,
+        get_validator_churn_limit(active_count, cfg),
+    )
+
+
+__all__ = [
+    "ForkData",
+    "SigningData",
+    "sha256",
+    "uint_to_bytes",
+    "bytes_to_uint64",
+    "integer_squareroot",
+    "xor",
+    "compute_epoch_at_slot",
+    "compute_start_slot_at_epoch",
+    "compute_activation_exit_epoch",
+    "committee_count_per_slot",
+    "compute_committee_partition",
+    "compute_proposer_index",
+    "compute_fork_data_root",
+    "compute_fork_digest",
+    "compute_domain",
+    "get_domain",
+    "compute_signing_root",
+    "get_randao_mix",
+    "get_seed",
+    "proposer_seed",
+    "get_validator_churn_limit",
+    "get_validator_activation_churn_limit",
+]

@@ -1,0 +1,271 @@
+"""State mutation seam: `StateDraft` + spec mutators.
+
+Reference parity: helper_functions/src/mutators.rs (increase/decrease
+balance, initiate_validator_exit, slash_validator) operating on
+`&mut BeaconState`. Here states are immutable SSZ containers, so a block's
+worth of mutations accumulates in a `StateDraft` — balances as one numpy
+working array, registry edits as sparse per-index replacements — and
+`commit()` produces the next immutable state. This keeps per-op cost O(1)
+instead of O(registry) (a naive `SszList.set` would copy the 50k-entry
+balance array for every reward) while preserving per-validator cached
+hash-tree-roots for untouched validators.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from grandine_tpu.consensus import accessors, misc
+from grandine_tpu.types.primitives import (
+    FAR_FUTURE_EPOCH,
+    PROPOSER_WEIGHT,
+    WEIGHT_DENOMINATOR,
+    Phase,
+)
+
+
+class StateDraft:
+    """Mutable working copy of a BeaconState for one processing unit
+    (a block, or a batch of slot updates). Reads fall through to the base
+    state unless overridden; `commit()` builds the successor state."""
+
+    __slots__ = (
+        "base",
+        "cfg",
+        "p",
+        "scratch",
+        "_fields",
+        "_balances",
+        "_validators",
+        "_exit_epoch_col",
+    )
+
+    def __init__(self, state, cfg) -> None:
+        object.__setattr__(self, "base", state)
+        object.__setattr__(self, "cfg", cfg)
+        object.__setattr__(self, "p", cfg.preset)
+        object.__setattr__(self, "scratch", {})  # never committed
+        object.__setattr__(self, "_fields", {})
+        object.__setattr__(self, "_balances", None)
+        object.__setattr__(self, "_validators", None)
+        object.__setattr__(self, "_exit_epoch_col", None)
+
+    def __setattr__(self, *_):
+        raise AttributeError("use set()/mutators; StateDraft fields are managed")
+
+    # -- reads --------------------------------------------------------------
+
+    def __getattr__(self, name):
+        fields = object.__getattribute__(self, "_fields")
+        if name in fields:
+            return fields[name]
+        return getattr(object.__getattribute__(self, "base"), name)
+
+    @property
+    def balances_array(self) -> np.ndarray:
+        """Mutable uint64 working copy of state.balances."""
+        if self._balances is None:
+            base = object.__getattribute__(self, "base")
+            arr = np.array(base.balances.array, dtype=np.uint64, copy=True)
+            object.__setattr__(self, "_balances", arr)
+        return self._balances
+
+    @property
+    def validators_list(self) -> list:
+        """Mutable list of Validator containers (unchanged entries keep
+        their cached hash-tree-roots)."""
+        if self._validators is None:
+            base = object.__getattribute__(self, "base")
+            object.__setattr__(self, "_validators", list(base.validators))
+        return self._validators
+
+    def validator(self, index: int):
+        if self._validators is not None:
+            return self._validators[index]
+        return object.__getattribute__(self, "base").validators[index]
+
+    def num_validators(self) -> int:
+        if self._validators is not None:
+            return len(self._validators)
+        return len(object.__getattribute__(self, "base").validators)
+
+    def exit_epoch_column(self) -> np.ndarray:
+        """uint64 working column of exit epochs (for churn scans)."""
+        if self._exit_epoch_col is None:
+            base = object.__getattribute__(self, "base")
+            col = np.array(
+                accessors.registry_columns(base).exit_epoch,
+                dtype=np.uint64,
+                copy=True,
+            )
+            if self._validators is not None:
+                for i in range(len(col), len(self._validators)):
+                    col = np.append(col, np.uint64(FAR_FUTURE_EPOCH))
+            object.__setattr__(self, "_exit_epoch_col", col)
+        return self._exit_epoch_col
+
+    def array_field(self, name: str) -> np.ndarray:
+        """Mutable numpy working copy of a packed-basic list field (e.g.
+        participation columns, inactivity scores), committed like any other
+        overridden field."""
+        val = self._fields.get(name)
+        if isinstance(val, np.ndarray):
+            return val
+        base_val = getattr(self, name)
+        arr = np.array(base_val.array, copy=True)
+        self._fields[name] = arr
+        return arr
+
+    # -- writes -------------------------------------------------------------
+
+    def set(self, name: str, value) -> None:
+        self._fields[name] = value
+
+    def set_validator(self, index: int, validator) -> None:
+        self.validators_list[index] = validator
+        if self._exit_epoch_col is not None:
+            self._exit_epoch_col[index] = np.uint64(int(validator.exit_epoch))
+
+    def append_validator(self, validator, balance: int) -> None:
+        self.validators_list.append(validator)
+        arr = self.balances_array
+        object.__setattr__(
+            self, "_balances", np.append(arr, np.uint64(balance))
+        )
+        if self._exit_epoch_col is not None:
+            object.__setattr__(
+                self,
+                "_exit_epoch_col",
+                np.append(self._exit_epoch_col, np.uint64(int(validator.exit_epoch))),
+            )
+
+    # -- commit -------------------------------------------------------------
+
+    def commit(self):
+        base = object.__getattribute__(self, "base")
+        changes = dict(self._fields)
+        if self._validators is not None:
+            changes["validators"] = self._validators
+        if self._balances is not None:
+            changes["balances"] = self._balances
+        return base.replace(**changes) if changes else base
+
+
+# --- balance mutators -------------------------------------------------------
+
+
+def increase_balance(draft: StateDraft, index: int, delta: int) -> None:
+    arr = draft.balances_array
+    arr[index] = np.uint64(int(arr[index]) + int(delta))
+
+
+def decrease_balance(draft: StateDraft, index: int, delta: int) -> None:
+    """Saturating at zero (spec decrease_balance)."""
+    arr = draft.balances_array
+    cur = int(arr[index])
+    arr[index] = np.uint64(max(0, cur - int(delta)))
+
+
+# --- validator lifecycle ----------------------------------------------------
+
+
+def initiate_validator_exit(draft: StateDraft, index: int) -> None:
+    """Spec `initiate_validator_exit`: assign the exit-queue epoch bounded
+    by the churn limit. Churn scans are vectorized over the draft's
+    exit-epoch column."""
+    v = draft.validator(index)
+    if int(v.exit_epoch) != FAR_FUTURE_EPOCH:
+        return
+    p = draft.p
+    cfg = draft.cfg
+    base = object.__getattribute__(draft, "base")
+    current_epoch = accessors.get_current_epoch(base, p)
+
+    col = draft.exit_epoch_column()
+    exiting = col[col != np.uint64(FAR_FUTURE_EPOCH)]
+    floor = misc.compute_activation_exit_epoch(current_epoch, p)
+    exit_queue_epoch = max(int(exiting.max()), floor) if len(exiting) else floor
+    churn = int((col == np.uint64(exit_queue_epoch)).sum())
+    active_count = len(accessors.get_active_validator_indices(base, current_epoch))
+    if churn >= misc.get_validator_churn_limit(active_count, cfg):
+        exit_queue_epoch += 1
+
+    draft.set_validator(
+        index,
+        v.replace(
+            exit_epoch=exit_queue_epoch,
+            withdrawable_epoch=exit_queue_epoch
+            + cfg.min_validator_withdrawability_delay,
+        ),
+    )
+
+
+def slashing_penalty_quotient(p, phase: Phase) -> int:
+    if phase >= Phase.BELLATRIX:
+        return p.MIN_SLASHING_PENALTY_QUOTIENT_BELLATRIX
+    if phase >= Phase.ALTAIR:
+        return p.MIN_SLASHING_PENALTY_QUOTIENT_ALTAIR
+    return p.MIN_SLASHING_PENALTY_QUOTIENT
+
+
+def proportional_slashing_multiplier(p, phase: Phase) -> int:
+    if phase >= Phase.BELLATRIX:
+        return p.PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX
+    if phase >= Phase.ALTAIR:
+        return p.PROPORTIONAL_SLASHING_MULTIPLIER_ALTAIR
+    return p.PROPORTIONAL_SLASHING_MULTIPLIER
+
+
+def slash_validator(
+    draft: StateDraft,
+    slashed_index: int,
+    phase: Phase,
+    whistleblower_index: "int | None" = None,
+) -> None:
+    """Spec `slash_validator` with per-fork penalty quotients and the
+    altair proposer-weight reward split."""
+    p = draft.p
+    base = object.__getattribute__(draft, "base")
+    epoch = accessors.get_current_epoch(base, p)
+    initiate_validator_exit(draft, slashed_index)
+    v = draft.validator(slashed_index)
+    draft.set_validator(
+        slashed_index,
+        v.replace(
+            slashed=True,
+            withdrawable_epoch=max(
+                int(v.withdrawable_epoch), epoch + p.EPOCHS_PER_SLASHINGS_VECTOR
+            ),
+        ),
+    )
+    eb = int(v.effective_balance)
+    slot_index = epoch % p.EPOCHS_PER_SLASHINGS_VECTOR
+    slashings = draft.slashings
+    draft.set(
+        "slashings", slashings.set(slot_index, int(slashings[slot_index]) + eb)
+    )
+    decrease_balance(draft, slashed_index, eb // slashing_penalty_quotient(p, phase))
+
+    proposer_index = accessors.get_beacon_proposer_index(base, p)
+    if whistleblower_index is None:
+        whistleblower_index = proposer_index
+    whistleblower_reward = eb // p.WHISTLEBLOWER_REWARD_QUOTIENT
+    if phase >= Phase.ALTAIR:
+        proposer_reward = (
+            whistleblower_reward * PROPOSER_WEIGHT // WEIGHT_DENOMINATOR
+        )
+    else:
+        proposer_reward = whistleblower_reward // p.PROPOSER_REWARD_QUOTIENT
+    increase_balance(draft, proposer_index, proposer_reward)
+    increase_balance(draft, whistleblower_index, whistleblower_reward - proposer_reward)
+
+
+__all__ = [
+    "StateDraft",
+    "increase_balance",
+    "decrease_balance",
+    "initiate_validator_exit",
+    "slash_validator",
+    "slashing_penalty_quotient",
+    "proportional_slashing_multiplier",
+]

@@ -1,0 +1,107 @@
+"""Spec predicates — reference: helper_functions/src/predicates.rs
+(is_active_validator, slashability, indexed-attestation validity,
+merkle-branch validation).
+
+Registry-wide variants take numpy columns (accessors.RegistryColumns) so
+epoch processing stays vectorized.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from grandine_tpu.ssz.merkle import verify_merkle_proof
+from grandine_tpu.types.primitives import FAR_FUTURE_EPOCH
+
+
+# --- single-validator predicates (container-typed) -------------------------
+
+
+def is_active_validator(validator, epoch: int) -> bool:
+    return int(validator.activation_epoch) <= epoch < int(validator.exit_epoch)
+
+
+def is_eligible_for_activation_queue(validator, p) -> bool:
+    return (
+        int(validator.activation_eligibility_epoch) == FAR_FUTURE_EPOCH
+        and int(validator.effective_balance) == p.MAX_EFFECTIVE_BALANCE
+    )
+
+
+def is_eligible_for_activation(validator, finalized_epoch: int) -> bool:
+    return (
+        int(validator.activation_eligibility_epoch) <= finalized_epoch
+        and int(validator.activation_epoch) == FAR_FUTURE_EPOCH
+    )
+
+
+def is_slashable_validator(validator, epoch: int) -> bool:
+    return not bool(validator.slashed) and (
+        int(validator.activation_epoch) <= epoch < int(validator.withdrawable_epoch)
+    )
+
+
+# --- vectorized column variants --------------------------------------------
+
+
+def active_mask(
+    activation_epoch: np.ndarray, exit_epoch: np.ndarray, epoch: int
+) -> np.ndarray:
+    """Boolean mask of validators active at `epoch` over whole-registry
+    columns (uint64)."""
+    e = np.uint64(epoch)
+    return (activation_epoch <= e) & (e < exit_epoch)
+
+
+# --- attestation predicates ------------------------------------------------
+
+
+def is_slashable_attestation_data(data_1, data_2) -> bool:
+    """Double vote or surround vote (spec `is_slashable_attestation_data`)."""
+    double = (
+        data_1 != data_2
+        and int(data_1.target.epoch) == int(data_2.target.epoch)
+    )
+    surround = (
+        int(data_1.source.epoch) < int(data_2.source.epoch)
+        and int(data_2.target.epoch) < int(data_1.target.epoch)
+    )
+    return double or surround
+
+
+def validate_indexed_attestation(indexed, state, verifier, cfg) -> None:
+    """Spec `is_valid_indexed_attestation`, split in the reference's style:
+    structural checks raise; the signature is *deferred* into `verifier`
+    (helper_functions Verifier seam) so batch callers pay one pairing.
+
+    Raises ValueError on structural invalidity.
+    """
+    from grandine_tpu.consensus import signing
+
+    indices = list(indexed.attesting_indices)
+    if not indices:
+        raise ValueError("indexed attestation has no attesting indices")
+    if indices != sorted(set(indices)):
+        raise ValueError("attesting indices not sorted/unique")
+    n_validators = len(state.validators)
+    if indices[-1] >= n_validators:
+        raise ValueError("attesting index out of range")
+    signing.extend_with_indexed_attestation(verifier, state, indexed, cfg)
+
+
+def is_valid_merkle_branch(
+    leaf: bytes, branch, depth: int, index: int, root: bytes
+) -> bool:
+    return verify_merkle_proof(leaf, list(branch), depth, index, root)
+
+
+__all__ = [
+    "is_active_validator",
+    "is_eligible_for_activation_queue",
+    "is_eligible_for_activation",
+    "is_slashable_validator",
+    "active_mask",
+    "is_slashable_attestation_data",
+    "validate_indexed_attestation",
+    "is_valid_merkle_branch",
+]

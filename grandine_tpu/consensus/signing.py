@@ -1,0 +1,276 @@
+"""Signing-root machinery per signed container — reference:
+helper_functions/src/signing.rs:59-405 (`SignForSingleFork` /
+`SignForAllForks` impls for every signed object kind).
+
+Each `*_signing_root` computes the spec domain + signing root for one signed
+container kind; each `extend_with_*` resolves the signer's public key(s)
+from the state and defers the check into a Verifier. The fork-version
+plumbing (which fork version signs which object, including the EIP-7044
+capella-pinned voluntary exits and the genesis-pinned BLS-to-execution
+changes) lives here and nowhere else.
+"""
+
+from __future__ import annotations
+
+from grandine_tpu.consensus import accessors, keys, misc
+from grandine_tpu.consensus.verifier import SignatureInvalid, Verifier
+from grandine_tpu.ssz import uint64
+from grandine_tpu.types.primitives import (
+    DOMAIN_AGGREGATE_AND_PROOF,
+    DOMAIN_BEACON_ATTESTER,
+    DOMAIN_BEACON_PROPOSER,
+    DOMAIN_BLS_TO_EXECUTION_CHANGE,
+    DOMAIN_CONTRIBUTION_AND_PROOF,
+    DOMAIN_DEPOSIT,
+    DOMAIN_RANDAO,
+    DOMAIN_SELECTION_PROOF,
+    DOMAIN_SYNC_COMMITTEE,
+    DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF,
+    DOMAIN_VOLUNTARY_EXIT,
+    Phase,
+)
+
+
+def _pubkey(state, index: int):
+    cols = accessors.registry_columns(state)
+    try:
+        return keys.decompress_pubkey(cols.pubkeys[index])
+    except Exception as e:
+        raise SignatureInvalid(f"invalid registry pubkey at {index}: {e}") from e
+
+
+# --- blocks ----------------------------------------------------------------
+
+
+def block_signing_root(state, block, cfg) -> bytes:
+    p = cfg.preset
+    epoch = misc.compute_epoch_at_slot(int(block.slot), p)
+    domain = misc.get_domain(state, DOMAIN_BEACON_PROPOSER, epoch, p)
+    return misc.compute_signing_root(block, domain)
+
+
+def extend_with_block_signature(v: Verifier, state, signed_block, cfg) -> None:
+    block = signed_block.message
+    root = block_signing_root(state, block, cfg)
+    v.verify_singular(
+        root, bytes(signed_block.signature), _pubkey(state, int(block.proposer_index))
+    )
+
+
+def header_signing_root(state, header, cfg) -> bytes:
+    """SignedBeaconBlockHeader (proposer slashings)."""
+    p = cfg.preset
+    epoch = misc.compute_epoch_at_slot(int(header.slot), p)
+    domain = misc.get_domain(state, DOMAIN_BEACON_PROPOSER, epoch, p)
+    return misc.compute_signing_root(header, domain)
+
+
+# --- randao ----------------------------------------------------------------
+
+
+def randao_signing_root(state, epoch: int, cfg) -> bytes:
+    domain = misc.get_domain(state, DOMAIN_RANDAO, epoch, cfg.preset)
+    return misc.compute_signing_root(uint64.hash_tree_root(epoch), domain)
+
+
+def extend_with_randao_reveal(v: Verifier, state, block, cfg) -> None:
+    epoch = misc.compute_epoch_at_slot(int(block.slot), cfg.preset)
+    root = randao_signing_root(state, epoch, cfg)
+    v.verify_singular(
+        root,
+        bytes(block.body.randao_reveal),
+        _pubkey(state, int(block.proposer_index)),
+    )
+
+
+# --- attestations ----------------------------------------------------------
+
+
+def attestation_signing_root(state, data, cfg) -> bytes:
+    domain = misc.get_domain(
+        state, DOMAIN_BEACON_ATTESTER, int(data.target.epoch), cfg.preset
+    )
+    return misc.compute_signing_root(data, domain)
+
+
+def extend_with_indexed_attestation(v: Verifier, state, indexed, cfg) -> None:
+    """fast_aggregate_verify shape: aggregate the attesting keys host-side,
+    one triple (verifier.rs Triple aggregation :367-405)."""
+    if v.is_null():
+        return
+    root = attestation_signing_root(state, indexed.data, cfg)
+    pks = [_pubkey(state, int(i)) for i in indexed.attesting_indices]
+    v.verify_aggregate(root, bytes(indexed.signature), pks)
+
+
+# --- voluntary exits -------------------------------------------------------
+
+
+def voluntary_exit_signing_root(state, exit_msg, cfg, phase: Phase) -> bytes:
+    if phase >= Phase.DENEB:
+        # EIP-7044: exits are always signed with the capella fork version
+        domain = misc.compute_domain(
+            DOMAIN_VOLUNTARY_EXIT,
+            cfg.capella_fork_version,
+            bytes(state.genesis_validators_root),
+        )
+    else:
+        domain = misc.get_domain(
+            state, DOMAIN_VOLUNTARY_EXIT, int(exit_msg.epoch), cfg.preset
+        )
+    return misc.compute_signing_root(exit_msg, domain)
+
+
+def extend_with_voluntary_exit(v: Verifier, state, signed_exit, cfg, phase) -> None:
+    msg = signed_exit.message
+    root = voluntary_exit_signing_root(state, msg, cfg, phase)
+    v.verify_singular(
+        root, bytes(signed_exit.signature), _pubkey(state, int(msg.validator_index))
+    )
+
+
+# --- deposits --------------------------------------------------------------
+
+
+def deposit_signing_root(deposit_data, cfg) -> bytes:
+    """Deposit signatures are fork-agnostic: genesis fork version, ZERO
+    genesis_validators_root (valid before genesis exists)."""
+    from grandine_tpu.types.containers import spec_types
+
+    T = spec_types(cfg.preset)
+    message = T.phase0.DepositMessage(
+        pubkey=bytes(deposit_data.pubkey),
+        withdrawal_credentials=bytes(deposit_data.withdrawal_credentials),
+        amount=int(deposit_data.amount),
+    )
+    domain = misc.compute_domain(DOMAIN_DEPOSIT, cfg.genesis_fork_version)
+    return misc.compute_signing_root(message, domain)
+
+
+# --- sync committee --------------------------------------------------------
+
+
+def sync_aggregate_signing_root(state, cfg) -> bytes:
+    """The sync aggregate in a block at slot S signs the block root at
+    slot S-1 under DOMAIN_SYNC_COMMITTEE of epoch(S-1)."""
+    p = cfg.preset
+    prev_slot = max(int(state.slot), 1) - 1
+    epoch = misc.compute_epoch_at_slot(prev_slot, p)
+    domain = misc.get_domain(state, DOMAIN_SYNC_COMMITTEE, epoch, p)
+    root = accessors.get_block_root_at_slot(state, prev_slot, p)
+    return misc.compute_signing_root(root, domain)
+
+
+def extend_with_sync_aggregate(v: Verifier, state, sync_aggregate, cfg) -> None:
+    """Participating current-sync-committee keys sign the previous block
+    root. An empty participation set with the infinity signature is valid
+    (altair `eth_fast_aggregate_verify` G2_POINT_AT_INFINITY special case)."""
+    from grandine_tpu.crypto import bls as A
+
+    bits = sync_aggregate.sync_committee_bits
+    sig = bytes(sync_aggregate.sync_committee_signature)
+    participants = [
+        keys.decompress_pubkey(bytes(state.current_sync_committee.pubkeys[i]))
+        for i in bits.nonzero_indices()
+    ]
+    if not participants:
+        if sig == A.Signature.empty().to_bytes():
+            return
+        raise SignatureInvalid("empty sync aggregate with non-infinity signature")
+    if v.is_null():
+        return
+    root = sync_aggregate_signing_root(state, cfg)
+    v.verify_aggregate(root, sig, participants)
+
+
+def sync_committee_message_signing_root(state, block_root: bytes, epoch, cfg) -> bytes:
+    domain = misc.get_domain(state, DOMAIN_SYNC_COMMITTEE, epoch, cfg.preset)
+    return misc.compute_signing_root(block_root, domain)
+
+
+# --- BLS to execution change ----------------------------------------------
+
+
+def bls_to_execution_change_signing_root(state, change, cfg) -> bytes:
+    """Pinned to the GENESIS fork version for all time (capella spec)."""
+    domain = misc.compute_domain(
+        DOMAIN_BLS_TO_EXECUTION_CHANGE,
+        cfg.genesis_fork_version,
+        bytes(state.genesis_validators_root),
+    )
+    return misc.compute_signing_root(change, domain)
+
+
+def extend_with_bls_to_execution_change(v: Verifier, state, signed_change, cfg) -> None:
+    from grandine_tpu.crypto import bls as A
+
+    change = signed_change.message
+    root = bls_to_execution_change_signing_root(state, change, cfg)
+    try:
+        pk = keys.decompress_pubkey(bytes(change.from_bls_pubkey))
+    except A.BlsError as e:
+        raise SignatureInvalid(f"invalid from_bls_pubkey: {e}") from e
+    v.verify_singular(root, bytes(signed_change.signature), pk)
+
+
+# --- aggregator duties (validator plane) -----------------------------------
+
+
+def selection_proof_signing_root(state, slot: int, cfg) -> bytes:
+    domain = misc.get_domain(
+        state,
+        DOMAIN_SELECTION_PROOF,
+        misc.compute_epoch_at_slot(slot, cfg.preset),
+        cfg.preset,
+    )
+    return misc.compute_signing_root(uint64.hash_tree_root(slot), domain)
+
+
+def aggregate_and_proof_signing_root(state, aggregate_and_proof, cfg) -> bytes:
+    p = cfg.preset
+    epoch = misc.compute_epoch_at_slot(
+        int(aggregate_and_proof.aggregate.data.slot), p
+    )
+    domain = misc.get_domain(state, DOMAIN_AGGREGATE_AND_PROOF, epoch, p)
+    return misc.compute_signing_root(aggregate_and_proof, domain)
+
+
+def contribution_and_proof_signing_root(state, contribution_and_proof, cfg) -> bytes:
+    p = cfg.preset
+    epoch = misc.compute_epoch_at_slot(
+        int(contribution_and_proof.contribution.slot), p
+    )
+    domain = misc.get_domain(state, DOMAIN_CONTRIBUTION_AND_PROOF, epoch, p)
+    return misc.compute_signing_root(contribution_and_proof, domain)
+
+
+def sync_selection_proof_signing_root(state, selection_data, cfg) -> bytes:
+    p = cfg.preset
+    epoch = misc.compute_epoch_at_slot(int(selection_data.slot), p)
+    domain = misc.get_domain(
+        state, DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF, epoch, p
+    )
+    return misc.compute_signing_root(selection_data, domain)
+
+
+__all__ = [
+    "block_signing_root",
+    "extend_with_block_signature",
+    "header_signing_root",
+    "randao_signing_root",
+    "extend_with_randao_reveal",
+    "attestation_signing_root",
+    "extend_with_indexed_attestation",
+    "voluntary_exit_signing_root",
+    "extend_with_voluntary_exit",
+    "deposit_signing_root",
+    "sync_aggregate_signing_root",
+    "extend_with_sync_aggregate",
+    "sync_committee_message_signing_root",
+    "bls_to_execution_change_signing_root",
+    "extend_with_bls_to_execution_change",
+    "selection_proof_signing_root",
+    "aggregate_and_proof_signing_root",
+    "contribution_and_proof_signing_root",
+    "sync_selection_proof_signing_root",
+]

@@ -1,0 +1,198 @@
+"""The batch-verification seam — reference: helper_functions/src/verifier.rs
+(`Verifier` trait :16-69; `NullVerifier` :121, `SingleVerifier` :171,
+`MultiVerifier` :250 with one `multi_verify` in `finish()` :302-323).
+
+This is the ONE place that knows which BLS backend runs a batch:
+
+  NullVerifier   — trust everything (own blocks, spec replay of pre-checked
+                   data)
+  SingleVerifier — eager per-signature verification on the anchor (fails
+                   fast; used to isolate bad items after a batch failure)
+  MultiVerifier  — accumulate `Triple`s, one anchor RLC batch in finish()
+  TpuVerifier    — accumulate `Triple`s, ship ONE padded batch to
+                   `TpuBlsBackend.multi_verify` (the accelerator plane)
+
+Transition/fork-choice code takes a `Verifier` argument and never sees the
+backend choice, exactly like the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from grandine_tpu.crypto import bls as A
+from grandine_tpu.crypto import constants
+
+
+class SignatureInvalid(Exception):
+    """A signature (or batch of signatures) failed verification."""
+
+
+class Triple:
+    """One deferred signature check: 32-byte signing root (the BLS message),
+    96-byte compressed signature, and the (possibly aggregated) public key
+    point (verifier.rs `Triple`)."""
+
+    __slots__ = ("message", "signature", "public_key")
+
+    def __init__(self, message: bytes, signature: bytes, public_key: "A.PublicKey"):
+        self.message = bytes(message)
+        self.signature = bytes(signature)
+        self.public_key = public_key
+
+    def __repr__(self) -> str:
+        return f"Triple(msg={self.message.hex()[:16]}…)"
+
+
+class Verifier:
+    """Interface. `verify_singular`/`verify_aggregate` enqueue or eagerly
+    check one signature; `extend` takes prebuilt triples; `finish` settles
+    whatever was deferred, raising SignatureInvalid on failure."""
+
+    def verify_singular(
+        self, message: bytes, signature: bytes, public_key: "A.PublicKey"
+    ) -> None:
+        raise NotImplementedError
+
+    def verify_aggregate(
+        self,
+        message: bytes,
+        signature: bytes,
+        public_keys: "Sequence[A.PublicKey]",
+    ) -> None:
+        """fast_aggregate_verify shape: many signers, one message. The key
+        aggregation happens here (host G1 adds); an aggregate that sums to
+        the identity is rejected at verification time (infinity pubkey)."""
+        if not public_keys:
+            raise SignatureInvalid("aggregate with no public keys")
+        self.verify_singular(message, signature, A.PublicKey.aggregate(public_keys))
+
+    def extend(self, triples: "Sequence[Triple]") -> None:
+        for t in triples:
+            self.verify_singular(t.message, t.signature, t.public_key)
+
+    def finish(self) -> None:
+        pass
+
+    def finish_async(self):
+        """Dispatch whatever finish() would settle, returning a zero-arg
+        callable that completes it (raising SignatureInvalid on failure).
+        Backends with true async dispatch (TPU) overlap the device batch
+        with host work between the two calls — the verify-∥-process split."""
+        self.finish()
+        return lambda: None
+
+    # has_option_to_defer in the reference: lets callers skip building
+    # triples when verification is a no-op (NullVerifier).
+    def is_null(self) -> bool:
+        return False
+
+
+class NullVerifier(Verifier):
+    """Trust every signature (verifier.rs:121 — used for own blocks and
+    trusted replays)."""
+
+    def verify_singular(self, message, signature, public_key) -> None:
+        pass
+
+    def verify_aggregate(self, message, signature, public_keys) -> None:
+        pass
+
+    def extend(self, triples) -> None:
+        pass
+
+    def is_null(self) -> bool:
+        return True
+
+
+class SingleVerifier(Verifier):
+    """Eager per-signature verification (verifier.rs:171). Decompresses and
+    checks immediately — the fallback that isolates a bad signature after a
+    batch rejection."""
+
+    def verify_singular(self, message, signature, public_key) -> None:
+        try:
+            sig = A.Signature.from_bytes(signature)
+        except A.BlsError as e:
+            raise SignatureInvalid(f"malformed signature: {e}") from e
+        if not sig.verify(bytes(message), public_key):
+            raise SignatureInvalid(f"invalid signature over {bytes(message).hex()}")
+
+
+class MultiVerifier(Verifier):
+    """Accumulate triples; one anchor RLC `multi_verify` in finish()
+    (verifier.rs:250,302-323)."""
+
+    def __init__(self) -> None:
+        self.triples: "list[Triple]" = []
+
+    def verify_singular(self, message, signature, public_key) -> None:
+        self.triples.append(Triple(message, signature, public_key))
+
+    def extend(self, triples) -> None:
+        self.triples.extend(triples)
+
+    def _decompress(self):
+        messages = []
+        signatures = []
+        keys = []
+        for t in self.triples:
+            try:
+                signatures.append(A.Signature.from_bytes(t.signature))
+            except A.BlsError as e:
+                raise SignatureInvalid(f"malformed signature: {e}") from e
+            messages.append(t.message)
+            keys.append(t.public_key)
+        return messages, signatures, keys
+
+    def finish(self) -> None:
+        if not self.triples:
+            return
+        messages, signatures, keys = self._decompress()
+        if not A.multi_verify(messages, signatures, keys):
+            raise SignatureInvalid(f"batch of {len(messages)} failed multi_verify")
+        self.triples = []
+
+
+class TpuVerifier(MultiVerifier):
+    """MultiVerifier whose finish() ships the batch to the device backend —
+    the TPU instantiation of the seam (SURVEY.md §2.2: a TpuVerifier in
+    finish() requires zero changes to transition/fork-choice code)."""
+
+    def __init__(self, backend=None) -> None:
+        super().__init__()
+        if backend is None:
+            from grandine_tpu.tpu.bls import TpuBlsBackend
+
+            backend = TpuBlsBackend()
+        self.backend = backend
+
+    def finish(self) -> None:
+        self.finish_async()()
+
+    def finish_async(self):
+        """Dispatch the device batch now; the returned callable forces the
+        result (XLA async-execution overlap for the verify-∥-process split)."""
+        if not self.triples:
+            return lambda: None
+        messages, signatures, keys = self._decompress()
+        n = len(messages)
+        self.triples = []
+        pending = self.backend.multi_verify_async(messages, signatures, keys)
+
+        def settle() -> None:
+            if not pending():
+                raise SignatureInvalid(f"batch of {n} failed device multi_verify")
+
+        return settle
+
+
+__all__ = [
+    "SignatureInvalid",
+    "Triple",
+    "Verifier",
+    "NullVerifier",
+    "SingleVerifier",
+    "MultiVerifier",
+    "TpuVerifier",
+]

@@ -1,0 +1,15 @@
+"""Execution-layer seam — reference: execution_engine crate
+(`ExecutionEngine` trait execution_engine/src/execution_engine.rs:21-54,
+`NullExecutionEngine` :176, `MockExecutionEngine` :210).
+
+The consensus layer only needs the notification surface; the real
+JSON-RPC engine-API client (eth1_api crate) plugs in behind the same
+interface.
+"""
+
+from grandine_tpu.execution.engine import (  # noqa: F401
+    ExecutionEngine,
+    MockExecutionEngine,
+    NullExecutionEngine,
+    PayloadStatus,
+)

@@ -1,0 +1,90 @@
+"""ExecutionEngine interface + Null/Mock test seams.
+
+Reference: execution_engine/src/execution_engine.rs:21-54 (trait with
+`notify_new_payload` / `notify_forkchoice_updated`), :176 (Null), :210
+(Mock with scripted payload statuses) — the two I/O boundaries SURVEY.md §4.3
+swaps to run integration tests without a real chain.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Optional
+
+
+class PayloadStatus(enum.Enum):
+    VALID = "VALID"
+    INVALID = "INVALID"
+    SYNCING = "SYNCING"
+    ACCEPTED = "ACCEPTED"
+
+
+class ExecutionEngine:
+    """Interface: the consensus layer notifies, the EL answers."""
+
+    def notify_new_payload(self, payload) -> PayloadStatus:
+        raise NotImplementedError
+
+    def notify_forkchoice_updated(
+        self,
+        head_block_hash: bytes,
+        safe_block_hash: bytes,
+        finalized_block_hash: bytes,
+        payload_attributes=None,
+    ) -> PayloadStatus:
+        raise NotImplementedError
+
+    def allow_optimistic_import(self) -> bool:
+        return True
+
+
+class NullExecutionEngine(ExecutionEngine):
+    """Accepts everything (reference NullExecutionEngine: consensus-only
+    operation, spec replays)."""
+
+    def notify_new_payload(self, payload) -> PayloadStatus:
+        return PayloadStatus.VALID
+
+    def notify_forkchoice_updated(
+        self, head_block_hash, safe_block_hash, finalized_block_hash,
+        payload_attributes=None,
+    ) -> PayloadStatus:
+        return PayloadStatus.VALID
+
+
+class MockExecutionEngine(ExecutionEngine):
+    """Scripted statuses for fault-injection tests (reference
+    MockExecutionEngine). `status_for` maps payload block_hash -> status;
+    unknown hashes return `default`."""
+
+    def __init__(
+        self,
+        default: PayloadStatus = PayloadStatus.VALID,
+        status_for: "Optional[dict]" = None,
+    ) -> None:
+        self.default = default
+        self.status_for = dict(status_for or {})
+        self.new_payload_calls: list = []
+        self.forkchoice_calls: list = []
+
+    def notify_new_payload(self, payload) -> PayloadStatus:
+        block_hash = bytes(payload.block_hash)
+        self.new_payload_calls.append(block_hash)
+        return self.status_for.get(block_hash, self.default)
+
+    def notify_forkchoice_updated(
+        self, head_block_hash, safe_block_hash, finalized_block_hash,
+        payload_attributes=None,
+    ) -> PayloadStatus:
+        self.forkchoice_calls.append(
+            (bytes(head_block_hash), bytes(safe_block_hash), bytes(finalized_block_hash))
+        )
+        return self.status_for.get(bytes(head_block_hash), self.default)
+
+
+__all__ = [
+    "PayloadStatus",
+    "ExecutionEngine",
+    "NullExecutionEngine",
+    "MockExecutionEngine",
+]

@@ -288,24 +288,54 @@ class TpuBlsBackend:
         dst: bytes = constants.DST_SIGNATURE,
         rng=secrets,
     ) -> bool:
+        return self.multi_verify_async(messages, signatures, public_keys, dst, rng)()
+
+    def multi_verify_async(
+        self,
+        messages: Sequence[bytes],
+        signatures: Sequence["A.Signature"],
+        public_keys: Sequence["A.PublicKey"],
+        dst: bytes = constants.DST_SIGNATURE,
+        rng=secrets,
+    ):
+        """Dispatch the batch to the device WITHOUT blocking: returns a
+        zero-arg callable producing the bool. XLA execution is async until
+        the result is forced, so host work (block processing) overlaps the
+        device pairing — the seam `combined.custom_state_transition` uses
+        for its verify-∥-process split."""
         n = len(messages)
         if not (n == len(signatures) == len(public_keys)):
-            return False
+            return lambda: False
         if n == 0:
-            return True
+            return lambda: True
         if n > MAX_BUCKET:
-            return all(
-                self.multi_verify(
+            # Two-deep pipeline: only chunk 0 is dispatched now (so callers
+            # still overlap it with host work); settle() dispatches chunk
+            # k+1 before forcing chunk k. Bounds device residency at two
+            # chunks and stops dispatching after the first failure.
+            def chunk(i):
+                return self.multi_verify_async(
                     messages[i : i + MAX_BUCKET],
                     signatures[i : i + MAX_BUCKET],
                     public_keys[i : i + MAX_BUCKET],
                     dst,
                     rng,
                 )
-                for i in range(0, n, MAX_BUCKET)
-            )
+
+            first = chunk(0)
+
+            def settle_chunks() -> bool:
+                pending = first
+                for i in range(MAX_BUCKET, n, MAX_BUCKET):
+                    nxt = chunk(i)
+                    if not pending():
+                        return False
+                    pending = nxt
+                return pending()
+
+            return settle_chunks
         if any(pk.point.is_infinity() for pk in public_keys):
-            return False
+            return lambda: False
         b = _bucket(n)
         pk_x = np.zeros((b, L.NLIMBS), np.int32)
         pk_y = np.zeros((b, L.NLIMBS), np.int32)
@@ -326,9 +356,10 @@ class TpuBlsBackend:
         scalars = [self._nonzero_u64(rng) for _ in range(n)] + [1] * (b - n)
         r_bits = C.scalars_to_bits_msb(scalars, 64)
         fn = self._jitted("multi_verify", multi_verify_kernel)
-        return bool(
-            fn(pk_x, pk_y, pk_inf, sig_x, sig_y, sig_inf, msg_x, msg_y, msg_inf, r_bits)
-        )
+        result = fn(
+            pk_x, pk_y, pk_inf, sig_x, sig_y, sig_inf, msg_x, msg_y, msg_inf, r_bits
+        )  # async dispatch; forcing happens in the returned closure
+        return lambda: bool(result)
 
     def verify(
         self,

@@ -1,0 +1,21 @@
+"""State-transition functions — the equivalent of the reference's
+`transition_functions` crate (per-fork slot/epoch/block processing with the
+fork-dispatching `combined` entry points and the verify-∥-process split).
+
+Layout:
+  genesis.py       — interop/genesis state construction (genesis/interop crates)
+  slots.py         — process_slot(s) incl. epoch-boundary dispatch
+  epoch_common.py  — justification/finality engine + final-updates shared code
+  epoch_phase0.py  — pending-attestation-based epoch processing
+  epoch_altair.py  — participation-flag epoch processing (altair..deneb)
+  block.py         — per-fork block processing + signature collection
+  combined.py      — fork dispatch: state_transition / untrusted_state_transition
+"""
+
+from grandine_tpu.transition.combined import (  # noqa: F401
+    custom_state_transition,
+    process_slots,
+    state_transition,
+    untrusted_state_transition,
+    verify_signatures,
+)

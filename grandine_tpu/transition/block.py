@@ -1,0 +1,736 @@
+"""Block processing, phase0..deneb — reference:
+transition_functions/src/{phase0,altair,…}/block_processing.rs and
+unphased/block_processing.rs (shared operation processing).
+
+Structure mirrors the reference's verify-∥-process split: `collect_signatures`
+builds every deferred signature check for a signed block into a Verifier
+(the batch side), while `process_block` performs the state mutation with NO
+pairing work inside. `combined.state_transition` overlaps the two: the
+device batch is dispatched asynchronously before host-side processing runs
+(the XLA-async equivalent of the reference's
+`rayon::join(verify_signatures, process_block)`,
+transition_functions/src/altair/state_transition.rs:65).
+
+Raises TransitionError (structural) or SignatureInvalid (crypto) on
+invalid blocks.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from grandine_tpu.consensus import accessors, misc, mutators, predicates, signing
+from grandine_tpu.consensus.keys import decompress_pubkey
+from grandine_tpu.consensus.mutators import StateDraft
+from grandine_tpu.consensus.verifier import SignatureInvalid, Verifier
+from grandine_tpu.crypto import bls as A
+from grandine_tpu.types.primitives import (
+    DEPOSIT_CONTRACT_TREE_DEPTH,
+    ETH1_ADDRESS_WITHDRAWAL_PREFIX,
+    FAR_FUTURE_EPOCH,
+    PARTICIPATION_FLAG_WEIGHTS,
+    PROPOSER_WEIGHT,
+    SYNC_REWARD_WEIGHT,
+    WEIGHT_DENOMINATOR,
+    Phase,
+)
+
+ZERO32 = b"\x00" * 32
+
+
+class TransitionError(ValueError):
+    """Structurally invalid block/operation."""
+
+
+def _require(cond: bool, what: str) -> None:
+    if not cond:
+        raise TransitionError(what)
+
+
+# =========================================================== signature plane
+
+
+def collect_signatures(state, signed_block, verifier: Verifier, cfg, phase: Phase):
+    """Build every deferred signature check of `signed_block` against the
+    (slot-advanced) pre-state into `verifier` — the verify half of the
+    reference's per-fork `verify_signatures`
+    (transition_functions/src/altair/state_transition.rs:72-197).
+
+    Deposits are excluded: their proof-of-possession uses a fork-agnostic
+    domain and an invalid deposit signature skips the deposit rather than
+    invalidating the block (spec apply_deposit), so they are settled
+    separately in process_operations.
+    """
+    block = signed_block.message
+    body = block.body
+
+    signing.extend_with_block_signature(verifier, state, signed_block, cfg)
+    signing.extend_with_randao_reveal(verifier, state, block, cfg)
+
+    for ps in body.proposer_slashings:
+        for signed_header in (ps.signed_header_1, ps.signed_header_2):
+            header = signed_header.message
+            root = signing.header_signing_root(state, header, cfg)
+            verifier.verify_singular(
+                root,
+                bytes(signed_header.signature),
+                _registry_pubkey(state, int(header.proposer_index)),
+            )
+
+    for aslash in body.attester_slashings:
+        for indexed in (aslash.attestation_1, aslash.attestation_2):
+            signing.extend_with_indexed_attestation(verifier, state, indexed, cfg)
+
+    from grandine_tpu.types.containers import spec_types
+
+    ns = getattr(spec_types(cfg.preset), phase.key)
+    for att in body.attestations:
+        indexed = accessors.get_indexed_attestation(state, att, ns, cfg.preset)
+        signing.extend_with_indexed_attestation(verifier, state, indexed, cfg)
+
+    for exit_ in body.voluntary_exits:
+        signing.extend_with_voluntary_exit(verifier, state, exit_, cfg, phase)
+
+    if phase >= Phase.ALTAIR:
+        signing.extend_with_sync_aggregate(verifier, state, body.sync_aggregate, cfg)
+
+    if phase >= Phase.CAPELLA:
+        for change in body.bls_to_execution_changes:
+            signing.extend_with_bls_to_execution_change(verifier, state, change, cfg)
+
+
+def _registry_pubkey(state, index: int):
+    cols = accessors.registry_columns(state)
+    if index >= len(cols):
+        raise TransitionError(f"validator index {index} out of range")
+    return decompress_pubkey(cols.pubkeys[index])
+
+
+# ============================================================= block header
+
+
+def process_block_header(draft: StateDraft, block) -> None:
+    state = object.__getattribute__(draft, "base")
+    p = draft.p
+    _require(int(block.slot) == int(state.slot), "block slot != state slot")
+    _require(
+        int(block.slot) > int(state.latest_block_header.slot),
+        "block not newer than latest header",
+    )
+    proposer_index = accessors.get_beacon_proposer_index(state, p)
+    _require(
+        int(block.proposer_index) == proposer_index,
+        f"wrong proposer {int(block.proposer_index)} != {proposer_index}",
+    )
+    _require(
+        bytes(block.parent_root) == state.latest_block_header.hash_tree_root(),
+        "parent root mismatch",
+    )
+    proposer = draft.validator(proposer_index)
+    _require(not bool(proposer.slashed), "proposer is slashed")
+    Header = type(state.latest_block_header)
+    draft.set(
+        "latest_block_header",
+        Header(
+            slot=int(block.slot),
+            proposer_index=proposer_index,
+            parent_root=bytes(block.parent_root),
+            state_root=ZERO32,
+            body_root=block.body.hash_tree_root(),
+        ),
+    )
+
+
+# ==================================================================== randao
+
+
+def process_randao(draft: StateDraft, body) -> None:
+    state = object.__getattribute__(draft, "base")
+    p = draft.p
+    epoch = accessors.get_current_epoch(state, p)
+    mix = misc.xor(
+        misc.get_randao_mix(state, epoch, p),
+        misc.sha256(bytes(body.randao_reveal)),
+    )
+    mixes = draft.randao_mixes
+    draft.set(
+        "randao_mixes", mixes.set(epoch % p.EPOCHS_PER_HISTORICAL_VECTOR, mix)
+    )
+
+
+# ================================================================= eth1 data
+
+
+def process_eth1_data(draft: StateDraft, body) -> None:
+    p = draft.p
+    votes = list(draft.eth1_data_votes)
+    votes.append(body.eth1_data)
+    period_slots = p.EPOCHS_PER_ETH1_VOTING_PERIOD * p.SLOTS_PER_EPOCH
+    if sum(1 for v in votes if v == body.eth1_data) * 2 > period_slots:
+        draft.set("eth1_data", body.eth1_data)
+    draft.set("eth1_data_votes", tuple(votes))
+
+
+# ================================================================ operations
+
+
+def process_proposer_slashing(draft: StateDraft, ps, phase: Phase) -> None:
+    state = object.__getattribute__(draft, "base")
+    p = draft.p
+    h1 = ps.signed_header_1.message
+    h2 = ps.signed_header_2.message
+    _require(int(h1.slot) == int(h2.slot), "proposer slashing: slot mismatch")
+    _require(
+        int(h1.proposer_index) == int(h2.proposer_index),
+        "proposer slashing: proposer mismatch",
+    )
+    _require(h1 != h2, "proposer slashing: identical headers")
+    index = int(h1.proposer_index)
+    _require(index < draft.num_validators(), "proposer slashing: bad index")
+    proposer = draft.validator(index)
+    _require(
+        predicates.is_slashable_validator(
+            proposer, accessors.get_current_epoch(state, p)
+        ),
+        "proposer slashing: not slashable",
+    )
+    mutators.slash_validator(draft, index, phase)
+
+
+def process_attester_slashing(draft: StateDraft, aslash, phase: Phase) -> "list[int]":
+    state = object.__getattribute__(draft, "base")
+    p = draft.p
+    att1, att2 = aslash.attestation_1, aslash.attestation_2
+    _require(
+        predicates.is_slashable_attestation_data(att1.data, att2.data),
+        "attester slashing: data not slashable",
+    )
+    # structural validity of both indexed attestations (signatures were
+    # already deferred into the verifier by collect_signatures)
+    for indexed in (att1, att2):
+        indices = list(indexed.attesting_indices)
+        _require(bool(indices), "attester slashing: empty indices")
+        _require(
+            indices == sorted(set(indices)), "attester slashing: unsorted indices"
+        )
+        _require(
+            indices[-1] < draft.num_validators(),
+            "attester slashing: index out of range",
+        )
+    epoch = accessors.get_current_epoch(state, p)
+    slashed_any = []
+    common = sorted(
+        set(map(int, att1.attesting_indices))
+        & set(map(int, att2.attesting_indices))
+    )
+    for index in common:
+        if predicates.is_slashable_validator(draft.validator(index), epoch):
+            mutators.slash_validator(draft, index, phase)
+            slashed_any.append(index)
+    _require(bool(slashed_any), "attester slashing: nobody slashed")
+    return slashed_any
+
+
+def _attestation_structural_checks(draft: StateDraft, att, phase: Phase):
+    state = object.__getattribute__(draft, "base")
+    p = draft.p
+    data = att.data
+    cur = accessors.get_current_epoch(state, p)
+    prev = accessors.get_previous_epoch(state, p)
+    target_epoch = int(data.target.epoch)
+    _require(target_epoch in (prev, cur), "attestation: target epoch out of range")
+    _require(
+        target_epoch == misc.compute_epoch_at_slot(int(data.slot), p),
+        "attestation: target epoch != slot epoch",
+    )
+    slot = int(data.slot)
+    _require(
+        slot + p.MIN_ATTESTATION_INCLUSION_DELAY <= int(state.slot),
+        "attestation: too fresh",
+    )
+    if phase < Phase.DENEB:  # EIP-7045 removes the upper bound
+        _require(
+            int(state.slot) <= slot + p.SLOTS_PER_EPOCH, "attestation: too old"
+        )
+    _require(
+        int(data.index)
+        < accessors.get_committee_count_per_slot(state, target_epoch, p),
+        "attestation: bad committee index",
+    )
+    committee = accessors.get_beacon_committee(state, slot, int(data.index), p)
+    _require(
+        len(att.aggregation_bits) == len(committee),
+        "attestation: bits/committee size mismatch",
+    )
+    return committee
+
+
+def process_attestation_phase0(draft: StateDraft, att, types_ns) -> None:
+    state = object.__getattribute__(draft, "base")
+    p = draft.p
+    committee = _attestation_structural_checks(draft, att, Phase.PHASE0)
+    data = att.data
+    pending = types_ns.PendingAttestation(
+        aggregation_bits=att.aggregation_bits,
+        data=data,
+        inclusion_delay=int(state.slot) - int(data.slot),
+        proposer_index=accessors.get_beacon_proposer_index(state, p),
+    )
+    cur = accessors.get_current_epoch(state, p)
+    if int(data.target.epoch) == cur:
+        _require(
+            data.source == state.current_justified_checkpoint,
+            "attestation: source != current justified",
+        )
+        draft.set(
+            "current_epoch_attestations",
+            tuple(draft.current_epoch_attestations) + (pending,),
+        )
+    else:
+        _require(
+            data.source == state.previous_justified_checkpoint,
+            "attestation: source != previous justified",
+        )
+        draft.set(
+            "previous_epoch_attestations",
+            tuple(draft.previous_epoch_attestations) + (pending,),
+        )
+
+
+def process_attestation_altair(draft: StateDraft, att, cfg, phase: Phase) -> None:
+    state = object.__getattribute__(draft, "base")
+    p = draft.p
+    committee = _attestation_structural_checks(draft, att, phase)
+    data = att.data
+    inclusion_delay = int(state.slot) - int(data.slot)
+    try:
+        flag_indices = accessors.get_attestation_participation_flag_indices(
+            state, data, inclusion_delay, cfg, phase
+        )
+    except ValueError as e:
+        raise TransitionError(str(e)) from e
+
+    attesting = committee[np.asarray(att.aggregation_bits.array, dtype=bool)]
+    cur = accessors.get_current_epoch(state, p)
+    col_name = (
+        "current_epoch_participation"
+        if int(data.target.epoch) == cur
+        else "previous_epoch_participation"
+    )
+    participation = draft.array_field(col_name)
+    base_per_increment = accessors.get_base_reward_per_increment(state, p)
+    cols = accessors.registry_columns(state)
+    increments = cols.effective_balance.astype(np.int64) // p.EFFECTIVE_BALANCE_INCREMENT
+
+    proposer_reward_numerator = 0
+    flags = participation[attesting].astype(np.int64)
+    for flag_index in flag_indices:
+        weight = PARTICIPATION_FLAG_WEIGHTS[flag_index]
+        fresh = (flags >> flag_index) & 1 == 0
+        if not fresh.any():
+            continue
+        idx = attesting[fresh]
+        flags[fresh] |= 1 << flag_index
+        proposer_reward_numerator += int(
+            (increments[idx] * base_per_increment).sum()
+        ) * weight
+    participation[attesting] = flags.astype(participation.dtype)
+
+    if proposer_reward_numerator:
+        denominator = (
+            (WEIGHT_DENOMINATOR - PROPOSER_WEIGHT)
+            * WEIGHT_DENOMINATOR
+            // PROPOSER_WEIGHT
+        )
+        mutators.increase_balance(
+            draft,
+            accessors.get_beacon_proposer_index(state, p),
+            proposer_reward_numerator // denominator,
+        )
+
+
+def process_deposit(draft: StateDraft, deposit, cfg, phase: Phase) -> None:
+    """Spec `process_deposit`/`apply_deposit`: merkle proof against the
+    eth1 deposit root, then top-up or new-validator with eager
+    proof-of-possession (an invalid PoP skips the deposit, it does NOT
+    invalidate the block — hence no Verifier deferral; reference batches
+    these optimistically, unphased/block_processing.rs:376-404)."""
+    p = draft.p
+    leaf = deposit.data.hash_tree_root()
+    _require(
+        predicates.is_valid_merkle_branch(
+            leaf,
+            [bytes(b) for b in deposit.proof],
+            DEPOSIT_CONTRACT_TREE_DEPTH + 1,
+            int(draft.eth1_deposit_index),
+            bytes(draft.eth1_data.deposit_root),
+        ),
+        "deposit: bad merkle proof",
+    )
+    draft.set("eth1_deposit_index", int(draft.eth1_deposit_index) + 1)
+    apply_deposit(draft, deposit.data, cfg, phase)
+
+
+def apply_deposit(draft: StateDraft, data, cfg, phase: Phase) -> None:
+    p = draft.p
+    pubkey = bytes(data.pubkey)
+    amount = int(data.amount)
+    index = _pubkey_index(draft, pubkey)
+    if index is not None:
+        mutators.increase_balance(draft, index, amount)
+        return
+    # new validator: verify proof of possession eagerly
+    root = signing.deposit_signing_root(data, cfg)
+    try:
+        sig = A.Signature.from_bytes(bytes(data.signature))
+        pk = A.PublicKey.from_bytes(pubkey)
+    except A.BlsError:
+        return  # malformed: skip deposit
+    if not sig.verify(root, pk):
+        return  # invalid PoP: skip deposit
+    Validator = type(draft.validator(0)) if draft.num_validators() else None
+    if Validator is None:
+        from grandine_tpu.types.containers import spec_types
+
+        Validator = spec_types(p).phase0.Validator
+    new_validator = Validator(
+        pubkey=pubkey,
+        withdrawal_credentials=bytes(data.withdrawal_credentials),
+        effective_balance=min(
+            amount - amount % p.EFFECTIVE_BALANCE_INCREMENT,
+            p.MAX_EFFECTIVE_BALANCE,
+        ),
+        slashed=False,
+        activation_eligibility_epoch=FAR_FUTURE_EPOCH,
+        activation_epoch=FAR_FUTURE_EPOCH,
+        exit_epoch=FAR_FUTURE_EPOCH,
+        withdrawable_epoch=FAR_FUTURE_EPOCH,
+    )
+    draft.append_validator(new_validator, amount)
+    _register_pubkey(draft, pubkey, draft.num_validators() - 1)
+    if phase >= Phase.ALTAIR:
+        for name in ("previous_epoch_participation", "current_epoch_participation"):
+            arr = draft.array_field(name)
+            draft.set(name, np.append(arr, np.uint8(0)))
+        scores = draft.array_field("inactivity_scores")
+        draft.set("inactivity_scores", np.append(scores, np.uint64(0)))
+
+
+def _pubkey_index(draft: StateDraft, pubkey: bytes) -> "int | None":
+    state = object.__getattribute__(draft, "base")
+    lookup = draft.scratch.get("pubkey_lookup")
+    if lookup is None:
+        cols = accessors.registry_columns(state)
+        lookup = {pk: i for i, pk in enumerate(cols.pubkeys)}
+        draft.scratch["pubkey_lookup"] = lookup
+    return lookup.get(pubkey)
+
+
+def _register_pubkey(draft: StateDraft, pubkey: bytes, index: int) -> None:
+    lookup = draft.scratch.get("pubkey_lookup")
+    if lookup is not None:
+        lookup[pubkey] = index
+
+
+def process_voluntary_exit(draft: StateDraft, signed_exit) -> None:
+    state = object.__getattribute__(draft, "base")
+    p, cfg = draft.p, draft.cfg
+    exit_msg = signed_exit.message
+    index = int(exit_msg.validator_index)
+    _require(index < draft.num_validators(), "exit: bad index")
+    validator = draft.validator(index)
+    cur = accessors.get_current_epoch(state, p)
+    _require(predicates.is_active_validator(validator, cur), "exit: not active")
+    _require(
+        int(validator.exit_epoch) == FAR_FUTURE_EPOCH, "exit: already exiting"
+    )
+    _require(cur >= int(exit_msg.epoch), "exit: epoch in the future")
+    _require(
+        cur >= int(validator.activation_epoch) + cfg.shard_committee_period,
+        "exit: too young",
+    )
+    mutators.initiate_validator_exit(draft, index)
+
+
+def process_bls_to_execution_change(draft: StateDraft, signed_change) -> None:
+    change = signed_change.message
+    index = int(change.validator_index)
+    _require(index < draft.num_validators(), "bls change: bad index")
+    validator = draft.validator(index)
+    creds = bytes(validator.withdrawal_credentials)
+    _require(creds[:1] == b"\x00", "bls change: not BLS credentials")
+    _require(
+        creds[1:] == misc.sha256(bytes(change.from_bls_pubkey))[1:],
+        "bls change: pubkey does not match credentials",
+    )
+    draft.set_validator(
+        index,
+        validator.replace(
+            withdrawal_credentials=(
+                ETH1_ADDRESS_WITHDRAWAL_PREFIX
+                + b"\x00" * 11
+                + bytes(change.to_execution_address)
+            )
+        ),
+    )
+
+
+def process_operations(draft: StateDraft, body, cfg, phase: Phase, types_ns) -> None:
+    state = object.__getattribute__(draft, "base")
+    p = draft.p
+    expected_deposits = min(
+        p.MAX_DEPOSITS,
+        int(state.eth1_data.deposit_count) - int(state.eth1_deposit_index),
+    )
+    _require(
+        len(body.deposits) == expected_deposits,
+        f"expected {expected_deposits} deposits, block has {len(body.deposits)}",
+    )
+    for ps in body.proposer_slashings:
+        process_proposer_slashing(draft, ps, phase)
+    for aslash in body.attester_slashings:
+        process_attester_slashing(draft, aslash, phase)
+    for att in body.attestations:
+        if phase == Phase.PHASE0:
+            process_attestation_phase0(draft, att, types_ns)
+        else:
+            process_attestation_altair(draft, att, cfg, phase)
+    for deposit in body.deposits:
+        process_deposit(draft, deposit, cfg, phase)
+    for exit_ in body.voluntary_exits:
+        process_voluntary_exit(draft, exit_)
+    if phase >= Phase.CAPELLA:
+        for change in body.bls_to_execution_changes:
+            process_bls_to_execution_change(draft, change)
+
+
+# ============================================================ sync aggregate
+
+
+def process_sync_aggregate(draft: StateDraft, sync_aggregate) -> None:
+    """Altair `process_sync_aggregate` reward flow (the signature was
+    deferred by collect_signatures)."""
+    state = object.__getattribute__(draft, "base")
+    p = draft.p
+    total_active_increments = (
+        accessors.get_total_active_balance(state, p) // p.EFFECTIVE_BALANCE_INCREMENT
+    )
+    total_base_rewards = (
+        accessors.get_base_reward_per_increment(state, p) * total_active_increments
+    )
+    max_participant_rewards = (
+        total_base_rewards * SYNC_REWARD_WEIGHT
+        // WEIGHT_DENOMINATOR
+        // p.SLOTS_PER_EPOCH
+    )
+    participant_reward = max_participant_rewards // p.SYNC_COMMITTEE_SIZE
+    proposer_reward = (
+        participant_reward * PROPOSER_WEIGHT // (WEIGHT_DENOMINATOR - PROPOSER_WEIGHT)
+    )
+    proposer_index = accessors.get_beacon_proposer_index(state, p)
+
+    committee_pubkeys = [
+        bytes(pk) for pk in state.current_sync_committee.pubkeys
+    ]
+    committee_indices = [
+        _pubkey_index(draft, pk) for pk in committee_pubkeys
+    ]
+    bits = sync_aggregate.sync_committee_bits
+    for participant_index, bit in zip(committee_indices, bits):
+        _require(participant_index is not None, "sync committee pubkey unknown")
+        if bit:
+            mutators.increase_balance(draft, participant_index, participant_reward)
+            mutators.increase_balance(draft, proposer_index, proposer_reward)
+        else:
+            mutators.decrease_balance(draft, participant_index, participant_reward)
+
+
+# ======================================================== execution payload
+
+
+def _is_merge_transition_complete(state) -> bool:
+    header = state.latest_execution_payload_header
+    return header != type(header)()
+
+
+def process_withdrawals(draft: StateDraft, payload, types_ns) -> None:
+    """Capella `process_withdrawals`: sweep, compare against payload, debit."""
+    state = object.__getattribute__(draft, "base")
+    p = draft.p
+    expected = get_expected_withdrawals(state, draft, types_ns)
+    got = list(payload.withdrawals)
+    _require(
+        len(got) == len(expected) and all(a == b for a, b in zip(got, expected)),
+        "withdrawals: payload does not match expected sweep",
+    )
+    for w in expected:
+        mutators.decrease_balance(draft, int(w.validator_index), int(w.amount))
+    if expected:
+        draft.set("next_withdrawal_index", int(expected[-1].index) + 1)
+    n = draft.num_validators()
+    if len(expected) == p.MAX_WITHDRAWALS_PER_PAYLOAD:
+        draft.set(
+            "next_withdrawal_validator_index",
+            (int(expected[-1].validator_index) + 1) % n,
+        )
+    else:
+        draft.set(
+            "next_withdrawal_validator_index",
+            (int(state.next_withdrawal_validator_index)
+             + p.MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP) % n,
+        )
+
+
+def get_expected_withdrawals(state, draft, types_ns) -> list:
+    p = draft.p if draft is not None else None
+    if p is None:
+        raise ValueError("draft required")
+    epoch = accessors.get_current_epoch(state, p)
+    withdrawal_index = int(state.next_withdrawal_index)
+    validator_index = int(state.next_withdrawal_validator_index)
+    cols = accessors.registry_columns(state)
+    balances = (
+        draft.balances_array
+        if object.__getattribute__(draft, "_balances") is not None
+        else np.asarray(state.balances.array, dtype=np.uint64)
+    )
+    n = len(cols)
+    out = []
+    for _ in range(min(n, p.MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP)):
+        balance = int(balances[validator_index])
+        creds = cols.withdrawal_credentials[validator_index]
+        has_eth1 = creds[:1] == ETH1_ADDRESS_WITHDRAWAL_PREFIX
+        fully = (
+            has_eth1
+            and int(cols.withdrawable_epoch[validator_index]) <= epoch
+            and balance > 0
+        )
+        partially = (
+            has_eth1
+            and int(cols.effective_balance[validator_index]) == p.MAX_EFFECTIVE_BALANCE
+            and balance > p.MAX_EFFECTIVE_BALANCE
+        )
+        if fully or partially:
+            out.append(
+                types_ns.Withdrawal(
+                    index=withdrawal_index,
+                    validator_index=validator_index,
+                    address=creds[12:],
+                    amount=balance if fully else balance - p.MAX_EFFECTIVE_BALANCE,
+                )
+            )
+            withdrawal_index += 1
+        if len(out) == p.MAX_WITHDRAWALS_PER_PAYLOAD:
+            break
+        validator_index = (validator_index + 1) % n
+    return out
+
+
+def process_execution_payload(
+    draft: StateDraft, body, cfg, phase: Phase, execution_engine, types_ns
+) -> None:
+    state = object.__getattribute__(draft, "base")
+    p = draft.p
+    payload = body.execution_payload
+    if phase >= Phase.CAPELLA or _is_merge_transition_complete(state):
+        _require(
+            bytes(payload.parent_hash)
+            == bytes(state.latest_execution_payload_header.block_hash),
+            "payload: parent hash mismatch",
+        )
+    _require(
+        bytes(payload.prev_randao)
+        == misc.get_randao_mix(state, accessors.get_current_epoch(state, p), p),
+        "payload: prev_randao mismatch",
+    )
+    expected_ts = int(state.genesis_time) + int(state.slot) * cfg.seconds_per_slot
+    _require(int(payload.timestamp) == expected_ts, "payload: bad timestamp")
+    if phase >= Phase.DENEB:
+        _require(
+            len(body.blob_kzg_commitments) <= p.MAX_BLOBS_PER_BLOCK,
+            "too many blob commitments",
+        )
+    from grandine_tpu.execution import PayloadStatus
+
+    status = execution_engine.notify_new_payload(payload)
+    _require(
+        status in (PayloadStatus.VALID, PayloadStatus.SYNCING, PayloadStatus.ACCEPTED),
+        f"payload rejected by execution engine: {status}",
+    )
+
+    header_fields = dict(
+        parent_hash=bytes(payload.parent_hash),
+        fee_recipient=bytes(payload.fee_recipient),
+        state_root=bytes(payload.state_root),
+        receipts_root=bytes(payload.receipts_root),
+        logs_bloom=bytes(payload.logs_bloom),
+        prev_randao=bytes(payload.prev_randao),
+        block_number=int(payload.block_number),
+        gas_limit=int(payload.gas_limit),
+        gas_used=int(payload.gas_used),
+        timestamp=int(payload.timestamp),
+        extra_data=bytes(payload.extra_data),
+        base_fee_per_gas=int(payload.base_fee_per_gas),
+        block_hash=bytes(payload.block_hash),
+        transactions_root=payload.transactions.hash_tree_root(),
+    )
+    if phase >= Phase.CAPELLA:
+        header_fields["withdrawals_root"] = payload.withdrawals.hash_tree_root()
+    if phase >= Phase.DENEB:
+        header_fields["blob_gas_used"] = int(payload.blob_gas_used)
+        header_fields["excess_blob_gas"] = int(payload.excess_blob_gas)
+    draft.set(
+        "latest_execution_payload_header",
+        types_ns.ExecutionPayloadHeader(**header_fields),
+    )
+
+
+# ================================================================ full block
+
+
+def process_block(
+    draft: StateDraft, block, cfg, phase: Phase, execution_engine, types_ns
+) -> None:
+    """The mutation half (no pairings): header → (withdrawals → payload) →
+    randao → eth1 → operations → sync aggregate."""
+    process_block_header(draft, block)
+    body = block.body
+    if phase >= Phase.BELLATRIX:
+        # bellatrix `is_execution_enabled`: merge complete or a real payload
+        execution_enabled = (
+            phase >= Phase.CAPELLA
+            or _is_merge_transition_complete(object.__getattribute__(draft, "base"))
+            or body.execution_payload != type(body.execution_payload)()
+        )
+        if execution_enabled:
+            if phase >= Phase.CAPELLA:
+                process_withdrawals(draft, body.execution_payload, types_ns)
+            process_execution_payload(
+                draft, body, cfg, phase, execution_engine, types_ns
+            )
+    process_randao(draft, body)
+    process_eth1_data(draft, body)
+    process_operations(draft, body, cfg, phase, types_ns)
+    if phase >= Phase.ALTAIR:
+        process_sync_aggregate(draft, body.sync_aggregate)
+
+
+__all__ = [
+    "TransitionError",
+    "collect_signatures",
+    "process_block",
+    "process_block_header",
+    "process_randao",
+    "process_eth1_data",
+    "process_operations",
+    "process_attestation_phase0",
+    "process_attestation_altair",
+    "process_deposit",
+    "apply_deposit",
+    "process_voluntary_exit",
+    "process_bls_to_execution_change",
+    "process_sync_aggregate",
+    "process_withdrawals",
+    "get_expected_withdrawals",
+    "process_execution_payload",
+]

@@ -1,0 +1,131 @@
+"""Fork-dispatching state transition — reference:
+transition_functions/src/combined.rs (`untrusted_state_transition` :45,
+`custom_state_transition` :101-160) and the per-fork `state_transition`
+(altair/state_transition.rs:23-70).
+
+The verify-∥-process split: signatures are collected into the Verifier and
+dispatched (asynchronously, for the TPU backend — XLA execution overlaps
+host Python) BEFORE block processing runs; the result is awaited after.
+This is the accelerator-era twin of the reference's
+`rayon::join(verify_signatures, process_block)`
+(altair/state_transition.rs:65).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from grandine_tpu.consensus.mutators import StateDraft
+from grandine_tpu.consensus.verifier import (
+    MultiVerifier,
+    NullVerifier,
+    SignatureInvalid,
+    Verifier,
+)
+from grandine_tpu.transition import block as block_mod
+from grandine_tpu.transition.block import TransitionError
+from grandine_tpu.transition.fork_upgrade import state_phase
+from grandine_tpu.transition.slots import process_slots  # noqa: F401 (re-export)
+from grandine_tpu.types.containers import spec_types
+from grandine_tpu.types.primitives import Phase
+
+ZERO32 = b"\x00" * 32
+
+
+class StateRootMismatch(TransitionError):
+    pass
+
+
+def verify_signatures(state, signed_block, verifier: Verifier, cfg) -> None:
+    """Collect + settle all of a block's signatures against `state` (the
+    slot-advanced pre-state) without mutating anything — reference
+    combined::verify_signatures (used by the block-verification pool)."""
+    phase = cfg.phase_at_slot(int(signed_block.message.slot))
+    block_mod.collect_signatures(state, signed_block, verifier, cfg, phase)
+    verifier.finish()
+
+
+def custom_state_transition(
+    state,
+    signed_block,
+    cfg,
+    verifier: "Optional[Verifier]" = None,
+    execution_engine=None,
+    state_root_policy: str = "verify",
+):
+    """Full state transition with a caller-chosen verifier and execution
+    engine (reference custom_state_transition, combined.rs:101).
+
+    state_root_policy: "verify" compares the post-state root against
+    block.state_root (raising StateRootMismatch), "trust" skips the
+    comparison (reference StateRootPolicy::Trust for own blocks).
+    """
+    if verifier is None:
+        verifier = MultiVerifier()
+    if execution_engine is None:
+        from grandine_tpu.execution import NullExecutionEngine
+
+        execution_engine = NullExecutionEngine()
+
+    block = signed_block.message
+    slot = int(block.slot)
+    if int(state.slot) < slot:
+        state = process_slots(state, slot, cfg)
+    phase = state_phase(state, cfg)
+    ns = getattr(spec_types(cfg.preset), phase.key)
+
+    # --- verify ∥ process: dispatch the signature batch, then mutate
+    block_mod.collect_signatures(state, signed_block, verifier, cfg, phase)
+    settle = verifier.finish_async()
+
+    draft = StateDraft(state, cfg)
+    process_error: "Optional[Exception]" = None
+    try:
+        block_mod.process_block(draft, block, cfg, phase, execution_engine, ns)
+    except Exception as e:  # settle the device batch either way; an invalid
+        process_error = e   # signature outranks a processing error
+    settle()
+    if process_error is not None:
+        raise process_error
+    post = draft.commit()
+
+    if state_root_policy == "verify":
+        expected = bytes(block.state_root)
+        actual = post.hash_tree_root()
+        if actual != expected:
+            raise StateRootMismatch(
+                f"state root {actual.hex()} != block.state_root {expected.hex()}"
+            )
+    return post
+
+
+def state_transition(state, signed_block, cfg, verifier=None, **kw):
+    """Alias of custom_state_transition (per-fork dispatch is internal)."""
+    return custom_state_transition(state, signed_block, cfg, verifier, **kw)
+
+
+def untrusted_state_transition(state, signed_block, cfg):
+    """Spec `state_transition(..., validate_result=True)` — batch signature
+    verification, state-root check (reference untrusted_state_transition,
+    combined.rs:45)."""
+    return custom_state_transition(
+        state, signed_block, cfg, MultiVerifier(), state_root_policy="verify"
+    )
+
+
+def trusted_state_transition(state, signed_block, cfg):
+    """No signature checks, no state-root check (own blocks / replays)."""
+    return custom_state_transition(
+        state, signed_block, cfg, NullVerifier(), state_root_policy="trust"
+    )
+
+
+__all__ = [
+    "StateRootMismatch",
+    "verify_signatures",
+    "custom_state_transition",
+    "state_transition",
+    "untrusted_state_transition",
+    "trusted_state_transition",
+    "process_slots",
+]

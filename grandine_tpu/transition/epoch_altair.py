@@ -1,0 +1,200 @@
+"""Altair..Deneb epoch processing — reference:
+transition_functions/src/altair/epoch_processing.rs and
+epoch_intermediates.rs (participation-flag deltas, inactivity scores, sync
+committee rotation), with bellatrix+ penalty-quotient overrides.
+
+Every per-validator pass is one numpy expression over registry columns —
+the whole epoch's reward accounting is a handful of vectorized ops.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from grandine_tpu.consensus import accessors, misc
+from grandine_tpu.consensus.mutators import StateDraft
+from grandine_tpu.transition import epoch_common
+from grandine_tpu.types.primitives import (
+    GENESIS_EPOCH,
+    PARTICIPATION_FLAG_WEIGHTS,
+    TIMELY_HEAD_FLAG_INDEX,
+    TIMELY_TARGET_FLAG_INDEX,
+    WEIGHT_DENOMINATOR,
+    Phase,
+)
+
+
+def _inactivity_penalty_quotient(p, phase: Phase) -> int:
+    if phase >= Phase.BELLATRIX:
+        return p.INACTIVITY_PENALTY_QUOTIENT_BELLATRIX
+    return p.INACTIVITY_PENALTY_QUOTIENT_ALTAIR
+
+
+def _participation(state, epoch: int, p) -> np.ndarray:
+    cur = accessors.get_current_epoch(state, p)
+    col = (
+        state.current_epoch_participation
+        if epoch == cur
+        else state.previous_epoch_participation
+    )
+    return np.asarray(col.array, dtype=np.uint8)
+
+
+def _unslashed_flag_mask(state, flag_index: int, epoch: int, p) -> np.ndarray:
+    return accessors.get_unslashed_participating_mask(state, flag_index, epoch, p)
+
+
+def process_justification_and_finalization(draft: StateDraft) -> None:
+    state = object.__getattribute__(draft, "base")
+    p = draft.p
+    if accessors.get_current_epoch(state, p) <= GENESIS_EPOCH + 1:
+        return
+    cols = accessors.registry_columns(state)
+    eb = cols.effective_balance.astype(np.int64)
+    prev = accessors.get_previous_epoch(state, p)
+    cur = accessors.get_current_epoch(state, p)
+
+    def target_balance(epoch):
+        mask = _unslashed_flag_mask(state, TIMELY_TARGET_FLAG_INDEX, epoch, p)
+        return max(p.EFFECTIVE_BALANCE_INCREMENT, int(eb[mask].sum()))
+
+    epoch_common.weigh_justification_and_finalization(
+        draft,
+        accessors.get_total_active_balance(state, p),
+        target_balance(prev),
+        target_balance(cur),
+    )
+
+
+def process_inactivity_updates(draft: StateDraft) -> None:
+    state = object.__getattribute__(draft, "base")
+    p, cfg = draft.p, draft.cfg
+    if accessors.get_current_epoch(state, p) == GENESIS_EPOCH:
+        return
+    prev = accessors.get_previous_epoch(state, p)
+    eligible = epoch_common.get_eligible_validator_mask(state, p)
+    target_mask = _unslashed_flag_mask(state, TIMELY_TARGET_FLAG_INDEX, prev, p)
+    sc = draft.inactivity_scores
+    scores = np.asarray(getattr(sc, "array", sc), dtype=np.uint64).astype(
+        np.int64
+    )
+    n = len(scores)
+    el = eligible[:n]
+    tm = target_mask[:n]
+    new = scores.copy()
+    # participating: score -= min(1, score); else: += bias
+    new[el & tm] -= np.minimum(1, new[el & tm])
+    new[el & ~tm] += cfg.inactivity_score_bias
+    if not epoch_common.is_in_inactivity_leak(state, p):
+        dec = np.minimum(cfg.inactivity_score_recovery_rate, new[el])
+        new[el] -= dec
+    if not np.array_equal(new, scores):
+        draft.set("inactivity_scores", new.astype(np.uint64))
+
+
+def process_rewards_and_penalties(draft: StateDraft, phase: Phase) -> None:
+    state = object.__getattribute__(draft, "base")
+    p = draft.p
+    if accessors.get_current_epoch(state, p) == GENESIS_EPOCH:
+        return
+    prev = accessors.get_previous_epoch(state, p)
+    cols = accessors.registry_columns(state)
+    n = len(cols)
+    eligible = epoch_common.get_eligible_validator_mask(state, p)
+    increment = p.EFFECTIVE_BALANCE_INCREMENT
+    total = accessors.get_total_active_balance(state, p)
+    active_increments = total // increment
+    base_per_increment = accessors.get_base_reward_per_increment(state, p)
+    base = (
+        cols.effective_balance.astype(np.int64) // increment * base_per_increment
+    )
+    in_leak = epoch_common.is_in_inactivity_leak(state, p)
+    eb = cols.effective_balance.astype(np.int64)
+
+    rewards = np.zeros(n, dtype=np.int64)
+    penalties = np.zeros(n, dtype=np.int64)
+
+    for flag_index, weight in enumerate(PARTICIPATION_FLAG_WEIGHTS):
+        mask = _unslashed_flag_mask(state, flag_index, prev, p)
+        participating_increments = int(eb[mask].sum()) // increment
+        got = eligible & mask
+        missed = eligible & ~mask
+        if not in_leak:
+            rewards[got] += (
+                base[got] * weight * participating_increments
+                // (active_increments * WEIGHT_DENOMINATOR)
+            )
+        if flag_index != TIMELY_HEAD_FLAG_INDEX:
+            penalties[missed] += base[missed] * weight // WEIGHT_DENOMINATOR
+
+    # inactivity penalties (score-scaled, always on) — reads the scores as
+    # updated by process_inactivity_updates earlier in this epoch (the spec
+    # mutates in place; the draft carries the update)
+    sc = draft.inactivity_scores
+    target_mask = _unslashed_flag_mask(state, TIMELY_TARGET_FLAG_INDEX, prev, p)
+    scores = np.asarray(getattr(sc, "array", sc), dtype=np.uint64).astype(
+        np.int64
+    )
+    missed_target = eligible & ~target_mask
+    denominator = draft.cfg.inactivity_score_bias * _inactivity_penalty_quotient(
+        p, phase
+    )
+    # exact integer math (eb * score can exceed int64 only at absurd scores;
+    # go through object dtype for the hit set, which is small in practice)
+    hit = np.nonzero(missed_target)[0]
+    if len(hit):
+        pen = (
+            eb[hit].astype(object) * scores[hit].astype(object) // denominator
+        )
+        penalties[hit] += pen.astype(np.int64)
+
+    balances = draft.balances_array
+    net = balances.astype(np.int64) + rewards - penalties
+    np.maximum(net, 0, out=net)
+    balances[:] = net.astype(np.uint64)
+
+
+def process_participation_flag_updates(draft: StateDraft) -> None:
+    draft.set("previous_epoch_participation", draft.current_epoch_participation)
+    draft.set(
+        "current_epoch_participation",
+        np.zeros(draft.num_validators(), dtype=np.uint8),
+    )
+
+
+def process_sync_committee_updates(state, cfg):
+    """Runs on the already-committed epoch state so the new committee's
+    balance-weighted sampling sees this epoch's effective-balance updates
+    (the spec mutates in place; order matters)."""
+    p = cfg.preset
+    next_epoch = accessors.get_current_epoch(state, p) + 1
+    if next_epoch % p.EPOCHS_PER_SYNC_COMMITTEE_PERIOD != 0:
+        return state
+    from grandine_tpu.types.containers import spec_types
+
+    phase = cfg.phase_at_epoch(next_epoch)
+    ns = getattr(spec_types(p), phase.key)
+    return state.replace(
+        current_sync_committee=state.next_sync_committee,
+        next_sync_committee=accessors.get_next_sync_committee(state, ns, cfg),
+    )
+
+
+def process_epoch(state, cfg, phase: Phase):
+    """Altair..Deneb `process_epoch`."""
+    draft = StateDraft(state, cfg)
+    process_justification_and_finalization(draft)
+    process_inactivity_updates(draft)
+    process_rewards_and_penalties(draft, phase)
+    epoch_common.process_registry_updates(draft, phase)
+    epoch_common.process_slashings(draft, phase)
+    epoch_common.process_eth1_data_reset(draft)
+    epoch_common.process_effective_balance_updates(draft)
+    epoch_common.process_slashings_reset(draft)
+    epoch_common.process_randao_mixes_reset(draft)
+    epoch_common.process_historical_roots_update(draft, phase)
+    process_participation_flag_updates(draft)
+    return process_sync_committee_updates(draft.commit(), cfg)
+
+
+__all__ = ["process_epoch"]

@@ -1,0 +1,302 @@
+"""Epoch-processing machinery shared by every fork — reference:
+transition_functions/src/unphased/epoch_processing.rs (justification/
+finality engine, registry updates, slashings, final updates).
+
+Everything registry-wide is a vectorized numpy pass over
+`accessors.RegistryColumns` — the TPU-era answer to the reference's rayon
+epoch intermediates.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from grandine_tpu.consensus import accessors, misc, mutators
+from grandine_tpu.consensus.mutators import StateDraft
+from grandine_tpu.types.primitives import (
+    FAR_FUTURE_EPOCH,
+    GENESIS_EPOCH,
+    JUSTIFICATION_BITS_LENGTH,
+    Phase,
+)
+
+
+def get_eligible_validator_mask(state, p) -> np.ndarray:
+    """Spec `get_eligible_validator_indices` as a registry mask: active in
+    the previous epoch, or slashed and not yet withdrawable."""
+    cols = accessors.registry_columns(state)
+    prev = accessors.get_previous_epoch(state, p)
+    active_prev = np.zeros(len(cols), dtype=bool)
+    active_prev[cols.active_indices(prev)] = True
+    slashed_pending = cols.slashed & (
+        np.uint64(prev + 1) < cols.withdrawable_epoch
+    )
+    return active_prev | slashed_pending
+
+
+def finality_delay(state, p) -> int:
+    return accessors.get_previous_epoch(state, p) - int(
+        state.finalized_checkpoint.epoch
+    )
+
+
+def is_in_inactivity_leak(state, p) -> bool:
+    return finality_delay(state, p) > p.MIN_EPOCHS_TO_INACTIVITY_PENALTY
+
+
+# --- justification & finality ----------------------------------------------
+
+
+def weigh_justification_and_finalization(
+    draft: StateDraft,
+    total_active_balance: int,
+    previous_target_balance: int,
+    current_target_balance: int,
+) -> None:
+    """Spec `weigh_justification_and_finalization` — identical across forks
+    once target balances are computed (pending attestations in phase0,
+    participation flags in altair+)."""
+    state = object.__getattribute__(draft, "base")
+    p = draft.p
+    previous_epoch = accessors.get_previous_epoch(state, p)
+    current_epoch = accessors.get_current_epoch(state, p)
+    old_previous_justified = draft.previous_justified_checkpoint
+    old_current_justified = draft.current_justified_checkpoint
+    Checkpoint = type(old_current_justified)
+
+    # shift justification bits
+    bits = draft.justification_bits
+    new_bits = [False] + [bool(bits[i]) for i in range(JUSTIFICATION_BITS_LENGTH - 1)]
+    draft.set("previous_justified_checkpoint", old_current_justified)
+
+    if previous_target_balance * 3 >= total_active_balance * 2:
+        draft.set(
+            "current_justified_checkpoint",
+            Checkpoint(
+                epoch=previous_epoch,
+                root=accessors.get_block_root(state, previous_epoch, p),
+            ),
+        )
+        new_bits[1] = True
+    if current_target_balance * 3 >= total_active_balance * 2:
+        draft.set(
+            "current_justified_checkpoint",
+            Checkpoint(
+                epoch=current_epoch,
+                root=accessors.get_block_root(state, current_epoch, p),
+            ),
+        )
+        new_bits[0] = True
+    draft.set("justification_bits", new_bits)
+
+    # finalization rules (234/23/123/12)
+    if (
+        all(new_bits[1:4])
+        and int(old_previous_justified.epoch) + 3 == current_epoch
+    ):
+        draft.set("finalized_checkpoint", old_previous_justified)
+    if (
+        all(new_bits[1:3])
+        and int(old_previous_justified.epoch) + 2 == current_epoch
+    ):
+        draft.set("finalized_checkpoint", old_previous_justified)
+    if (
+        all(new_bits[0:3])
+        and int(old_current_justified.epoch) + 2 == current_epoch
+    ):
+        draft.set("finalized_checkpoint", old_current_justified)
+    if (
+        all(new_bits[0:2])
+        and int(old_current_justified.epoch) + 1 == current_epoch
+    ):
+        draft.set("finalized_checkpoint", old_current_justified)
+
+
+# --- registry updates -------------------------------------------------------
+
+
+def process_registry_updates(draft: StateDraft, phase: Phase) -> None:
+    """Spec `process_registry_updates`: eligibility, ejection, and the
+    churn-limited activation queue — scans vectorized over columns."""
+    state = object.__getattribute__(draft, "base")
+    p, cfg = draft.p, draft.cfg
+    cols = accessors.registry_columns(state)
+    current_epoch = accessors.get_current_epoch(state, p)
+
+    # eligibility for the activation queue
+    eligible_queue = np.nonzero(
+        (cols.activation_eligibility_epoch == np.uint64(FAR_FUTURE_EPOCH))
+        & (cols.effective_balance == np.uint64(p.MAX_EFFECTIVE_BALANCE))
+    )[0]
+    for i in eligible_queue:
+        v = draft.validator(int(i))
+        draft.set_validator(
+            int(i), v.replace(activation_eligibility_epoch=current_epoch + 1)
+        )
+
+    # ejections
+    active = cols.active_indices(current_epoch)
+    eject = active[
+        cols.effective_balance[active] <= np.uint64(cfg.ejection_balance)
+    ]
+    for i in eject:
+        mutators.initiate_validator_exit(draft, int(i))
+
+    # activation queue, ordered by (eligibility epoch, index)
+    finalized = int(draft.finalized_checkpoint.epoch)
+    # draft may have just set eligibility epochs — rescan from the draft
+    elig = cols.activation_eligibility_epoch.copy()
+    elig[eligible_queue] = np.uint64(current_epoch + 1)
+    queue_mask = (elig <= np.uint64(finalized)) & (
+        cols.activation_epoch == np.uint64(FAR_FUTURE_EPOCH)
+    )
+    queue = np.nonzero(queue_mask)[0]
+    order = np.lexsort((queue, elig[queue]))
+    queue = queue[order]
+
+    churn = (
+        misc.get_validator_activation_churn_limit(len(active), cfg)
+        if phase >= Phase.DENEB
+        else misc.get_validator_churn_limit(len(active), cfg)
+    )
+    activation_epoch = misc.compute_activation_exit_epoch(current_epoch, p)
+    for i in queue[:churn]:
+        v = draft.validator(int(i))
+        draft.set_validator(int(i), v.replace(activation_epoch=activation_epoch))
+
+
+# --- slashings sweep --------------------------------------------------------
+
+
+def process_slashings(draft: StateDraft, phase: Phase) -> None:
+    state = object.__getattribute__(draft, "base")
+    p = draft.p
+    epoch = accessors.get_current_epoch(state, p)
+    cols = accessors.registry_columns(state)
+    total_balance = accessors.get_total_active_balance(state, p)
+    multiplier = mutators.proportional_slashing_multiplier(p, phase)
+    adjusted = min(
+        int(np.asarray(state.slashings.array, dtype=np.uint64).sum(dtype=np.uint64))
+        * multiplier,
+        total_balance,
+    )
+    target_epoch = np.uint64(epoch + p.EPOCHS_PER_SLASHINGS_VECTOR // 2)
+    hit = np.nonzero(cols.slashed & (cols.withdrawable_epoch == target_epoch))[0]
+    if len(hit) == 0:
+        return
+    increment = p.EFFECTIVE_BALANCE_INCREMENT
+    eb = cols.effective_balance[hit].astype(object)  # exact int math
+    penalties = eb // increment * adjusted // total_balance * increment
+    balances = draft.balances_array
+    for i, pen in zip(hit, penalties):
+        balances[i] = np.uint64(max(0, int(balances[i]) - int(pen)))
+
+
+# --- final updates ----------------------------------------------------------
+
+
+def process_eth1_data_reset(draft: StateDraft) -> None:
+    state = object.__getattribute__(draft, "base")
+    p = draft.p
+    next_epoch = accessors.get_current_epoch(state, p) + 1
+    if next_epoch % p.EPOCHS_PER_ETH1_VOTING_PERIOD == 0:
+        draft.set("eth1_data_votes", ())
+
+
+def process_effective_balance_updates(draft: StateDraft) -> None:
+    """Hysteresis sweep, vectorized: one compare over the registry, then
+    per-index replacement only where the effective balance actually moves."""
+    state = object.__getattribute__(draft, "base")
+    p = draft.p
+    cols = accessors.registry_columns(state)
+    balances = (
+        draft.balances_array
+        if object.__getattribute__(draft, "_balances") is not None
+        else np.asarray(state.balances.array, dtype=np.uint64)
+    )
+    increment = p.EFFECTIVE_BALANCE_INCREMENT
+    hysteresis_increment = increment // p.HYSTERESIS_QUOTIENT
+    downward = hysteresis_increment * p.HYSTERESIS_DOWNWARD_MULTIPLIER
+    upward = hysteresis_increment * p.HYSTERESIS_UPWARD_MULTIPLIER
+    eb = cols.effective_balance
+    n = min(len(eb), len(balances))
+    bal = balances[:n].astype(np.int64)
+    ebi = eb[:n].astype(np.int64)
+    needs_update = (bal + downward < ebi) | (ebi + upward < bal)
+    new_eb = np.minimum(bal - bal % increment, p.MAX_EFFECTIVE_BALANCE)
+    for i in np.nonzero(needs_update)[0]:
+        v = draft.validator(int(i))
+        draft.set_validator(int(i), v.replace(effective_balance=int(new_eb[i])))
+    # validators appended this epoch (deposits) keep their init-time EB
+
+
+def process_slashings_reset(draft: StateDraft) -> None:
+    state = object.__getattribute__(draft, "base")
+    p = draft.p
+    next_epoch = accessors.get_current_epoch(state, p) + 1
+    slashings = draft.slashings
+    draft.set(
+        "slashings", slashings.set(next_epoch % p.EPOCHS_PER_SLASHINGS_VECTOR, 0)
+    )
+
+
+def process_randao_mixes_reset(draft: StateDraft) -> None:
+    state = object.__getattribute__(draft, "base")
+    p = draft.p
+    current_epoch = accessors.get_current_epoch(state, p)
+    next_epoch = current_epoch + 1
+    mixes = draft.randao_mixes
+    draft.set(
+        "randao_mixes",
+        mixes.set(
+            next_epoch % p.EPOCHS_PER_HISTORICAL_VECTOR,
+            misc.get_randao_mix(state, current_epoch, p),
+        ),
+    )
+
+
+def process_historical_roots_update(draft: StateDraft, phase: Phase) -> None:
+    """Pre-capella: append HistoricalBatch root to historical_roots.
+    Capella+: append a HistoricalSummary instead."""
+    state = object.__getattribute__(draft, "base")
+    p = draft.p
+    next_epoch = accessors.get_current_epoch(state, p) + 1
+    if next_epoch % (p.SLOTS_PER_HISTORICAL_ROOT // p.SLOTS_PER_EPOCH) != 0:
+        return
+    from grandine_tpu.types.containers import spec_types
+
+    T = spec_types(p)
+    if phase >= Phase.CAPELLA:
+        ns = getattr(T, phase.key)
+        summary = ns.HistoricalSummary(
+            block_summary_root=draft.block_roots.hash_tree_root(),
+            state_summary_root=draft.state_roots.hash_tree_root(),
+        )
+        draft.set(
+            "historical_summaries",
+            tuple(draft.historical_summaries) + (summary,),
+        )
+    else:
+        batch = T.phase0.HistoricalBatch(
+            block_roots=draft.block_roots, state_roots=draft.state_roots
+        )
+        draft.set(
+            "historical_roots",
+            tuple(bytes(r) for r in draft.historical_roots)
+            + (batch.hash_tree_root(),),
+        )
+
+
+__all__ = [
+    "get_eligible_validator_mask",
+    "finality_delay",
+    "is_in_inactivity_leak",
+    "weigh_justification_and_finalization",
+    "process_registry_updates",
+    "process_slashings",
+    "process_eth1_data_reset",
+    "process_effective_balance_updates",
+    "process_slashings_reset",
+    "process_randao_mixes_reset",
+    "process_historical_roots_update",
+]

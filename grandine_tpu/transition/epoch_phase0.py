@@ -1,0 +1,193 @@
+"""Phase0 epoch processing — reference:
+transition_functions/src/phase0/epoch_processing.rs (pending-attestation
+matching, component deltas, inclusion-delay rewards, inactivity penalties).
+
+The per-attestation committee expansion reuses the globally-cached
+committee partitions; all per-validator accounting is numpy over registry
+columns.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from grandine_tpu.consensus import accessors, misc, mutators
+from grandine_tpu.consensus.mutators import StateDraft
+from grandine_tpu.transition import epoch_common
+from grandine_tpu.types.primitives import GENESIS_EPOCH, Phase
+
+BASE_REWARDS_PER_EPOCH = 4
+
+
+def _base_rewards(state, p) -> np.ndarray:
+    """Phase0 per-validator base reward column."""
+    cols = accessors.registry_columns(state)
+    total = accessors.get_total_active_balance(state, p)
+    sqrt_total = misc.integer_squareroot(total)
+    return (
+        cols.effective_balance.astype(np.int64)
+        * p.BASE_REWARD_FACTOR
+        // sqrt_total
+        // BASE_REWARDS_PER_EPOCH
+    )
+
+
+def _matching_attestations(state, epoch: int, p):
+    cur = accessors.get_current_epoch(state, p)
+    if epoch == cur:
+        return list(state.current_epoch_attestations)
+    if epoch == accessors.get_previous_epoch(state, p):
+        return list(state.previous_epoch_attestations)
+    raise ValueError("attestations only tracked for current/previous epoch")
+
+
+def _attesting_mask(state, attestations, p) -> np.ndarray:
+    """Union of attesting indices (unslashed) as a registry mask."""
+    cols = accessors.registry_columns(state)
+    mask = np.zeros(len(cols), dtype=bool)
+    for att in attestations:
+        idx = accessors.get_attesting_indices(
+            state, att.data, att.aggregation_bits, p
+        )
+        mask[idx] = True
+    return mask & ~cols.slashed
+
+
+def _matching_target(state, attestations, epoch: int, p):
+    root = accessors.get_block_root(state, epoch, p)
+    return [a for a in attestations if bytes(a.data.target.root) == root]
+
+
+def _matching_head(state, attestations, epoch: int, p):
+    return [
+        a
+        for a in _matching_target(state, attestations, epoch, p)
+        if bytes(a.data.beacon_block_root)
+        == accessors.get_block_root_at_slot(state, int(a.data.slot), p)
+    ]
+
+
+def process_justification_and_finalization(draft: StateDraft) -> None:
+    state = object.__getattribute__(draft, "base")
+    p = draft.p
+    if accessors.get_current_epoch(state, p) <= GENESIS_EPOCH + 1:
+        return
+    prev = accessors.get_previous_epoch(state, p)
+    cur = accessors.get_current_epoch(state, p)
+    cols = accessors.registry_columns(state)
+    eb = cols.effective_balance.astype(np.int64)
+
+    def target_balance(epoch):
+        atts = _matching_target(
+            state, _matching_attestations(state, epoch, p), epoch, p
+        )
+        mask = _attesting_mask(state, atts, p)
+        return max(p.EFFECTIVE_BALANCE_INCREMENT, int(eb[mask].sum()))
+
+    epoch_common.weigh_justification_and_finalization(
+        draft,
+        accessors.get_total_active_balance(state, p),
+        target_balance(prev),
+        target_balance(cur),
+    )
+
+
+def process_rewards_and_penalties(draft: StateDraft) -> None:
+    state = object.__getattribute__(draft, "base")
+    p = draft.p
+    if accessors.get_current_epoch(state, p) == GENESIS_EPOCH:
+        return
+    prev = accessors.get_previous_epoch(state, p)
+    cols = accessors.registry_columns(state)
+    n = len(cols)
+    eb = cols.effective_balance.astype(np.int64)
+    base = _base_rewards(state, p)
+    eligible = epoch_common.get_eligible_validator_mask(state, p)
+    total = accessors.get_total_active_balance(state, p)
+    increment = p.EFFECTIVE_BALANCE_INCREMENT
+    in_leak = epoch_common.is_in_inactivity_leak(state, p)
+
+    rewards = np.zeros(n, dtype=np.int64)
+    penalties = np.zeros(n, dtype=np.int64)
+
+    source_atts = _matching_attestations(state, prev, p)
+    target_atts = _matching_target(state, source_atts, prev, p)
+    head_atts = _matching_head(state, source_atts, prev, p)
+
+    # --- source/target/head component deltas
+    for atts in (source_atts, target_atts, head_atts):
+        mask = _attesting_mask(state, atts, p)
+        attesting_balance = max(increment, int(eb[mask].sum()))
+        got = eligible & mask
+        missed = eligible & ~mask
+        if in_leak:
+            rewards[got] += base[got]
+        else:
+            rewards[got] += (
+                base[got] * (attesting_balance // increment)
+                // (total // increment)
+            )
+        penalties[missed] += base[missed]
+
+    # --- inclusion-delay rewards (earliest source attestation per attester)
+    best_delay = np.full(n, np.iinfo(np.int64).max, dtype=np.int64)
+    best_proposer = np.full(n, -1, dtype=np.int64)
+    source_mask = _attesting_mask(state, source_atts, p)
+    for att in source_atts:
+        idx = accessors.get_attesting_indices(
+            state, att.data, att.aggregation_bits, p
+        )
+        delay = int(att.inclusion_delay)
+        better = best_delay[idx] > delay
+        upd = idx[better]
+        best_delay[upd] = delay
+        best_proposer[upd] = int(att.proposer_index)
+    attesters = np.nonzero(source_mask)[0]
+    proposer_rewards = base[attesters] // p.PROPOSER_REWARD_QUOTIENT
+    for i, prop_reward in zip(attesters, proposer_rewards):
+        rewards[best_proposer[i]] += int(prop_reward)
+        max_attester = int(base[i]) - int(prop_reward)
+        rewards[i] += max_attester // int(best_delay[i])
+
+    # --- inactivity penalties
+    if in_leak:
+        delay = epoch_common.finality_delay(state, p)
+        target_mask = _attesting_mask(state, target_atts, p)
+        penalties[eligible] += (
+            BASE_REWARDS_PER_EPOCH * base[eligible]
+            - base[eligible] // p.PROPOSER_REWARD_QUOTIENT
+        )
+        missed_target = eligible & ~target_mask
+        penalties[missed_target] += (
+            eb[missed_target] * delay // p.INACTIVITY_PENALTY_QUOTIENT
+        )
+
+    balances = draft.balances_array
+    net = balances.astype(np.int64) + rewards - penalties
+    np.maximum(net, 0, out=net)
+    balances[:] = net.astype(np.uint64)
+
+
+def process_participation_record_updates(draft: StateDraft) -> None:
+    draft.set("previous_epoch_attestations", draft.current_epoch_attestations)
+    draft.set("current_epoch_attestations", ())
+
+
+def process_epoch(state, cfg):
+    """Phase0 `process_epoch` (transition_functions/src/phase0)."""
+    p = cfg.preset
+    draft = StateDraft(state, cfg)
+    process_justification_and_finalization(draft)
+    process_rewards_and_penalties(draft)
+    epoch_common.process_registry_updates(draft, Phase.PHASE0)
+    epoch_common.process_slashings(draft, Phase.PHASE0)
+    epoch_common.process_eth1_data_reset(draft)
+    epoch_common.process_effective_balance_updates(draft)
+    epoch_common.process_slashings_reset(draft)
+    epoch_common.process_randao_mixes_reset(draft)
+    epoch_common.process_historical_roots_update(draft, Phase.PHASE0)
+    process_participation_record_updates(draft)
+    return draft.commit()
+
+
+__all__ = ["process_epoch"]

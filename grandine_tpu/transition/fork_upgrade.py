@@ -1,0 +1,158 @@
+"""Fork-boundary state upgrades — reference:
+transition_functions/src/{altair,bellatrix,capella,deneb}/fork.rs
+(`upgrade_to_*` run at the first slot of the fork epoch).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from grandine_tpu.consensus import accessors, misc
+from grandine_tpu.types.containers import spec_types
+from grandine_tpu.types.primitives import (
+    PARTICIPATION_FLAG_WEIGHTS,
+    Phase,
+)
+
+
+def state_phase(state, cfg) -> Phase:
+    """Determine a state's phase from its fork's current version."""
+    version = bytes(state.fork.current_version)
+    for phase in reversed(list(Phase)):
+        if cfg.fork_version(phase) == version:
+            return phase
+    raise ValueError(f"unknown fork version {version.hex()}")
+
+
+def _shared_fields(pre, post_cls) -> dict:
+    """Copy every field the new state class shares with the old one."""
+    post_names = {name for name, _ in post_cls.FIELDS}
+    return {
+        name: getattr(pre, name)
+        for name, _ in type(pre).FIELDS
+        if name in post_names
+    }
+
+
+def _new_fork(pre, ns, version: bytes, epoch: int):
+    return ns.Fork(
+        previous_version=bytes(pre.fork.current_version),
+        current_version=version,
+        epoch=epoch,
+    )
+
+
+def upgrade_to_altair(pre, cfg):
+    p = cfg.preset
+    ns = spec_types(p).altair
+    epoch = accessors.get_current_epoch(pre, p)
+    n = len(pre.validators)
+    fields = _shared_fields(pre, ns.BeaconState)
+    fields.pop("previous_epoch_attestations", None)
+    fields.pop("current_epoch_attestations", None)
+    fields["fork"] = _new_fork(pre, ns, cfg.altair_fork_version, epoch)
+    fields["previous_epoch_participation"] = np.zeros(n, np.uint8)
+    fields["current_epoch_participation"] = np.zeros(n, np.uint8)
+    fields["inactivity_scores"] = np.zeros(n, np.uint64)
+    post = ns.BeaconState(**fields)
+    # translate_participation: replay previous-epoch pending attestations
+    # into participation flags on the post state
+    part = np.zeros(n, np.uint8)
+    for att in pre.previous_epoch_attestations:
+        inclusion_delay = int(att.inclusion_delay)
+        try:
+            flag_indices = accessors.get_attestation_participation_flag_indices(
+                post, att.data, inclusion_delay, cfg, Phase.ALTAIR
+            )
+        except ValueError:
+            continue
+        idx = accessors.get_attesting_indices(
+            post, att.data, att.aggregation_bits, p
+        )
+        for flag_index in flag_indices:
+            part[idx] |= np.uint8(1 << flag_index)
+    post = post.replace(previous_epoch_participation=part)
+    committee = accessors.get_next_sync_committee(post, ns, cfg)
+    return post.replace(
+        current_sync_committee=committee,
+        next_sync_committee=accessors.get_next_sync_committee(post, ns, cfg),
+    )
+
+
+def upgrade_to_bellatrix(pre, cfg):
+    p = cfg.preset
+    ns = spec_types(p).bellatrix
+    epoch = accessors.get_current_epoch(pre, p)
+    fields = _shared_fields(pre, ns.BeaconState)
+    fields["fork"] = _new_fork(pre, ns, cfg.bellatrix_fork_version, epoch)
+    fields["latest_execution_payload_header"] = ns.ExecutionPayloadHeader()
+    return ns.BeaconState(**fields)
+
+
+def upgrade_to_capella(pre, cfg):
+    p = cfg.preset
+    ns = spec_types(p).capella
+    epoch = accessors.get_current_epoch(pre, p)
+    fields = _shared_fields(pre, ns.BeaconState)
+    old = pre.latest_execution_payload_header
+    fields["fork"] = _new_fork(pre, ns, cfg.capella_fork_version, epoch)
+    fields["latest_execution_payload_header"] = ns.ExecutionPayloadHeader(
+        **{
+            name: getattr(old, name)
+            for name, _ in type(old).FIELDS
+        },
+        withdrawals_root=b"\x00" * 32,
+    )
+    fields["next_withdrawal_index"] = 0
+    fields["next_withdrawal_validator_index"] = 0
+    fields["historical_summaries"] = ()
+    return ns.BeaconState(**fields)
+
+
+def upgrade_to_deneb(pre, cfg):
+    p = cfg.preset
+    ns = spec_types(p).deneb
+    epoch = accessors.get_current_epoch(pre, p)
+    fields = _shared_fields(pre, ns.BeaconState)
+    old = pre.latest_execution_payload_header
+    fields["fork"] = _new_fork(pre, ns, cfg.deneb_fork_version, epoch)
+    fields["latest_execution_payload_header"] = ns.ExecutionPayloadHeader(
+        **{name: getattr(old, name) for name, _ in type(old).FIELDS},
+        blob_gas_used=0,
+        excess_blob_gas=0,
+    )
+    return ns.BeaconState(**fields)
+
+
+_UPGRADES = {
+    Phase.ALTAIR: upgrade_to_altair,
+    Phase.BELLATRIX: upgrade_to_bellatrix,
+    Phase.CAPELLA: upgrade_to_capella,
+    Phase.DENEB: upgrade_to_deneb,
+}
+
+
+def maybe_upgrade_state(state, cfg):
+    """Apply every fork upgrade scheduled at the state's current epoch
+    (called by process_slots right after crossing into an epoch start)."""
+    p = cfg.preset
+    epoch = accessors.get_current_epoch(state, p)
+    current = state_phase(state, cfg)
+    target = cfg.phase_at_epoch(epoch)
+    while current < target:
+        nxt = Phase(current + 1)
+        if cfg.fork_epoch(nxt) > epoch:
+            break
+        state = _UPGRADES[nxt](state, cfg)
+        current = nxt
+    return state
+
+
+__all__ = [
+    "state_phase",
+    "maybe_upgrade_state",
+    "upgrade_to_altair",
+    "upgrade_to_bellatrix",
+    "upgrade_to_capella",
+    "upgrade_to_deneb",
+]

@@ -1,0 +1,117 @@
+"""Genesis state construction — reference: `genesis`/`interop`/`factory`
+crates (deterministic interop validators, genesis state assembly per fork).
+
+`interop_genesis_state` builds a valid genesis BeaconState at whatever
+phase the config activates at epoch 0, with deterministic interop keys —
+the test/bench substrate for the whole framework (no eth1 needed).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from grandine_tpu.consensus import accessors
+from grandine_tpu.crypto import bls as A
+from grandine_tpu.types.config import Config
+from grandine_tpu.types.containers import spec_types
+from grandine_tpu.types.primitives import (
+    BLS_WITHDRAWAL_PREFIX,
+    FAR_FUTURE_EPOCH,
+    GENESIS_EPOCH,
+    Phase,
+)
+
+_SK_CACHE: dict = {}
+
+
+def interop_secret_key(index: int) -> "A.SecretKey":
+    """Deterministic interop key for validator `index` (the well-known
+    interop scheme's spirit: keyed from the index; NOT the eth2 interop
+    curve-order derivation — these keys are for in-framework testing)."""
+    sk = _SK_CACHE.get(index)
+    if sk is None:
+        sk = A.SecretKey.keygen(index.to_bytes(32, "little"), b"interop")
+        _SK_CACHE[index] = sk
+    return sk
+
+
+def interop_pubkeys(n: int) -> "list[bytes]":
+    return [interop_secret_key(i).public_key().to_bytes() for i in range(n)]
+
+
+def interop_genesis_state(
+    n_validators: int,
+    cfg: Config,
+    genesis_time: int = 0,
+    eth1_block_hash: bytes = b"\x42" * 32,
+    pubkeys: "Optional[Sequence[bytes]]" = None,
+):
+    """Genesis BeaconState at the phase `cfg` activates at epoch 0
+    (spec `initialize_beacon_state_from_eth1` + per-fork upgrades folded
+    into direct construction)."""
+    p = cfg.preset
+    phase = cfg.phase_at_epoch(GENESIS_EPOCH)
+    T = spec_types(p)
+    ns = getattr(T, phase.key)
+
+    if pubkeys is None:
+        pubkeys = interop_pubkeys(n_validators)
+    balance = p.MAX_EFFECTIVE_BALANCE
+
+    validators = [
+        ns.Validator(
+            pubkey=bytes(pk),
+            withdrawal_credentials=BLS_WITHDRAWAL_PREFIX + b"\x00" * 31,
+            effective_balance=balance,
+            slashed=False,
+            activation_eligibility_epoch=GENESIS_EPOCH,
+            activation_epoch=GENESIS_EPOCH,
+            exit_epoch=FAR_FUTURE_EPOCH,
+            withdrawable_epoch=FAR_FUTURE_EPOCH,
+        )
+        for pk in pubkeys
+    ]
+
+    state_fields = dict(
+        genesis_time=genesis_time,
+        slot=0,
+        fork=ns.Fork(
+            previous_version=cfg.fork_version(phase),
+            current_version=cfg.fork_version(phase),
+            epoch=GENESIS_EPOCH,
+        ),
+        latest_block_header=ns.BeaconBlockHeader(
+            body_root=ns.BeaconBlockBody().hash_tree_root()
+        ),
+        eth1_data=ns.Eth1Data(
+            deposit_root=b"\x00" * 32,
+            deposit_count=len(validators),
+            block_hash=eth1_block_hash,
+        ),
+        eth1_deposit_index=len(validators),
+        validators=validators,
+        balances=[balance] * len(validators),
+        randao_mixes=[eth1_block_hash] * p.EPOCHS_PER_HISTORICAL_VECTOR,
+    )
+    if phase >= Phase.ALTAIR:
+        state_fields["inactivity_scores"] = [0] * len(validators)
+        state_fields["previous_epoch_participation"] = [0] * len(validators)
+        state_fields["current_epoch_participation"] = [0] * len(validators)
+
+    state = ns.BeaconState(**state_fields)
+    # genesis_validators_root commits to the registry
+    state = state.replace(
+        genesis_validators_root=state.validators.hash_tree_root()
+    )
+
+    if phase >= Phase.ALTAIR:
+        # both committees derive from the genesis state (altair fork spec)
+        committee = accessors.get_next_sync_committee(state, ns, cfg)
+        state = state.replace(
+            current_sync_committee=committee,
+            next_sync_committee=accessors.get_next_sync_committee(state, ns, cfg),
+        )
+    return state
+
+
+__all__ = ["interop_secret_key", "interop_pubkeys", "interop_genesis_state"]

@@ -1,0 +1,53 @@
+"""Slot processing — reference: transition_functions/src/*/slot_processing.rs
+(`process_slots` loop with per-boundary epoch processing) and the cache of
+rolling block/state roots.
+"""
+
+from __future__ import annotations
+
+from grandine_tpu.types.primitives import Phase
+
+
+def process_slot(state, cfg):
+    """Spec `process_slot`: cache the state root, backfill the header's
+    state root, cache the block root."""
+    p = cfg.preset
+    slot = int(state.slot)
+    idx = slot % p.SLOTS_PER_HISTORICAL_ROOT
+    previous_state_root = state.hash_tree_root()
+    changes = {
+        "state_roots": state.state_roots.set(idx, previous_state_root),
+    }
+    header = state.latest_block_header
+    if bytes(header.state_root) == b"\x00" * 32:
+        header = header.replace(state_root=previous_state_root)
+        changes["latest_block_header"] = header
+    changes["block_roots"] = state.block_roots.set(idx, header.hash_tree_root())
+    return state.replace(**changes)
+
+
+def process_slots(state, slot: int, cfg):
+    """Spec `process_slots`: advance through empty slots, running epoch
+    processing (and fork upgrades) at epoch boundaries."""
+    from grandine_tpu.transition import epoch_altair, epoch_phase0
+    from grandine_tpu.transition.fork_upgrade import maybe_upgrade_state
+
+    p = cfg.preset
+    if int(state.slot) > slot:
+        raise ValueError(f"state slot {int(state.slot)} is past target {slot}")
+    while int(state.slot) < slot:
+        state = process_slot(state, cfg)
+        next_slot = int(state.slot) + 1
+        if next_slot % p.SLOTS_PER_EPOCH == 0:
+            phase = cfg.phase_at_slot(int(state.slot))
+            if phase == Phase.PHASE0:
+                state = epoch_phase0.process_epoch(state, cfg)
+            else:
+                state = epoch_altair.process_epoch(state, cfg, phase)
+        state = state.replace(slot=next_slot)
+        if next_slot % p.SLOTS_PER_EPOCH == 0:
+            state = maybe_upgrade_state(state, cfg)
+    return state
+
+
+__all__ = ["process_slot", "process_slots"]

@@ -1,0 +1,15 @@
+"""Validator duty engine — reference: `validator` crate
+(validator/src/validator.rs: propose/attest/aggregate driven by clock
+ticks) plus the `signer` key registry.
+
+`duties.py` holds the duty *production* functions (blocks, attestations,
+sync aggregates); `signer.py` the key registry with device batch signing;
+the tick-driven service loop lives in grandine_tpu.runtime.
+"""
+
+from grandine_tpu.validator.duties import (  # noqa: F401
+    produce_attestations,
+    produce_block,
+    produce_sync_aggregate,
+)
+from grandine_tpu.validator.signer import Signer  # noqa: F401

@@ -1,0 +1,260 @@
+"""Duty production: blocks, attestations, sync aggregates — reference:
+validator/src/validator.rs (`propose` :1292, `build_beacon_block` :1007,
+`attest_and_start_aggregating` :1492, sync-committee duties :1751-2213).
+
+These functions produce *valid* objects against a head state: the block
+producer advances slots, builds a body (matching execution payload for
+post-merge forks, expected-withdrawals sweep, sync aggregate), runs the
+trusted transition to fill in the state root, and signs. They power the
+in-process chain used by tests, the runtime, and the block-replay bench.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from grandine_tpu.consensus import accessors, misc, signing
+from grandine_tpu.consensus.verifier import NullVerifier
+from grandine_tpu.crypto import bls as A
+from grandine_tpu.transition import block as block_mod
+from grandine_tpu.transition.fork_upgrade import state_phase
+from grandine_tpu.transition.slots import process_slots
+from grandine_tpu.types.containers import spec_types
+from grandine_tpu.types.primitives import Phase
+
+KeyProvider = Callable[[int], "A.SecretKey"]
+
+
+def _interop_keys(index: int) -> "A.SecretKey":
+    from grandine_tpu.transition.genesis import interop_secret_key
+
+    return interop_secret_key(index)
+
+
+# ------------------------------------------------------------- attestations
+
+
+def produce_attestations(
+    state,
+    cfg,
+    keys: KeyProvider = _interop_keys,
+    slot: "Optional[int]" = None,
+    participation: float = 1.0,
+):
+    """One aggregate attestation per committee of `slot` (default: the
+    state's current slot), signed by the first `participation` fraction of
+    each committee. `state` must be at or past `slot` (committees and the
+    head vote are read from it)."""
+    p = cfg.preset
+    if slot is None:
+        slot = int(state.slot)
+    epoch = misc.compute_epoch_at_slot(slot, p)
+    cur = accessors.get_current_epoch(state, p)
+    phase = state_phase(state, cfg)
+    ns = getattr(spec_types(p), phase.key)
+
+    if slot == int(state.slot):
+        # attesting to the head at its own slot: the block root is the
+        # latest header with its state root filled in
+        header = state.latest_block_header
+        if bytes(header.state_root) == b"\x00" * 32:
+            header = header.replace(state_root=state.hash_tree_root())
+        head_root = header.hash_tree_root()
+    else:
+        head_root = accessors.get_block_root_at_slot(state, slot, p)
+
+    target_slot = misc.compute_start_slot_at_epoch(epoch, p)
+    if target_slot == slot:
+        target_root = head_root
+    else:
+        target_root = accessors.get_block_root_at_slot(state, target_slot, p)
+    source = (
+        state.current_justified_checkpoint
+        if epoch == cur
+        else state.previous_justified_checkpoint
+    )
+
+    count = accessors.get_committee_count_per_slot(state, epoch, p)
+    out = []
+    for index in range(count):
+        committee = accessors.get_beacon_committee(state, slot, index, p)
+        data = ns.AttestationData(
+            slot=slot,
+            index=index,
+            beacon_block_root=head_root,
+            source=source,
+            target=ns.Checkpoint(epoch=epoch, root=target_root),
+        )
+        root = signing.attestation_signing_root(state, data, cfg)
+        n_sign = max(1, int(len(committee) * participation))
+        bits = np.zeros(len(committee), dtype=bool)
+        bits[:n_sign] = True
+        sigs = [keys(int(v)).sign(root) for v in committee[:n_sign]]
+        out.append(
+            ns.Attestation(
+                aggregation_bits=bits,
+                data=data,
+                signature=A.Signature.aggregate(sigs).to_bytes(),
+            )
+        )
+    return out
+
+
+# ----------------------------------------------------------- sync aggregate
+
+
+def produce_sync_aggregate(state, cfg, keys: KeyProvider = _interop_keys):
+    """Full-participation sync aggregate for a block built on `state`
+    (signs the previous block root under DOMAIN_SYNC_COMMITTEE)."""
+    p = cfg.preset
+    phase = state_phase(state, cfg)
+    ns = getattr(spec_types(p), phase.key)
+    lookup = {
+        pk: i
+        for i, pk in enumerate(accessors.registry_columns(state).pubkeys)
+    }
+    root = signing.sync_aggregate_signing_root(state, cfg)
+    sigs = []
+    bits = np.ones(p.SYNC_COMMITTEE_SIZE, dtype=bool)
+    for pk in state.current_sync_committee.pubkeys:
+        index = lookup[bytes(pk)]
+        sigs.append(keys(index).sign(root))
+    return ns.SyncAggregate(
+        sync_committee_bits=bits,
+        sync_committee_signature=A.Signature.aggregate(sigs).to_bytes(),
+    )
+
+
+def empty_sync_aggregate(state, cfg):
+    p = cfg.preset
+    ns = getattr(spec_types(p), state_phase(state, cfg).key)
+    return ns.SyncAggregate(
+        sync_committee_bits=np.zeros(p.SYNC_COMMITTEE_SIZE, dtype=bool),
+        sync_committee_signature=A.Signature.empty().to_bytes(),
+    )
+
+
+# ------------------------------------------------------------------ payload
+
+
+def build_matching_payload(state, cfg, ns, phase: Phase):
+    """Execution payload consistent with the (slot-advanced) pre-state:
+    right parent hash, prev_randao, timestamp; synthetic block hash."""
+    p = cfg.preset
+    slot = int(state.slot)
+    prev = state.latest_execution_payload_header
+    fields = dict(
+        parent_hash=bytes(prev.block_hash),
+        prev_randao=misc.get_randao_mix(
+            state, accessors.get_current_epoch(state, p), p
+        ),
+        block_number=int(prev.block_number) + 1,
+        timestamp=int(state.genesis_time) + slot * cfg.seconds_per_slot,
+        block_hash=hashlib.sha256(b"payload@%d" % slot).digest(),
+        gas_limit=30_000_000,
+    )
+    if phase >= Phase.CAPELLA:
+        from grandine_tpu.consensus.mutators import StateDraft
+
+        draft = StateDraft(state, cfg)
+        fields["withdrawals"] = block_mod.get_expected_withdrawals(
+            state, draft, ns
+        )
+    return ns.ExecutionPayload(**fields)
+
+
+# -------------------------------------------------------------------- block
+
+
+def produce_block(
+    state,
+    slot: int,
+    cfg,
+    keys: KeyProvider = _interop_keys,
+    attestations: "Sequence" = (),
+    full_sync_participation: bool = True,
+    deposits: "Sequence" = (),
+    voluntary_exits: "Sequence" = (),
+    proposer_slashings: "Sequence" = (),
+    attester_slashings: "Sequence" = (),
+    bls_to_execution_changes: "Sequence" = (),
+    graffiti: bytes = b"",
+):
+    """Produce a valid SignedBeaconBlock for `slot` on top of `state`
+    (validator.rs propose :1292 → build_beacon_block :1007). Returns
+    (signed_block, post_state)."""
+    from grandine_tpu.transition.combined import custom_state_transition
+
+    p = cfg.preset
+    if int(state.slot) < slot:
+        state = process_slots(state, slot, cfg)
+    phase = state_phase(state, cfg)
+    ns = getattr(spec_types(p), phase.key)
+
+    proposer_index = accessors.get_beacon_proposer_index(state, p)
+    proposer_key = keys(proposer_index)
+    epoch = accessors.get_current_epoch(state, p)
+
+    reveal = proposer_key.sign(
+        signing.randao_signing_root(state, epoch, cfg)
+    ).to_bytes()
+
+    body_fields = dict(
+        randao_reveal=reveal,
+        eth1_data=state.eth1_data,
+        graffiti=graffiti.ljust(32, b"\x00")[:32],
+        proposer_slashings=proposer_slashings,
+        attester_slashings=attester_slashings,
+        attestations=attestations,
+        deposits=deposits,
+        voluntary_exits=voluntary_exits,
+    )
+    if phase >= Phase.ALTAIR:
+        body_fields["sync_aggregate"] = (
+            produce_sync_aggregate(state, cfg, keys)
+            if full_sync_participation
+            else empty_sync_aggregate(state, cfg)
+        )
+    if phase >= Phase.BELLATRIX:
+        body_fields["execution_payload"] = build_matching_payload(
+            state, cfg, ns, phase
+        )
+    if phase >= Phase.CAPELLA:
+        body_fields["bls_to_execution_changes"] = bls_to_execution_changes
+
+    body = ns.BeaconBlockBody(**body_fields)
+    block = ns.BeaconBlock(
+        slot=slot,
+        proposer_index=proposer_index,
+        parent_root=state.latest_block_header.replace(
+            state_root=(
+                state.hash_tree_root()
+                if bytes(state.latest_block_header.state_root) == b"\x00" * 32
+                else bytes(state.latest_block_header.state_root)
+            )
+        ).hash_tree_root(),
+        state_root=b"\x00" * 32,
+        body=body,
+    )
+
+    unsigned = ns.SignedBeaconBlock(message=block)
+    post = custom_state_transition(
+        state, unsigned, cfg, NullVerifier(), state_root_policy="trust"
+    )
+    block = block.replace(state_root=post.hash_tree_root())
+    signature = proposer_key.sign(
+        signing.block_signing_root(state, block, cfg)
+    ).to_bytes()
+    return ns.SignedBeaconBlock(message=block, signature=signature), post
+
+
+__all__ = [
+    "produce_attestations",
+    "produce_sync_aggregate",
+    "empty_sync_aggregate",
+    "build_matching_payload",
+    "produce_block",
+]

@@ -1,0 +1,76 @@
+"""Key registry + batch signing — reference: signer/src/signer.rs
+(`Signer` :40-49 key registry, `sign` :154, batch `sign_triples` :173-229).
+
+Local keys sign either on host (anchor, one at a time) or as one device
+batch through `TpuBlsBackend.batch_sign` (the signer/src rayon fan-out
+mapped onto the accelerator's batch axis). Remote/Web3Signer keys are out
+of scope for this build (the registry records the kind for parity).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from grandine_tpu.crypto import bls as A
+
+
+class Signer:
+    """pubkey-bytes -> SecretKey registry with single and batch signing."""
+
+    def __init__(self, use_device: bool = False, backend=None) -> None:
+        self._keys: "dict[bytes, A.SecretKey]" = {}
+        self._use_device = use_device
+        self._backend = backend
+
+    # -- registry ----------------------------------------------------------
+
+    def add_key(self, secret_key: "A.SecretKey") -> bytes:
+        pk = secret_key.public_key().to_bytes()
+        self._keys[pk] = secret_key
+        return pk
+
+    def remove_key(self, pubkey: bytes) -> bool:
+        return self._keys.pop(bytes(pubkey), None) is not None
+
+    def has_key(self, pubkey: bytes) -> bool:
+        return bytes(pubkey) in self._keys
+
+    def pubkeys(self) -> "list[bytes]":
+        return list(self._keys)
+
+    def __len__(self) -> int:
+        return len(self._keys)
+
+    # -- signing -----------------------------------------------------------
+
+    def sign(self, pubkey: bytes, signing_root: bytes) -> bytes:
+        sk = self._keys.get(bytes(pubkey))
+        if sk is None:
+            raise KeyError(f"no key for {bytes(pubkey).hex()[:16]}…")
+        return sk.sign(signing_root).to_bytes()
+
+    def sign_triples(
+        self, items: "Sequence[tuple[bytes, bytes]]"
+    ) -> "list[bytes]":
+        """Batch sign (pubkey, signing_root) pairs — signer.rs sign_triples.
+        Device path: ONE `batch_sign_kernel` launch for all N items."""
+        sks = []
+        for pubkey, _root in items:
+            sk = self._keys.get(bytes(pubkey))
+            if sk is None:
+                raise KeyError(f"no key for {bytes(pubkey).hex()[:16]}…")
+            sks.append(sk)
+        if self._use_device and len(items) > 1:
+            backend = self._backend
+            if backend is None:
+                from grandine_tpu.tpu.bls import TpuBlsBackend
+
+                backend = self._backend = TpuBlsBackend()
+            sigs = backend.batch_sign([root for _, root in items], sks)
+            return [s.to_bytes() for s in sigs]
+        return [
+            sk.sign(bytes(root)).to_bytes() for sk, (_, root) in zip(sks, items)
+        ]
+
+
+__all__ = ["Signer"]

@@ -1,0 +1,413 @@
+"""State-transition tests: genesis, slot/epoch processing, block sanity,
+operations, finality, fork upgrades.
+
+Reference test parity: the consensus-spec-tests sanity/finality/operations
+suites' *shapes* (transition_functions/src/*/block_processing.rs:550-605)
+built from in-framework produced chains (no network, no eth1 — the §4.3
+Null seams).
+"""
+
+import numpy as np
+import pytest
+
+from grandine_tpu.consensus import accessors
+from grandine_tpu.consensus.verifier import (
+    MultiVerifier,
+    NullVerifier,
+    SignatureInvalid,
+)
+from grandine_tpu.crypto import bls as A
+from grandine_tpu.ssz.merkle import MerkleTree
+from grandine_tpu.transition import combined
+from grandine_tpu.transition.block import TransitionError
+from grandine_tpu.transition.combined import (
+    StateRootMismatch,
+    untrusted_state_transition,
+)
+from grandine_tpu.transition.fork_upgrade import state_phase
+from grandine_tpu.transition.genesis import interop_genesis_state, interop_secret_key
+from grandine_tpu.transition.slots import process_slots
+from grandine_tpu.types.config import Config
+from grandine_tpu.types.containers import spec_types
+from grandine_tpu.types.primitives import (
+    DEPOSIT_CONTRACT_TREE_DEPTH,
+    FAR_FUTURE_EPOCH,
+    Phase,
+)
+from grandine_tpu.validator.duties import produce_attestations, produce_block
+
+CFG = Config.minimal()
+P = CFG.preset
+
+PHASE0_CFG = Config(
+    config_name="phase0-test",
+    preset_base="minimal",
+    altair_fork_epoch=FAR_FUTURE_EPOCH,
+    bellatrix_fork_epoch=FAR_FUTURE_EPOCH,
+    capella_fork_epoch=FAR_FUTURE_EPOCH,
+    deneb_fork_epoch=FAR_FUTURE_EPOCH,
+)
+
+
+@pytest.fixture(scope="module")
+def genesis():
+    return interop_genesis_state(32, CFG)
+
+
+@pytest.fixture(scope="module")
+def chain(genesis):
+    """A 3-block deneb chain: genesis -> b1 -> b2 -> b3 with attestations."""
+    states = [genesis]
+    blocks = []
+    prev = genesis
+    for slot in (1, 2, 3):
+        atts = produce_attestations(prev, CFG, slot=slot - 1) if slot > 1 else []
+        blk, post = produce_block(
+            prev, slot, CFG, attestations=atts, full_sync_participation=(slot == 2)
+        )
+        blocks.append(blk)
+        states.append(post)
+        prev = post
+    return blocks, states
+
+
+# ------------------------------------------------------------------ genesis
+
+
+def test_genesis_invariants(genesis):
+    assert int(genesis.slot) == 0
+    assert state_phase(genesis, CFG) == Phase.DENEB
+    assert (
+        bytes(genesis.genesis_validators_root)
+        == genesis.validators.hash_tree_root()
+    )
+    registry = {bytes(v.pubkey) for v in genesis.validators}
+    for pk in genesis.current_sync_committee.pubkeys:
+        assert bytes(pk) in registry
+    # aggregate pubkey is the real aggregate
+    agg = A.PublicKey.aggregate(
+        [A.PublicKey.from_bytes(bytes(pk))
+         for pk in genesis.current_sync_committee.pubkeys]
+    )
+    assert bytes(genesis.current_sync_committee.aggregate_pubkey) == agg.to_bytes()
+
+
+# ------------------------------------------------------------------- slots
+
+
+def test_process_slots_records_roots(genesis):
+    s3 = process_slots(genesis, 3, CFG)
+    assert int(s3.slot) == 3
+    # slot-0 state root was cached, header state root backfilled
+    assert bytes(s3.state_roots[0]) == genesis.hash_tree_root()
+    assert bytes(s3.latest_block_header.state_root) == genesis.hash_tree_root()
+    # the same block root repeats for empty slots
+    assert bytes(s3.block_roots[0]) == bytes(s3.block_roots[2])
+    with pytest.raises(ValueError):
+        process_slots(s3, 1, CFG)  # backwards
+
+
+# ------------------------------------------------------------ block sanity
+
+
+def test_valid_chain_verifies(chain, genesis):
+    blocks, states = chain
+    state = genesis
+    for blk, expected in zip(blocks, states[1:]):
+        state = untrusted_state_transition(state, blk, CFG)
+        assert state.hash_tree_root() == expected.hash_tree_root()
+
+
+def test_bad_proposer_signature_rejected(chain, genesis):
+    blocks, _ = chain
+    bad = blocks[0].replace(signature=interop_secret_key(9).sign(b"x" * 32).to_bytes())
+    with pytest.raises(SignatureInvalid):
+        untrusted_state_transition(genesis, bad, CFG)
+
+
+def test_wrong_state_root_rejected(chain, genesis):
+    blocks, _ = chain
+    msg = blocks[0].message.replace(state_root=b"\x13" * 32)
+    proposer = interop_secret_key(int(msg.proposer_index))
+    from grandine_tpu.consensus import signing
+
+    pre = process_slots(genesis, 1, CFG)
+    sig = proposer.sign(signing.block_signing_root(pre, msg, CFG)).to_bytes()
+    bad = blocks[0].replace(message=msg, signature=sig)
+    with pytest.raises(StateRootMismatch):
+        untrusted_state_transition(genesis, bad, CFG)
+
+
+def test_wrong_proposer_rejected(chain, genesis):
+    blocks, _ = chain
+    msg = blocks[0].message
+    wrong = (int(msg.proposer_index) + 1) % 32
+    msg = msg.replace(proposer_index=wrong)
+    bad = blocks[0].replace(message=msg)
+    with pytest.raises((TransitionError, SignatureInvalid)):
+        untrusted_state_transition(genesis, bad, CFG)
+
+
+def test_tampered_attestation_rejected(chain):
+    blocks, states = chain
+    blk3 = blocks[2]
+    body = blk3.message.body
+    atts = list(body.attestations)
+    if not atts:
+        pytest.skip("no attestations in block 3")
+    # flip a participation bit without re-signing
+    a0 = atts[0]
+    flipped = a0.aggregation_bits.set(0, not a0.aggregation_bits[0])
+    atts[0] = a0.replace(aggregation_bits=flipped)
+    bad = blk3.replace(
+        message=blk3.message.replace(body=body.replace(attestations=atts))
+    )
+    with pytest.raises((SignatureInvalid, TransitionError)):
+        combined.verify_signatures(
+            process_slots(states[2], 3, CFG), bad, MultiVerifier(), CFG
+        )
+
+
+# -------------------------------------------------------------- operations
+
+
+def test_proposer_slashing(chain):
+    _, states = chain
+    state = states[-1]
+    ns = spec_types(P).deneb
+    from grandine_tpu.consensus import signing
+
+    offender = 7
+    sk = interop_secret_key(offender)
+    h = ns.BeaconBlockHeader(
+        slot=int(state.slot), proposer_index=offender,
+        parent_root=b"\x01" * 32, state_root=b"\x02" * 32, body_root=b"\x03" * 32,
+    )
+    h2 = h.replace(body_root=b"\x04" * 32)
+    pre = process_slots(state, int(state.slot) + 1, CFG)
+    sh1 = ns.SignedBeaconBlockHeader(
+        message=h, signature=sk.sign(signing.header_signing_root(pre, h, CFG)).to_bytes()
+    )
+    sh2 = ns.SignedBeaconBlockHeader(
+        message=h2, signature=sk.sign(signing.header_signing_root(pre, h2, CFG)).to_bytes()
+    )
+    ps = ns.ProposerSlashing(signed_header_1=sh1, signed_header_2=sh2)
+    blk, post = produce_block(
+        state, int(state.slot) + 1, CFG, proposer_slashings=[ps],
+        full_sync_participation=False,
+    )
+    v = untrusted_state_transition(state, blk, CFG)
+    assert v.hash_tree_root() == post.hash_tree_root()
+    assert bool(post.validators[offender].slashed)
+    assert int(post.balances[offender]) < int(state.balances[offender])
+
+
+def test_attester_slashing(chain):
+    _, states = chain
+    state = states[-1]
+    ns = spec_types(P).deneb
+    from grandine_tpu.consensus import signing
+
+    offenders = [3, 11]
+    cp = lambda e, r: ns.Checkpoint(epoch=e, root=r)  # noqa: E731
+    root_a = b"\x0a" * 32
+    root_b = b"\x0b" * 32
+    d1 = ns.AttestationData(
+        slot=int(state.slot), index=0, beacon_block_root=root_a,
+        source=cp(0, b"\x00" * 32), target=cp(1, root_a),
+    )
+    d2 = d1.replace(beacon_block_root=root_b, target=cp(1, root_b))
+    pre = process_slots(state, int(state.slot) + 1, CFG)
+
+    def indexed(data):
+        root = signing.attestation_signing_root(pre, data, CFG)
+        sigs = [interop_secret_key(i).sign(root) for i in offenders]
+        return ns.IndexedAttestation(
+            attesting_indices=offenders, data=data,
+            signature=A.Signature.aggregate(sigs).to_bytes(),
+        )
+
+    aslash = ns.AttesterSlashing(attestation_1=indexed(d1), attestation_2=indexed(d2))
+    blk, post = produce_block(
+        state, int(state.slot) + 1, CFG, attester_slashings=[aslash],
+        full_sync_participation=False,
+    )
+    v = untrusted_state_transition(state, blk, CFG)
+    assert v.hash_tree_root() == post.hash_tree_root()
+    for i in offenders:
+        assert bool(post.validators[i].slashed)
+
+
+def test_deposit_flow(genesis):
+    """New-validator deposit with a real merkle proof + a top-up."""
+    ns = spec_types(P).deneb
+    from grandine_tpu.consensus import signing as sgn
+
+    new_sk = interop_secret_key(1000)
+    amount = P.MAX_EFFECTIVE_BALANCE
+
+    def deposit_data(sk, creds):
+        dd = ns.DepositData(
+            pubkey=sk.public_key().to_bytes(),
+            withdrawal_credentials=creds,
+            amount=amount,
+        )
+        sig = sk.sign(sgn.deposit_signing_root(dd, CFG))
+        return dd.replace(signature=sig.to_bytes())
+
+    dd_new = deposit_data(new_sk, b"\x00" + b"\x05" * 31)
+    dd_topup = deposit_data(interop_secret_key(0), b"\x00" + b"\x06" * 31)
+
+    # the deposit tree continues from the genesis deposits
+    tree = MerkleTree(DEPOSIT_CONTRACT_TREE_DEPTH, track_leaves=True)
+    for v in genesis.validators:
+        dd = ns.DepositData(
+            pubkey=bytes(v.pubkey),
+            withdrawal_credentials=bytes(v.withdrawal_credentials),
+            amount=P.MAX_EFFECTIVE_BALANCE,
+        )
+        tree.push(dd.hash_tree_root())  # placeholder leaves for prior slots
+
+    deposits = []
+    leaves = [dd_new, dd_topup]
+    for dd in leaves:
+        tree.push(dd.hash_tree_root())
+    count = tree.count
+    root = tree.root_with_length()
+    for k, dd in enumerate(leaves):
+        index = 32 + k
+        proof = tree.proof(index) + [count.to_bytes(32, "little")]
+        deposits.append(ns.Deposit(proof=proof, data=dd))
+
+    state = genesis.replace(
+        eth1_data=ns.Eth1Data(
+            deposit_root=root, deposit_count=count,
+            block_hash=bytes(genesis.eth1_data.block_hash),
+        )
+    )
+    blk, post = produce_block(
+        state, 1, CFG, deposits=deposits, full_sync_participation=False
+    )
+    v = untrusted_state_transition(state, blk, CFG)
+    assert v.hash_tree_root() == post.hash_tree_root()
+    assert len(post.validators) == 33
+    assert bytes(post.validators[32].pubkey) == new_sk.public_key().to_bytes()
+    # top-up landed (validator 0 may also pay a small sync-committee
+    # non-participation penalty in the same block)
+    assert int(post.balances[0]) >= int(state.balances[0]) + amount - 10**6
+    assert len(post.previous_epoch_participation) == 33
+    assert len(post.inactivity_scores) == 33
+
+
+def test_voluntary_exit(genesis):
+    cfg = Config.minimal()
+    # shard_committee_period epochs must pass; shortcut with a fresh config
+    import dataclasses
+
+    cfg = dataclasses.replace(cfg, shard_committee_period=0)
+    state = interop_genesis_state(32, cfg)
+    ns = spec_types(P).deneb
+    from grandine_tpu.consensus import signing as sgn
+
+    exiting = 4
+    exit_msg = ns.VoluntaryExit(epoch=0, validator_index=exiting)
+    pre = process_slots(state, 1, cfg)
+    sig = interop_secret_key(exiting).sign(
+        sgn.voluntary_exit_signing_root(pre, exit_msg, cfg, Phase.DENEB)
+    )
+    signed = ns.SignedVoluntaryExit(message=exit_msg, signature=sig.to_bytes())
+    blk, post = produce_block(
+        state, 1, cfg, voluntary_exits=[signed], full_sync_participation=False
+    )
+    v = untrusted_state_transition(state, blk, cfg)
+    assert v.hash_tree_root() == post.hash_tree_root()
+    assert int(post.validators[exiting].exit_epoch) != FAR_FUTURE_EPOCH
+
+
+def test_bls_to_execution_change(genesis):
+    ns = spec_types(P).deneb
+    from grandine_tpu.consensus import misc as m
+    from grandine_tpu.consensus import signing as sgn
+
+    index = 6
+    sk = interop_secret_key(index)
+    pk_bytes = sk.public_key().to_bytes()
+    creds = b"\x00" + m.sha256(pk_bytes)[1:]
+    vs = list(genesis.validators)
+    vs[index] = vs[index].replace(withdrawal_credentials=creds)
+    state = genesis.replace(validators=vs)
+
+    change = ns.BLSToExecutionChange(
+        validator_index=index,
+        from_bls_pubkey=pk_bytes,
+        to_execution_address=b"\xaa" * 20,
+    )
+    sig = sk.sign(sgn.bls_to_execution_change_signing_root(state, change, CFG))
+    signed = ns.SignedBLSToExecutionChange(message=change, signature=sig.to_bytes())
+    blk, post = produce_block(
+        state, 1, CFG, bls_to_execution_changes=[signed],
+        full_sync_participation=False,
+    )
+    v = untrusted_state_transition(state, blk, CFG)
+    assert v.hash_tree_root() == post.hash_tree_root()
+    new_creds = bytes(post.validators[index].withdrawal_credentials)
+    assert new_creds[:1] == b"\x01"
+    assert new_creds[12:] == b"\xaa" * 20
+
+
+# ---------------------------------------------------------------- finality
+
+
+def test_phase0_finality_two_epochs():
+    state = interop_genesis_state(32, PHASE0_CFG)
+    prev = state
+    for slot in range(1, 33):
+        atts = (
+            produce_attestations(prev, PHASE0_CFG, slot=slot - 1)
+            if slot > 1
+            else []
+        )
+        _, prev = produce_block(prev, slot, PHASE0_CFG, attestations=atts)
+    assert int(prev.current_justified_checkpoint.epoch) == 3
+    assert int(prev.finalized_checkpoint.epoch) == 2
+    assert state_phase(prev, PHASE0_CFG) == Phase.PHASE0
+
+
+def test_no_attestations_no_finality():
+    state = interop_genesis_state(32, PHASE0_CFG)
+    prev = state
+    for slot in range(1, 25):
+        _, prev = produce_block(prev, slot, PHASE0_CFG)
+    assert int(prev.current_justified_checkpoint.epoch) == 0
+    assert int(prev.finalized_checkpoint.epoch) == 0
+
+
+# ------------------------------------------------------------ fork upgrade
+
+
+def test_fork_upgrade_phase0_to_altair():
+    cfg = Config(
+        config_name="upgrade-test",
+        preset_base="minimal",
+        altair_fork_epoch=1,
+        bellatrix_fork_epoch=2,
+        capella_fork_epoch=3,
+        deneb_fork_epoch=FAR_FUTURE_EPOCH,
+        genesis_fork_version=bytes.fromhex("00000001"),
+        altair_fork_version=bytes.fromhex("01000001"),
+        bellatrix_fork_version=bytes.fromhex("02000001"),
+        capella_fork_version=bytes.fromhex("03000001"),
+        deneb_fork_version=bytes.fromhex("04000001"),
+    )
+    prev = interop_genesis_state(32, cfg)
+    assert state_phase(prev, cfg) == Phase.PHASE0
+    for slot in range(1, 25):
+        atts = produce_attestations(prev, cfg, slot=slot - 1) if slot > 1 else []
+        _, prev = produce_block(prev, slot, cfg, attestations=atts)
+        expected_phase = cfg.phase_at_slot(slot)
+        assert state_phase(prev, cfg) == expected_phase
+    assert state_phase(prev, cfg) == Phase.CAPELLA
+    # cross-fork participation accounting worked: epochs 1 and 2 (spanning
+    # the altair/bellatrix/capella upgrades) are justified by slot 24
+    # (finalization needs one more epoch than this chain runs)
+    assert int(prev.current_justified_checkpoint.epoch) >= 2
