@@ -16,6 +16,7 @@ from types/src/cache.rs, without tying cache lifetime to one state object.
 from __future__ import annotations
 
 import hashlib
+import threading
 from collections import OrderedDict
 from typing import Sequence
 
@@ -37,6 +38,12 @@ from grandine_tpu.types.primitives import (
     TIMELY_SOURCE_FLAG_INDEX,
     TIMELY_TARGET_FLAG_INDEX,
 )
+
+
+# One coarse lock for all accessor caches: they are hit concurrently from
+# the controller's parallel validation tasks; get+move_to_end / put+evict
+# are not atomic on their own.
+_CACHE_LOCK = threading.Lock()
 
 
 def _lru_put(cache: OrderedDict, key, value, cap: int) -> None:
@@ -106,12 +113,14 @@ def registry_columns(state) -> RegistryColumns:
     share one columnar view)."""
     items = state.validators.items
     key = id(items)
-    hit = _COLUMNS_CACHE.get(key)
-    if hit is not None and hit[0] is items:
-        _COLUMNS_CACHE.move_to_end(key)
-        return hit[1]
+    with _CACHE_LOCK:
+        hit = _COLUMNS_CACHE.get(key)
+        if hit is not None and hit[0] is items:
+            _COLUMNS_CACHE.move_to_end(key)
+            return hit[1]
     cols = RegistryColumns(state.validators)
-    _lru_put(_COLUMNS_CACHE, key, (items, cols), cap=8)
+    with _CACHE_LOCK:
+        _lru_put(_COLUMNS_CACHE, key, (items, cols), cap=8)
     return cols
 
 
@@ -132,15 +141,17 @@ def shuffled_active_indices(
     seed: bytes, active: np.ndarray, p: Preset
 ) -> np.ndarray:
     key = (seed, _active_digest(active))
-    hit = _SHUFFLE_CACHE.get(key)
-    if hit is None:
-        from grandine_tpu.core.shuffling import shuffled_indices
+    with _CACHE_LOCK:
+        hit = _SHUFFLE_CACHE.get(key)
+        if hit is not None:
+            _SHUFFLE_CACHE.move_to_end(key)
+            return hit
+    from grandine_tpu.core.shuffling import shuffled_indices
 
-        sigma = shuffled_indices(seed, len(active), p.SHUFFLE_ROUND_COUNT)
-        hit = np.asarray(active)[sigma]
+    sigma = shuffled_indices(seed, len(active), p.SHUFFLE_ROUND_COUNT)
+    hit = np.asarray(active)[sigma]
+    with _CACHE_LOCK:
         _lru_put(_SHUFFLE_CACHE, key, hit, cap=16)
-    else:
-        _SHUFFLE_CACHE.move_to_end(key)
     return hit
 
 
@@ -150,18 +161,19 @@ def committee_partition(
     """All committees of the epoch with shuffle seed `seed`, flat-indexed
     k = (slot % SLOTS_PER_EPOCH) * committees_per_slot + committee_index."""
     key = (seed, _active_digest(active))
-    hit = _PARTITION_CACHE.get(key)
-    if hit is None:
-        shuffled = shuffled_active_indices(seed, active, p)
-        n = len(shuffled)
-        count = committee_count_per_slot(n, p) * p.SLOTS_PER_EPOCH
-        hit = [
-            shuffled[n * k // count : n * (k + 1) // count]
-            for k in range(count)
-        ]
+    with _CACHE_LOCK:
+        hit = _PARTITION_CACHE.get(key)
+        if hit is not None:
+            _PARTITION_CACHE.move_to_end(key)
+            return hit
+    shuffled = shuffled_active_indices(seed, active, p)
+    n = len(shuffled)
+    count = committee_count_per_slot(n, p) * p.SLOTS_PER_EPOCH
+    hit = [
+        shuffled[n * k // count : n * (k + 1) // count] for k in range(count)
+    ]
+    with _CACHE_LOCK:
         _lru_put(_PARTITION_CACHE, key, hit, cap=16)
-    else:
-        _PARTITION_CACHE.move_to_end(key)
     return hit
 
 

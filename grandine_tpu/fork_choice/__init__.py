@@ -1,0 +1,16 @@
+"""Fork choice — reference: `fork_choice_store` (pure in-memory state
+machine, fork_choice_store/src/lib.rs) + `fork_choice_control` (threading/
+persistence orchestration).
+
+`store.py` is the pure half: LMD-GHOST + Casper FFG with the reference's
+validate_*/apply_* split (immutable, parallel-safe validation vs
+mutator-only application). The controller/runtime wiring lives in
+grandine_tpu.runtime.
+"""
+
+from grandine_tpu.fork_choice.store import (  # noqa: F401
+    ForkChoiceError,
+    Store,
+    Tick,
+    TickKind,
+)

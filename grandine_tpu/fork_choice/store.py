@@ -1,0 +1,525 @@
+"""Pure fork-choice store: LMD-GHOST + Casper FFG.
+
+Reference: fork_choice_store/src/store.rs — the split between `validate_*`
+(immutable, runs the expensive work: full state transition, signature
+batches; safe to run on many threads/tasks in parallel, store.rs:925,1013)
+and `apply_*` (cheap, mutator-only DAG/checkpoint updates, store.rs:1784,
+1860,2022). The controller (grandine_tpu.runtime) owns one Store and feeds
+it applications from a single thread, exactly like the reference's mutator
+actor.
+
+Fork-choice semantics implemented (ethereum consensus spec, deneb-era):
+  - LMD-GHOST head with effective-balance weights from the justified state
+  - pull-up justification: a block's *unrealized* justification (running
+    the justification calculation on its post-state) updates checkpoints
+    immediately for blocks from prior epochs
+  - proposer boost for timely blocks, reset every slot
+  - equivocating validators (attester slashings) excluded from weights
+  - attestation validity windows (target epoch current/previous, one-slot
+    gossip delay for non-block attestations)
+  - pruning at finalization
+
+Weight accumulation is vectorized: latest messages are numpy columns
+(validator -> block ordinal, balance), one bincount per head computation,
+then a bottom-up subtree sum over the (small) block DAG.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Optional, Sequence
+
+import numpy as np
+
+from grandine_tpu.consensus import accessors, misc
+from grandine_tpu.consensus.verifier import (
+    MultiVerifier,
+    SignatureInvalid,
+    Verifier,
+)
+from grandine_tpu.transition.combined import custom_state_transition
+from grandine_tpu.transition.fork_upgrade import state_phase
+from grandine_tpu.transition.slots import process_slots
+from grandine_tpu.types.primitives import Phase
+
+ZERO32 = b"\x00" * 32
+INTERVALS_PER_SLOT = 3
+
+
+class ForkChoiceError(ValueError):
+    pass
+
+
+class TickKind(enum.IntEnum):
+    """3 ticks per slot (reference clock crate: Propose/Attest/Aggregate)."""
+
+    PROPOSE = 0
+    ATTEST = 1
+    AGGREGATE = 2
+
+
+class Tick:
+    __slots__ = ("slot", "kind")
+
+    def __init__(self, slot: int, kind: TickKind) -> None:
+        self.slot = slot
+        self.kind = kind
+
+    def __repr__(self) -> str:
+        return f"Tick({self.slot}, {self.kind.name})"
+
+
+class BlockNode:
+    """One block in the DAG."""
+
+    __slots__ = (
+        "root",
+        "signed_block",
+        "state",
+        "parent_root",
+        "slot",
+        "ordinal",
+        "unrealized_justified",
+        "unrealized_finalized",
+    )
+
+    def __init__(self, root, signed_block, state, ordinal,
+                 unrealized_justified, unrealized_finalized) -> None:
+        self.root = root
+        self.signed_block = signed_block
+        self.state = state
+        self.parent_root = bytes(signed_block.message.parent_root)
+        self.slot = int(signed_block.message.slot)
+        self.ordinal = ordinal  # dense index for vectorized weights
+        self.unrealized_justified = unrealized_justified
+        self.unrealized_finalized = unrealized_finalized
+
+
+class ValidBlock:
+    """Result of validate_block, ready for apply_block."""
+
+    __slots__ = ("signed_block", "root", "state", "is_timely")
+
+    def __init__(self, signed_block, root, state, is_timely) -> None:
+        self.signed_block = signed_block
+        self.root = root
+        self.state = state
+        self.is_timely = is_timely
+
+
+class ValidAttestation:
+    __slots__ = ("indices", "epoch", "beacon_block_root", "earliest_slot")
+
+    def __init__(self, indices, epoch, beacon_block_root,
+                 earliest_slot: int = 0) -> None:
+        self.indices = indices
+        self.epoch = epoch
+        self.beacon_block_root = beacon_block_root
+        # first store slot at which this vote may count (spec: an
+        # attestation for slot S only enters fork choice from S+1; the
+        # controller delays application until then — mutator.rs
+        # delayed_until_slot)
+        self.earliest_slot = earliest_slot
+
+
+def unrealized_checkpoints(state, cfg):
+    """Run ONLY the justification/finalization calculation on `state`
+    (spec compute_pulled_up_tip / process_justification_and_finalization
+    without committing the rest of epoch processing)."""
+    from grandine_tpu.consensus.mutators import StateDraft
+    from grandine_tpu.transition import epoch_altair, epoch_phase0
+
+    draft = StateDraft(state, cfg)
+    if state_phase(state, cfg) == Phase.PHASE0:
+        epoch_phase0.process_justification_and_finalization(draft)
+    else:
+        epoch_altair.process_justification_and_finalization(draft)
+    fields = object.__getattribute__(draft, "_fields")
+    justified = fields.get(
+        "current_justified_checkpoint", state.current_justified_checkpoint
+    )
+    finalized = fields.get("finalized_checkpoint", state.finalized_checkpoint)
+    return justified, finalized
+
+
+class Store:
+    """The pure fork-choice state machine. NOT thread-safe for mutation:
+    all apply_* calls must come from one mutator (the reference's actor
+    model); validate_* methods touch no mutable state."""
+
+    def __init__(self, anchor_state, cfg, anchor_block=None,
+                 execution_engine=None) -> None:
+        from grandine_tpu.execution import NullExecutionEngine
+
+        self.cfg = cfg
+        self.p = cfg.preset
+        self.execution_engine = execution_engine or NullExecutionEngine()
+
+        header = anchor_state.latest_block_header
+        if bytes(header.state_root) == ZERO32:
+            header = header.replace(state_root=anchor_state.hash_tree_root())
+        anchor_root = header.hash_tree_root()
+
+        self.anchor_root = anchor_root
+        self.blocks: "dict[bytes, BlockNode]" = {}
+        self.children: "dict[bytes, list[bytes]]" = {}
+        self._next_ordinal = 0
+
+        anchor_epoch = accessors.get_current_epoch(anchor_state, self.p)
+        Checkpoint = type(anchor_state.finalized_checkpoint)
+        anchor_cp = Checkpoint(epoch=anchor_epoch, root=anchor_root)
+        self.justified_checkpoint = anchor_cp
+        self.finalized_checkpoint = anchor_cp
+        self.justified_state = anchor_state
+        # best unrealized checkpoints over all applied blocks, promoted at
+        # epoch boundaries (spec store.unrealized_* + on_tick pull-up)
+        self.unrealized_justified = anchor_cp
+        self.unrealized_finalized = anchor_cp
+
+        node = BlockNode(
+            anchor_root,
+            _AnchorBlock(header),
+            anchor_state,
+            self._take_ordinal(),
+            anchor_cp,
+            anchor_cp,
+        )
+        self.blocks[anchor_root] = node
+        self.children[anchor_root] = []
+
+        # latest messages: validator -> (epoch, block root)
+        self.latest_message_epoch: "dict[int, int]" = {}
+        self.latest_message_root: "dict[int, bytes]" = {}
+        self.equivocating: "set[int]" = set()
+
+        self.proposer_boost_root: "Optional[bytes]" = None
+        self.slot = int(anchor_state.slot)
+        self.interval = 0
+
+    # ------------------------------------------------------------ plumbing
+
+    def _take_ordinal(self) -> int:
+        o = self._next_ordinal
+        self._next_ordinal += 1
+        return o
+
+    def contains_block(self, root: bytes) -> bool:
+        return bytes(root) in self.blocks
+
+    def block_slot(self, root: bytes) -> int:
+        return self.blocks[bytes(root)].slot
+
+    def state_at(self, root: bytes):
+        return self.blocks[bytes(root)].state
+
+    def ancestor_at_slot(self, root: bytes, slot: int) -> bytes:
+        """Walk parents until the block's slot is <= slot (spec
+        get_ancestor)."""
+        node = self.blocks[bytes(root)]
+        while node.slot > slot:
+            parent = self.blocks.get(node.parent_root)
+            if parent is None:
+                return node.root
+            node = parent
+        return node.root
+
+    def is_descendant(self, ancestor: bytes, root: bytes) -> bool:
+        ancestor = bytes(ancestor)
+        if ancestor not in self.blocks:
+            return False
+        return (
+            self.ancestor_at_slot(root, self.blocks[ancestor].slot) == ancestor
+        )
+
+    # ------------------------------------------------------------ validate_*
+
+    def validate_block(
+        self,
+        signed_block,
+        verifier: "Optional[Verifier]" = None,
+        state_root_policy: str = "verify",
+    ) -> ValidBlock:
+        """Immutable, expensive half: parent lookup, full state transition
+        with batch signature verification (store.rs:925 validate_block →
+        :1013 custom_state_transition). Parallel-safe: touches no mutable
+        store state (reads immutable snapshots only)."""
+        block = signed_block.message
+        root = block.hash_tree_root()
+        if root in self.blocks:
+            raise ForkChoiceError("duplicate block")
+        slot = int(block.slot)
+        if slot > self.slot:
+            raise ForkChoiceError(f"block from future slot {slot} > {self.slot}")
+        parent = self.blocks.get(bytes(block.parent_root))
+        if parent is None:
+            raise ForkChoiceError("unknown parent")  # controller delays/retries
+        fin_slot = misc.compute_start_slot_at_epoch(
+            int(self.finalized_checkpoint.epoch), self.p
+        )
+        if slot <= fin_slot:
+            raise ForkChoiceError("block not newer than finalized slot")
+        if (
+            self.ancestor_at_slot(bytes(block.parent_root), fin_slot)
+            != bytes(self.finalized_checkpoint.root)
+        ):
+            raise ForkChoiceError("block does not descend from finalized root")
+
+        if verifier is None:
+            verifier = MultiVerifier()
+        post = custom_state_transition(
+            parent.state,
+            signed_block,
+            self.cfg,
+            verifier,
+            execution_engine=self.execution_engine,
+            state_root_policy=state_root_policy,
+        )
+        is_timely = self.slot == slot and self.interval == 0
+        return ValidBlock(signed_block, root, post, is_timely)
+
+    def validate_attestation(
+        self, data_slot: int, committee_index: int, target_epoch: int,
+        beacon_block_root: bytes, target_root: bytes,
+        attesting_indices: "Sequence[int]", is_from_block: bool = False,
+    ) -> ValidAttestation:
+        """Fork-choice attestation validation (spec on_attestation checks;
+        signature verification happens in the gossip pipeline before this).
+        Pure: reads the DAG, mutates nothing."""
+        p = self.p
+        current_epoch = misc.compute_epoch_at_slot(self.slot, p)
+        if target_epoch not in (current_epoch, max(0, current_epoch - 1)):
+            raise ForkChoiceError("attestation target epoch out of window")
+        if target_epoch != misc.compute_epoch_at_slot(data_slot, p):
+            raise ForkChoiceError("attestation target/slot mismatch")
+        beacon_block_root = bytes(beacon_block_root)
+        if beacon_block_root not in self.blocks:
+            raise ForkChoiceError("unknown attestation head block")
+        if self.blocks[beacon_block_root].slot > data_slot:
+            raise ForkChoiceError("attestation head newer than its slot")
+        target_root = bytes(target_root)
+        if target_root not in self.blocks:
+            raise ForkChoiceError("unknown attestation target")
+        expected_target = self.ancestor_at_slot(
+            beacon_block_root,
+            misc.compute_start_slot_at_epoch(target_epoch, p),
+        )
+        if expected_target != target_root:
+            raise ForkChoiceError("attestation target not on head's chain")
+        if data_slot > self.slot:
+            raise ForkChoiceError("attestation from future slot")
+        earliest = data_slot if is_from_block else data_slot + 1
+        return ValidAttestation(
+            [int(i) for i in attesting_indices],
+            target_epoch,
+            beacon_block_root,
+            earliest_slot=earliest,
+        )
+
+    # --------------------------------------------------------------- apply_*
+
+    def apply_tick(self, tick: Tick) -> None:
+        """Mutator-only (store.rs apply_tick): advance time, reset the
+        proposer boost at each new slot."""
+        if tick.slot < self.slot:
+            return
+        crossed_epoch = (
+            misc.compute_epoch_at_slot(tick.slot, self.p)
+            > misc.compute_epoch_at_slot(self.slot, self.p)
+        )
+        if tick.slot > self.slot:
+            self.proposer_boost_root = None
+        self.slot = tick.slot
+        self.interval = int(tick.kind)
+        if crossed_epoch:
+            # promote unrealized justification at the boundary (spec
+            # on_tick_per_slot → update_checkpoints(store.unrealized_*))
+            self._update_checkpoints(
+                self.unrealized_justified, self.unrealized_finalized
+            )
+
+    def apply_block(self, valid: ValidBlock) -> None:
+        """Mutator-only cheap half (store.rs:1860 apply_block): insert into
+        the DAG, pull up justification, boost, prune on new finality."""
+        root = valid.root
+        if root in self.blocks:
+            return
+        post = valid.state
+        uj, uf = unrealized_checkpoints(post, self.cfg)
+        node = BlockNode(
+            root, valid.signed_block, post, self._take_ordinal(), uj, uf
+        )
+        self.blocks[root] = node
+        self.children.setdefault(node.parent_root, []).append(root)
+        self.children.setdefault(root, [])
+
+        if valid.is_timely and self.proposer_boost_root is None:
+            self.proposer_boost_root = root
+
+        p = self.p
+        block_epoch = misc.compute_epoch_at_slot(node.slot, p)
+        current_epoch = misc.compute_epoch_at_slot(self.slot, p)
+        # realized checkpoints always count; unrealized count immediately
+        # for blocks from prior epochs (pull-up tip)
+        candidates = [
+            (post.current_justified_checkpoint, post.finalized_checkpoint)
+        ]
+        if block_epoch < current_epoch:
+            candidates.append((uj, uf))
+        for justified, finalized in candidates:
+            self._update_checkpoints(justified, finalized)
+        # track the best unrealized tip for boundary promotion
+        if int(uj.epoch) > int(self.unrealized_justified.epoch):
+            self.unrealized_justified = uj
+        if int(uf.epoch) > int(self.unrealized_finalized.epoch):
+            self.unrealized_finalized = uf
+
+    def apply_attestation(self, valid: ValidAttestation) -> None:
+        """Mutator-only (store.rs:2022): LMD latest-message updates."""
+        root = valid.beacon_block_root
+        epoch = valid.epoch
+        for i in valid.indices:
+            if i in self.equivocating:
+                continue
+            if self.latest_message_epoch.get(i, -1) < epoch:
+                self.latest_message_epoch[i] = epoch
+                self.latest_message_root[i] = root
+
+    def apply_attester_slashing(self, indices: "Sequence[int]") -> None:
+        """Equivocating validators never count toward weights again."""
+        for i in indices:
+            self.equivocating.add(int(i))
+            self.latest_message_epoch.pop(int(i), None)
+            self.latest_message_root.pop(int(i), None)
+
+    def _update_checkpoints(self, justified, finalized) -> None:
+        if int(justified.epoch) > int(self.justified_checkpoint.epoch):
+            jroot = bytes(justified.root)
+            if jroot in self.blocks:
+                self.justified_checkpoint = justified
+                self.justified_state = self._checkpoint_state(justified)
+        if int(finalized.epoch) > int(self.finalized_checkpoint.epoch):
+            if bytes(finalized.root) in self.blocks:
+                self.finalized_checkpoint = finalized
+                self._prune_finalized()
+
+    def _checkpoint_state(self, checkpoint):
+        """State at a checkpoint (advanced to the checkpoint's epoch start
+        if the block is older) — spec store.checkpoint_states cache."""
+        state = self.blocks[bytes(checkpoint.root)].state
+        target_slot = misc.compute_start_slot_at_epoch(
+            int(checkpoint.epoch), self.p
+        )
+        if int(state.slot) < target_slot:
+            state = process_slots(state, target_slot, self.cfg)
+        return state
+
+    def _prune_finalized(self) -> None:
+        fin_root = bytes(self.finalized_checkpoint.root)
+        keep = {
+            r
+            for r in self.blocks
+            if self.is_descendant(fin_root, r)
+        }
+        keep.add(fin_root)
+        self.blocks = {r: n for r, n in self.blocks.items() if r in keep}
+        self.children = {
+            r: [c for c in cs if c in keep]
+            for r, cs in self.children.items()
+            if r in keep
+        }
+
+    # ------------------------------------------------------------------ head
+
+    def get_head(self) -> bytes:
+        """LMD-GHOST from the justified root, vectorized weight pass."""
+        justified_root = bytes(self.justified_checkpoint.root)
+        if justified_root not in self.blocks:
+            justified_root = self.anchor_root
+        weights = self._subtree_weights(justified_root)
+        head = justified_root
+        while True:
+            kids = self.children.get(head, ())
+            if not kids:
+                return head
+            head = max(kids, key=lambda r: (weights.get(r, 0), r))
+
+    def _subtree_weights(self, from_root: bytes) -> "dict[bytes, int]":
+        """Per-node subtree weight: one numpy pass over latest messages,
+        then a bottom-up accumulation over the DAG."""
+        p = self.p
+        jstate = self.justified_state
+        cols = accessors.registry_columns(jstate)
+        n = len(cols)
+
+        own: "dict[bytes, int]" = {}
+        if self.latest_message_root:
+            idx = np.fromiter(self.latest_message_epoch.keys(), np.int64)
+            idx = idx[idx < n]
+            active = cols.active_indices(
+                accessors.get_current_epoch(jstate, p)
+            )
+            active_mask = np.zeros(n, dtype=bool)
+            active_mask[active] = True
+            for i in idx:
+                i = int(i)
+                if not active_mask[i] or bool(cols.slashed[i]):
+                    continue
+                root = self.latest_message_root[i]
+                if root in self.blocks:
+                    own[root] = own.get(root, 0) + int(cols.effective_balance[i])
+
+        if self.proposer_boost_root and self.proposer_boost_root in self.blocks:
+            total_active = accessors.get_total_active_balance(jstate, p)
+            committee_weight = total_active // p.SLOTS_PER_EPOCH
+            boost = committee_weight * 40 // 100  # PROPOSER_SCORE_BOOST
+            own[self.proposer_boost_root] = (
+                own.get(self.proposer_boost_root, 0) + boost
+            )
+
+        # bottom-up: deepest-first accumulation into parents
+        weights: "dict[bytes, int]" = dict(own)
+        for root in sorted(
+            self.blocks, key=lambda r: self.blocks[r].slot, reverse=True
+        ):
+            w = weights.get(root, 0)
+            parent = self.blocks[root].parent_root
+            if parent in self.blocks and root != from_root:
+                weights[parent] = weights.get(parent, 0) + w
+        return weights
+
+    # -------------------------------------------------------------- queries
+
+    def head_state(self):
+        return self.blocks[self.get_head()].state
+
+    def __len__(self) -> int:
+        return len(self.blocks)
+
+
+class _AnchorBlock:
+    """Header-shaped stand-in for the anchor's signed block."""
+
+    __slots__ = ("message",)
+
+    def __init__(self, header) -> None:
+        self.message = _AnchorMessage(header)
+
+
+class _AnchorMessage:
+    __slots__ = ("slot", "parent_root", "state_root")
+
+    def __init__(self, header) -> None:
+        self.slot = int(header.slot)
+        self.parent_root = bytes(header.parent_root)
+        self.state_root = bytes(header.state_root)
+
+
+__all__ = [
+    "ForkChoiceError",
+    "Store",
+    "Tick",
+    "TickKind",
+    "ValidBlock",
+    "ValidAttestation",
+    "unrealized_checkpoints",
+]

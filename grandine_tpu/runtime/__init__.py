@@ -1,0 +1,28 @@
+"""Runtime assembly — reference: `runtime` crate (service wiring,
+runtime/src/runtime.rs:49-597), `fork_choice_control` threading
+(controller/mutator/thread pool), `clock`, and the p2p
+`AttestationVerifier` batching service.
+
+  clock.py                — slot/tick timing (clock/src/lib.rs:1-30)
+  thread_pool.py          — 2-priority worker pool + WaitGroup test drain
+                            (fork_choice_control/src/thread_pool.rs, wait.rs)
+  controller.py           — mutator-actor Controller with snapshots and
+                            delayed-object retry (controller.rs, mutator.rs)
+  attestation_verifier.py — accumulate→deadline→batch→fallback firehose
+                            (p2p/src/attestation_verifier.rs)
+  node.py                 — in-process node: clock + controller + duties
+                            ticking through slots on synthetic data
+"""
+
+from grandine_tpu.runtime.clock import SlotClock, ticks_for_slot  # noqa: F401
+from grandine_tpu.runtime.controller import Controller, Snapshot  # noqa: F401
+from grandine_tpu.runtime.thread_pool import (  # noqa: F401
+    Priority,
+    ThreadPool,
+    WaitGroup,
+)
+from grandine_tpu.runtime.attestation_verifier import (  # noqa: F401
+    AttestationVerifier,
+    GossipAttestation,
+)
+from grandine_tpu.runtime.node import InProcessNode  # noqa: F401

@@ -1,0 +1,228 @@
+"""The gossip-attestation firehose service — reference:
+p2p/src/attestation_verifier.rs (`AttestationVerifier` :39: accumulate up
+to 64 per batch :37, bounded concurrent batch tasks :44-45,68, spawn on the
+low-priority executor :142-163, prevalidate + build triples :352-457, ONE
+batch verification :396-417, and on batch failure fall back to per-item
+verification so a single bad signature can't stall the stream :231-239,
+:377-386).
+
+TPU shape: each batch becomes ONE `fast_aggregate_verify_batch` launch
+(M aggregates × K committee members — the aggregate_fast_verify_kernel's
+native geometry). The deadline keeps latency bounded when gossip is slow;
+the batch bound keeps device launches dense when it's fast.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional, Sequence
+
+from grandine_tpu.consensus import accessors, keys, signing
+from grandine_tpu.consensus.verifier import SignatureInvalid
+from grandine_tpu.crypto import bls as A
+from grandine_tpu.fork_choice.store import ForkChoiceError, ValidAttestation
+from grandine_tpu.runtime.thread_pool import Priority
+
+MAX_BATCH = 64  # attestation_verifier.rs:37
+
+
+class GossipAttestation:
+    """One attestation off the wire, pre-verification."""
+
+    __slots__ = ("attestation", "received_at")
+
+    def __init__(self, attestation, received_at: "Optional[float]" = None) -> None:
+        self.attestation = attestation
+        self.received_at = received_at if received_at is not None else time.time()
+
+
+class AttestationVerifier:
+    """Accumulate → deadline/size-bound batch → device verify → feedback.
+
+    `submit` is called from gossip (any thread); a collector thread forms
+    batches; verification tasks run on the controller's LOW-priority pool;
+    verified attestations flow to `controller.on_valid_attestation_batch`.
+    """
+
+    def __init__(
+        self,
+        controller,
+        backend=None,
+        max_batch: int = MAX_BATCH,
+        deadline_s: float = 0.050,
+        max_active: "Optional[int]" = None,
+        use_device: bool = True,
+    ) -> None:
+        self.controller = controller
+        self.cfg = controller.cfg
+        self.backend = backend
+        self.use_device = use_device
+        self.max_batch = max_batch
+        self.deadline_s = deadline_s
+        self.max_active = max_active or controller.pool.n_threads
+
+        self._queue: "deque[GossipAttestation]" = deque()
+        self._cond = threading.Condition()
+        self._active = 0
+        self._stop = False
+        self.stats = {"batches": 0, "accepted": 0, "rejected": 0, "fallbacks": 0}
+        self._collector = threading.Thread(
+            target=self._collect, name="attestation-verifier", daemon=True
+        )
+        self._collector.start()
+
+    # ----------------------------------------------------------- ingestion
+
+    def submit(self, attestation) -> None:
+        with self._cond:
+            self._queue.append(GossipAttestation(attestation))
+            self._cond.notify()
+
+    def submit_many(self, attestations: "Sequence") -> None:
+        with self._cond:
+            self._queue.extend(GossipAttestation(a) for a in attestations)
+            self._cond.notify()
+
+    # ----------------------------------------------------------- collector
+
+    def _collect(self) -> None:
+        while True:
+            with self._cond:
+                while not self._stop and (
+                    not self._queue or self._active >= self.max_active
+                ):
+                    self._cond.wait(self.deadline_s)
+                    if self._queue and self._active < self.max_active:
+                        break  # deadline expired with pending items
+                if self._stop and not self._queue:
+                    return
+                batch = [
+                    self._queue.popleft()
+                    for _ in range(min(self.max_batch, len(self._queue)))
+                ]
+                if not batch:
+                    continue
+                self._active += 1
+            self.controller.pool.spawn(
+                lambda b=batch: self._verify_batch(b), Priority.LOW
+            )
+
+    # ------------------------------------------------------------- verify
+
+    def _verify_batch(self, batch: "Sequence[GossipAttestation]") -> None:
+        try:
+            snapshot = self.controller.snapshot()
+            state = snapshot.head_state
+            prepared = []
+            for item in batch:
+                try:
+                    prepared.append(self._prevalidate(state, item.attestation))
+                except (ForkChoiceError, ValueError, KeyError):
+                    # KeyError: raced the mutator's finalization prune (the
+                    # same race the block task path catches)
+                    self.stats["rejected"] += 1
+            if not prepared:
+                return
+            messages = [p[0] for p in prepared]
+            signatures = [p[1] for p in prepared]
+            members = [p[2] for p in prepared]
+            ok = self._batch_check(messages, signatures, members)
+            if ok:
+                self.stats["accepted"] += len(prepared)
+                self.controller.on_valid_attestation_batch(
+                    [p[3] for p in prepared]
+                )
+                return
+            # batch failed: isolate bad items singularly
+            # (attestation_verifier.rs:231-239,377-386)
+            self.stats["fallbacks"] += 1
+            good = []
+            for msg, sig, mems, valid in prepared:
+                try:
+                    ok = A.Signature.from_bytes(sig).fast_aggregate_verify(
+                        msg, mems
+                    )
+                except A.BlsError:
+                    ok = False  # malformed signature: drop just this item
+                if ok:
+                    good.append(valid)
+                    self.stats["accepted"] += 1
+                else:
+                    self.stats["rejected"] += 1
+            if good:
+                self.controller.on_valid_attestation_batch(good)
+        finally:
+            with self._cond:
+                self._active -= 1
+                self._cond.notify()
+            self.stats["batches"] += 1
+
+    def _prevalidate(self, state, attestation):
+        """Committee lookup + fork-choice windows; returns
+        (signing_root, signature_bytes, member_keys, ValidAttestation)."""
+        p = self.cfg.preset
+        data = attestation.data
+        indices = accessors.get_attesting_indices(
+            state, data, attestation.aggregation_bits, p
+        )
+        if len(indices) == 0:
+            raise ValueError("empty attestation")
+        valid = self.controller.store.validate_attestation(
+            int(data.slot),
+            int(data.index),
+            int(data.target.epoch),
+            bytes(data.beacon_block_root),
+            bytes(data.target.root),
+            [int(i) for i in indices],
+        )
+        root = signing.attestation_signing_root(state, data, self.cfg)
+        cols = accessors.registry_columns(state)
+        members = [keys.decompress_pubkey(cols.pubkeys[int(i)]) for i in indices]
+        return root, bytes(attestation.signature), members, valid
+
+    def _batch_check(self, messages, signatures, members) -> bool:
+        if self.use_device:
+            backend = self.backend
+            if backend is None:
+                from grandine_tpu.tpu.bls import TpuBlsBackend
+
+                backend = self.backend = TpuBlsBackend()
+            try:
+                sigs = [A.Signature.from_bytes(s) for s in signatures]
+            except A.BlsError:
+                return False
+            return backend.fast_aggregate_verify_batch(messages, sigs, members)
+        # host anchor path (small batches / tests)
+        try:
+            return all(
+                A.Signature.from_bytes(sig).fast_aggregate_verify(msg, mems)
+                for msg, sig, mems in zip(messages, signatures, members)
+            )
+        except A.BlsError:
+            return False
+
+    # ------------------------------------------------------------ control
+
+    def flush(self, timeout: float = 30.0) -> None:
+        """Drain the queue and all in-flight batches (test barrier)."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            self._cond.notify()
+        while time.monotonic() < deadline:
+            with self._cond:
+                if not self._queue and self._active == 0:
+                    return
+                self._cond.notify()
+            time.sleep(0.01)
+        raise TimeoutError("attestation verifier did not drain")
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        self._collector.join(timeout=5)
+
+
+__all__ = ["AttestationVerifier", "GossipAttestation", "MAX_BATCH"]

@@ -1,0 +1,63 @@
+"""Slot clock — reference: `clock` crate (clock/src/lib.rs:1-30: a Stream
+of Ticks, 3 per slot at 0, 1/3 and 2/3 of the slot, driving propose /
+attest / aggregate duties).
+
+Pure time math here; the driving loop (sleep-until-next-tick) lives in the
+node. Everything is testable without wall time by feeding ticks manually.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Iterator, Optional
+
+from grandine_tpu.fork_choice.store import Tick, TickKind
+
+INTERVALS_PER_SLOT = 3
+
+
+class SlotClock:
+    """Maps wall time <-> (slot, interval)."""
+
+    def __init__(self, genesis_time: int, seconds_per_slot: int) -> None:
+        self.genesis_time = genesis_time
+        self.seconds_per_slot = seconds_per_slot
+
+    def current_slot(self, now: "Optional[float]" = None) -> int:
+        now = time.time() if now is None else now
+        if now < self.genesis_time:
+            return 0
+        return int(now - self.genesis_time) // self.seconds_per_slot
+
+    def tick_at(self, now: "Optional[float]" = None) -> Tick:
+        now = time.time() if now is None else now
+        slot = self.current_slot(now)
+        into = (now - self.genesis_time) - slot * self.seconds_per_slot
+        interval = min(
+            INTERVALS_PER_SLOT - 1,
+            int(into * INTERVALS_PER_SLOT / self.seconds_per_slot),
+        )
+        return Tick(slot, TickKind(interval))
+
+    def time_of(self, tick: Tick) -> float:
+        return (
+            self.genesis_time
+            + tick.slot * self.seconds_per_slot
+            + int(tick.kind) * self.seconds_per_slot / INTERVALS_PER_SLOT
+        )
+
+    def next_tick(self, now: "Optional[float]" = None) -> Tick:
+        now = time.time() if now is None else now
+        cur = self.tick_at(now)
+        if int(cur.kind) + 1 < INTERVALS_PER_SLOT:
+            return Tick(cur.slot, TickKind(int(cur.kind) + 1))
+        return Tick(cur.slot + 1, TickKind.PROPOSE)
+
+
+def ticks_for_slot(slot: int) -> "Iterator[Tick]":
+    """The three duty ticks of one slot, in order."""
+    for kind in TickKind:
+        yield Tick(slot, kind)
+
+
+__all__ = ["SlotClock", "ticks_for_slot", "INTERVALS_PER_SLOT", "Tick", "TickKind"]

@@ -1,0 +1,264 @@
+"""Controller + mutator actor — reference: fork_choice_control/src/
+controller.rs (facade :62-72, spawn_block_task :199-201), mutator.rs (the
+single-writer thread owning the Store :1-15,167, delayed-object retry maps
+:84-104), tasks.rs (Block/Attestation task types with panic catching).
+
+Threading model (the reference's, kept):
+  - expensive validation (state transition + signature batches) runs on a
+    2-priority ThreadPool, many tasks in parallel, reading the store
+    without locks (insert-only BlockNode graph; a read racing a prune is
+    caught and surfaces as a retryable ForkChoiceError);
+  - ALL mutation flows through one mutator thread via a queue (actor);
+  - readers get an immutable `Snapshot` swapped atomically after each
+    mutation (ArcSwap equivalent: Python attribute store is atomic);
+  - blocks with unknown parents are delayed and retried when the parent
+    arrives (mutator.rs delayed_until_block).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Optional, Sequence
+
+from grandine_tpu.consensus.verifier import (
+    MultiVerifier,
+    SignatureInvalid,
+    Verifier,
+)
+from grandine_tpu.fork_choice.store import (
+    ForkChoiceError,
+    Store,
+    Tick,
+    ValidAttestation,
+    ValidBlock,
+)
+from grandine_tpu.runtime.thread_pool import Priority, ThreadPool, WaitGroup
+from grandine_tpu.transition.block import TransitionError
+
+
+class Snapshot:
+    """Immutable post-mutation view for lock-free readers
+    (controller.rs:62-72 `Snapshot` over ArcSwap)."""
+
+    __slots__ = (
+        "head_root",
+        "head_state",
+        "slot",
+        "justified_checkpoint",
+        "finalized_checkpoint",
+        "block_count",
+    )
+
+    def __init__(self, store: Store) -> None:
+        self.head_root = store.get_head()
+        self.head_state = store.blocks[self.head_root].state
+        self.slot = store.slot
+        self.justified_checkpoint = store.justified_checkpoint
+        self.finalized_checkpoint = store.finalized_checkpoint
+        self.block_count = len(store)
+
+
+class Controller:
+    """Public API is callable from any thread; everything mutating is
+    marshalled onto the store-mutator thread."""
+
+    def __init__(
+        self,
+        anchor_state,
+        cfg,
+        execution_engine=None,
+        verifier_factory: "Callable[[], Verifier]" = MultiVerifier,
+        pool: "Optional[ThreadPool]" = None,
+        wait_group: "Optional[WaitGroup]" = None,
+    ) -> None:
+        self.cfg = cfg
+        self.verifier_factory = verifier_factory
+        self.store = Store(anchor_state, cfg, execution_engine=execution_engine)
+        self.wait_group = wait_group or WaitGroup()
+        self.pool = pool or ThreadPool(wait_group=self.wait_group)
+        self._owns_pool = pool is None
+
+        self._delayed_by_parent: "dict[bytes, list]" = {}
+        self._delayed_attestations: "list[ValidAttestation]" = []
+        self._rejected: "list[tuple[bytes, str]]" = []
+        self.on_head_change: "list[Callable[[Snapshot], None]]" = []
+
+        self._snapshot = Snapshot(self.store)
+        self._mutations: "queue.Queue" = queue.Queue()
+        self._mutator = threading.Thread(
+            target=self._mutator_run, name="store-mutator", daemon=True
+        )
+        self._mutator.start()
+
+    # ---------------------------------------------------------------- reads
+
+    def snapshot(self) -> Snapshot:
+        return self._snapshot
+
+    # --------------------------------------------------------------- inputs
+
+    def on_tick(self, tick: Tick) -> None:
+        self._send(("tick", tick))
+
+    def on_gossip_block(self, signed_block) -> None:
+        """Untrusted block: full verification on the high-priority pool
+        (controller.rs spawn_block_task → tasks.rs BlockTask)."""
+        self._spawn_block_task(signed_block, trusted=False)
+
+    def on_requested_block(self, signed_block) -> None:
+        self.on_gossip_block(signed_block)
+
+    def on_own_block(self, signed_block) -> None:
+        """Own (just produced) block: signatures are trusted, the state
+        root is still checked (tasks.rs:103-118 TrustOwnBlockSignatures)."""
+        self._spawn_block_task(signed_block, trusted=True)
+
+    def on_valid_attestation_batch(
+        self, valids: "Sequence[ValidAttestation]"
+    ) -> None:
+        """Prevalidated attestations (from the AttestationVerifier service)."""
+        self._send(("attestations", list(valids)))
+
+    def on_gossip_attestation(
+        self, data_slot, committee_index, target_epoch, beacon_block_root,
+        target_root, attesting_indices,
+    ) -> None:
+        """Single fork-choice vote, validated on the low-priority pool."""
+
+        def task() -> None:
+            try:
+                valid = self.store.validate_attestation(
+                    data_slot, committee_index, target_epoch,
+                    beacon_block_root, target_root, attesting_indices,
+                )
+            except ForkChoiceError:
+                return
+            self._send(("attestations", [valid]))
+
+        self.pool.spawn(task, Priority.LOW)
+
+    def on_attester_slashing(self, indices: "Sequence[int]") -> None:
+        self._send(("attester_slashing", list(indices)))
+
+    # ---------------------------------------------------------- test hooks
+
+    def wait(self, timeout: "Optional[float]" = 30.0) -> None:
+        """Block until every spawned task AND every queued mutation drained
+        (the WaitGroup test barrier, wait.rs). Loops because applying a
+        block can re-spawn delayed children (new pool tasks)."""
+        import time as _time
+
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        while True:
+            remaining = (
+                None if deadline is None else max(0.0, deadline - _time.monotonic())
+            )
+            self.wait_group.wait(remaining)
+            self._mutations.join()
+            if self.wait_group.idle() and self._mutations.unfinished_tasks == 0:
+                return
+
+    def rejected(self) -> "list[tuple[bytes, str]]":
+        return list(self._rejected)
+
+    def stop(self) -> None:
+        self._send(("stop", None))
+        self._mutator.join(timeout=5)
+        if self._owns_pool:
+            self.pool.stop()
+
+    # ------------------------------------------------------------ internals
+
+    def _send(self, msg) -> None:
+        self._mutations.put(msg)
+
+    def _spawn_block_task(self, signed_block, trusted: bool) -> None:
+        def task() -> None:
+            from grandine_tpu.consensus.verifier import NullVerifier
+
+            verifier = NullVerifier() if trusted else self.verifier_factory()
+            try:
+                valid = self.store.validate_block(signed_block, verifier)
+            except ForkChoiceError as e:
+                if "unknown parent" in str(e):
+                    self._send(("delay_block", signed_block))
+                else:
+                    self._send(("reject", (signed_block, str(e))))
+                return
+            except (SignatureInvalid, TransitionError, KeyError) as e:
+                # KeyError: raced a prune — the block is pre-finalized
+                self._send(("reject", (signed_block, repr(e))))
+                return
+            self._send(("block", valid))
+
+        self.pool.spawn(task, Priority.HIGH)
+
+    # ------------------------------------------------------- mutator thread
+
+    def _mutator_run(self) -> None:
+        while True:
+            kind, payload = self._mutations.get()
+            try:
+                if kind == "stop":
+                    return
+                elif kind == "tick":
+                    self.store.apply_tick(payload)
+                    self._apply_matured_attestations()
+                elif kind == "block":
+                    self._handle_block(payload)
+                elif kind == "attestations":
+                    for valid in payload:
+                        if valid.earliest_slot > self.store.slot:
+                            # spec: votes count from data.slot + 1
+                            self._delayed_attestations.append(valid)
+                        else:
+                            self.store.apply_attestation(valid)
+                elif kind == "attester_slashing":
+                    self.store.apply_attester_slashing(payload)
+                elif kind == "delay_block":
+                    parent = bytes(payload.message.parent_root)
+                    self._delayed_by_parent.setdefault(parent, []).append(payload)
+                elif kind == "reject":
+                    signed_block, reason = payload
+                    self._rejected.append(
+                        (signed_block.message.hash_tree_root(), reason)
+                    )
+                # snapshot refresh only for mutating kinds ("block" refreshes
+                # inside _handle_block; delay/reject mutate nothing) — the
+                # head computation is the mutator's main cost
+                if kind in ("tick", "attestations", "attester_slashing"):
+                    self._refresh_snapshot()
+            except BaseException as e:  # poison so tests fail loudly
+                self.wait_group.add()
+                self.wait_group.done(e)
+            finally:
+                self._mutations.task_done()
+
+    def _handle_block(self, valid: ValidBlock) -> None:
+        old_head = self._snapshot.head_root
+        self.store.apply_block(valid)
+        # retry children that were waiting for this parent
+        for delayed in self._delayed_by_parent.pop(valid.root, []):
+            self._spawn_block_task(delayed, trusted=False)
+        self._refresh_snapshot()
+        if self._snapshot.head_root != old_head:
+            for cb in self.on_head_change:
+                cb(self._snapshot)
+
+    def _apply_matured_attestations(self) -> None:
+        if not self._delayed_attestations:
+            return
+        still = []
+        for valid in self._delayed_attestations:
+            if valid.earliest_slot <= self.store.slot:
+                self.store.apply_attestation(valid)
+            else:
+                still.append(valid)
+        self._delayed_attestations = still
+
+    def _refresh_snapshot(self) -> None:
+        self._snapshot = Snapshot(self.store)
+
+
+__all__ = ["Controller", "Snapshot"]

@@ -72,10 +72,10 @@ def upgrade_to_altair(pre, cfg):
         for flag_index in flag_indices:
             part[idx] |= np.uint8(1 << flag_index)
     post = post.replace(previous_epoch_participation=part)
+    # both committees sample the same (state, epoch+1) seed — one compute
     committee = accessors.get_next_sync_committee(post, ns, cfg)
     return post.replace(
-        current_sync_committee=committee,
-        next_sync_committee=accessors.get_next_sync_committee(post, ns, cfg),
+        current_sync_committee=committee, next_sync_committee=committee
     )
 
 

@@ -105,11 +105,11 @@ def interop_genesis_state(
     )
 
     if phase >= Phase.ALTAIR:
-        # both committees derive from the genesis state (altair fork spec)
+        # both committees derive identically from the genesis state
+        # (altair fork spec) — one compute
         committee = accessors.get_next_sync_committee(state, ns, cfg)
         state = state.replace(
-            current_sync_committee=committee,
-            next_sync_committee=accessors.get_next_sync_committee(state, ns, cfg),
+            current_sync_committee=committee, next_sync_committee=committee
         )
     return state
 

@@ -1,0 +1,238 @@
+"""Runtime tests: thread pool/WaitGroup, mutator-actor controller with
+delayed-block retry, the attestation-verifier firehose (batching +
+bad-signature fallback), and the in-process node ticking through epochs.
+
+Reference test parity: fork_choice_control's TestController harness
+(specialized.rs:43-47, helpers.rs:34-80 — WaitGroup drain, channel-boundary
+assertions) and attestation_verifier batching semantics.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from grandine_tpu.consensus.verifier import NullVerifier
+from grandine_tpu.fork_choice.store import Tick, TickKind
+from grandine_tpu.runtime import (
+    AttestationVerifier,
+    Controller,
+    InProcessNode,
+    Priority,
+    SlotClock,
+    ThreadPool,
+    WaitGroup,
+)
+from grandine_tpu.runtime.thread_pool import PoolPoisoned
+from grandine_tpu.transition.genesis import interop_genesis_state
+from grandine_tpu.types.config import Config
+from grandine_tpu.validator.duties import produce_attestations, produce_block
+
+CFG = Config.minimal()
+
+
+@pytest.fixture()
+def genesis():
+    return interop_genesis_state(32, CFG)
+
+
+# ------------------------------------------------------------- thread pool
+
+
+def test_pool_runs_and_drains():
+    results = []
+    with ThreadPool(n_threads=4) as pool:
+        for i in range(20):
+            pool.spawn(lambda i=i: results.append(i), Priority.LOW)
+        pool.wait_group.wait(10)
+    assert sorted(results) == list(range(20))
+
+
+def test_pool_priority_order():
+    order = []
+    lock = threading.Lock()
+    with ThreadPool(n_threads=1) as pool:
+        gate = threading.Event()
+        pool.spawn(gate.wait)  # block the single worker
+        for i in range(3):
+            pool.spawn(lambda i=i: order.append(("low", i)), Priority.LOW)
+        for i in range(3):
+            pool.spawn(lambda i=i: order.append(("high", i)), Priority.HIGH)
+        gate.set()
+        pool.wait_group.wait(10)
+    assert order[:3] == [("high", 0), ("high", 1), ("high", 2)]
+
+
+def test_wait_group_poisons_on_panic():
+    with ThreadPool(n_threads=2) as pool:
+        pool.spawn(lambda: 1 / 0)
+        with pytest.raises(PoolPoisoned):
+            pool.wait_group.wait(10)
+
+
+# -------------------------------------------------------------- slot clock
+
+
+def test_slot_clock_math():
+    clock = SlotClock(genesis_time=1000, seconds_per_slot=12)
+    assert clock.current_slot(1000) == 0
+    assert clock.current_slot(1000 + 25) == 2
+    t = clock.tick_at(1000 + 12 + 5)
+    assert (t.slot, t.kind) == (1, TickKind.ATTEST)
+    nxt = clock.next_tick(1000 + 12 + 11.9)
+    assert (nxt.slot, nxt.kind) == (2, TickKind.PROPOSE)
+    assert clock.time_of(Tick(2, TickKind.PROPOSE)) == 1000 + 24
+
+
+# -------------------------------------------------------------- controller
+
+
+def test_controller_applies_blocks_and_updates_head(genesis):
+    ctrl = Controller(genesis, CFG, verifier_factory=NullVerifier)
+    try:
+        state = genesis
+        roots = []
+        for slot in (1, 2):
+            blk, state = produce_block(
+                state, slot, CFG, full_sync_participation=False
+            )
+            ctrl.on_tick(Tick(slot, TickKind.PROPOSE))
+            ctrl.on_gossip_block(blk)
+            ctrl.wait()
+            roots.append(blk.message.hash_tree_root())
+        snap = ctrl.snapshot()
+        assert snap.head_root == roots[-1]
+        assert snap.block_count == 3
+        assert not ctrl.rejected()
+    finally:
+        ctrl.stop()
+
+
+def test_controller_delays_until_parent_arrives(genesis):
+    """Child delivered before parent: delayed, then retried and applied."""
+    ctrl = Controller(genesis, CFG, verifier_factory=NullVerifier)
+    try:
+        b1, s1 = produce_block(genesis, 1, CFG, full_sync_participation=False)
+        b2, s2 = produce_block(s1, 2, CFG, full_sync_participation=False)
+        ctrl.on_tick(Tick(2, TickKind.PROPOSE))
+        ctrl.on_gossip_block(b2)  # parent unknown -> delayed
+        ctrl.wait()
+        assert ctrl.snapshot().block_count == 1
+        ctrl.on_gossip_block(b1)  # parent arrives -> child retried
+        ctrl.wait()
+        snap = ctrl.snapshot()
+        assert snap.block_count == 3
+        assert snap.head_root == b2.message.hash_tree_root()
+    finally:
+        ctrl.stop()
+
+
+def test_controller_rejects_invalid_block(genesis):
+    from grandine_tpu.consensus.verifier import MultiVerifier
+
+    ctrl = Controller(genesis, CFG, verifier_factory=MultiVerifier)
+    try:
+        blk, _ = produce_block(genesis, 1, CFG, full_sync_participation=False)
+        bad = blk.replace(signature=b"\x80" + b"\x22" * 95)
+        ctrl.on_tick(Tick(1, TickKind.PROPOSE))
+        ctrl.on_gossip_block(bad)
+        ctrl.wait()
+        assert ctrl.snapshot().block_count == 1
+        assert len(ctrl.rejected()) == 1
+    finally:
+        ctrl.stop()
+
+
+def test_controller_concurrent_forks(genesis):
+    """Two sibling blocks validated concurrently on the pool; both land."""
+    ctrl = Controller(genesis, CFG, verifier_factory=NullVerifier)
+    try:
+        ba, _ = produce_block(
+            genesis, 1, CFG, full_sync_participation=False, graffiti=b"a"
+        )
+        bb, _ = produce_block(
+            genesis, 1, CFG, full_sync_participation=False, graffiti=b"b"
+        )
+        ctrl.on_tick(Tick(1, TickKind.ATTEST))
+        ctrl.on_gossip_block(ba)
+        ctrl.on_gossip_block(bb)
+        ctrl.wait()
+        assert ctrl.snapshot().block_count == 3
+    finally:
+        ctrl.stop()
+
+
+# ---------------------------------------------------------------- firehose
+
+
+def test_firehose_verifies_and_feeds_fork_choice(genesis):
+    ctrl = Controller(genesis, CFG, verifier_factory=NullVerifier)
+    verifier = AttestationVerifier(ctrl, use_device=False, deadline_s=0.01)
+    try:
+        blk, post = produce_block(genesis, 1, CFG, full_sync_participation=False)
+        ctrl.on_tick(Tick(1, TickKind.PROPOSE))
+        ctrl.on_own_block(blk)
+        ctrl.wait()
+        atts = produce_attestations(post, CFG, slot=1)
+        verifier.submit_many(atts)
+        verifier.flush()
+        ctrl.wait()
+        assert verifier.stats["accepted"] == len(atts)
+        assert verifier.stats["rejected"] == 0
+        # votes are delayed until slot 2, then counted
+        assert not ctrl.store.latest_message_root
+        ctrl.on_tick(Tick(2, TickKind.PROPOSE))
+        ctrl.wait()
+        assert len(ctrl.store.latest_message_root) > 0
+    finally:
+        verifier.stop()
+        ctrl.stop()
+
+
+def test_firehose_fallback_isolates_bad_signature(genesis):
+    """A batch with one corrupted signature: batch check fails, singular
+    fallback accepts the good ones and drops the bad one."""
+    ctrl = Controller(genesis, CFG, verifier_factory=NullVerifier)
+    verifier = AttestationVerifier(ctrl, use_device=False, deadline_s=0.01)
+    try:
+        blk, post = produce_block(genesis, 1, CFG, full_sync_participation=False)
+        ctrl.on_tick(Tick(1, TickKind.PROPOSE))
+        ctrl.on_own_block(blk)
+        ctrl.wait()
+        atts = produce_attestations(post, CFG, slot=1)
+        good = atts[0]
+        # drop a signer from the bits without re-signing: structurally fine,
+        # signature no longer matches the claimed participant set
+        bad = good.replace(aggregation_bits=good.aggregation_bits.set(1, False))
+        verifier.submit_many([bad, good])
+        verifier.flush()
+        ctrl.wait()
+        assert verifier.stats["fallbacks"] >= 1
+        assert verifier.stats["accepted"] == 1
+        assert verifier.stats["rejected"] == 1
+    finally:
+        verifier.stop()
+        ctrl.stop()
+
+
+# ------------------------------------------------------------------- node
+
+
+def test_in_process_node_runs_epochs(genesis):
+    """The minimal runtime skeleton: clock ticks drive propose/attest
+    through the controller + firehose for 2+ epochs; head advances and
+    justification kicks in."""
+    node = InProcessNode(genesis, CFG)
+    try:
+        node.run_until(17)  # two minimal epochs + 1
+        snap = node.head()
+        assert snap.slot == 17
+        assert int(snap.head_state.slot) == 17
+        assert len(node.produced_blocks) == 17
+        assert int(snap.justified_checkpoint.epoch) >= 0
+        # LMD messages accumulated from the firehose
+        assert len(node.controller.store.latest_message_root) > 0
+        assert node.attestation_verifier.stats["rejected"] == 0
+    finally:
+        node.stop()
