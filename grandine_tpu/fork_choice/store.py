@@ -20,8 +20,10 @@ Fork-choice semantics implemented (ethereum consensus spec, deneb-era):
   - pruning at finalization
 
 Weight accumulation is vectorized: latest messages are numpy columns
-(validator -> block ordinal, balance), one bincount per head computation,
-then a bottom-up subtree sum over the (small) block DAG.
+validator -> (epoch, block root) maps folded into per-block weights,
+then a bottom-up subtree sum over the (small) block DAG; spec
+filter_block_tree viability (voting-source / finalized consistency)
+restricts which branches may win.
 """
 
 from __future__ import annotations
@@ -78,19 +80,17 @@ class BlockNode:
         "state",
         "parent_root",
         "slot",
-        "ordinal",
         "unrealized_justified",
         "unrealized_finalized",
     )
 
-    def __init__(self, root, signed_block, state, ordinal,
+    def __init__(self, root, signed_block, state,
                  unrealized_justified, unrealized_finalized) -> None:
         self.root = root
         self.signed_block = signed_block
         self.state = state
         self.parent_root = bytes(signed_block.message.parent_root)
         self.slot = int(signed_block.message.slot)
-        self.ordinal = ordinal  # dense index for vectorized weights
         self.unrealized_justified = unrealized_justified
         self.unrealized_finalized = unrealized_finalized
 
@@ -163,7 +163,6 @@ class Store:
         self.anchor_root = anchor_root
         self.blocks: "dict[bytes, BlockNode]" = {}
         self.children: "dict[bytes, list[bytes]]" = {}
-        self._next_ordinal = 0
 
         anchor_epoch = accessors.get_current_epoch(anchor_state, self.p)
         Checkpoint = type(anchor_state.finalized_checkpoint)
@@ -180,7 +179,6 @@ class Store:
             anchor_root,
             _AnchorBlock(header),
             anchor_state,
-            self._take_ordinal(),
             anchor_cp,
             anchor_cp,
         )
@@ -197,11 +195,6 @@ class Store:
         self.interval = 0
 
     # ------------------------------------------------------------ plumbing
-
-    def _take_ordinal(self) -> int:
-        o = self._next_ordinal
-        self._next_ordinal += 1
-        return o
 
     def contains_block(self, root: bytes) -> bool:
         return bytes(root) in self.blocks
@@ -346,7 +339,7 @@ class Store:
         post = valid.state
         uj, uf = unrealized_checkpoints(post, self.cfg)
         node = BlockNode(
-            root, valid.signed_block, post, self._take_ordinal(), uj, uf
+            root, valid.signed_block, post, uj, uf
         )
         self.blocks[root] = node
         self.children.setdefault(node.parent_root, []).append(root)
@@ -431,17 +424,61 @@ class Store:
     # ------------------------------------------------------------------ head
 
     def get_head(self) -> bytes:
-        """LMD-GHOST from the justified root, vectorized weight pass."""
+        """LMD-GHOST from the justified root, restricted to viable branches
+        (spec get_head over filter_block_tree)."""
         justified_root = bytes(self.justified_checkpoint.root)
         if justified_root not in self.blocks:
             justified_root = self.anchor_root
         weights = self._subtree_weights(justified_root)
+        viable = self._viable_subtrees()
         head = justified_root
         while True:
-            kids = self.children.get(head, ())
+            kids = [
+                k for k in self.children.get(head, ()) if viable.get(k, False)
+            ]
             if not kids:
                 return head
             head = max(kids, key=lambda r: (weights.get(r, 0), r))
+
+    def _viable_for_head(self, node: BlockNode) -> bool:
+        """Spec `is_head_viable`/filter_block_tree leaf condition: the
+        branch's voting source and finalized checkpoint must be consistent
+        with the store's."""
+        p = self.p
+        current_epoch = misc.compute_epoch_at_slot(self.slot, p)
+        justified = self.justified_checkpoint
+        voting_source = node.state.current_justified_checkpoint
+        correct_justified = (
+            int(justified.epoch) == 0
+            or int(voting_source.epoch) == int(justified.epoch)
+            # post-capella fork-choice relaxation
+            or int(voting_source.epoch) + 2 >= current_epoch
+        )
+        fin = self.finalized_checkpoint
+        if int(fin.epoch) == 0:
+            correct_finalized = True
+        else:
+            fin_slot = misc.compute_start_slot_at_epoch(int(fin.epoch), p)
+            correct_finalized = (
+                self.ancestor_at_slot(node.root, fin_slot) == bytes(fin.root)
+            )
+        return correct_justified and correct_finalized
+
+    def _viable_subtrees(self) -> "dict[bytes, bool]":
+        """root -> does the subtree contain a viable leaf (spec
+        filter_block_tree: internal nodes survive iff some descendant leaf
+        is viable)."""
+        viable: "dict[bytes, bool]" = {}
+        for root in sorted(
+            self.blocks, key=lambda r: self.blocks[r].slot, reverse=True
+        ):
+            kids = self.children.get(root, ())
+            if kids:
+                # internal nodes survive only through viable descendants
+                viable[root] = any(viable.get(k, False) for k in kids)
+            else:
+                viable[root] = self._viable_for_head(self.blocks[root])
+        return viable
 
     def _subtree_weights(self, from_root: bytes) -> "dict[bytes, int]":
         """Per-node subtree weight: one numpy pass over latest messages,

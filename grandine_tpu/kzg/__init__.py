@@ -1,0 +1,23 @@
+"""KZG / EIP-4844 blob commitments — reference: `kzg_utils` crate
+(kzg_utils/src/eip_4844.rs: blob_to_kzg_commitment, compute_kzg_proof,
+compute_blob_kzg_proof, verify_kzg_proof, verify_blob_kzg_proof[_batch]
+over rust-kzg-blst; trusted_setup.rs embeds the ceremony output).
+
+TPU-first: the two hot operations are 4096-point G1 multi-scalar
+multiplications (commitment and proof) — mapped onto the existing batch
+scalar-mul + sum-tree kernels as ONE device launch each. Pairing checks
+(2 pairings per verify) run on the anchor. The embedded trusted setup is
+the public KZG ceremony output (data, not code), bit-reversal-permuted at
+load exactly as the deneb spec requires.
+"""
+
+from grandine_tpu.kzg.eip4844 import (  # noqa: F401
+    KzgError,
+    blob_to_kzg_commitment,
+    compute_blob_kzg_proof,
+    compute_kzg_proof,
+    verify_blob_kzg_proof,
+    verify_blob_kzg_proof_batch,
+    verify_kzg_proof,
+)
+from grandine_tpu.kzg.setup import TrustedSetup, dev_setup, official_setup  # noqa: F401

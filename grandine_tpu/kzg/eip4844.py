@@ -1,0 +1,335 @@
+"""EIP-4844 KZG operations — reference: kzg_utils/src/eip_4844.rs (the six
+public functions over rust-kzg-blst) and the deneb
+polynomial-commitments.md spec they implement.
+
+The hot path is the G1 multi-scalar multiplication (one per commitment /
+proof): on device it is ONE batched scalar-mul launch + a log-depth sum
+tree over the existing TPU curve kernels; the host fallback is a windowed
+Pippenger. Verification (2 pairings) runs on the anchor pairing.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Optional, Sequence
+
+from grandine_tpu.crypto import bls as A
+from grandine_tpu.crypto.curves import G1, G2, Point, g1_infinity
+from grandine_tpu.crypto.pairing import pairing_check
+from grandine_tpu.kzg import fr
+from grandine_tpu.kzg.setup import TrustedSetup, official_setup
+
+BLS_MODULUS = fr.BLS_MODULUS
+BYTES_PER_FIELD_ELEMENT = 32
+FIAT_SHAMIR_PROTOCOL_DOMAIN = b"FSBLOBVERIFY_V1_"
+RANDOM_CHALLENGE_KZG_BATCH_DOMAIN = b"RCKZGBATCH___V1_"
+KZG_ENDIANNESS = "big"
+
+G1_POINT_AT_INFINITY = bytes([0xC0]) + b"\x00" * 47
+
+#: flip to False to force the host Pippenger MSM (no JAX)
+USE_DEVICE_MSM = True
+
+
+class KzgError(ValueError):
+    pass
+
+
+# ------------------------------------------------------------ (de)serialize
+
+
+def _bytes_to_bls_field(b: bytes) -> int:
+    v = int.from_bytes(b, KZG_ENDIANNESS)
+    if v >= BLS_MODULUS:
+        raise KzgError("field element out of range")
+    return v
+
+
+def _field_to_bytes(v: int) -> bytes:
+    return int(v).to_bytes(BYTES_PER_FIELD_ELEMENT, KZG_ENDIANNESS)
+
+
+def _blob_to_polynomial(blob: bytes, width: int) -> "list[int]":
+    if len(blob) != width * BYTES_PER_FIELD_ELEMENT:
+        raise KzgError(f"blob must be {width * BYTES_PER_FIELD_ELEMENT} bytes")
+    return [
+        _bytes_to_bls_field(blob[i * 32 : (i + 1) * 32]) for i in range(width)
+    ]
+
+
+def _g1_from_commitment_bytes(b: bytes) -> Point:
+    try:
+        return A.g1_from_bytes(bytes(b), subgroup_check=True)
+    except A.BlsError as e:
+        raise KzgError(f"invalid G1 encoding: {e}") from e
+
+
+def _hash_to_bls_field(data: bytes) -> int:
+    return int.from_bytes(hashlib.sha256(data).digest(), KZG_ENDIANNESS) % BLS_MODULUS
+
+
+def _compute_challenge(blob: bytes, commitment: bytes, width: int) -> int:
+    degree_poly = width.to_bytes(16, KZG_ENDIANNESS)
+    return _hash_to_bls_field(
+        FIAT_SHAMIR_PROTOCOL_DOMAIN + degree_poly + blob + commitment
+    )
+
+
+# ----------------------------------------------------------------------- MSM
+
+
+def _msm_host(points: "Sequence[Point]", scalars: "Sequence[int]") -> Point:
+    """Windowed Pippenger MSM (host fallback)."""
+    window = 8
+    acc_total = g1_infinity()
+    n_windows = (255 + window - 1) // window
+    for w in range(n_windows - 1, -1, -1):
+        shift = w * window
+        buckets: "dict[int, Point]" = {}
+        for p, s in zip(points, scalars):
+            digit = (s >> shift) & ((1 << window) - 1)
+            if digit:
+                cur = buckets.get(digit)
+                buckets[digit] = p if cur is None else cur + p
+        if w != n_windows - 1:
+            for _ in range(window):
+                acc_total = acc_total.double()
+        # Σ d·B_d via descending running sums weighted by digit gaps
+        running = g1_infinity()
+        window_sum = g1_infinity()
+        digits = sorted(buckets, reverse=True)
+        for i, digit in enumerate(digits):
+            running = running + buckets[digit]
+            next_digit = digits[i + 1] if i + 1 < len(digits) else 0
+            window_sum = window_sum + running.mul(digit - next_digit)
+        acc_total = acc_total + window_sum
+    return acc_total
+
+
+def _msm_device(setup: TrustedSetup, scalars: "Sequence[int]") -> Point:
+    """Device MSM over the setup's (cached, limb-form) G1 points: one
+    batched scalar-mul kernel + a complete-addition sum tree."""
+    import jax
+    import numpy as np
+
+    from grandine_tpu.tpu import curve as C
+    from grandine_tpu.tpu import limbs as L
+
+    cache = setup._dev_cache
+    if cache is None:
+        n = setup.width
+        xs = np.zeros((n, L.NLIMBS), np.int32)
+        ys = np.zeros((n, L.NLIMBS), np.int32)
+        inf = np.zeros(n, bool)
+        for i, pt in enumerate(setup.g1_lagrange_brp):
+            xs[i], ys[i], inf[i] = C.g1_point_to_dev(pt)
+        cache = setup._dev_cache = (xs, ys, inf)
+    xs, ys, inf = cache
+
+    from grandine_tpu.tpu.bls import _jitted_global
+
+    def msm_kernel(px, py, p_inf, bits):
+        import jax.numpy as jnp
+
+        jac = C.scalar_mul(px, py, p_inf, bits, C.FP_OPS)
+        return C.sum_points(jac, C.FP_OPS)
+
+    fn = _jitted_global(f"kzg_msm_{setup.width}", msm_kernel)
+    bits = C.scalars_to_bits_msb([s % BLS_MODULUS for s in scalars], 255)
+    X, Y, Z = fn(xs, ys, inf, bits)
+    import numpy as np
+
+    return C.dev_to_g1_point(np.asarray(X), np.asarray(Y), np.asarray(Z))
+
+
+def _g1_lincomb(setup: TrustedSetup, scalars: "Sequence[int]") -> Point:
+    if USE_DEVICE_MSM:
+        try:
+            return _msm_device(setup, scalars)
+        except Exception:
+            pass  # fall back to host (no JAX / shape issues)
+    return _msm_host(setup.g1_lagrange_brp, scalars)
+
+
+# ------------------------------------------------------------ the six calls
+
+
+def blob_to_kzg_commitment(
+    blob: bytes, setup: "Optional[TrustedSetup]" = None
+) -> bytes:
+    setup = setup or official_setup()
+    poly = _blob_to_polynomial(bytes(blob), setup.width)
+    return A.g1_to_bytes(_g1_lincomb(setup, poly))
+
+
+def compute_kzg_proof(
+    blob: bytes, z_bytes: bytes, setup: "Optional[TrustedSetup]" = None
+) -> "tuple[bytes, bytes]":
+    """Returns (proof, y) for the evaluation p(z) = y."""
+    setup = setup or official_setup()
+    poly = _blob_to_polynomial(bytes(blob), setup.width)
+    z = _bytes_to_bls_field(bytes(z_bytes))
+    proof, y = _compute_kzg_proof_impl(poly, z, setup)
+    return proof, _field_to_bytes(y)
+
+
+def _compute_kzg_proof_impl(poly, z: int, setup: TrustedSetup):
+    roots = setup.roots_brp
+    y = fr.evaluate_polynomial_in_evaluation_form(poly, z, roots)
+    # quotient q_i = (f_i - y) / (w_i - z), with the special row when
+    # z equals a root (spec compute_kzg_proof_impl)
+    width = setup.width
+    denoms = [(w - z) % BLS_MODULUS for w in roots]
+    inv_denoms = fr.batch_inverse(denoms)
+    q = [0] * width
+    special = None
+    for i in range(width):
+        if denoms[i] == 0:
+            special = i
+            continue
+        q[i] = (poly[i] - y) % BLS_MODULUS * inv_denoms[i] % BLS_MODULUS
+    if special is not None:
+        # q_m = sum_{i != m} f_i * w_i / (m_root * (m_root - w_i))... spec:
+        # build from the other rows
+        m = special
+        zm = roots[m]
+        inv_z = fr.batch_inverse(
+            [zm * ((zm - w) % BLS_MODULUS) % BLS_MODULUS for w in roots]
+        )
+        acc = 0
+        for i in range(width):
+            if i == m:
+                continue
+            acc += (
+                (poly[i] - y)
+                % BLS_MODULUS
+                * roots[i]
+                % BLS_MODULUS
+                * inv_z[i]
+                % BLS_MODULUS
+            )
+        q[m] = acc % BLS_MODULUS
+    return A.g1_to_bytes(_g1_lincomb(setup, q)), y
+
+
+def verify_kzg_proof(
+    commitment_bytes: bytes,
+    z_bytes: bytes,
+    y_bytes: bytes,
+    proof_bytes: bytes,
+    setup: "Optional[TrustedSetup]" = None,
+) -> bool:
+    """e(P - [y]G1, G2) == e(proof, [tau - z]G2) — spec verify_kzg_proof."""
+    setup = setup or official_setup()
+    commitment = _g1_from_commitment_bytes(commitment_bytes)
+    proof = _g1_from_commitment_bytes(proof_bytes)
+    z = _bytes_to_bls_field(bytes(z_bytes))
+    y = _bytes_to_bls_field(bytes(y_bytes))
+    return _verify_kzg_proof_impl(commitment, z, y, proof, setup)
+
+
+def _verify_kzg_proof_impl(commitment, z, y, proof, setup) -> bool:
+    # X_minus_z = [tau]G2 - [z]G2 ; P_minus_y = commitment - [y]G1
+    x_minus_z = setup.tau_g2 + (-G2.mul(z) if z else _g2_zero())
+    p_minus_y = commitment + (-G1.mul(y) if y else g1_infinity())
+    # e(P - y, G2) * e(-proof, X - z) == 1
+    return pairing_check([(p_minus_y, G2), (-proof, x_minus_z)])
+
+
+def _g2_zero():
+    from grandine_tpu.crypto.curves import g2_infinity
+
+    return g2_infinity()
+
+
+def compute_blob_kzg_proof(
+    blob: bytes, commitment_bytes: bytes, setup: "Optional[TrustedSetup]" = None
+) -> bytes:
+    setup = setup or official_setup()
+    _g1_from_commitment_bytes(commitment_bytes)  # validate encoding
+    poly = _blob_to_polynomial(bytes(blob), setup.width)
+    z = _compute_challenge(bytes(blob), bytes(commitment_bytes), setup.width)
+    proof, _y = _compute_kzg_proof_impl(poly, z, setup)
+    return proof
+
+
+def verify_blob_kzg_proof(
+    blob: bytes,
+    commitment_bytes: bytes,
+    proof_bytes: bytes,
+    setup: "Optional[TrustedSetup]" = None,
+) -> bool:
+    setup = setup or official_setup()
+    commitment = _g1_from_commitment_bytes(commitment_bytes)
+    proof = _g1_from_commitment_bytes(proof_bytes)
+    poly = _blob_to_polynomial(bytes(blob), setup.width)
+    z = _compute_challenge(bytes(blob), bytes(commitment_bytes), setup.width)
+    y = fr.evaluate_polynomial_in_evaluation_form(poly, z, setup.roots_brp)
+    return _verify_kzg_proof_impl(commitment, z, y, proof, setup)
+
+
+def verify_blob_kzg_proof_batch(
+    blobs: "Sequence[bytes]",
+    commitments: "Sequence[bytes]",
+    proofs: "Sequence[bytes]",
+    setup: "Optional[TrustedSetup]" = None,
+) -> bool:
+    """Random-linear-combination batch verification (spec
+    verify_blob_kzg_proof_batch): ONE pairing check for N blobs."""
+    setup = setup or official_setup()
+    n = len(blobs)
+    if not (n == len(commitments) == len(proofs)):
+        raise KzgError("length mismatch")
+    if n == 0:
+        return True
+    if n == 1:
+        return verify_blob_kzg_proof(blobs[0], commitments[0], proofs[0], setup)
+
+    commitment_points = [_g1_from_commitment_bytes(c) for c in commitments]
+    proof_points = [_g1_from_commitment_bytes(p) for p in proofs]
+    zs, ys = [], []
+    for blob, commitment in zip(blobs, commitments):
+        poly = _blob_to_polynomial(bytes(blob), setup.width)
+        z = _compute_challenge(bytes(blob), bytes(commitment), setup.width)
+        zs.append(z)
+        ys.append(
+            fr.evaluate_polynomial_in_evaluation_form(poly, z, setup.roots_brp)
+        )
+
+    # powers of r from the spec's batch-challenge domain
+    data = (
+        RANDOM_CHALLENGE_KZG_BATCH_DOMAIN
+        + setup.width.to_bytes(8, KZG_ENDIANNESS)
+        + n.to_bytes(8, KZG_ENDIANNESS)
+    )
+    for commitment, z, y, proof in zip(commitments, zs, ys, proofs):
+        data += bytes(commitment) + _field_to_bytes(z) + _field_to_bytes(y) + bytes(proof)
+    r = _hash_to_bls_field(data)
+    r_powers = [pow(r, i, BLS_MODULUS) for i in range(n)]
+
+    # Σ r^i (C_i - [y_i]G1 + z_i·proof_i)  vs  Σ r^i proof_i under tau:
+    #   e(Σ r^i(C_i - y_i + z_i·W_i), G2) == e(Σ r^i W_i, [tau]G2)
+    proof_lincomb = g1_infinity()
+    rhs_lincomb = g1_infinity()
+    for ri, C_pt, W_pt, z, y in zip(
+        r_powers, commitment_points, proof_points, zs, ys
+    ):
+        proof_lincomb = proof_lincomb + W_pt.mul(ri)
+        interp = C_pt + (-G1.mul(y) if y else g1_infinity())
+        interp = interp + W_pt.mul(z)
+        rhs_lincomb = rhs_lincomb + interp.mul(ri)
+    return pairing_check(
+        [(rhs_lincomb, G2), (-proof_lincomb, setup.tau_g2)]
+    )
+
+
+__all__ = [
+    "KzgError",
+    "blob_to_kzg_commitment",
+    "compute_kzg_proof",
+    "compute_blob_kzg_proof",
+    "verify_kzg_proof",
+    "verify_blob_kzg_proof",
+    "verify_blob_kzg_proof_batch",
+    "G1_POINT_AT_INFINITY",
+]

@@ -1,0 +1,144 @@
+"""Trusted setup loading — reference: kzg_utils/src/trusted_setup.rs
+(embedded ceremony output, lazily parsed into library settings).
+
+Two sources:
+  - `official_setup()`: the vendored public KZG-ceremony file
+    (data/trusted_setup.txt — 4096 Lagrange-form G1 points + 65 monomial
+    G2 points; PUBLIC DATA from the Ethereum ceremony). Decompression of
+    4096 G1 points is pure-Python sqrt work (~seconds), so the affine
+    integer coordinates are cached beside the file after the first load.
+  - `dev_setup(n)`: an INSECURE synthetic setup from a known tau, any
+    power-of-two size — for tests and small-degree development; never for
+    production verification of real blobs.
+
+Per the deneb spec, the G1 Lagrange points are stored/used in
+bit-reversal-permuted order.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Optional, Sequence
+
+from grandine_tpu.crypto import bls as A
+from grandine_tpu.crypto.curves import G1, G2, Point, g1_infinity
+from grandine_tpu.crypto.fields import Fq
+from grandine_tpu.kzg import fr
+
+_DATA_DIR = os.path.join(os.path.dirname(__file__), "data")
+_OFFICIAL_TXT = os.path.join(_DATA_DIR, "trusted_setup.txt")
+_OFFICIAL_CACHE = os.path.join(_DATA_DIR, "trusted_setup.cache.pkl")
+
+
+class TrustedSetup:
+    """g1_lagrange_brp: [L_i(tau)]·G1 in bit-reversed order (length n);
+    g2_monomial: [tau^i]·G2 (length >= 2); roots_brp: matching roots."""
+
+    def __init__(self, g1_lagrange_brp, g2_monomial, name: str) -> None:
+        self.g1_lagrange_brp = list(g1_lagrange_brp)
+        self.g2_monomial = list(g2_monomial)
+        self.name = name
+        self.width = len(self.g1_lagrange_brp)
+        assert self.width & (self.width - 1) == 0
+        self.roots_brp = fr.bit_reversal_permutation(
+            fr.compute_roots_of_unity(self.width)
+        )
+        self._dev_cache = None  # device-limb arrays, built lazily
+
+    @property
+    def tau_g2(self):
+        return self.g2_monomial[1]
+
+
+_OFFICIAL: "Optional[TrustedSetup]" = None
+_DEV: dict = {}
+
+
+def official_setup() -> TrustedSetup:
+    """The production setup (FIELD_ELEMENTS_PER_BLOB = 4096)."""
+    global _OFFICIAL
+    if _OFFICIAL is not None:
+        return _OFFICIAL
+    points = _load_cached_official()
+    if points is None:
+        points = _parse_official_txt()
+        _store_cache(points)
+    g1, g2 = points
+    g1_points = [_g1_from_affine(x, y) for x, y in g1]
+    g2_points = [_g2_from_bytes_unchecked(b) for b in g2[:2]]
+    _OFFICIAL = TrustedSetup(
+        fr.bit_reversal_permutation(g1_points), g2_points, "official"
+    )
+    return _OFFICIAL
+
+
+def dev_setup(n: int = 64, tau: int = 0x1337_F00D_D00D_5EED) -> TrustedSetup:
+    """INSECURE known-tau setup for tests/dev (tau is public!)."""
+    key = (n, tau)
+    hit = _DEV.get(key)
+    if hit is not None:
+        return hit
+    roots = fr.compute_roots_of_unity(n)
+    # Lagrange basis at tau: L_i(tau) = (tau^n - 1) * w^i / (n * (tau - w^i))
+    R = fr.BLS_MODULUS
+    tau %= R
+    tn = (pow(tau, n, R) - 1) % R
+    n_inv = pow(n % R, R - 2, R)
+    denoms = fr.batch_inverse([(tau - w) % R for w in roots])
+    lag = [tn * w % R * d % R * n_inv % R for w, d in zip(roots, denoms)]
+    g1_points = [G1.mul(v) if v else g1_infinity() for v in lag]
+    g2_points = [G2.mul(pow(tau, i, R)) for i in range(2)]
+    setup = TrustedSetup(
+        fr.bit_reversal_permutation(g1_points), g2_points, f"dev-{n}"
+    )
+    _DEV[key] = setup
+    return setup
+
+
+# ----------------------------------------------------------------- parsing
+
+
+def _parse_official_txt():
+    with open(_OFFICIAL_TXT) as f:
+        lines = [ln.strip() for ln in f if ln.strip()]
+    n_g1 = int(lines[0])
+    n_g2 = int(lines[1])
+    g1_hex = lines[2 : 2 + n_g1]
+    g2_hex = lines[2 + n_g1 : 2 + n_g1 + n_g2]
+    g1 = []
+    for h in g1_hex:
+        p = A.g1_from_bytes(bytes.fromhex(h), subgroup_check=False)
+        aff = p.to_affine()
+        g1.append((aff[0].n, aff[1].n))
+    g2 = [bytes.fromhex(h) for h in g2_hex]
+    return g1, g2
+
+
+def _load_cached_official():
+    try:
+        if os.path.getmtime(_OFFICIAL_CACHE) < os.path.getmtime(_OFFICIAL_TXT):
+            return None
+        with open(_OFFICIAL_CACHE, "rb") as f:
+            return pickle.load(f)
+    except (OSError, pickle.PickleError, EOFError):
+        return None
+
+
+def _store_cache(points) -> None:
+    try:
+        with open(_OFFICIAL_CACHE, "wb") as f:
+            pickle.dump(points, f)
+    except OSError:
+        pass
+
+
+def _g1_from_affine(x: int, y: int):
+    return Point.from_affine(Fq(x), Fq(y), A.B1)
+
+
+def _g2_from_bytes_unchecked(data: bytes):
+    return A.g2_from_bytes(data, subgroup_check=False)
+
+
+__all__ = ["TrustedSetup", "official_setup", "dev_setup"]

@@ -1,0 +1,135 @@
+"""Blob-sidecar validation — reference: the deneb blob plane
+(types/src/deneb containers, fork-choice BlobSidecar tasks, and
+helper_functions misc::kzg_commitment_inclusion_proof).
+
+A BlobSidecar carries (blob, commitment, proof) plus a Merkle branch
+proving the commitment sits in the signed block body it claims. Both the
+branch and the KZG proof must verify before a sidecar enters the blob
+cache.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from grandine_tpu.core import hashing
+from grandine_tpu.kzg import eip4844
+from grandine_tpu.ssz import Bytes48
+from grandine_tpu.ssz.merkle import verify_merkle_proof
+
+
+def _body_layout(body_cls, p):
+    """(field_position, body_depth, list_depth) for blob_kzg_commitments."""
+    names = [name for name, _ in body_cls.FIELDS]
+    field_pos = names.index("blob_kzg_commitments")
+    n_fields = len(names)
+    body_depth = max(1, (n_fields - 1).bit_length())
+    list_depth = (p.MAX_BLOB_COMMITMENTS_PER_BLOCK - 1).bit_length()
+    return field_pos, body_depth, list_depth
+
+
+def inclusion_proof_depth(body_cls, p) -> int:
+    field_pos, body_depth, list_depth = _body_layout(body_cls, p)
+    return body_depth + 1 + list_depth  # +1: list length mixin
+
+
+def build_commitment_inclusion_proof(body, index: int, p) -> "list[bytes]":
+    """Merkle branch for commitment `index` of `body.blob_kzg_commitments`
+    against the body root (producer side; reference
+    misc::kzg_commitment_inclusion_proof)."""
+    body_cls = type(body)
+    field_pos, body_depth, list_depth = _body_layout(body_cls, p)
+    commitments = list(body.blob_kzg_commitments)
+    if not 0 <= index < len(commitments):
+        raise IndexError(index)
+
+    # branch inside the commitment data tree (depth list_depth)
+    leaves = [Bytes48.hash_tree_root(bytes(c)) for c in commitments]
+    branch = []
+    level = leaves
+    idx = index
+    for d in range(list_depth):
+        sibling = idx ^ 1
+        branch.append(
+            level[sibling] if sibling < len(level) else hashing.ZERO_HASHES[d]
+        )
+        if len(level) % 2:
+            level = level + [hashing.ZERO_HASHES[d]]
+        level = [
+            hashing.hash_pair(level[i], level[i + 1])
+            for i in range(0, len(level), 2)
+        ]
+        idx >>= 1
+    # length mixin sibling
+    branch.append(len(commitments).to_bytes(32, "little"))
+    # body-level branch: siblings of the field subtree
+    field_roots = [
+        ftyp.hash_tree_root(getattr(body, fname))
+        for fname, ftyp in body_cls.FIELDS
+    ]
+    level = field_roots
+    idx = field_pos
+    for d in range(body_depth):
+        sibling = idx ^ 1
+        branch.append(
+            level[sibling] if sibling < len(level) else hashing.ZERO_HASHES[d]
+        )
+        if len(level) % 2:
+            level = level + [hashing.ZERO_HASHES[d]]
+        level = [
+            hashing.hash_pair(level[i], level[i + 1])
+            for i in range(0, len(level), 2)
+        ]
+        idx >>= 1
+    return branch
+
+
+def verify_commitment_inclusion(
+    commitment: bytes,
+    index: int,
+    branch,
+    body_root: bytes,
+    body_cls,
+    p,
+) -> bool:
+    """Spec verify_blob_sidecar_inclusion_proof."""
+    field_pos, body_depth, list_depth = _body_layout(body_cls, p)
+    depth = body_depth + 1 + list_depth
+    gindex = (field_pos << (list_depth + 1)) | index
+    leaf = Bytes48.hash_tree_root(bytes(commitment))
+    return verify_merkle_proof(leaf, list(branch), depth, gindex, body_root)
+
+
+def validate_blob_sidecar(
+    sidecar, body_cls, p, setup: "Optional[object]" = None
+) -> None:
+    """Full sidecar validation: index bound, inclusion proof against the
+    signed header's body root, then the KZG proof. Raises KzgError."""
+    if int(sidecar.index) >= p.MAX_BLOBS_PER_BLOCK:
+        raise eip4844.KzgError("sidecar index out of range")
+    header = sidecar.signed_block_header.message
+    ok = verify_commitment_inclusion(
+        bytes(sidecar.kzg_commitment),
+        int(sidecar.index),
+        [bytes(b) for b in sidecar.kzg_commitment_inclusion_proof],
+        bytes(header.body_root),
+        body_cls,
+        p,
+    )
+    if not ok:
+        raise eip4844.KzgError("commitment inclusion proof invalid")
+    if not eip4844.verify_blob_kzg_proof(
+        bytes(sidecar.blob),
+        bytes(sidecar.kzg_commitment),
+        bytes(sidecar.kzg_proof),
+        setup,
+    ):
+        raise eip4844.KzgError("blob KZG proof invalid")
+
+
+__all__ = [
+    "build_commitment_inclusion_proof",
+    "verify_commitment_inclusion",
+    "validate_blob_sidecar",
+    "inclusion_proof_depth",
+]

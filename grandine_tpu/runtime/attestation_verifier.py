@@ -90,12 +90,24 @@ class AttestationVerifier:
     def _collect(self) -> None:
         while True:
             with self._cond:
-                while not self._stop and (
-                    not self._queue or self._active >= self.max_active
+                # wait for the first item
+                while not self._stop and not self._queue:
+                    self._cond.wait()
+                if self._stop and not self._queue:
+                    return
+                # accumulate: dispatch when the batch bound is reached, the
+                # deadline since the first item expires, or on shutdown —
+                # this is what makes device launches dense under load
+                deadline = time.monotonic() + self.deadline_s
+                while (
+                    not self._stop
+                    and len(self._queue) < self.max_batch
+                    and (remaining := deadline - time.monotonic()) > 0
                 ):
-                    self._cond.wait(self.deadline_s)
-                    if self._queue and self._active < self.max_active:
-                        break  # deadline expired with pending items
+                    self._cond.wait(remaining)
+                # respect the concurrent-batch bound before dispatching
+                while not self._stop and self._active >= self.max_active:
+                    self._cond.wait()
                 if self._stop and not self._queue:
                     return
                 batch = [

@@ -35,8 +35,8 @@ class SlotClock:
         into = (now - self.genesis_time) - slot * self.seconds_per_slot
         interval = min(
             INTERVALS_PER_SLOT - 1,
-            int(into * INTERVALS_PER_SLOT / self.seconds_per_slot),
-        )
+            max(0, int(into * INTERVALS_PER_SLOT / self.seconds_per_slot)),
+        )  # clamped at 0: before genesis `into` is negative
         return Tick(slot, TickKind(interval))
 
     def time_of(self, tick: Tick) -> float:

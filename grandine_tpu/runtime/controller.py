@@ -218,7 +218,16 @@ class Controller:
                     self.store.apply_attester_slashing(payload)
                 elif kind == "delay_block":
                     parent = bytes(payload.message.parent_root)
-                    self._delayed_by_parent.setdefault(parent, []).append(payload)
+                    if parent in self.store.blocks:
+                        # parent landed between the failed validation and
+                        # this message: retry immediately instead of filing
+                        # under an already-applied parent (would be lost)
+                        self._spawn_block_task(payload, trusted=False)
+                    else:
+                        self._delayed_by_parent.setdefault(parent, []).append(
+                            payload
+                        )
+                        self._prune_delayed()
                 elif kind == "reject":
                     signed_block, reason = payload
                     self._rejected.append(
@@ -245,6 +254,29 @@ class Controller:
         if self._snapshot.head_root != old_head:
             for cb in self.on_head_change:
                 cb(self._snapshot)
+
+    #: caps for the retry/reject books (delayed blocks from parents that
+    #: never arrive would otherwise grow without bound under gossip spam)
+    MAX_DELAYED_PARENTS = 256
+    MAX_REJECTED = 256
+
+    def _prune_delayed(self) -> None:
+        # drop pre-finalized delays, then oldest parents over the cap
+        fin_epoch = int(self.store.finalized_checkpoint.epoch)
+        fin_slot = fin_epoch * self.cfg.preset.SLOTS_PER_EPOCH
+        for parent in list(self._delayed_by_parent):
+            kept = [
+                b
+                for b in self._delayed_by_parent[parent]
+                if int(b.message.slot) > fin_slot
+            ]
+            if kept:
+                self._delayed_by_parent[parent] = kept
+            else:
+                del self._delayed_by_parent[parent]
+        while len(self._delayed_by_parent) > self.MAX_DELAYED_PARENTS:
+            self._delayed_by_parent.pop(next(iter(self._delayed_by_parent)))
+        del self._rejected[: -self.MAX_REJECTED]
 
     def _apply_matured_attestations(self) -> None:
         if not self._delayed_attestations:

@@ -120,7 +120,13 @@ class ThreadPool:
     def stop(self) -> None:
         with self._cond:
             self._stop = True
+            # release the WaitGroup counts of tasks that will never run
+            abandoned = sum(len(q) for q in self._queues.values())
+            for q in self._queues.values():
+                q.clear()
             self._cond.notify_all()
+        for _ in range(abandoned):
+            self.wait_group.done()
         for t in self._threads:
             t.join(timeout=5)
 

@@ -213,3 +213,20 @@ def test_attestation_validation_windows(genesis):
         store.validate_attestation(
             1, 0, 0, ra, store.ancestor_at_slot(ra, 0), [0], is_from_block=False
         )
+
+
+def test_viability_filter_excludes_stale_branches(genesis):
+    """filter_block_tree: when the store's justified checkpoint races ahead
+    of every branch's voting source (and the +2-epoch grace expires), no
+    leaf is viable and the head falls back to the justified root."""
+    store = make_store(genesis)
+    state = genesis
+    for slot in (1, 2):
+        _, state = add_block(store, state, slot)
+    assert store.get_head() != store.anchor_root  # normally viable
+
+    Checkpoint = type(genesis.finalized_checkpoint)
+    store.justified_checkpoint = Checkpoint(epoch=40, root=store.anchor_root)
+    tick_to(store, 50 * P.SLOTS_PER_EPOCH)  # grace window long gone
+    # voting sources are epoch 0 != 40 and 0 + 2 < current epoch: not viable
+    assert store.get_head() == store.anchor_root

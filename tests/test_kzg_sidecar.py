@@ -1,0 +1,107 @@
+"""Blob-sidecar inclusion-proof and validation tests."""
+
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from grandine_tpu.kzg import eip4844
+from grandine_tpu.kzg.sidecar import (
+    build_commitment_inclusion_proof,
+    inclusion_proof_depth,
+    validate_blob_sidecar,
+    verify_commitment_inclusion,
+)
+from grandine_tpu.kzg.setup import dev_setup
+from grandine_tpu.types.containers import spec_types
+from grandine_tpu.types.preset import MINIMAL
+
+P = MINIMAL
+NS = spec_types(P).deneb
+
+
+@pytest.fixture(autouse=True)
+def host_msm(monkeypatch):
+    monkeypatch.setattr(eip4844, "USE_DEVICE_MSM", False)
+
+
+def test_inclusion_proof_depth_matches_preset():
+    assert (
+        inclusion_proof_depth(NS.BeaconBlockBody, P)
+        == P.KZG_COMMITMENT_INCLUSION_PROOF_DEPTH
+    )
+
+
+def test_inclusion_proof_roundtrip():
+    commitments = [bytes([i]) * 48 for i in (1, 2, 3)]
+    body = NS.BeaconBlockBody(blob_kzg_commitments=commitments)
+    body_root = body.hash_tree_root()
+    for i, c in enumerate(commitments):
+        branch = build_commitment_inclusion_proof(body, i, P)
+        assert len(branch) == P.KZG_COMMITMENT_INCLUSION_PROOF_DEPTH
+        assert verify_commitment_inclusion(
+            c, i, branch, body_root, NS.BeaconBlockBody, P
+        )
+        # wrong index / wrong commitment / tampered branch all fail
+        assert not verify_commitment_inclusion(
+            c, (i + 1) % 3, branch, body_root, NS.BeaconBlockBody, P
+        )
+        assert not verify_commitment_inclusion(
+            b"\xff" * 48, i, branch, body_root, NS.BeaconBlockBody, P
+        )
+        bad = list(branch)
+        bad[0] = b"\x11" * 32
+        assert not verify_commitment_inclusion(
+            c, i, bad, body_root, NS.BeaconBlockBody, P
+        )
+
+
+def test_validate_blob_sidecar_end_to_end():
+    """Duck-typed sidecar over the dev setup: inclusion proof + KZG proof
+    must both hold; each failure mode raises."""
+    setup = dev_setup(64)
+    rng = np.random.default_rng(42)
+    blob = b"".join(
+        (int.from_bytes(rng.bytes(31), "big")).to_bytes(32, "big")
+        for _ in range(64)
+    )
+    commitment = eip4844.blob_to_kzg_commitment(blob, setup)
+    proof = eip4844.compute_blob_kzg_proof(blob, commitment, setup)
+
+    body = NS.BeaconBlockBody(blob_kzg_commitments=[commitment])
+    header = NS.BeaconBlockHeader(body_root=body.hash_tree_root())
+    sidecar = SimpleNamespace(
+        index=0,
+        blob=blob,
+        kzg_commitment=commitment,
+        kzg_proof=proof,
+        signed_block_header=SimpleNamespace(message=header),
+        kzg_commitment_inclusion_proof=build_commitment_inclusion_proof(
+            body, 0, P
+        ),
+    )
+    validate_blob_sidecar(sidecar, NS.BeaconBlockBody, P, setup)  # no raise
+
+    with pytest.raises(eip4844.KzgError, match="index out of range"):
+        validate_blob_sidecar(
+            SimpleNamespace(**{**vars(sidecar), "index": P.MAX_BLOBS_PER_BLOCK}),
+            NS.BeaconBlockBody,
+            P,
+            setup,
+        )
+    with pytest.raises(eip4844.KzgError, match="inclusion proof"):
+        validate_blob_sidecar(
+            SimpleNamespace(**{**vars(sidecar), "kzg_commitment": b"\x01" * 48}),
+            NS.BeaconBlockBody,
+            P,
+            setup,
+        )
+    tampered = bytearray(blob)
+    tampered[33] ^= 1
+    with pytest.raises(eip4844.KzgError, match="KZG proof"):
+        validate_blob_sidecar(
+            SimpleNamespace(**{**vars(sidecar), "blob": bytes(tampered)}),
+            NS.BeaconBlockBody,
+            P,
+            setup,
+        )
