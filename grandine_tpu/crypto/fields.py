@@ -405,3 +405,21 @@ def frobenius_coefficients() -> dict:
         "fq6_g2": (_FROB6_G2.c0.n, _FROB6_G2.c1.n),
         "fq12_gw": (_FROB12_GW.c0.n, _FROB12_GW.c1.n),
     }
+
+
+def batch_inverse(values, modulus):
+    """Montgomery-trick batch inversion: ONE modular inverse for N values.
+    Zeros map to zero (callers decide whether zero input is an error).
+    Shared by the KZG Fr math and the host point-conversion paths."""
+    n = len(values)
+    prefix = [1] * (n + 1)
+    for i, v in enumerate(values):
+        prefix[i + 1] = prefix[i] * (v if v else 1) % modulus
+    inv = pow(prefix[n], modulus - 2, modulus)
+    out = [0] * n
+    for i in range(n - 1, -1, -1):
+        v = values[i]
+        if v:
+            out[i] = prefix[i] * inv % modulus
+            inv = inv * v % modulus
+    return out

@@ -447,7 +447,14 @@ class Store:
         p = self.p
         current_epoch = misc.compute_epoch_at_slot(self.slot, p)
         justified = self.justified_checkpoint
-        voting_source = node.state.current_justified_checkpoint
+        block_epoch = misc.compute_epoch_at_slot(node.slot, p)
+        # spec get_voting_source: prior-epoch blocks vote with their
+        # unrealized justification (the pulled-up tip), current-epoch
+        # blocks with their realized checkpoint
+        if block_epoch < current_epoch:
+            voting_source = node.unrealized_justified
+        else:
+            voting_source = node.state.current_justified_checkpoint
         correct_justified = (
             int(justified.epoch) == 0
             or int(voting_source.epoch) == int(justified.epoch)

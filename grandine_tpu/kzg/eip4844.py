@@ -146,8 +146,14 @@ def _g1_lincomb(setup: TrustedSetup, scalars: "Sequence[int]") -> Point:
     if USE_DEVICE_MSM:
         try:
             return _msm_device(setup, scalars)
-        except Exception:
-            pass  # fall back to host (no JAX / shape issues)
+        except ImportError:
+            pass  # no JAX: host path
+        except Exception as e:
+            import warnings
+
+            warnings.warn(
+                f"device MSM failed ({e!r}); falling back to host Pippenger"
+            )
     return _msm_host(setup.g1_lagrange_brp, scalars)
 
 

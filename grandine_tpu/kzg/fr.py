@@ -37,20 +37,11 @@ def bit_reversal_permutation(values: Sequence) -> list:
 
 
 def batch_inverse(values: "Sequence[int]") -> "list[int]":
-    """Montgomery batch inversion: one modular inverse for N elements.
-    Zero inputs map to zero (callers guard the z == root case)."""
-    n = len(values)
-    prefix = [1] * (n + 1)
-    for i, v in enumerate(values):
-        prefix[i + 1] = prefix[i] * (v if v else 1) % BLS_MODULUS
-    inv = pow(prefix[n], BLS_MODULUS - 2, BLS_MODULUS)
-    out = [0] * n
-    for i in range(n - 1, -1, -1):
-        v = values[i]
-        if v:
-            out[i] = prefix[i] * inv % BLS_MODULUS
-            inv = inv * v % BLS_MODULUS
-    return out
+    """Montgomery batch inversion over Fr; zeros map to zero (callers
+    guard the z == root case). Delegates to the shared field helper."""
+    from grandine_tpu.crypto.fields import batch_inverse as _bi
+
+    return _bi(values, BLS_MODULUS)
 
 
 def evaluate_polynomial_in_evaluation_form(

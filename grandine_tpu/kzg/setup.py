@@ -17,9 +17,11 @@ bit-reversal-permuted order.
 
 from __future__ import annotations
 
+import hashlib
 import os
-import pickle
 from typing import Optional, Sequence
+
+import numpy as np
 
 from grandine_tpu.crypto import bls as A
 from grandine_tpu.crypto.curves import G1, G2, Point, g1_infinity
@@ -28,7 +30,7 @@ from grandine_tpu.kzg import fr
 
 _DATA_DIR = os.path.join(os.path.dirname(__file__), "data")
 _OFFICIAL_TXT = os.path.join(_DATA_DIR, "trusted_setup.txt")
-_OFFICIAL_CACHE = os.path.join(_DATA_DIR, "trusted_setup.cache.pkl")
+_OFFICIAL_CACHE = os.path.join(_DATA_DIR, "trusted_setup.cache.npz")
 
 
 class TrustedSetup:
@@ -115,20 +117,49 @@ def _parse_official_txt():
     return g1, g2
 
 
+def _txt_digest() -> bytes:
+    with open(_OFFICIAL_TXT, "rb") as f:
+        return hashlib.sha256(f.read()).digest()
+
+
 def _load_cached_official():
+    """npz cache (no pickle: nothing executable in the file), keyed on a
+    content hash of the source txt."""
     try:
-        if os.path.getmtime(_OFFICIAL_CACHE) < os.path.getmtime(_OFFICIAL_TXT):
-            return None
-        with open(_OFFICIAL_CACHE, "rb") as f:
-            return pickle.load(f)
-    except (OSError, pickle.PickleError, EOFError):
+        with np.load(_OFFICIAL_CACHE, allow_pickle=False) as z:
+            if bytes(z["digest"].tobytes()) != _txt_digest():
+                return None
+            g1_raw = z["g1"]  # (N, 2, 48) big-endian affine coords
+            g2_raw = z["g2"]  # (M, 96) compressed points
+        g1 = [
+            (
+                int.from_bytes(g1_raw[i, 0].tobytes(), "big"),
+                int.from_bytes(g1_raw[i, 1].tobytes(), "big"),
+            )
+            for i in range(g1_raw.shape[0])
+        ]
+        g2 = [g2_raw[i].tobytes() for i in range(g2_raw.shape[0])]
+        return g1, g2
+    except (OSError, KeyError, ValueError):
         return None
 
 
 def _store_cache(points) -> None:
+    g1, g2 = points
     try:
-        with open(_OFFICIAL_CACHE, "wb") as f:
-            pickle.dump(points, f)
+        g1_raw = np.zeros((len(g1), 2, 48), np.uint8)
+        for i, (x, y) in enumerate(g1):
+            g1_raw[i, 0] = np.frombuffer(x.to_bytes(48, "big"), np.uint8)
+            g1_raw[i, 1] = np.frombuffer(y.to_bytes(48, "big"), np.uint8)
+        g2_raw = np.stack(
+            [np.frombuffer(b, np.uint8) for b in g2]
+        )
+        np.savez(
+            _OFFICIAL_CACHE,
+            digest=np.frombuffer(_txt_digest(), np.uint8),
+            g1=g1_raw,
+            g2=g2_raw,
+        )
     except OSError:
         pass
 

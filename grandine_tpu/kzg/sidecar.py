@@ -33,6 +33,26 @@ def inclusion_proof_depth(body_cls, p) -> int:
     return body_depth + 1 + list_depth  # +1: list length mixin
 
 
+def _merkle_branch(leaves: "list[bytes]", index: int, depth: int) -> "list[bytes]":
+    """Sibling path for `leaves[index]` in a zero-padded depth-`depth` tree."""
+    branch = []
+    level = list(leaves)
+    idx = index
+    for d in range(depth):
+        sibling = idx ^ 1
+        branch.append(
+            level[sibling] if sibling < len(level) else hashing.ZERO_HASHES[d]
+        )
+        if len(level) % 2:
+            level = level + [hashing.ZERO_HASHES[d]]
+        level = [
+            hashing.hash_pair(level[i], level[i + 1])
+            for i in range(0, len(level), 2)
+        ]
+        idx >>= 1
+    return branch
+
+
 def build_commitment_inclusion_proof(body, index: int, p) -> "list[bytes]":
     """Merkle branch for commitment `index` of `body.blob_kzg_commitments`
     against the body root (producer side; reference
@@ -43,44 +63,14 @@ def build_commitment_inclusion_proof(body, index: int, p) -> "list[bytes]":
     if not 0 <= index < len(commitments):
         raise IndexError(index)
 
-    # branch inside the commitment data tree (depth list_depth)
     leaves = [Bytes48.hash_tree_root(bytes(c)) for c in commitments]
-    branch = []
-    level = leaves
-    idx = index
-    for d in range(list_depth):
-        sibling = idx ^ 1
-        branch.append(
-            level[sibling] if sibling < len(level) else hashing.ZERO_HASHES[d]
-        )
-        if len(level) % 2:
-            level = level + [hashing.ZERO_HASHES[d]]
-        level = [
-            hashing.hash_pair(level[i], level[i + 1])
-            for i in range(0, len(level), 2)
-        ]
-        idx >>= 1
-    # length mixin sibling
-    branch.append(len(commitments).to_bytes(32, "little"))
-    # body-level branch: siblings of the field subtree
+    branch = _merkle_branch(leaves, index, list_depth)
+    branch.append(len(commitments).to_bytes(32, "little"))  # length mixin
     field_roots = [
         ftyp.hash_tree_root(getattr(body, fname))
         for fname, ftyp in body_cls.FIELDS
     ]
-    level = field_roots
-    idx = field_pos
-    for d in range(body_depth):
-        sibling = idx ^ 1
-        branch.append(
-            level[sibling] if sibling < len(level) else hashing.ZERO_HASHES[d]
-        )
-        if len(level) % 2:
-            level = level + [hashing.ZERO_HASHES[d]]
-        level = [
-            hashing.hash_pair(level[i], level[i + 1])
-            for i in range(0, len(level), 2)
-        ]
-        idx >>= 1
+    branch += _merkle_branch(field_roots, field_pos, body_depth)
     return branch
 
 

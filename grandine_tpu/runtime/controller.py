@@ -233,6 +233,7 @@ class Controller:
                     self._rejected.append(
                         (signed_block.message.hash_tree_root(), reason)
                     )
+                    del self._rejected[: -self.MAX_REJECTED]
                 # snapshot refresh only for mutating kinds ("block" refreshes
                 # inside _handle_block; delay/reject mutate nothing) — the
                 # head computation is the mutator's main cost

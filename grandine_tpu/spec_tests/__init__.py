@@ -1,0 +1,16 @@
+"""Consensus-spec-tests plumbing — reference: spec_test_utils crate
+(`Case` loader, spec_test_utils/src/lib.rs:50-168) and the
+`#[test_resources]` glob binding.
+
+`case.py` mirrors the official directory layout
+(`tests/<preset>/<fork>/<runner>/<handler>/<suite>/<case>/` with
+`meta.yaml` / `*.yaml` / `*.ssz_snappy` files) so the official vectors
+drop in unchanged; `snappy.py` is a dependency-free snappy codec for the
+`.ssz_snappy` encoding.
+"""
+
+from grandine_tpu.spec_tests.case import Case, iter_cases  # noqa: F401
+from grandine_tpu.spec_tests.snappy import (  # noqa: F401
+    frame_compress,
+    frame_decompress,
+)

@@ -1,0 +1,70 @@
+"""Case loader mirroring spec_test_utils/src/lib.rs:50-168.
+
+A `Case` wraps one on-disk case directory; accessors read `*.yaml` (parsed)
+and `*.ssz_snappy` (decompressed bytes) files, raising if a required file
+is absent — the same surface the reference's suites consume. `iter_cases`
+is the `#[test_resources(glob)]` equivalent: every matching directory is
+one case, so pytest parametrization mirrors the reference's one-test-per-
+case generation.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+from typing import Any, Iterator, Optional
+
+import yaml
+
+from grandine_tpu.spec_tests.snappy import frame_decompress
+
+
+class Case:
+    def __init__(self, directory: str) -> None:
+        self.directory = directory
+
+    def __repr__(self) -> str:
+        return f"Case({self.directory})"
+
+    @property
+    def name(self) -> str:
+        return os.path.basename(self.directory)
+
+    def path(self, relative: str) -> str:
+        return os.path.join(self.directory, relative)
+
+    def exists(self, relative: str) -> bool:
+        return os.path.exists(self.path(relative))
+
+    def bytes(self, relative: str) -> bytes:
+        with open(self.path(relative), "rb") as f:
+            return f.read()
+
+    def ssz_bytes(self, relative: str) -> bytes:
+        """Decompressed payload of a `.ssz_snappy` file."""
+        return frame_decompress(self.bytes(relative))
+
+    def ssz(self, relative: str, typ):
+        """Deserialize a `.ssz_snappy` file with an SSZ type descriptor /
+        container class."""
+        return typ.deserialize(self.ssz_bytes(relative))
+
+    def yaml(self, relative: str) -> Any:
+        with open(self.path(relative)) as f:
+            return yaml.safe_load(f)
+
+    def meta(self) -> dict:
+        return self.yaml("meta.yaml") if self.exists("meta.yaml") else {}
+
+
+def iter_cases(pattern: str, root: "Optional[str]" = None) -> "Iterator[Case]":
+    """All case directories matching `pattern` (a glob over directories),
+    sorted for stable test ordering."""
+    if root is not None:
+        pattern = os.path.join(root, pattern)
+    for directory in sorted(_glob.glob(pattern)):
+        if os.path.isdir(directory):
+            yield Case(directory)
+
+
+__all__ = ["Case", "iter_cases"]

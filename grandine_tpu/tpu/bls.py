@@ -157,6 +157,23 @@ def batch_sign_kernel(msg_x, msg_y, msg_inf, sk_bits):
     return C.scalar_mul(msg_x, msg_y, msg_inf, sk_bits, C.FP2_OPS)
 
 
+def g1_normalize_kernel(X, Y, Z):
+    """Batched Jacobian → affine on device (one Fermat inversion scan for
+    the whole batch): (x, y, inf). Infinity rows return garbage coords
+    under a True mask."""
+    zinv = L.inv_mod(Z)
+    zinv2 = L.montmul(zinv, zinv)
+    zinv3 = L.montmul(zinv2, zinv)
+    return L.montmul(X, zinv2), L.montmul(Y, zinv3), L.is_zero_val(Z)
+
+
+def g2_normalize_kernel(X, Y, Z):
+    zinv = F.fp2_inv(Z)
+    zinv2 = F.fp2_sq(zinv)
+    zinv3 = F.fp2_mul(zinv2, zinv)
+    return F.fp2_mul(X, zinv2), F.fp2_mul(Y, zinv3), F.fp2_is_zero(Z)
+
+
 def batch_pubkey_kernel(sk_bits):
     """N public keys: [skᵢ]·g1. sk_bits (N, 255) MSB-first."""
     gx, gy, _ = C.g1_point_to_dev(G1)
@@ -337,6 +354,9 @@ class TpuBlsBackend:
         if any(pk.point.is_infinity() for pk in public_keys):
             return lambda: False
         b = _bucket(n)
+        # batched host conversions: one inversion + one limb pass per class
+        g1x, g1y, g1inf = C.g1_points_to_dev([pk.point for pk in public_keys])
+        g2x, g2y, g2inf = C.g2_points_to_dev([s.point for s in signatures])
         pk_x = np.zeros((b, L.NLIMBS), np.int32)
         pk_y = np.zeros((b, L.NLIMBS), np.int32)
         pk_inf = np.ones((b,), bool)
@@ -346,11 +366,9 @@ class TpuBlsBackend:
         msg_x = np.zeros((b, 2, L.NLIMBS), np.int32)
         msg_y = np.zeros((b, 2, L.NLIMBS), np.int32)
         msg_inf = np.ones((b,), bool)
+        pk_x[:n], pk_y[:n], pk_inf[:n] = g1x, g1y, g1inf
+        sig_x[:n], sig_y[:n], sig_inf[:n] = g2x, g2y, g2inf
         for i in range(n):
-            x, y, inf = C.g1_point_to_dev(public_keys[i].point)
-            pk_x[i], pk_y[i], pk_inf[i] = x, y, inf
-            x, y, inf = C.g2_point_to_dev(signatures[i].point)
-            sig_x[i], sig_y[i], sig_inf[i] = x, y, inf
             x, y, inf = self._hash_to_g2_dev(messages[i], dst)
             msg_x[i], msg_y[i], msg_inf[i] = x, y, inf
         scalars = [self._nonzero_u64(rng) for _ in range(n)] + [1] * (b - n)
@@ -418,12 +436,18 @@ class TpuBlsBackend:
         msg_x = np.zeros((bm, 2, L.NLIMBS), np.int32)
         msg_y = np.zeros((bm, 2, L.NLIMBS), np.int32)
         msg_inf = np.ones((bm,), bool)
+        flat_keys = [pk.point for ks in member_keys for pk in ks]
+        fx, fy, finf = C.g1_points_to_dev(flat_keys)
+        pos = 0
         for i in range(m):
-            for j, pk in enumerate(member_keys[i]):
-                x, y, inf = C.g1_point_to_dev(pk.point)
-                mem_x[i, j], mem_y[i, j], mem_inf[i, j] = x, y, inf
-            x, y, inf = C.g2_point_to_dev(signatures[i].point)
-            sig_x[i], sig_y[i], sig_inf[i] = x, y, inf
+            k = len(member_keys[i])
+            mem_x[i, :k] = fx[pos : pos + k]
+            mem_y[i, :k] = fy[pos : pos + k]
+            mem_inf[i, :k] = finf[pos : pos + k]
+            pos += k
+        g2x, g2y, g2inf = C.g2_points_to_dev([s.point for s in signatures])
+        sig_x[:m], sig_y[:m], sig_inf[:m] = g2x, g2y, g2inf
+        for i in range(m):
             x, y, inf = self._hash_to_g2_dev(messages[i], dst)
             msg_x[i], msg_y[i], msg_inf[i] = x, y, inf
         scalars = [self._nonzero_u64(rng) for _ in range(m)] + [1] * (bm - m)
@@ -499,4 +523,6 @@ __all__ = [
     "aggregate_fast_verify_kernel",
     "batch_sign_kernel",
     "batch_pubkey_kernel",
+    "g1_normalize_kernel",
+    "g2_normalize_kernel",
 ]

@@ -23,11 +23,18 @@ compiles and fuses well.
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 import jax.numpy as jnp
 from jax import lax
 
 from grandine_tpu.crypto.constants import P
+
+#: lax.scan unroll factor for the CIOS inner loop (1 = plain while loop;
+#: larger values trade compile time for fused step bodies). Tunable via env
+#: for kernel experiments.
+MONTMUL_UNROLL = int(os.environ.get("GT_MONTMUL_UNROLL", "1"))
 
 LIMB_BITS = 15
 NLIMBS = 26
@@ -136,7 +143,7 @@ def montmul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
         t = t + jnp.concatenate([carry[..., None], zpadN, zpad1], axis=-1)
         return t, None
 
-    t, _ = lax.scan(step, t0, jnp.moveaxis(a, -1, 0))
+    t, _ = lax.scan(step, t0, jnp.moveaxis(a, -1, 0), unroll=MONTMUL_UNROLL)
     # fold the 27th column (weight 2^390 = R) back in via R mod p
     main = t[..., :NLIMBS] + t[..., NLIMBS : NLIMBS + 1] * jnp.asarray(R_MOD_P)
     return relax(main)

@@ -1,0 +1,90 @@
+"""Fork-combined type dispatch — reference: types/src/combined.rs (enums
+over the per-fork BeaconState/SignedBeaconBlock with phase-aware SSZ
+decode) consumed by storage, the HTTP API, and networking.
+
+A value's concrete container class is chosen by its phase; the phase comes
+from the chain config (by slot/epoch) or from the value itself (a state's
+fork version). Decoding is therefore `(bytes, cfg[, slot]) -> container`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from grandine_tpu.types.config import Config
+from grandine_tpu.types.containers import spec_types
+from grandine_tpu.types.primitives import Phase
+
+
+def fork_namespace(cfg: Config, phase: Phase):
+    return getattr(spec_types(cfg.preset), phase.key)
+
+
+def state_phase_of(state, cfg: Config) -> Phase:
+    """Phase of a state container (by its fork's current version)."""
+    version = bytes(state.fork.current_version)
+    for phase in reversed(list(Phase)):
+        if cfg.fork_version(phase) == version:
+            return phase
+    raise ValueError(f"unknown fork version {version.hex()}")
+
+
+def block_phase_of(signed_block, cfg: Config) -> Phase:
+    return cfg.phase_at_slot(int(signed_block.message.slot))
+
+
+# --- SSZ decode with fork dispatch -----------------------------------------
+
+# A serialized BeaconState starts with genesis_time (8) +
+# genesis_validators_root (32) + slot (8) + fork: previous_version (4) +
+# current_version (4) — the current version at fixed offset 52.
+_STATE_VERSION_OFFSET = 48 + 4
+
+
+def decode_state(data: bytes, cfg: Config):
+    """Deserialize a BeaconState of any fork: the fork version is read
+    from its fixed offset, then the phase's container class decodes
+    (combined.rs `BeaconState::from_ssz`)."""
+    data = bytes(data)
+    if len(data) < _STATE_VERSION_OFFSET + 4:
+        raise ValueError("state payload too short")
+    version = data[_STATE_VERSION_OFFSET : _STATE_VERSION_OFFSET + 4]
+    for phase in reversed(list(Phase)):
+        if cfg.fork_version(phase) == version:
+            return fork_namespace(cfg, phase).BeaconState.deserialize(data)
+    raise ValueError(f"unknown fork version {version.hex()}")
+
+
+# A SignedBeaconBlock is [offset(4) | signature(96) | message…]; the
+# message starts with its slot.
+_BLOCK_SLOT_OFFSET = 4 + 96
+
+
+def decode_signed_block(data: bytes, cfg: Config,
+                        slot: "Optional[int]" = None):
+    """Deserialize a SignedBeaconBlock of any fork; the phase comes from
+    the block's own slot (read at its fixed offset) unless given."""
+    data = bytes(data)
+    if slot is None:
+        if len(data) < _BLOCK_SLOT_OFFSET + 8:
+            raise ValueError("block payload too short")
+        slot = int.from_bytes(
+            data[_BLOCK_SLOT_OFFSET : _BLOCK_SLOT_OFFSET + 8], "little"
+        )
+    phase = cfg.phase_at_slot(slot)
+    return fork_namespace(cfg, phase).SignedBeaconBlock.deserialize(data)
+
+
+def decode_attestation(data: bytes, cfg: Config, slot: int):
+    phase = cfg.phase_at_slot(slot)
+    return fork_namespace(cfg, phase).Attestation.deserialize(data)
+
+
+__all__ = [
+    "fork_namespace",
+    "state_phase_of",
+    "block_phase_of",
+    "decode_state",
+    "decode_signed_block",
+    "decode_attestation",
+]

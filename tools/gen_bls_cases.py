@@ -1,0 +1,185 @@
+"""Generate the BLS suite case files (consensus-spec-tests `bls/` layout).
+
+The official vectors are not fetchable in this environment (zero egress),
+so these cases are produced by the pure-Python anchor — whose primitives
+are externally anchored by the vendored RFC 9380 known-answer vectors
+(tests/test_rfc9380_vectors.py) — and serve as (a) the drop-in directory
+layout for the official vectors when available, (b) cross-backend
+conformance (anchor vs TPU) and (c) regression pinning.
+
+Layout: tests/vectors/bls/<handler>/<case_name>/data.yaml, exactly the
+official format (hex-string inputs, output value or null).
+
+Usage: python tools/gen_bls_cases.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import yaml
+
+from grandine_tpu.crypto import bls as A
+
+ROOT = os.path.join(os.path.dirname(__file__), "..", "tests", "vectors", "bls")
+
+
+def hx(b: bytes) -> str:
+    return "0x" + bytes(b).hex()
+
+
+def write_case(handler: str, name: str, data: dict) -> None:
+    d = os.path.join(ROOT, handler, name)
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "data.yaml"), "w") as f:
+        yaml.safe_dump(data, f, sort_keys=False)
+
+
+def main() -> None:
+    sks = [A.SecretKey.keygen(bytes([i]) * 32, b"case") for i in range(1, 6)]
+    pks = [sk.public_key() for sk in sks]
+    msgs = [bytes([m]) * 32 for m in (0x01, 0x02, 0x03, 0x04, 0x05)]
+    inf_sig = hx(A.Signature.empty().to_bytes())
+    inf_pk = hx(b"\xc0" + b"\x00" * 47)
+
+    # ---- sign
+    for i, (sk, msg) in enumerate(zip(sks[:3], msgs[:3])):
+        write_case("sign", f"sign_case_{i}", {
+            "input": {"privkey": hx(sk.to_bytes()), "message": hx(msg)},
+            "output": hx(sk.sign(msg).to_bytes()),
+        })
+    # zero privkey is invalid -> null output
+    write_case("sign", "sign_case_zero_privkey", {
+        "input": {"privkey": hx(b"\x00" * 32), "message": hx(msgs[0])},
+        "output": None,
+    })
+
+    # ---- verify
+    sig0 = sks[0].sign(msgs[0])
+    write_case("verify", "verify_valid", {
+        "input": {"pubkey": hx(pks[0].to_bytes()), "message": hx(msgs[0]),
+                  "signature": hx(sig0.to_bytes())},
+        "output": True,
+    })
+    write_case("verify", "verify_wrong_message", {
+        "input": {"pubkey": hx(pks[0].to_bytes()), "message": hx(msgs[1]),
+                  "signature": hx(sig0.to_bytes())},
+        "output": False,
+    })
+    write_case("verify", "verify_wrong_pubkey", {
+        "input": {"pubkey": hx(pks[1].to_bytes()), "message": hx(msgs[0]),
+                  "signature": hx(sig0.to_bytes())},
+        "output": False,
+    })
+    write_case("verify", "verify_infinity_pubkey_and_infinity_signature", {
+        "input": {"pubkey": inf_pk, "message": hx(msgs[0]),
+                  "signature": inf_sig},
+        "output": False,
+    })
+    write_case("verify", "verify_tampered_signature", {
+        "input": {"pubkey": hx(pks[0].to_bytes()), "message": hx(msgs[0]),
+                  "signature": hx(b"\xff" * 96)},
+        "output": False,
+    })
+
+    # ---- aggregate
+    sigs = [sk.sign(msgs[0]) for sk in sks[:3]]
+    write_case("aggregate", "aggregate_3_signatures", {
+        "input": [hx(s.to_bytes()) for s in sigs],
+        "output": hx(A.Signature.aggregate(sigs).to_bytes()),
+    })
+    write_case("aggregate", "aggregate_single_signature", {
+        "input": [hx(sigs[0].to_bytes())],
+        "output": hx(sigs[0].to_bytes()),
+    })
+    write_case("aggregate", "aggregate_na_signatures", {
+        "input": [],
+        "output": None,  # aggregating nothing is an error
+    })
+    write_case("aggregate", "aggregate_invalid_signature", {
+        "input": [hx(b"\xff" * 96)],
+        "output": None,
+    })
+
+    # ---- fast_aggregate_verify
+    fav_sig = A.Signature.aggregate([sk.sign(msgs[2]) for sk in sks[:3]])
+    write_case("fast_aggregate_verify", "fast_aggregate_verify_valid", {
+        "input": {"pubkeys": [hx(pk.to_bytes()) for pk in pks[:3]],
+                  "message": hx(msgs[2]),
+                  "signature": hx(fav_sig.to_bytes())},
+        "output": True,
+    })
+    write_case("fast_aggregate_verify", "fast_aggregate_verify_extra_pubkey", {
+        "input": {"pubkeys": [hx(pk.to_bytes()) for pk in pks[:4]],
+                  "message": hx(msgs[2]),
+                  "signature": hx(fav_sig.to_bytes())},
+        "output": False,
+    })
+    write_case("fast_aggregate_verify", "fast_aggregate_verify_na_pubkeys_and_infinity_signature", {
+        "input": {"pubkeys": [], "message": hx(msgs[2]),
+                  "signature": inf_sig},
+        "output": False,
+    })
+    write_case("fast_aggregate_verify", "fast_aggregate_verify_infinity_pubkey", {
+        "input": {"pubkeys": [hx(pks[0].to_bytes()), inf_pk],
+                  "message": hx(msgs[2]),
+                  "signature": hx(fav_sig.to_bytes())},
+        "output": False,
+    })
+
+    # ---- aggregate_verify (distinct messages)
+    av_sig = A.Signature.aggregate(
+        [sk.sign(m) for sk, m in zip(sks[:3], msgs[:3])]
+    )
+    write_case("aggregate_verify", "aggregate_verify_valid", {
+        "input": {"pubkeys": [hx(pk.to_bytes()) for pk in pks[:3]],
+                  "messages": [hx(m) for m in msgs[:3]],
+                  "signature": hx(av_sig.to_bytes())},
+        "output": True,
+    })
+    write_case("aggregate_verify", "aggregate_verify_tampered", {
+        "input": {"pubkeys": [hx(pk.to_bytes()) for pk in pks[:3]],
+                  "messages": [hx(m) for m in msgs[:3]],
+                  "signature": hx(sks[0].sign(msgs[0]).to_bytes())},
+        "output": False,
+    })
+    write_case("aggregate_verify", "aggregate_verify_na_pubkeys_and_infinity_signature", {
+        "input": {"pubkeys": [], "messages": [], "signature": inf_sig},
+        "output": False,
+    })
+
+    # ---- eth_aggregate_pubkeys
+    write_case("eth_aggregate_pubkeys", "eth_aggregate_pubkeys_valid", {
+        "input": [hx(pk.to_bytes()) for pk in pks[:3]],
+        "output": hx(A.PublicKey.aggregate(pks[:3]).to_bytes()),
+    })
+    write_case("eth_aggregate_pubkeys", "eth_aggregate_pubkeys_empty", {
+        "input": [],
+        "output": None,
+    })
+    write_case("eth_aggregate_pubkeys", "eth_aggregate_pubkeys_infinity", {
+        "input": [inf_pk],
+        "output": None,  # infinity pubkey fails KeyValidate
+    })
+
+    # ---- eth_fast_aggregate_verify (altair: empty+infinity is VALID)
+    write_case("eth_fast_aggregate_verify", "eth_fast_aggregate_verify_valid", {
+        "input": {"pubkeys": [hx(pk.to_bytes()) for pk in pks[:3]],
+                  "message": hx(msgs[2]),
+                  "signature": hx(fav_sig.to_bytes())},
+        "output": True,
+    })
+    write_case("eth_fast_aggregate_verify",
+               "eth_fast_aggregate_verify_na_pubkeys_and_infinity_signature", {
+        "input": {"pubkeys": [], "message": hx(msgs[2]),
+                  "signature": inf_sig},
+        "output": True,
+    })
+
+    n = sum(len(files) for _, _, files in os.walk(ROOT))
+    print(f"wrote {n} case files under {os.path.relpath(ROOT)}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,106 @@
+"""Microbenchmark: montmul chain in (batch, limbs) vs (limbs, batch) layout.
+
+TPU vector layout maps the minor-most dim to 128 lanes; (N, 26) uses 26 of
+128 (≈20%). If the transposed layout wins big, the whole limb stack should
+be relaid out.
+
+Usage: [N=2048] [K=64] python tools/layout_microbench.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from grandine_tpu.tpu import limbs as L
+
+N = int(os.environ.get("N", "2048"))
+K = int(os.environ.get("K", "64"))
+NL = L.NLIMBS
+MASK = L.MASK
+LIMB_BITS = L.LIMB_BITS
+N0_INV = L.N0_INV
+
+
+def montmul_T(a, b):
+    """Transposed montmul: shapes (NLIMBS, N); scan over limb rows."""
+    p_limbs = jnp.asarray(L.P_LIMBS).astype(jnp.int32)[:, None]  # (26, 1)
+    batch = a.shape[1:]
+    t0 = jnp.zeros((NL + 1,) + batch, jnp.int32)
+    zpad1 = jnp.zeros((1,) + batch, jnp.int32)
+    zpadN = jnp.zeros((NL - 1,) + batch, jnp.int32)
+
+    def step(t, ai):
+        prod = ai[None, :] * b  # (26, N)
+        t = t + jnp.concatenate([prod & MASK, zpad1], axis=0)
+        t = t + jnp.concatenate([zpad1, prod >> LIMB_BITS], axis=0)
+        m = (t[0] * N0_INV) & MASK
+        prod2 = m[None, :] * p_limbs
+        t = t + jnp.concatenate([prod2 & MASK, zpad1], axis=0)
+        t = t + jnp.concatenate([zpad1, prod2 >> LIMB_BITS], axis=0)
+        carry = t[0] >> LIMB_BITS
+        t = jnp.concatenate([t[1:], zpad1], axis=0)
+        t = t + jnp.concatenate([carry[None], zpadN, zpad1], axis=0)
+        return t, None
+
+    t, _ = lax.scan(step, t0, a)
+    main = t[:NL] + t[NL : NL + 1] * jnp.asarray(L.R_MOD_P).astype(jnp.int32)[:, None]
+    # relax (transposed)
+    hi = main >> LIMB_BITS
+    lo = main & MASK
+    low = lo[: NL - 1] + jnp.concatenate([zpad1, hi[: NL - 2]], axis=0)
+    top = main[NL - 1 :] + hi[NL - 2 : NL - 1]
+    return jnp.concatenate([low, top], axis=0)
+
+
+def chain(fn, a, b, k):
+    def body(x, _):
+        return fn(x, b), None
+
+    out, _ = lax.scan(body, a, None, length=k)
+    return out
+
+
+def bench(name, fn, a, b):
+    f = jax.jit(lambda a, b: chain(fn, a, b, K))
+    t0 = time.time()
+    jax.block_until_ready(f(a, b))
+    compile_s = time.time() - t0
+    t0 = time.time()
+    iters = 10
+    for _ in range(iters):
+        out = f(a, b)
+    jax.block_until_ready(out)
+    run = (time.time() - t0) / iters
+    per_mul_ns = run / (K * N) * 1e9
+    print(
+        f"{name:24s} compile={compile_s:6.1f}s chain={run * 1000:8.2f}ms "
+        f"-> {per_mul_ns:8.0f} ns/montmul/elem"
+    )
+    return out
+
+
+def main():
+    print(f"platform={jax.devices()[0].platform} N={N} K={K}")
+    rng = np.random.default_rng(0)
+    a = rng.integers(0, MASK, size=(N, NL), dtype=np.int32)
+    b = rng.integers(0, MASK, size=(N, NL), dtype=np.int32)
+
+    out1 = bench("montmul (N, limbs)", L.montmul, a, b)
+    aT = np.ascontiguousarray(a.T)
+    bT = np.ascontiguousarray(b.T)
+    out2 = bench("montmul_T (limbs, N)", montmul_T, aT, bT)
+    # agreement (values equal mod p)
+    v1 = [L.from_mont(np.asarray(out1)[i]) for i in range(4)]
+    v2 = [L.from_mont(np.asarray(out2).T[i]) for i in range(4)]
+    print("agree:", v1 == v2)
+
+
+if __name__ == "__main__":
+    main()

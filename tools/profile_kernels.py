@@ -1,0 +1,106 @@
+"""Stage-level timing of the multi_verify kernel on the current device.
+
+Times each pipeline stage separately (jit'd in isolation):
+  scalar_mul G1 (rlc), scalar_mul G2, sum_points G2, miller_loop,
+  fp12 product tree, final_exponentiation
+plus the fused multi_verify_kernel, at a given batch size.
+
+Usage: [BENCH_N=2048] python tools/profile_kernels.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+def main() -> None:
+    n = int(os.environ.get("BENCH_N", "2048"))
+    import jax
+    import jax.numpy as jnp
+
+    import bench
+    from grandine_tpu.tpu import curve as C
+    from grandine_tpu.tpu import field as F
+    from grandine_tpu.tpu import pairing as TP
+    from grandine_tpu.tpu.bls import (
+        _fp12_product_tree,
+        multi_verify_kernel,
+    )
+
+    bench._enable_compilation_cache()
+
+    print(f"platform={jax.devices()[0].platform} n={n}", file=sys.stderr)
+    t0 = time.time()
+    args = bench.build_batch(n)
+    (pk_x, pk_y, pk_inf, sig_x, sig_y, sig_inf,
+     msg_x, msg_y, msg_inf, r_bits) = args
+    print(f"prep {time.time() - t0:.1f}s", file=sys.stderr)
+
+    def timed(name, fn, *xs, iters=5):
+        f = jax.jit(fn)
+        t0 = time.time()
+        for attempt in range(4):
+            try:
+                out = f(*xs)
+                jax.block_until_ready(out)
+                break
+            except Exception as e:  # flaky remote_compile tunnel: retry
+                if attempt == 3 or "remote_compile" not in repr(e):
+                    raise
+                print(f"{name}: retrying after {e!r}", file=sys.stderr)
+                time.sleep(3)
+        compile_s = time.time() - t0
+        t0 = time.time()
+        for _ in range(iters):
+            out = f(*xs)
+        jax.block_until_ready(out)
+        run = (time.time() - t0) / iters
+        print(f"{name:28s} compile={compile_s:7.1f}s run={run * 1000:9.1f}ms")
+        return out
+
+    rpk = timed(
+        "scalar_mul G1 (64b rlc)",
+        lambda: C.scalar_mul(pk_x, pk_y, pk_inf, r_bits, C.FP_OPS),
+    )
+    rsig = timed(
+        "scalar_mul G2 (64b rlc)",
+        lambda: C.scalar_mul(sig_x, sig_y, sig_inf, r_bits, C.FP2_OPS),
+    )
+    sig_acc = timed(
+        "sum_points G2 (tree)",
+        lambda: C.sum_points(
+            tuple(jnp.asarray(c) for c in rsig), C.FP2_OPS
+        ),
+    )
+
+    rpk_h = tuple(np.asarray(c) for c in rpk)
+    pair_inf = np.asarray(pk_inf | msg_inf)
+
+    def miller(px, py, pz, mx, my, inf):
+        msg_q = (mx, my, F.fp2_one((mx.shape[0],)))
+        return TP.miller_loop((px, py, pz), msg_q, inf)
+
+    f_msgs = timed(
+        "miller_loop (N pairs)", miller, *rpk_h, msg_x, msg_y, pair_inf
+    )
+    f_msgs_h = np.asarray(f_msgs)
+    ftree = timed("fp12 product tree", lambda x: _fp12_product_tree(x), f_msgs_h)
+    timed(
+        "final_exponentiation",
+        lambda x: TP.final_exponentiation(x),
+        np.asarray(ftree),
+    )
+    timed(
+        "FUSED multi_verify_kernel",
+        multi_verify_kernel,
+        *args,
+        iters=3,
+    )
+
+
+if __name__ == "__main__":
+    main()

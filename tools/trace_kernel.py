@@ -1,0 +1,63 @@
+"""Capture a device profile of multi_verify_kernel and print the top HLO
+ops by self time (parsed from the Chrome-trace JSON the JAX profiler
+emits — no TensorBoard needed).
+
+Usage: [BENCH_N=2048] python tools/trace_kernel.py
+"""
+
+import glob
+import gzip
+import json
+import os
+import sys
+import time
+from collections import defaultdict
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main() -> None:
+    n = int(os.environ.get("BENCH_N", "2048"))
+    import jax
+
+    import bench
+    from grandine_tpu.tpu.bls import multi_verify_kernel
+
+    bench._enable_compilation_cache()
+    args = bench.build_batch(n)
+    fn = jax.jit(multi_verify_kernel)
+    print("compiling…", file=sys.stderr)
+    jax.block_until_ready(fn(*args))
+
+    trace_dir = "/tmp/gt_trace"
+    os.system(f"rm -rf {trace_dir}")
+    with jax.profiler.trace(trace_dir):
+        for _ in range(2):
+            out = fn(*args)
+        jax.block_until_ready(out)
+
+    files = glob.glob(f"{trace_dir}/**/*.trace.json.gz", recursive=True)
+    if not files:
+        print("no trace file found", file=sys.stderr)
+        return
+    with gzip.open(files[0], "rt") as f:
+        trace = json.load(f)
+
+    # Aggregate complete events by name on device tracks
+    durations = defaultdict(float)
+    counts = defaultdict(int)
+    for ev in trace.get("traceEvents", []):
+        if ev.get("ph") != "X":
+            continue
+        name = ev.get("name", "")
+        dur = ev.get("dur", 0)
+        durations[name] += dur
+        counts[name] += 1
+    total = sum(durations.values())
+    print(f"n={n}; total traced op-time {total / 1e6:.3f}s (2 runs)")
+    for name, dur in sorted(durations.items(), key=lambda kv: -kv[1])[:40]:
+        print(f"{dur / 1e3:10.1f}ms  x{counts[name]:<6d} {name[:110]}")
+
+
+if __name__ == "__main__":
+    main()
