@@ -63,6 +63,31 @@ def build_batch(n: int, n_msgs: int = 8):
     return (pk_x, pk_y, inf, sig_x, sig_y, inf.copy(), msg_x, msg_y, inf.copy(), r_bits)
 
 
+def regroup_batch(args, n_msgs: int):
+    """Reshape a flat build_batch output (messages cyclic mod n_msgs) into
+    the (M, K, …) layout of grouped_multi_verify_kernel — the workload's
+    real shape (few distinct AttestationData per many signatures)."""
+    (pk_x, pk_y, pk_inf, sig_x, sig_y, sig_inf,
+     msg_x, msg_y, msg_inf, r_bits) = args
+    n = len(pk_inf)
+    assert n % n_msgs == 0
+    k = n // n_msgs
+    order = np.argsort(np.arange(n) % n_msgs, kind="stable")
+
+    def grp(a):
+        return np.ascontiguousarray(a[order].reshape((n_msgs, k) + a.shape[1:]))
+
+    first = order.reshape(n_msgs, k)[:, 0]
+    return (
+        grp(pk_x), grp(pk_y), grp(pk_inf),
+        grp(sig_x), grp(sig_y), grp(sig_inf),
+        np.ascontiguousarray(msg_x[first]),
+        np.ascontiguousarray(msg_y[first]),
+        np.ascontiguousarray(msg_inf[first]),
+        grp(r_bits),
+    )
+
+
 def _enable_compilation_cache() -> None:
     """Persistent XLA compilation cache: recompiling the pairing kernels
     costs minutes; cache entries make every bench/process after the first
@@ -82,18 +107,29 @@ def _enable_compilation_cache() -> None:
 
 def main() -> None:
     n = int(os.environ.get("BENCH_N", "512"))
+    n_msgs = int(os.environ.get("BENCH_MSGS", "8"))
+    grouped = os.environ.get("BENCH_GROUPED", "1") != "0"
     try:
         import jax
 
         _enable_compilation_cache()
 
-        from grandine_tpu.tpu.bls import multi_verify_kernel
+        from grandine_tpu.tpu.bls import (
+            grouped_multi_verify_kernel,
+            multi_verify_kernel,
+        )
 
+        if grouped and n % n_msgs != 0:
+            grouped = False  # ragged grouping: fall back to the flat kernel
         t_prep = time.time()
-        args = build_batch(n)
+        args = build_batch(n, n_msgs)
+        if grouped:
+            args = regroup_batch(args, n_msgs)
         prep_s = time.time() - t_prep
 
-        fn = jax.jit(multi_verify_kernel)
+        fn = jax.jit(
+            grouped_multi_verify_kernel if grouped else multi_verify_kernel
+        )
         t_compile = time.time()
         ok = bool(fn(*args))  # compile + first run
         compile_s = time.time() - t_compile

@@ -1,0 +1,265 @@
+"""CLI — reference: the `grandine` binary crate (clap `GrandineArgs`,
+grandine/src/grandine_args.rs:77,110-647; restart loop main.rs:101-123;
+export/replay subcommands commands.rs).
+
+Subcommands:
+  run          in-process node on an interop genesis (devnet mode), with
+               storage, HTTP API, metrics and the restart supervisor
+  info         print resolved config/preset
+  export / import-interchange   EIP-3076 slashing-protection data
+  replay       re-validate a stored finalized chain from the database
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="grandine-tpu",
+        description="TPU-native Ethereum consensus framework",
+    )
+    parser.add_argument(
+        "--network", default="minimal",
+        help="named config: mainnet | minimal (default)")
+    parser.add_argument(
+        "--config-file", help="custom chain config YAML (consensus-specs format)")
+    parser.add_argument("--data-dir", default="./grandine-tpu-data")
+    parser.add_argument(
+        "--features", default="",
+        help="comma-separated runtime feature toggles")
+    parser.add_argument(
+        "--use-device", action="store_true",
+        help="route batch verification through the TPU backend")
+
+    sub = parser.add_subparsers(dest="command")
+
+    run = sub.add_parser("run", help="run an in-process devnet node")
+    run.add_argument("--validators", type=int, default=32)
+    run.add_argument("--slots", type=int, default=32,
+                     help="stop after this many slots (0 = run forever)")
+    run.add_argument("--http-port", type=int, default=0,
+                     help="serve the Beacon API on this port (0 = off)")
+    run.add_argument("--no-restart", action="store_true",
+                     help="disable the crash-restart supervisor")
+
+    sub.add_parser("info", help="print the resolved configuration")
+
+    exp = sub.add_parser("export-interchange",
+                         help="export EIP-3076 slashing-protection data")
+    exp.add_argument("output", help="output JSON path")
+
+    imp = sub.add_parser("import-interchange",
+                         help="import EIP-3076 slashing-protection data")
+    imp.add_argument("input", help="input JSON path")
+
+    rep = sub.add_parser("replay",
+                         help="re-validate the stored finalized chain")
+    return parser
+
+
+def load_config(args):
+    from grandine_tpu.types.config import Config
+
+    if args.config_file:
+        return Config.from_yaml(args.config_file)
+    if args.network == "mainnet":
+        return Config.mainnet()
+    if args.network == "minimal":
+        return Config.minimal()
+    raise SystemExit(f"unknown network {args.network!r}")
+
+
+def cmd_info(args) -> int:
+    cfg = load_config(args)
+    print(json.dumps({
+        "config_name": cfg.config_name,
+        "preset": cfg.preset_base,
+        "slots_per_epoch": cfg.preset.SLOTS_PER_EPOCH,
+        "seconds_per_slot": cfg.seconds_per_slot,
+        "genesis_fork_version": "0x" + cfg.genesis_fork_version.hex(),
+        "fork_epochs": {
+            "altair": cfg.altair_fork_epoch,
+            "bellatrix": cfg.bellatrix_fork_epoch,
+            "capella": cfg.capella_fork_epoch,
+            "deneb": cfg.deneb_fork_epoch,
+        },
+        "data_dir": args.data_dir,
+    }, indent=2))
+    return 0
+
+
+def _node_once(args, cfg) -> int:
+    """One node lifetime (the body inside the restart supervisor)."""
+    from grandine_tpu.consensus.verifier import MultiVerifier, TpuVerifier
+    from grandine_tpu.http_api import ApiContext, serve
+    from grandine_tpu.metrics import Metrics
+    from grandine_tpu.pools import AttestationAggPool, OperationPool
+    from grandine_tpu.runtime import Controller, InProcessNode
+    from grandine_tpu.runtime.liveness import LivenessTracker
+    from grandine_tpu.storage import Database, Storage
+    from grandine_tpu.transition.genesis import interop_genesis_state
+
+    os.makedirs(args.data_dir, exist_ok=True)
+    db = Database.persistent(os.path.join(args.data_dir, "chain.sqlite"))
+    storage = Storage(db, cfg)
+    metrics = Metrics()
+    genesis = interop_genesis_state(args.validators, cfg)
+
+    try:
+        stored, _ = storage.load(anchor_state=genesis)
+    except ValueError:
+        stored = genesis
+
+    node = InProcessNode(stored, cfg, use_device_firehose=args.use_device)
+    node.controller.storage = storage
+    node.controller.store.pre_prune_hook = node.controller._persist_finalized
+    node.controller.metrics = metrics
+
+    server = None
+    if args.http_port:
+        ctx = ApiContext(
+            node.controller, cfg,
+            attestation_pool=AttestationAggPool(cfg),
+            operation_pool=OperationPool(cfg),
+            liveness=LivenessTracker(args.validators),
+            metrics=metrics,
+        )
+        server, _thread = serve(ctx, port=args.http_port)
+        print(f"Beacon API on http://127.0.0.1:{args.http_port}")
+
+    start = int(node.controller.snapshot().slot) + 1
+    stop = start + args.slots if args.slots else None
+    slot = start
+    try:
+        while stop is None or slot < stop:
+            node.run_slot(slot)
+            snap = node.head()
+            print(
+                f"slot {slot}: head={snap.head_root.hex()[:12]} "
+                f"justified={int(snap.justified_checkpoint.epoch)} "
+                f"finalized={int(snap.finalized_checkpoint.epoch)}"
+            )
+            slot += 1
+    finally:
+        if server is not None:
+            server.shutdown()
+        node.stop()
+        db.close()
+    return 0
+
+
+def cmd_run(args) -> int:
+    """The restart supervisor (grandine/src/main.rs:101-123): a crash
+    restarts the node from storage unless inhibited."""
+    from grandine_tpu import features
+
+    cfg = load_config(args)
+    while True:
+        try:
+            return _node_once(args, cfg)
+        except KeyboardInterrupt:
+            return 130
+        except Exception as e:
+            if args.no_restart or features.is_enabled(
+                features.Feature.INHIBIT_APPLICATION_RESTART
+            ):
+                raise
+            print(f"node crashed ({e!r}); restarting from storage…",
+                  file=sys.stderr)
+            time.sleep(1)
+
+
+def cmd_export_interchange(args) -> int:
+    from grandine_tpu.storage import Database
+    from grandine_tpu.validator.slashing_protection import SlashingProtection
+
+    db = Database.persistent(
+        os.path.join(args.data_dir, "slashing_protection.sqlite"))
+    sp = SlashingProtection(db)
+    with open(args.output, "w") as f:
+        json.dump(sp.export_interchange(), f, indent=2)
+    print(f"exported to {args.output}")
+    return 0
+
+
+def cmd_import_interchange(args) -> int:
+    from grandine_tpu.storage import Database
+    from grandine_tpu.validator.slashing_protection import SlashingProtection
+
+    with open(args.input) as f:
+        blob = json.load(f)
+    gvr = bytes.fromhex(
+        blob["metadata"]["genesis_validators_root"].removeprefix("0x"))
+    db = Database.persistent(
+        os.path.join(args.data_dir, "slashing_protection.sqlite"))
+    sp = SlashingProtection(db, genesis_validators_root=gvr)
+    sp.import_interchange(blob)
+    print(f"imported {len(blob.get('data', []))} validator records")
+    return 0
+
+
+def cmd_replay(args) -> int:
+    """Re-validate the stored finalized chain (the ad_hoc_bench shape)."""
+    from grandine_tpu.consensus.verifier import MultiVerifier
+    from grandine_tpu.storage import Database, Storage
+    from grandine_tpu.transition.combined import untrusted_state_transition
+
+    cfg = load_config(args)
+    db = Database.persistent(os.path.join(args.data_dir, "chain.sqlite"))
+    storage = Storage(db, cfg)
+    state = storage.load_anchor_state()
+    if state is None:
+        print("no stored chain", file=sys.stderr)
+        return 1
+    # walk the canonical slot index forward from the archival state
+    archival = storage.archival_state_at_or_before(0)
+    start_state = archival if archival is not None else state
+    n = 0
+    t0 = time.time()
+    slot = int(start_state.slot) + 1
+    cur = start_state
+    while True:
+        root = storage.finalized_root_by_slot(slot)
+        if root is None:
+            if slot > storage.latest_persisted_slot():
+                break
+            slot += 1
+            continue
+        blk = storage.finalized_block_by_root(root)
+        cur = untrusted_state_transition(cur, blk, cfg)
+        n += 1
+        slot += 1
+    dt = time.time() - t0
+    print(f"replayed {n} blocks in {dt:.1f}s "
+          f"({n / dt:.1f} blocks/s)" if n else "nothing to replay")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    from grandine_tpu import features
+
+    for name in filter(None, args.features.split(",")):
+        features.enable_by_name(name)
+    commands = {
+        "run": cmd_run,
+        "info": cmd_info,
+        "export-interchange": cmd_export_interchange,
+        "import-interchange": cmd_import_interchange,
+        "replay": cmd_replay,
+    }
+    if args.command is None:
+        parser.print_help()
+        return 2
+    return commands[args.command](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

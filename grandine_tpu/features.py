@@ -1,0 +1,68 @@
+"""Runtime feature toggles — reference: `features` crate (enum of flags in
+a static AtomicBool array, features/src/lib.rs:24,40-71; settable from the
+CLI `--features` flag and PATCHable at runtime).
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+
+
+class Feature(enum.Enum):
+    # the subset of the reference's 28 flags meaningful to this framework
+    TRUST_OWN_BLOCK_SIGNATURES = "TrustOwnBlockSignatures"
+    TRUST_BACK_SYNC_BLOCKS = "TrustBackSyncBlocks"
+    INHIBIT_APPLICATION_RESTART = "InhibitApplicationRestart"
+    LOG_BLOCK_PROCESSING_TIME = "LogBlockProcessingTime"
+    PROPOSE_WITHOUT_AGGREGATES = "ProposeWithoutAggregates"
+    DISABLE_DEVICE_BACKEND = "DisableDeviceBackend"
+    DISABLE_PROPOSER_BOOST = "DisableProposerBoost"
+    ALWAYS_PREPROCESS_NEXT_SLOT = "AlwaysPreprocessNextSlot"
+
+
+_STATE: "dict[Feature, bool]" = {f: False for f in Feature}
+_LOCK = threading.Lock()
+
+
+def is_enabled(feature: Feature) -> bool:
+    return _STATE[feature]
+
+
+def enable(feature: Feature) -> None:
+    with _LOCK:
+        _STATE[feature] = True
+
+
+def disable(feature: Feature) -> None:
+    with _LOCK:
+        _STATE[feature] = False
+
+
+def enable_by_name(name: str) -> Feature:
+    for f in Feature:
+        if f.value == name or f.name == name:
+            enable(f)
+            return f
+    raise ValueError(f"unknown feature {name!r}")
+
+
+def all_features() -> "dict[str, bool]":
+    return {f.value: _STATE[f] for f in Feature}
+
+
+def reset() -> None:
+    with _LOCK:
+        for f in Feature:
+            _STATE[f] = False
+
+
+__all__ = [
+    "Feature",
+    "is_enabled",
+    "enable",
+    "disable",
+    "enable_by_name",
+    "all_features",
+    "reset",
+]

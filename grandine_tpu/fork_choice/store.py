@@ -193,6 +193,9 @@ class Store:
         self.proposer_boost_root: "Optional[bytes]" = None
         self.slot = int(anchor_state.slot)
         self.interval = 0
+        #: called with the store right before finalization pruning discards
+        #: pre-finalized blocks (the controller persists them here)
+        self.pre_prune_hook: "Optional[callable]" = None
 
     # ------------------------------------------------------------ plumbing
 
@@ -393,6 +396,8 @@ class Store:
         if int(finalized.epoch) > int(self.finalized_checkpoint.epoch):
             if bytes(finalized.root) in self.blocks:
                 self.finalized_checkpoint = finalized
+                if self.pre_prune_hook is not None:
+                    self.pre_prune_hook(self)
                 self._prune_finalized()
 
     def _checkpoint_state(self, checkpoint):

@@ -1,0 +1,13 @@
+"""HTTP API — reference: `http_api` crate (Beacon API eth/v1-v3 +
+keymanager + GUI routes on axum, http_api/src/routing.rs:221-234; state
+routes :341-369; pool routes :389-410) and `http_api_utils` (middleware,
+BlockId/StateId parsing).
+
+`routing.py` defines handlers over an `ApiContext` (controller + pools +
+services) with a dependency-free router; `server.py` serves it over the
+stdlib's threading HTTP server. Tests drive handlers in-process through
+the same dispatch (the reference's http_api context.rs pattern).
+"""
+
+from grandine_tpu.http_api.routing import ApiContext, ApiError, Router  # noqa: F401
+from grandine_tpu.http_api.server import serve  # noqa: F401

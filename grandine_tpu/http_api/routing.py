@@ -1,0 +1,448 @@
+"""Beacon API routes + dispatch — reference: http_api/src/routing.rs
+(route table :221-234, states :341-369, pools :389-410), standard.rs
+(handlers), http_api_utils (StateId/BlockId parsing).
+
+The router is dependency-free: `(method, pattern)` pairs with `{param}`
+segments; handlers take (ctx, params, query, body) and return JSON-able
+dicts. `ApiContext` bundles the live services the handlers read.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Any, Callable, Optional
+
+from grandine_tpu import __version__
+from grandine_tpu.consensus import accessors
+from grandine_tpu.types.combined import state_phase_of
+from grandine_tpu.types.primitives import FAR_FUTURE_EPOCH
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, message: str) -> None:
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+class ApiContext:
+    """What handlers see (reference http_api context): controller snapshot
+    access plus the pools/trackers/metrics wired by the runtime."""
+
+    def __init__(
+        self,
+        controller,
+        cfg,
+        attestation_pool=None,
+        operation_pool=None,
+        liveness=None,
+        metrics=None,
+        genesis_time: "Optional[int]" = None,
+    ) -> None:
+        self.controller = controller
+        self.cfg = cfg
+        self.attestation_pool = attestation_pool
+        self.operation_pool = operation_pool
+        self.liveness = liveness
+        self.metrics = metrics
+        self.genesis_time = genesis_time
+
+    def snapshot(self):
+        return self.controller.snapshot()
+
+    def resolve_state(self, state_id: str):
+        """StateId: head | finalized | justified | genesis | <slot> | <0xroot>."""
+        snap = self.snapshot()
+        if state_id == "head":
+            return snap.head_state
+        if state_id == "finalized":
+            root = bytes(snap.finalized_checkpoint.root)
+            node = self.controller.store.blocks.get(root)
+            if node is not None:
+                return node.state
+            return snap.head_state  # anchor pruned: best effort
+        if state_id == "justified":
+            return self.controller.store.justified_state
+        if state_id == "genesis":
+            state_id = "0"
+        if state_id.startswith("0x"):
+            root = bytes.fromhex(state_id[2:])
+            for node in self.controller.store.blocks.values():
+                if node.state.hash_tree_root() == root:
+                    return node.state
+            raise ApiError(404, f"state {state_id} not found")
+        try:
+            slot = int(state_id)
+        except ValueError:
+            raise ApiError(400, f"invalid state id {state_id!r}") from None
+        for node in sorted(
+            self.controller.store.blocks.values(), key=lambda n: n.slot
+        ):
+            if node.slot == slot:
+                return node.state
+        raise ApiError(404, f"no state at slot {slot}")
+
+    def resolve_block(self, block_id: str):
+        snap = self.snapshot()
+        store = self.controller.store
+        if block_id == "head":
+            return store.blocks[snap.head_root]
+        if block_id == "finalized":
+            node = store.blocks.get(bytes(snap.finalized_checkpoint.root))
+            if node is None:
+                raise ApiError(404, "finalized block pruned")
+            return node
+        if block_id.startswith("0x"):
+            node = store.blocks.get(bytes.fromhex(block_id[2:]))
+            if node is None:
+                raise ApiError(404, f"block {block_id} not found")
+            return node
+        try:
+            slot = int(block_id)
+        except ValueError:
+            raise ApiError(400, f"invalid block id {block_id!r}") from None
+        for node in store.blocks.values():
+            if node.slot == slot:
+                return node
+        raise ApiError(404, f"no block at slot {slot}")
+
+
+def hex_(b: bytes) -> str:
+    return "0x" + bytes(b).hex()
+
+
+# ------------------------------------------------------------------ router
+
+
+class Router:
+    def __init__(self) -> None:
+        self.routes: "list[tuple[str, re.Pattern, Callable]]" = []
+
+    def add(self, method: str, pattern: str, handler: Callable) -> None:
+        regex = re.compile(
+            "^" + re.sub(r"\{(\w+)\}", r"(?P<\1>[^/]+)", pattern) + "$"
+        )
+        self.routes.append((method.upper(), regex, handler))
+
+    def dispatch(
+        self, ctx: ApiContext, method: str, path: str,
+        query: "Optional[dict]" = None, body: Any = None,
+    ):
+        """Returns (status, payload). JSON endpoints return dicts; /metrics
+        returns text."""
+        for m, regex, handler in self.routes:
+            if m != method.upper():
+                continue
+            match = regex.match(path)
+            if match is None:
+                continue
+            try:
+                payload = handler(ctx, match.groupdict(), query or {}, body)
+                return 200, payload
+            except ApiError as e:
+                return e.status, {"code": e.status, "message": e.message}
+            except Exception as e:  # handler crash -> 500, not a dead server
+                return 500, {"code": 500, "message": repr(e)}
+        return 404, {"code": 404, "message": f"no route for {method} {path}"}
+
+
+# ---------------------------------------------------------------- handlers
+
+
+def get_node_version(ctx, params, query, body):
+    return {"data": {"version": f"grandine-tpu/{__version__}"}}
+
+
+def get_node_health(ctx, params, query, body):
+    return {}
+
+
+def get_node_syncing(ctx, params, query, body):
+    snap = ctx.snapshot()
+    head_slot = int(snap.head_state.slot)
+    return {
+        "data": {
+            "head_slot": str(head_slot),
+            "sync_distance": str(max(0, snap.slot - head_slot)),
+            "is_syncing": snap.slot - head_slot > 1,
+            "is_optimistic": False,
+            "el_offline": True,
+        }
+    }
+
+
+def get_genesis(ctx, params, query, body):
+    snap = ctx.snapshot()
+    state = snap.head_state
+    return {
+        "data": {
+            "genesis_time": str(int(state.genesis_time)),
+            "genesis_validators_root": hex_(state.genesis_validators_root),
+            "genesis_fork_version": hex_(ctx.cfg.genesis_fork_version),
+        }
+    }
+
+
+def get_state_root(ctx, params, query, body):
+    state = ctx.resolve_state(params["state_id"])
+    return {"data": {"root": hex_(state.hash_tree_root())}}
+
+
+def get_state_fork(ctx, params, query, body):
+    state = ctx.resolve_state(params["state_id"])
+    return {
+        "data": {
+            "previous_version": hex_(state.fork.previous_version),
+            "current_version": hex_(state.fork.current_version),
+            "epoch": str(int(state.fork.epoch)),
+        }
+    }
+
+
+def get_finality_checkpoints(ctx, params, query, body):
+    state = ctx.resolve_state(params["state_id"])
+
+    def cp(c):
+        return {"epoch": str(int(c.epoch)), "root": hex_(c.root)}
+
+    return {
+        "data": {
+            "previous_justified": cp(state.previous_justified_checkpoint),
+            "current_justified": cp(state.current_justified_checkpoint),
+            "finalized": cp(state.finalized_checkpoint),
+        }
+    }
+
+
+def _validator_status(v, balance: int, epoch: int) -> str:
+    if int(v.activation_epoch) > epoch:
+        return (
+            "pending_queued"
+            if int(v.activation_eligibility_epoch) != FAR_FUTURE_EPOCH
+            else "pending_initialized"
+        )
+    if epoch < int(v.exit_epoch):
+        return "active_slashed" if bool(v.slashed) else "active_ongoing"
+    if epoch < int(v.withdrawable_epoch):
+        return "exited_slashed" if bool(v.slashed) else "exited_unslashed"
+    return "withdrawal_done" if balance == 0 else "withdrawal_possible"
+
+
+def get_state_validators(ctx, params, query, body):
+    state = ctx.resolve_state(params["state_id"])
+    p = ctx.cfg.preset
+    epoch = accessors.get_current_epoch(state, p)
+    ids = query.get("id")
+    indices = (
+        [int(i) for i in ids.split(",")]
+        if ids
+        else range(len(state.validators))
+    )
+    rows = []
+    for i in indices:
+        if i >= len(state.validators):
+            continue
+        v = state.validators[i]
+        balance = int(state.balances[i])
+        rows.append({
+            "index": str(i),
+            "balance": str(balance),
+            "status": _validator_status(v, balance, epoch),
+            "validator": {
+                "pubkey": hex_(v.pubkey),
+                "withdrawal_credentials": hex_(v.withdrawal_credentials),
+                "effective_balance": str(int(v.effective_balance)),
+                "slashed": bool(v.slashed),
+                "activation_eligibility_epoch": str(int(v.activation_eligibility_epoch)),
+                "activation_epoch": str(int(v.activation_epoch)),
+                "exit_epoch": str(int(v.exit_epoch)),
+                "withdrawable_epoch": str(int(v.withdrawable_epoch)),
+            },
+        })
+    return {"execution_optimistic": False, "finalized": False, "data": rows}
+
+
+def get_block(ctx, params, query, body):
+    node = ctx.resolve_block(params["block_id"])
+    signed = node.signed_block
+    state = ctx.snapshot().head_state
+    version = state_phase_of(node.state, ctx.cfg).key
+    message = getattr(signed, "message", None)
+    if message is None or not hasattr(signed, "serialize"):
+        raise ApiError(404, "anchor block body unavailable")
+    return {
+        "version": version,
+        "execution_optimistic": False,
+        "finalized": node.slot
+        <= int(ctx.snapshot().finalized_checkpoint.epoch)
+        * ctx.cfg.preset.SLOTS_PER_EPOCH,
+        "data": {"message_root": hex_(message.hash_tree_root()),
+                 "slot": str(node.slot),
+                 "proposer_index": str(int(message.proposer_index)),
+                 "ssz": hex_(signed.serialize())},
+    }
+
+
+def get_block_root(ctx, params, query, body):
+    node = ctx.resolve_block(params["block_id"])
+    return {"data": {"root": hex_(node.root)}}
+
+
+def get_headers(ctx, params, query, body):
+    snap = ctx.snapshot()
+    node = ctx.controller.store.blocks[snap.head_root]
+    return {
+        "data": [{
+            "root": hex_(node.root),
+            "canonical": True,
+            "header": {
+                "message": {
+                    "slot": str(node.slot),
+                    "parent_root": hex_(node.parent_root),
+                    "state_root": hex_(node.state.hash_tree_root()),
+                },
+            },
+        }]
+    }
+
+
+def post_pool_attestations(ctx, params, query, body):
+    if ctx.attestation_pool is None:
+        raise ApiError(503, "attestation pool not wired")
+    from grandine_tpu.types.combined import fork_namespace
+    from grandine_tpu.types.primitives import Phase
+
+    failures = []
+    for i, att_json in enumerate(body or []):
+        try:
+            att = _attestation_from_json(ctx, att_json)
+            ctx.attestation_pool.insert(att)
+        except Exception as e:
+            failures.append({"index": i, "message": repr(e)})
+    if failures:
+        raise ApiError(400, json.dumps(failures))
+    return {}
+
+
+def _attestation_from_json(ctx, j):
+    from grandine_tpu.types.combined import fork_namespace
+
+    snap = ctx.snapshot()
+    phase = state_phase_of(snap.head_state, ctx.cfg)
+    ns = fork_namespace(ctx.cfg, phase)
+    d = j["data"]
+    bits_hex = j["aggregation_bits"]
+    bitlist_bytes = bytes.fromhex(bits_hex[2:])
+    typ = ns.Attestation.FIELDS[0][1]
+    bits = typ.deserialize(bitlist_bytes)
+    return ns.Attestation(
+        aggregation_bits=bits,
+        data=ns.AttestationData(
+            slot=int(d["slot"]),
+            index=int(d["index"]),
+            beacon_block_root=bytes.fromhex(d["beacon_block_root"][2:]),
+            source=ns.Checkpoint(
+                epoch=int(d["source"]["epoch"]),
+                root=bytes.fromhex(d["source"]["root"][2:]),
+            ),
+            target=ns.Checkpoint(
+                epoch=int(d["target"]["epoch"]),
+                root=bytes.fromhex(d["target"]["root"][2:]),
+            ),
+        ),
+        signature=bytes.fromhex(j["signature"][2:]),
+    )
+
+
+def get_pool_voluntary_exits(ctx, params, query, body):
+    if ctx.operation_pool is None:
+        raise ApiError(503, "operation pool not wired")
+    exits = ctx.operation_pool.contents()["voluntary_exits"]
+    return {
+        "data": [
+            {
+                "message": {
+                    "epoch": str(int(e.message.epoch)),
+                    "validator_index": str(int(e.message.validator_index)),
+                },
+                "signature": hex_(e.signature),
+            }
+            for e in exits
+        ]
+    }
+
+
+def get_config_spec(ctx, params, query, body):
+    cfg = ctx.cfg
+    p = cfg.preset
+    data = {
+        "PRESET_BASE": cfg.preset_base,
+        "CONFIG_NAME": cfg.config_name,
+        "SECONDS_PER_SLOT": str(cfg.seconds_per_slot),
+        "SLOTS_PER_EPOCH": str(p.SLOTS_PER_EPOCH),
+        "GENESIS_FORK_VERSION": hex_(cfg.genesis_fork_version),
+        "ALTAIR_FORK_EPOCH": str(cfg.altair_fork_epoch),
+        "BELLATRIX_FORK_EPOCH": str(cfg.bellatrix_fork_epoch),
+        "CAPELLA_FORK_EPOCH": str(cfg.capella_fork_epoch),
+        "DENEB_FORK_EPOCH": str(cfg.deneb_fork_epoch),
+        "MAX_EFFECTIVE_BALANCE": str(p.MAX_EFFECTIVE_BALANCE),
+        "MIN_ATTESTATION_INCLUSION_DELAY": str(p.MIN_ATTESTATION_INCLUSION_DELAY),
+        "DEPOSIT_CONTRACT_ADDRESS": hex_(cfg.deposit_contract_address),
+        "DEPOSIT_CHAIN_ID": str(cfg.deposit_chain_id),
+    }
+    return {"data": data}
+
+
+def get_deposit_contract(ctx, params, query, body):
+    return {
+        "data": {
+            "chain_id": str(ctx.cfg.deposit_chain_id),
+            "address": hex_(ctx.cfg.deposit_contract_address),
+        }
+    }
+
+
+def post_validator_liveness(ctx, params, query, body):
+    if ctx.liveness is None:
+        raise ApiError(503, "liveness tracker not wired")
+    epoch = int(params["epoch"])
+    indices = [int(i) for i in (body or [])]
+    return {"data": ctx.liveness.liveness(epoch, indices)}
+
+
+def get_metrics(ctx, params, query, body):
+    if ctx.metrics is None:
+        raise ApiError(503, "metrics not wired")
+    return ctx.metrics.expose()  # text payload
+
+
+def build_router() -> Router:
+    r = Router()
+    r.add("GET", "/eth/v1/node/version", get_node_version)
+    r.add("GET", "/eth/v1/node/health", get_node_health)
+    r.add("GET", "/eth/v1/node/syncing", get_node_syncing)
+    r.add("GET", "/eth/v1/beacon/genesis", get_genesis)
+    r.add("GET", "/eth/v1/beacon/states/{state_id}/root", get_state_root)
+    r.add("GET", "/eth/v1/beacon/states/{state_id}/fork", get_state_fork)
+    r.add(
+        "GET",
+        "/eth/v1/beacon/states/{state_id}/finality_checkpoints",
+        get_finality_checkpoints,
+    )
+    r.add(
+        "GET", "/eth/v1/beacon/states/{state_id}/validators", get_state_validators
+    )
+    r.add("GET", "/eth/v1/beacon/headers", get_headers)
+    r.add("GET", "/eth/v2/beacon/blocks/{block_id}", get_block)
+    r.add("GET", "/eth/v1/beacon/blocks/{block_id}/root", get_block_root)
+    r.add("POST", "/eth/v1/beacon/pool/attestations", post_pool_attestations)
+    r.add("GET", "/eth/v1/beacon/pool/voluntary_exits", get_pool_voluntary_exits)
+    r.add("GET", "/eth/v1/config/spec", get_config_spec)
+    r.add("GET", "/eth/v1/config/deposit_contract", get_deposit_contract)
+    r.add("POST", "/eth/v1/validator/liveness/{epoch}", post_validator_liveness)
+    r.add("GET", "/metrics", get_metrics)
+    return r
+
+
+__all__ = ["ApiContext", "ApiError", "Router", "build_router"]

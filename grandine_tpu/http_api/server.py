@@ -1,0 +1,62 @@
+"""Threaded HTTP server over the router — the serving half of the
+reference's http_api (axum server) using only the stdlib.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qsl, urlsplit
+
+from grandine_tpu.http_api.routing import ApiContext, build_router
+
+
+def serve(ctx: ApiContext, host: str = "127.0.0.1", port: int = 5052):
+    """Start the API server on a daemon thread; returns (server, thread).
+    `server.shutdown()` stops it."""
+    router = build_router()
+
+    class Handler(BaseHTTPRequestHandler):
+        def _dispatch(self, body=None):
+            split = urlsplit(self.path)
+            query = dict(parse_qsl(split.query))
+            status, payload = router.dispatch(
+                ctx, self.command, split.path, query, body
+            )
+            if isinstance(payload, str):  # /metrics text exposition
+                raw = payload.encode()
+                ctype = "text/plain; version=0.0.4"
+            else:
+                raw = json.dumps(payload).encode()
+                ctype = "application/json"
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(raw)))
+            self.end_headers()
+            self.wfile.write(raw)
+
+        def do_GET(self):  # noqa: N802
+            self._dispatch()
+
+        def do_POST(self):  # noqa: N802
+            length = int(self.headers.get("Content-Length", 0))
+            raw = self.rfile.read(length) if length else b""
+            try:
+                body = json.loads(raw) if raw else None
+            except json.JSONDecodeError:
+                self.send_response(400)
+                self.end_headers()
+                return
+            self._dispatch(body)
+
+        def log_message(self, *args):  # quiet
+            pass
+
+    server = ThreadingHTTPServer((host, port), Handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server, thread
+
+
+__all__ = ["serve"]

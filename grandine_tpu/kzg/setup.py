@@ -140,7 +140,9 @@ def _load_cached_official():
         ]
         g2 = [g2_raw[i].tobytes() for i in range(g2_raw.shape[0])]
         return g1, g2
-    except (OSError, KeyError, ValueError):
+    except Exception:
+        # any unreadable/corrupt cache (incl. zipfile.BadZipFile from a
+        # truncated write) falls back to re-parsing the source txt
         return None
 
 
@@ -154,12 +156,14 @@ def _store_cache(points) -> None:
         g2_raw = np.stack(
             [np.frombuffer(b, np.uint8) for b in g2]
         )
+        tmp = _OFFICIAL_CACHE + ".tmp"
         np.savez(
-            _OFFICIAL_CACHE,
+            tmp,
             digest=np.frombuffer(_txt_digest(), np.uint8),
             g1=g1_raw,
             g2=g2_raw,
         )
+        os.replace(tmp + ".npz", _OFFICIAL_CACHE)  # atomic publish
     except OSError:
         pass
 

@@ -1,0 +1,159 @@
+"""Metrics registry — reference: prometheus_metrics crate (the one
+`Metrics` struct of ~100 histograms/gauges/counters shared via
+Option<Arc<Metrics>> through every constructor, prometheus_metrics/src/
+metrics.rs:14-120) plus the `metrics` crate's scrape server.
+
+Dependency-free: counters/gauges/histograms with Prometheus text
+exposition. The scrape endpoint is served by the HTTP API layer.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional, Sequence
+
+
+class Counter:
+    __slots__ = ("name", "help", "_value", "_lock")
+
+    def __init__(self, name: str, help_: str = "") -> None:
+        self.name = name
+        self.help = help_
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def expose(self) -> str:
+        return (
+            f"# HELP {self.name} {self.help}\n"
+            f"# TYPE {self.name} counter\n"
+            f"{self.name} {self._value}\n"
+        )
+
+
+class Gauge(Counter):
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+    def expose(self) -> str:
+        return (
+            f"# HELP {self.name} {self.help}\n"
+            f"# TYPE {self.name} gauge\n"
+            f"{self.name} {self._value}\n"
+        )
+
+
+_DEFAULT_BUCKETS = (
+    0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0
+)
+
+
+class Histogram:
+    __slots__ = ("name", "help", "buckets", "_counts", "_sum", "_count", "_lock")
+
+    def __init__(self, name: str, help_: str = "",
+                 buckets: "Sequence[float]" = _DEFAULT_BUCKETS) -> None:
+        self.name = name
+        self.help = help_
+        self.buckets = tuple(buckets)
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self._sum += value
+            self._count += 1
+            for i, bound in enumerate(self.buckets):
+                if value <= bound:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    def time(self) -> "_Timer":
+        return _Timer(self)
+
+    def expose(self) -> str:
+        out = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} histogram",
+        ]
+        cumulative = 0
+        for bound, count in zip(self.buckets, self._counts):
+            cumulative += count
+            out.append(f'{self.name}_bucket{{le="{bound}"}} {cumulative}')
+        cumulative += self._counts[-1]
+        out.append(f'{self.name}_bucket{{le="+Inf"}} {cumulative}')
+        out.append(f"{self.name}_sum {self._sum}")
+        out.append(f"{self.name}_count {self._count}")
+        return "\n".join(out) + "\n"
+
+
+class _Timer:
+    def __init__(self, hist: Histogram) -> None:
+        self._hist = hist
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *_):
+        self._hist.observe(time.perf_counter() - self._t0)
+
+
+class Metrics:
+    """The shared metrics struct: the framework's counterpart of
+    prometheus_metrics::Metrics, passed as Optional through constructors."""
+
+    def __init__(self) -> None:
+        # fork choice / mutator (metrics.rs:49-53,106)
+        self.fc_blocks_applied = Counter(
+            "fc_blocks_applied_total", "blocks applied to the store")
+        self.fc_attestations_applied = Counter(
+            "fc_attestations_applied_total", "attestations applied")
+        self.fc_block_task_times = Histogram(
+            "fc_block_task_seconds", "block validation task duration")
+        self.fc_head_changes = Counter(
+            "fc_head_changes_total", "head switches")
+        # attestation verifier (metrics.rs:58-60)
+        self.att_batches = Counter(
+            "attestation_verifier_batches_total", "verified gossip batches")
+        self.att_batch_times = Histogram(
+            "attestation_verifier_batch_seconds", "batch verify duration")
+        self.att_fallbacks = Counter(
+            "attestation_verifier_fallbacks_total",
+            "batches degraded to singular verification")
+        # device plane
+        self.device_batch_sigs = Counter(
+            "device_batch_signatures_total",
+            "signatures shipped to the accelerator")
+        self.block_processing_times = Histogram(
+            "block_processing_seconds", "state-transition duration")
+        self.head_slot = Gauge("head_slot", "current head slot")
+        self.finalized_epoch = Gauge("finalized_epoch", "finalized epoch")
+
+    def all(self):
+        return [
+            v for v in vars(self).values()
+            if isinstance(v, (Counter, Gauge, Histogram))
+        ]
+
+    def expose(self) -> str:
+        """Prometheus text exposition of every registered metric."""
+        return "".join(m.expose() for m in self.all())
+
+
+__all__ = ["Counter", "Gauge", "Histogram", "Metrics"]

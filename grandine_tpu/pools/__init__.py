@@ -1,0 +1,12 @@
+"""Operation pools — reference: `operation_pools` crate
+(AttestationAggPool with aggregate-on-insert + the attestation packer,
+SyncCommitteeAggPool, BlsToExecutionChangePool, and the slashing/exit
+pools the reference keeps in http_api/validator state).
+
+All pools are head-state-agnostic accumulators; the packer resolves
+against a concrete pre-state at proposal time.
+"""
+
+from grandine_tpu.pools.attestation_pool import AttestationAggPool  # noqa: F401
+from grandine_tpu.pools.operation_pool import OperationPool  # noqa: F401
+from grandine_tpu.pools.sync_committee_pool import SyncCommitteeAggPool  # noqa: F401

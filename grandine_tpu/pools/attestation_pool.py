@@ -1,0 +1,165 @@
+"""Attestation aggregation pool + block packer — reference:
+operation_pools/src/attestation_agg_pool (aggregate-on-insert per
+committee, pool.rs) and attestation_packer.rs (ILP packing via HiGHS with
+a greedy fallback; greedy here — the ILP seam is `pack_attestations`).
+
+Pool shape: (slot, committee_index, data_root) -> list of non-dominated
+aggregates. Insertion merges disjoint aggregates eagerly (aggregate-on-
+insert) and drops dominated ones, so the packer chooses among few,
+near-maximal aggregates per committee.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Sequence
+
+from grandine_tpu.crypto import bls as A
+
+
+class _Entry:
+    __slots__ = ("attestation", "bits")
+
+    def __init__(self, attestation) -> None:
+        self.attestation = attestation
+        self.bits = attestation.aggregation_bits
+
+
+class AttestationAggPool:
+    def __init__(self, cfg, capacity_slots: "Optional[int]" = None) -> None:
+        self.cfg = cfg
+        self.p = cfg.preset
+        # retain at most ~2 epochs of slots (packable window)
+        self.capacity_slots = capacity_slots or 2 * self.p.SLOTS_PER_EPOCH
+        self._by_key: "dict[tuple, list[_Entry]]" = {}
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return sum(len(v) for v in self._by_key.values())
+
+    # ------------------------------------------------------------- insert
+
+    def insert(self, attestation) -> None:
+        """Aggregate-on-insert: merge with every disjoint aggregate of the
+        same attestation data, keep the non-dominated frontier."""
+        data = attestation.data
+        key = (int(data.slot), int(data.index), data.hash_tree_root())
+        new = _Entry(attestation)
+        with self._lock:
+            entries = self._by_key.setdefault(key, [])
+            # merge into disjoint existing aggregates
+            merged: "list[_Entry]" = []
+            for e in entries:
+                if not e.bits.intersects(new.bits):
+                    merged.append(self._merge(e, new))
+            candidates = entries + [new] + merged
+            # non-dominated frontier (drop strict subsets)
+            frontier: "list[_Entry]" = []
+            for cand in sorted(
+                candidates, key=lambda e: -e.bits.count()
+            ):
+                if not any(f.bits.covers(cand.bits) for f in frontier):
+                    frontier.append(cand)
+            self._by_key[key] = frontier[:8]  # bounded per committee
+            self._evict()
+
+    def _merge(self, a: _Entry, b: _Entry) -> _Entry:
+        sig = A.Signature.aggregate(
+            [
+                A.Signature.from_bytes(bytes(a.attestation.signature)),
+                A.Signature.from_bytes(bytes(b.attestation.signature)),
+            ]
+        )
+        merged = a.attestation.replace(
+            aggregation_bits=a.bits.union(b.bits),
+            signature=sig.to_bytes(),
+        )
+        return _Entry(merged)
+
+    def _evict(self) -> None:
+        slots = sorted({k[0] for k in self._by_key})
+        while len(slots) > self.capacity_slots:
+            victim = slots.pop(0)
+            for k in [k for k in self._by_key if k[0] == victim]:
+                del self._by_key[k]
+
+    # --------------------------------------------------------------- query
+
+    def best_aggregate(self, slot: int, index: int, data_root: bytes):
+        """Widest known aggregate for (slot, committee, data) — what the
+        aggregator duty publishes."""
+        with self._lock:
+            entries = self._by_key.get((slot, index, bytes(data_root)), [])
+            if not entries:
+                return None
+            return max(entries, key=lambda e: e.bits.count()).attestation
+
+    def prune_before(self, slot: int) -> None:
+        with self._lock:
+            for k in [k for k in self._by_key if k[0] < slot]:
+                del self._by_key[k]
+
+    # --------------------------------------------------------------- pack
+
+    def pack_attestations(
+        self, state, cfg, max_count: "Optional[int]" = None,
+        slot: "Optional[int]" = None,
+    ):
+        """Greedy weight packer for block production
+        (attestation_packer.rs:142 greedy fallback; the ILP seam): pick
+        includable attestations maximizing NEW attesting validators,
+        de-duplicating across overlapping aggregates.
+
+        `slot` is the slot of the block being built (defaults to the
+        state's slot); inclusion windows are computed against it, so a
+        packer fed the previous head state stays correct across epoch
+        boundaries."""
+        from grandine_tpu.consensus import accessors, misc
+
+        p = cfg.preset
+        max_count = max_count or p.MAX_ATTESTATIONS
+        state_slot = int(state.slot) if slot is None else int(slot)
+        cur = misc.compute_epoch_at_slot(state_slot, p)
+        prev = max(0, cur - 1)
+
+        candidates = []
+        with self._lock:
+            items = [
+                (k, e) for k, entries in self._by_key.items() for e in entries
+            ]
+        for (slot, index, _root), e in items:
+            if slot + p.MIN_ATTESTATION_INCLUSION_DELAY > state_slot:
+                continue
+            target_epoch = misc.compute_epoch_at_slot(slot, p)
+            if target_epoch not in (cur, prev):
+                continue
+            # source must match the state's justified checkpoint
+            data = e.attestation.data
+            justified = (
+                state.current_justified_checkpoint
+                if target_epoch == cur
+                else state.previous_justified_checkpoint
+            )
+            if data.source != justified:
+                continue
+            candidates.append(e)
+
+        seen: "dict[tuple, set]" = {}
+        packed = []
+        # widest-first greedy with incremental coverage accounting
+        for e in sorted(candidates, key=lambda e: -e.bits.count()):
+            data = e.attestation.data
+            cov_key = (int(data.slot), int(data.index))
+            covered = seen.setdefault(cov_key, set())
+            new_bits = set(int(i) for i in e.bits.nonzero_indices()) - covered
+            if not new_bits:
+                continue
+            packed.append(e.attestation)
+            covered |= new_bits
+            if len(packed) >= max_count:
+                break
+        return packed
+
+
+__all__ = ["AttestationAggPool"]

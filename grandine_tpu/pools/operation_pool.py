@@ -1,0 +1,152 @@
+"""Pool for non-attestation operations — reference: the
+BlsToExecutionChangePool (operation_pools) plus the slashing / voluntary-
+exit accumulation the reference keeps alongside (fed to the proposer and
+served by the Beacon API's pool endpoints).
+
+Dedup keys follow the spec's inclusion semantics: one exit per validator,
+one proposer slashing per proposer, attester slashings by content,
+one BLS change per validator.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Sequence
+
+
+class OperationPool:
+    def __init__(self, cfg) -> None:
+        self.cfg = cfg
+        self.p = cfg.preset
+        self._lock = threading.Lock()
+        self._proposer_slashings: dict = {}   # proposer index -> op
+        self._attester_slashings: dict = {}   # content root -> op
+        self._voluntary_exits: dict = {}      # validator index -> op
+        self._bls_changes: dict = {}          # validator index -> op
+
+    # ------------------------------------------------------------- inserts
+
+    def insert_proposer_slashing(self, slashing) -> bool:
+        key = int(slashing.signed_header_1.message.proposer_index)
+        with self._lock:
+            if key in self._proposer_slashings:
+                return False
+            self._proposer_slashings[key] = slashing
+            return True
+
+    def insert_attester_slashing(self, slashing) -> bool:
+        key = slashing.hash_tree_root()
+        with self._lock:
+            if key in self._attester_slashings:
+                return False
+            self._attester_slashings[key] = slashing
+            return True
+
+    def insert_voluntary_exit(self, signed_exit) -> bool:
+        key = int(signed_exit.message.validator_index)
+        with self._lock:
+            if key in self._voluntary_exits:
+                return False
+            self._voluntary_exits[key] = signed_exit
+            return True
+
+    def insert_bls_to_execution_change(self, signed_change) -> bool:
+        key = int(signed_change.message.validator_index)
+        with self._lock:
+            if key in self._bls_changes:
+                return False
+            self._bls_changes[key] = signed_change
+            return True
+
+    # -------------------------------------------------------------- state
+
+    def contents(self) -> dict:
+        with self._lock:
+            return {
+                "proposer_slashings": list(self._proposer_slashings.values()),
+                "attester_slashings": list(self._attester_slashings.values()),
+                "voluntary_exits": list(self._voluntary_exits.values()),
+                "bls_to_execution_changes": list(self._bls_changes.values()),
+            }
+
+    # ------------------------------------------------------------- packing
+
+    def pack(self, state) -> dict:
+        """Block-sized op sets, filtered to those still applicable to
+        `state` (exited validators drop out, already-slashed proposers
+        drop out)."""
+        from grandine_tpu.consensus import accessors, predicates
+        from grandine_tpu.types.primitives import FAR_FUTURE_EPOCH
+
+        p = self.p
+        epoch = accessors.get_current_epoch(state, p)
+        cols = accessors.registry_columns(state)
+        n = len(cols)
+        ops = self.contents()
+
+        def slashable(i: int) -> bool:
+            return i < n and not bool(cols.slashed[i]) and (
+                int(cols.activation_epoch[i]) <= epoch
+                < int(cols.withdrawable_epoch[i])
+            )
+
+        proposer_slashings = [
+            s for s in ops["proposer_slashings"]
+            if slashable(int(s.signed_header_1.message.proposer_index))
+        ][: p.MAX_PROPOSER_SLASHINGS]
+
+        attester_slashings = []
+        for s in ops["attester_slashings"]:
+            common = set(map(int, s.attestation_1.attesting_indices)) & set(
+                map(int, s.attestation_2.attesting_indices)
+            )
+            if any(slashable(i) for i in common):
+                attester_slashings.append(s)
+            if len(attester_slashings) >= p.MAX_ATTESTER_SLASHINGS:
+                break
+
+        exits = []
+        for e in ops["voluntary_exits"]:
+            i = int(e.message.validator_index)
+            if (
+                i < n
+                and int(cols.exit_epoch[i]) == FAR_FUTURE_EPOCH
+                and int(cols.activation_epoch[i]) <= epoch
+            ):
+                exits.append(e)
+            if len(exits) >= p.MAX_VOLUNTARY_EXITS:
+                break
+
+        changes = []
+        for c in ops["bls_to_execution_changes"]:
+            i = int(c.message.validator_index)
+            if i < n and cols.withdrawal_credentials[i][:1] == b"\x00":
+                changes.append(c)
+            if len(changes) >= p.MAX_BLS_TO_EXECUTION_CHANGES:
+                break
+
+        return {
+            "proposer_slashings": proposer_slashings,
+            "attester_slashings": attester_slashings,
+            "voluntary_exits": exits,
+            "bls_to_execution_changes": changes,
+        }
+
+    def on_block_applied(self, block) -> None:
+        """Drop operations included in an accepted block."""
+        body = block.message.body if hasattr(block, "message") else block.body
+        with self._lock:
+            for s in body.proposer_slashings:
+                self._proposer_slashings.pop(
+                    int(s.signed_header_1.message.proposer_index), None
+                )
+            for s in body.attester_slashings:
+                self._attester_slashings.pop(s.hash_tree_root(), None)
+            for e in body.voluntary_exits:
+                self._voluntary_exits.pop(int(e.message.validator_index), None)
+            if hasattr(body, "bls_to_execution_changes"):
+                for c in body.bls_to_execution_changes:
+                    self._bls_changes.pop(int(c.message.validator_index), None)
+
+
+__all__ = ["OperationPool"]

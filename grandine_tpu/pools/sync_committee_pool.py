@@ -1,0 +1,99 @@
+"""Sync-committee aggregation pool — reference:
+operation_pools/src/sync_committee_agg_pool (per-slot, per-subcommittee
+contribution aggregation feeding the proposer's SyncAggregate).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+
+from grandine_tpu.crypto import bls as A
+
+
+class SyncCommitteeAggPool:
+    """(slot, beacon_block_root) -> per-subcommittee best contributions,
+    foldable into one block-level SyncAggregate."""
+
+    def __init__(self, cfg) -> None:
+        self.cfg = cfg
+        self.p = cfg.preset
+        self.subcommittees = 4  # SYNC_COMMITTEE_SUBNET_COUNT
+        self._contribs: "dict[tuple, dict[int, object]]" = {}
+        self._lock = threading.Lock()
+
+    def insert_message(
+        self, slot: int, beacon_block_root: bytes,
+        committee_position: int, signature: bytes,
+    ) -> None:
+        """One validator's SyncCommitteeMessage placed at its position(s)
+        in the committee (positions map to subcommittees)."""
+        sub_size = self.p.SYNC_COMMITTEE_SIZE // self.subcommittees
+        sub = committee_position // sub_size
+        pos_in_sub = committee_position % sub_size
+        key = (int(slot), bytes(beacon_block_root))
+        with self._lock:
+            subs = self._contribs.setdefault(key, {})
+            entry = subs.get(sub)
+            bits = np.zeros(sub_size, dtype=bool)
+            bits[pos_in_sub] = True
+            sig = A.Signature.from_bytes(bytes(signature))
+            if entry is None:
+                subs[sub] = (bits, sig)
+            else:
+                old_bits, old_sig = entry
+                if old_bits[pos_in_sub]:
+                    return  # already have this participant
+                merged = old_bits | bits
+                subs[sub] = (
+                    merged,
+                    A.Signature.aggregate([old_sig, sig]),
+                )
+
+    def insert_contribution(self, contribution) -> None:
+        """An aggregated SyncCommitteeContribution (gossip aggregate)."""
+        key = (
+            int(contribution.slot),
+            bytes(contribution.beacon_block_root),
+        )
+        sub = int(contribution.subcommittee_index)
+        bits = np.asarray(contribution.aggregation_bits.array, dtype=bool)
+        sig = A.Signature.from_bytes(
+            bytes(contribution.signature)
+        )
+        with self._lock:
+            subs = self._contribs.setdefault(key, {})
+            entry = subs.get(sub)
+            if entry is None or bits.sum() > entry[0].sum():
+                subs[sub] = (bits.copy(), sig)
+
+    def best_aggregate(self, slot: int, beacon_block_root: bytes, types_ns):
+        """Fold the best per-subcommittee contributions into a block-level
+        SyncAggregate (empty aggregate when nothing is known)."""
+        sub_size = self.p.SYNC_COMMITTEE_SIZE // self.subcommittees
+        with self._lock:
+            subs = dict(
+                self._contribs.get((int(slot), bytes(beacon_block_root)), {})
+            )
+        bits = np.zeros(self.p.SYNC_COMMITTEE_SIZE, dtype=bool)
+        sigs = []
+        for sub, (sub_bits, sig) in subs.items():
+            bits[sub * sub_size : (sub + 1) * sub_size] = sub_bits
+            sigs.append(sig)
+        signature = (
+            A.Signature.aggregate(sigs) if sigs else A.Signature.empty()
+        )
+        return types_ns.SyncAggregate(
+            sync_committee_bits=bits,
+            sync_committee_signature=signature.to_bytes(),
+        )
+
+    def prune_before(self, slot: int) -> None:
+        with self._lock:
+            for k in [k for k in self._contribs if k[0] < slot]:
+                del self._contribs[k]
+
+
+__all__ = ["SyncCommitteeAggPool"]

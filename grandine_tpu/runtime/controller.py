@@ -71,15 +71,24 @@ class Controller:
         verifier_factory: "Callable[[], Verifier]" = MultiVerifier,
         pool: "Optional[ThreadPool]" = None,
         wait_group: "Optional[WaitGroup]" = None,
+        storage=None,
+        metrics=None,
     ) -> None:
         self.cfg = cfg
         self.verifier_factory = verifier_factory
+        self.storage = storage
+        self.metrics = metrics
+        self._persisted_finalized = -1
         self.store = Store(anchor_state, cfg, execution_engine=execution_engine)
+        if storage is not None:
+            # persist the finalized chain BEFORE the store prunes it away
+            self.store.pre_prune_hook = self._persist_finalized
         self.wait_group = wait_group or WaitGroup()
         self.pool = pool or ThreadPool(wait_group=self.wait_group)
         self._owns_pool = pool is None
 
         self._delayed_by_parent: "dict[bytes, list]" = {}
+        self._delayed_by_slot: "dict[int, list]" = {}
         self._delayed_attestations: "list[ValidAttestation]" = []
         self._rejected: "list[tuple[bytes, str]]" = []
         self.on_head_change: "list[Callable[[Snapshot], None]]" = []
@@ -90,6 +99,25 @@ class Controller:
             target=self._mutator_run, name="store-mutator", daemon=True
         )
         self._mutator.start()
+
+    # -------------------------------------------------------------- restore
+
+    @classmethod
+    def restore(cls, storage, cfg, anchor_state=None, **kwargs):
+        """Rebuild a controller from persisted storage: load the anchor
+        (finalized) state, then replay unfinalized blocks through normal
+        validation (controller.rs:140 process_unfinalized_blocks)."""
+        state, unfinalized = storage.load(anchor_state=anchor_state)
+        ctrl = cls(state, cfg, storage=storage, **kwargs)
+        if unfinalized:
+            from grandine_tpu.fork_choice.store import Tick, TickKind
+
+            max_slot = max(int(b.message.slot) for b in unfinalized)
+            ctrl.on_tick(Tick(max_slot, TickKind.AGGREGATE))
+            for blk in unfinalized:
+                ctrl.on_requested_block(blk)
+            ctrl.wait()
+        return ctrl
 
     # ---------------------------------------------------------------- reads
 
@@ -183,6 +211,10 @@ class Controller:
             except ForkChoiceError as e:
                 if "unknown parent" in str(e):
                     self._send(("delay_block", signed_block))
+                elif "future slot" in str(e):
+                    # mutator.rs delayed_until_slot: a block may arrive (or
+                    # race the tick mutation) before its slot starts
+                    self._send(("delay_block_slot", signed_block))
                 else:
                     self._send(("reject", (signed_block, str(e))))
                 return
@@ -205,6 +237,7 @@ class Controller:
                 elif kind == "tick":
                     self.store.apply_tick(payload)
                     self._apply_matured_attestations()
+                    self._respawn_matured_blocks()
                 elif kind == "block":
                     self._handle_block(payload)
                 elif kind == "attestations":
@@ -216,6 +249,17 @@ class Controller:
                             self.store.apply_attestation(valid)
                 elif kind == "attester_slashing":
                     self.store.apply_attester_slashing(payload)
+                elif kind == "delay_block_slot":
+                    slot = int(payload.message.slot)
+                    if slot <= self.store.slot:
+                        self._spawn_block_task(payload, trusted=False)
+                    else:
+                        pending = self._delayed_by_slot.setdefault(slot, [])
+                        if len(pending) < 64:  # per-slot bound (spam guard)
+                            pending.append(payload)
+                        while len(self._delayed_by_slot) > 64:
+                            # drop the furthest-future slots under spam
+                            self._delayed_by_slot.pop(max(self._delayed_by_slot))
                 elif kind == "delay_block":
                     parent = bytes(payload.message.parent_root)
                     if parent in self.store.blocks:
@@ -251,8 +295,23 @@ class Controller:
         # retry children that were waiting for this parent
         for delayed in self._delayed_by_parent.pop(valid.root, []):
             self._spawn_block_task(delayed, trusted=False)
+        # persistence (runs on the mutator thread like the reference):
+        # every applied block immediately; the finalized chain is promoted
+        # by the store's pre-prune hook (_persist_finalized)
+        if self.storage is not None:
+            self.storage.persist_unfinalized_block(
+                valid.root, valid.signed_block
+            )
         self._refresh_snapshot()
+        if self.metrics is not None:
+            self.metrics.fc_blocks_applied.inc()
+            self.metrics.head_slot.set(int(self._snapshot.head_state.slot))
+            self.metrics.finalized_epoch.set(
+                int(self.store.finalized_checkpoint.epoch)
+            )
         if self._snapshot.head_root != old_head:
+            if self.metrics is not None:
+                self.metrics.fc_head_changes.inc()
             for cb in self.on_head_change:
                 cb(self._snapshot)
 
@@ -278,6 +337,17 @@ class Controller:
         while len(self._delayed_by_parent) > self.MAX_DELAYED_PARENTS:
             self._delayed_by_parent.pop(next(iter(self._delayed_by_parent)))
         del self._rejected[: -self.MAX_REJECTED]
+
+    def _persist_finalized(self, store) -> None:
+        fin = int(store.finalized_checkpoint.epoch)
+        if fin > self._persisted_finalized:
+            self.storage.persist_finalized_chain(store)
+            self._persisted_finalized = fin
+
+    def _respawn_matured_blocks(self) -> None:
+        for slot in [s for s in self._delayed_by_slot if s <= self.store.slot]:
+            for blk in self._delayed_by_slot.pop(slot):
+                self._spawn_block_task(blk, trusted=False)
 
     def _apply_matured_attestations(self) -> None:
         if not self._delayed_attestations:

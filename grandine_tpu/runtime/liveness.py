@@ -1,0 +1,67 @@
+"""Liveness tracker — reference: liveness_tracker crate
+(liveness_tracker/src/lib.rs:30-39: per-epoch validator liveness bitvecs
+fed from blocks / attestations / sync messages, served by the Beacon API's
+/eth/v1/validator/liveness endpoint).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+
+class LivenessTracker:
+    """Two rolling epochs of per-validator liveness bits."""
+
+    def __init__(self, n_validators: int = 0) -> None:
+        self._epochs: "dict[int, np.ndarray]" = {}
+        self._n = n_validators
+        self._lock = threading.Lock()
+
+    def _bits(self, epoch: int) -> np.ndarray:
+        bits = self._epochs.get(epoch)
+        if bits is None:
+            bits = np.zeros(max(self._n, 1), dtype=bool)
+            self._epochs[epoch] = bits
+            # keep only the two most recent epochs
+            for old in sorted(self._epochs)[:-2]:
+                del self._epochs[old]
+        return bits
+
+    def _grow(self, bits: np.ndarray, index: int, epoch: int) -> np.ndarray:
+        if index >= len(bits):
+            grown = np.zeros(index + 1, dtype=bool)
+            grown[: len(bits)] = bits
+            self._epochs[epoch] = grown
+            self._n = max(self._n, index + 1)
+            return grown
+        return bits
+
+    def on_attestation(self, epoch: int, indices) -> None:
+        with self._lock:
+            bits = self._bits(epoch)
+            for i in indices:
+                bits = self._grow(bits, int(i), epoch)
+                bits[int(i)] = True
+
+    def on_block(self, epoch: int, proposer_index: int) -> None:
+        self.on_attestation(epoch, [proposer_index])
+
+    def on_sync_message(self, epoch: int, validator_index: int) -> None:
+        self.on_attestation(epoch, [validator_index])
+
+    def is_live(self, epoch: int, index: int) -> bool:
+        with self._lock:
+            bits = self._epochs.get(epoch)
+            return bool(bits[index]) if bits is not None and index < len(bits) else False
+
+    def liveness(self, epoch: int, indices) -> "list[dict]":
+        """Beacon-API-shaped response rows."""
+        return [
+            {"index": str(int(i)), "is_live": self.is_live(epoch, int(i))}
+            for i in indices
+        ]
+
+
+__all__ = ["LivenessTracker"]

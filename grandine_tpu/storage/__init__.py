@@ -1,0 +1,14 @@
+"""Storage — reference: `database` crate (libmdbx or in-memory OrdMap,
+database/src/lib.rs:21-70, snappy-compressed values, prefix iteration) and
+`fork_choice_control::storage` (persistence schema, archival states,
+checkpoint load, storage.rs:769-868).
+
+Here: a `Database` interface with in-memory and sqlite3 backends (sqlite is
+the stdlib's battle-tested B-tree — the mdbx role), values snappy-framed
+with the in-tree codec, and a `Storage` schema layer handling finalized
+chain persistence, periodic archival states, and anchor load for restart /
+checkpoint sync.
+"""
+
+from grandine_tpu.storage.database import Database  # noqa: F401
+from grandine_tpu.storage.storage import StateLoadStrategy, Storage  # noqa: F401

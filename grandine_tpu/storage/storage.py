@@ -1,0 +1,186 @@
+"""Persistence schema + anchor loading — reference:
+fork_choice_control/src/storage.rs (schema :769-868: `cstate2`/`cblock`
+anchor keys, per-root block/state prefixes, slot indexes, archival states
+every DEFAULT_ARCHIVAL_EPOCH_INTERVAL=32 epochs :37) and
+checkpoint_sync.rs / `StateLoadStrategy` (:39).
+
+Schema (all values SSZ, snappy-framed by the Database layer):
+  b"cstate"            anchor (latest persisted finalized) state
+  b"cblock"            anchor block
+  b"b" + root          finalized signed block by root
+  b"s" + slot_be8      finalized block root by slot (canonical index)
+  b"t" + slot_be8      archival state by slot (every archival interval)
+  b"u" + root          unfinalized signed block (replayed into the store
+                       on restart, mutator.process_unfinalized_blocks)
+  b"meta:slot"         latest persisted finalized slot (u64 LE)
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Callable, Optional
+
+from grandine_tpu.storage.database import Database
+from grandine_tpu.types.combined import decode_signed_block, decode_state
+
+DEFAULT_ARCHIVAL_EPOCH_INTERVAL = 32
+
+KEY_ANCHOR_STATE = b"cstate"
+KEY_ANCHOR_BLOCK = b"cblock"
+PREFIX_BLOCK = b"b"
+PREFIX_SLOT_INDEX = b"s"
+PREFIX_ARCHIVAL_STATE = b"t"
+PREFIX_UNFINALIZED = b"u"
+KEY_LATEST_SLOT = b"meta:slot"
+
+
+class StateLoadStrategy(enum.Enum):
+    AUTO = "auto"          # local DB if present, else anchor source
+    ANCHOR = "anchor"      # provided genesis/anchor state
+    REMOTE = "remote"      # checkpoint sync via a fetcher
+
+
+def _slot_key(prefix: bytes, slot: int) -> bytes:
+    return prefix + int(slot).to_bytes(8, "big")
+
+
+class Storage:
+    def __init__(
+        self,
+        database: Database,
+        cfg,
+        archival_epoch_interval: int = DEFAULT_ARCHIVAL_EPOCH_INTERVAL,
+    ) -> None:
+        self.db = database
+        self.cfg = cfg
+        self.archival_epoch_interval = archival_epoch_interval
+
+    # ------------------------------------------------------------- persist
+
+    def persist_anchor(self, state, signed_block=None) -> None:
+        self.db.put(KEY_ANCHOR_STATE, state.serialize())
+        if signed_block is not None:
+            self.db.put(KEY_ANCHOR_BLOCK, signed_block.serialize())
+
+    def persist_unfinalized_block(self, root: bytes, signed_block) -> None:
+        """Every applied block is persisted immediately (the reference
+        stores blocks on insertion; restart replays them)."""
+        if hasattr(signed_block, "serialize"):
+            self.db.put(PREFIX_UNFINALIZED + bytes(root), signed_block.serialize())
+
+    def persist_finalized_chain(self, store) -> None:
+        """Persist everything at or below the store's finalized checkpoint
+        and refresh the anchor to the finalized state (called by the
+        controller after finality advances)."""
+        p = self.cfg.preset
+        fin_root = bytes(store.finalized_checkpoint.root)
+        node = store.blocks.get(fin_root)
+        if node is None:
+            return
+        items = []
+        # walk the finalized chain down to what we already persisted
+        latest = self.latest_persisted_slot()
+        cursor = node
+        while cursor is not None and cursor.slot > latest:
+            signed = cursor.signed_block
+            if hasattr(signed, "serialize"):
+                raw = signed.serialize()
+                items.append((PREFIX_BLOCK + cursor.root, raw))
+                items.append(
+                    (_slot_key(PREFIX_SLOT_INDEX, cursor.slot), cursor.root)
+                )
+            cursor = store.blocks.get(cursor.parent_root)
+        if items:
+            self.db.put_batch(items)
+        self.db.put(KEY_LATEST_SLOT, int(node.slot).to_bytes(8, "little"))
+        self.persist_anchor(
+            node.state,
+            node.signed_block if hasattr(node.signed_block, "serialize") else None,
+        )
+        # archival state every N epochs
+        epoch = node.slot // p.SLOTS_PER_EPOCH
+        if epoch % self.archival_epoch_interval == 0:
+            self.db.put(
+                _slot_key(PREFIX_ARCHIVAL_STATE, node.slot),
+                node.state.serialize(),
+            )
+        # unfinalized set: everything above finality, for restart replay
+        for root, n in store.blocks.items():
+            if n.slot > node.slot and hasattr(n.signed_block, "serialize"):
+                self.db.put(
+                    PREFIX_UNFINALIZED + root, n.signed_block.serialize()
+                )
+        self._prune_unfinalized(node.slot, store)
+
+    def _prune_unfinalized(self, finalized_slot: int, store) -> None:
+        for key, raw in list(self.db.iterate_prefix(PREFIX_UNFINALIZED)):
+            root = key[len(PREFIX_UNFINALIZED) :]
+            if root in store.blocks and store.blocks[root].slot > finalized_slot:
+                continue
+            self.db.delete(key)
+
+    # --------------------------------------------------------------- loads
+
+    def latest_persisted_slot(self) -> int:
+        raw = self.db.get(KEY_LATEST_SLOT)
+        return int.from_bytes(raw, "little") if raw else -1
+
+    def load_anchor_state(self):
+        raw = self.db.get(KEY_ANCHOR_STATE)
+        return None if raw is None else decode_state(raw, self.cfg)
+
+    def load_unfinalized_blocks(self) -> list:
+        """Unfinalized blocks sorted by slot (restart replay order —
+        controller feeds them back through validation)."""
+        out = []
+        for _key, raw in self.db.iterate_prefix(PREFIX_UNFINALIZED):
+            out.append(decode_signed_block(raw, self.cfg))
+        out.sort(key=lambda b: int(b.message.slot))
+        return out
+
+    def finalized_block_by_root(self, root: bytes):
+        raw = self.db.get(PREFIX_BLOCK + bytes(root))
+        return None if raw is None else decode_signed_block(raw, self.cfg)
+
+    def finalized_root_by_slot(self, slot: int) -> "Optional[bytes]":
+        return self.db.get(_slot_key(PREFIX_SLOT_INDEX, slot))
+
+    def archival_state_at_or_before(self, slot: int):
+        hit = self.db.prev(
+            PREFIX_ARCHIVAL_STATE, int(slot).to_bytes(8, "big")
+        )
+        return None if hit is None else decode_state(hit[1], self.cfg)
+
+    # ------------------------------------------------------ anchor sources
+
+    def load(
+        self,
+        strategy: StateLoadStrategy = StateLoadStrategy.AUTO,
+        anchor_state=None,
+        fetcher: "Optional[Callable[[str], bytes]]" = None,
+    ):
+        """Resolve the anchor state (reference StateLoadStrategy::{Auto,
+        Anchor, Remote}): local DB first under AUTO, explicit state under
+        ANCHOR, `fetcher('finalized_state')` bytes under REMOTE
+        (checkpoint sync — the fetcher is the injected HTTP boundary).
+        Returns (state, unfinalized_blocks)."""
+        if strategy == StateLoadStrategy.ANCHOR:
+            if anchor_state is None:
+                raise ValueError("ANCHOR strategy requires anchor_state")
+            return anchor_state, []
+        if strategy == StateLoadStrategy.REMOTE:
+            if fetcher is None:
+                raise ValueError("REMOTE strategy requires a fetcher")
+            state = decode_state(fetcher("finalized_state"), self.cfg)
+            self.persist_anchor(state)
+            return state, []
+        stored = self.load_anchor_state()
+        if stored is not None:
+            return stored, self.load_unfinalized_blocks()
+        if anchor_state is None:
+            raise ValueError("no stored anchor and no anchor_state given")
+        self.persist_anchor(anchor_state)
+        return anchor_state, []
+
+
+__all__ = ["Storage", "StateLoadStrategy", "DEFAULT_ARCHIVAL_EPOCH_INTERVAL"]

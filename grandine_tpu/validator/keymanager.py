@@ -1,0 +1,256 @@
+"""Keymanager — reference: `keymanager` crate (keystore import/export
+keystores.rs, remote keys remote_keys.rs, proposer configs
+proposer_configs.rs serving the keymanager API) and `eip_2335` (keystore
+crypto: scrypt/PBKDF2 + AES-128-CTR).
+
+EIP-2335 keystores are implemented with hashlib.scrypt / pbkdf2_hmac and a
+CTR-mode AES built on the stdlib — no external crypto dependency. The
+checksum is SHA-256 per the spec.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import secrets
+import uuid
+from typing import Optional
+
+from grandine_tpu.crypto import bls as A
+
+# --- minimal AES-128 (encryption only, used in CTR mode) -------------------
+
+_SBOX = None
+
+
+def _build_sbox():
+    global _SBOX
+    if _SBOX is not None:
+        return _SBOX
+    sbox = [0] * 256
+    p = q = 1
+    sbox[0] = 0x63
+    while True:
+        # multiply p by 3 in GF(2^8)
+        p = p ^ ((p << 1) & 0xFF) ^ (0x1B if p & 0x80 else 0)
+        # divide q by 3
+        q ^= (q << 1) & 0xFF
+        q ^= (q << 2) & 0xFF
+        q ^= (q << 4) & 0xFF
+        q ^= 0x09 if q & 0x80 else 0
+        x = q ^ ((q << 1) | (q >> 7)) & 0xFF ^ ((q << 2) | (q >> 6)) & 0xFF \
+            ^ ((q << 3) | (q >> 5)) & 0xFF ^ ((q << 4) | (q >> 4)) & 0xFF
+        sbox[p] = (x ^ 0x63) & 0xFF
+        if p == 1:
+            break
+    _SBOX = sbox
+    return sbox
+
+
+def _aes128_expand_key(key: bytes):
+    sbox = _build_sbox()
+    rcon = 1
+    words = [list(key[i : i + 4]) for i in range(0, 16, 4)]
+    for i in range(4, 44):
+        w = list(words[i - 1])
+        if i % 4 == 0:
+            w = w[1:] + w[:1]
+            w = [sbox[b] for b in w]
+            w[0] ^= rcon
+            rcon = ((rcon << 1) ^ 0x1B) & 0xFF if rcon & 0x80 else rcon << 1
+        words.append([a ^ b for a, b in zip(words[i - 4], w)])
+    return words
+
+
+def _aes128_encrypt_block(block: bytes, words) -> bytes:
+    sbox = _build_sbox()
+    state = [list(block[i::4]) for i in range(4)]  # column-major
+
+    def add_round_key(rnd):
+        for c in range(4):
+            for r in range(4):
+                state[r][c] ^= words[rnd * 4 + c][r]
+
+    def sub_shift():
+        for r in range(4):
+            row = [sbox[b] for b in state[r]]
+            state[r] = row[r:] + row[:r]
+
+    def xtime(b):
+        return ((b << 1) ^ 0x1B) & 0xFF if b & 0x80 else b << 1
+
+    def mix():
+        for c in range(4):
+            a = [state[r][c] for r in range(4)]
+            state[0][c] = xtime(a[0]) ^ (xtime(a[1]) ^ a[1]) ^ a[2] ^ a[3]
+            state[1][c] = a[0] ^ xtime(a[1]) ^ (xtime(a[2]) ^ a[2]) ^ a[3]
+            state[2][c] = a[0] ^ a[1] ^ xtime(a[2]) ^ (xtime(a[3]) ^ a[3])
+            state[3][c] = (xtime(a[0]) ^ a[0]) ^ a[1] ^ a[2] ^ xtime(a[3])
+
+    add_round_key(0)
+    for rnd in range(1, 10):
+        sub_shift()
+        mix()
+        add_round_key(rnd)
+    sub_shift()
+    add_round_key(10)
+    return bytes(state[r][c] for c in range(4) for r in range(4))
+
+
+def _aes128_ctr(key: bytes, iv: bytes, data: bytes) -> bytes:
+    words = _aes128_expand_key(key)
+    out = bytearray()
+    counter = int.from_bytes(iv, "big")
+    for i in range(0, len(data), 16):
+        keystream = _aes128_encrypt_block(
+            counter.to_bytes(16, "big"), words
+        )
+        chunk = data[i : i + 16]
+        out += bytes(a ^ b for a, b in zip(chunk, keystream))
+        counter = (counter + 1) % (1 << 128)
+    return bytes(out)
+
+
+# --- EIP-2335 keystores -----------------------------------------------------
+
+
+def encrypt_keystore(
+    secret_key: "A.SecretKey",
+    password: str,
+    path: str = "m/12381/3600/0/0/0",
+    kdf: str = "pbkdf2",
+) -> dict:
+    """EIP-2335 keystore JSON (pbkdf2 or scrypt KDF)."""
+    salt = secrets.token_bytes(32)
+    if kdf == "scrypt":
+        dk = hashlib.scrypt(
+            password.encode(), salt=salt, n=262144, r=8, p=1, dklen=32,
+            maxmem=512 * 1024 * 1024,
+        )
+        kdf_module = {
+            "function": "scrypt",
+            "params": {"dklen": 32, "n": 262144, "p": 1, "r": 8,
+                       "salt": salt.hex()},
+            "message": "",
+        }
+    else:
+        dk = hashlib.pbkdf2_hmac(
+            "sha256", password.encode(), salt, 262144, dklen=32
+        )
+        kdf_module = {
+            "function": "pbkdf2",
+            "params": {"dklen": 32, "c": 262144, "prf": "hmac-sha256",
+                       "salt": salt.hex()},
+            "message": "",
+        }
+    iv = secrets.token_bytes(16)
+    secret = secret_key.to_bytes()
+    cipher_text = _aes128_ctr(dk[:16], iv, secret)
+    checksum = hashlib.sha256(dk[16:32] + cipher_text).digest()
+    return {
+        "crypto": {
+            "kdf": kdf_module,
+            "checksum": {"function": "sha256", "params": {},
+                         "message": checksum.hex()},
+            "cipher": {"function": "aes-128-ctr", "params": {"iv": iv.hex()},
+                       "message": cipher_text.hex()},
+        },
+        "path": path,
+        "pubkey": secret_key.public_key().to_bytes().hex(),
+        "uuid": str(uuid.uuid4()),
+        "version": 4,
+    }
+
+
+def decrypt_keystore(keystore: dict, password: str) -> "A.SecretKey":
+    crypto = keystore["crypto"]
+    kdf = crypto["kdf"]
+    params = kdf["params"]
+    salt = bytes.fromhex(params["salt"])
+    if kdf["function"] == "scrypt":
+        dk = hashlib.scrypt(
+            password.encode(), salt=salt, n=params["n"], r=params["r"],
+            p=params["p"], dklen=params["dklen"],
+            maxmem=512 * 1024 * 1024,
+        )
+    elif kdf["function"] == "pbkdf2":
+        dk = hashlib.pbkdf2_hmac(
+            "sha256", password.encode(), salt, params["c"],
+            dklen=params["dklen"],
+        )
+    else:
+        raise ValueError(f"unsupported KDF {kdf['function']}")
+    cipher_text = bytes.fromhex(crypto["cipher"]["message"])
+    checksum = hashlib.sha256(dk[16:32] + cipher_text).digest()
+    if checksum.hex() != crypto["checksum"]["message"]:
+        raise ValueError("keystore checksum mismatch (wrong password?)")
+    iv = bytes.fromhex(crypto["cipher"]["params"]["iv"])
+    secret = _aes128_ctr(dk[:16], iv, cipher_text)
+    return A.SecretKey.from_bytes(secret)
+
+
+# --- keymanager surface -----------------------------------------------------
+
+
+class KeyManager:
+    """Local keystore import/export + proposer configs (the keymanager-API
+    backend: keystores.rs, proposer_configs.rs)."""
+
+    def __init__(self, signer, slashing_protection=None) -> None:
+        self.signer = signer
+        self.slashing_protection = slashing_protection
+        self.proposer_configs: "dict[bytes, dict]" = {}
+
+    def import_keystores(
+        self, keystores: "list[dict]", passwords: "list[str]"
+    ) -> "list[dict]":
+        out = []
+        for ks, pw in zip(keystores, passwords):
+            try:
+                sk = decrypt_keystore(ks, pw)
+                pk = self.signer.add_key(sk)
+                out.append({"status": "imported",
+                            "message": "0x" + pk.hex()})
+            except Exception as e:
+                out.append({"status": "error", "message": repr(e)})
+        return out
+
+    def list_keystores(self) -> "list[dict]":
+        return [
+            {"validating_pubkey": "0x" + pk.hex(), "derivation_path": "",
+             "readonly": False}
+            for pk in self.signer.pubkeys()
+        ]
+
+    def delete_keystores(self, pubkeys: "list[bytes]") -> "list[dict]":
+        out = []
+        for pk in pubkeys:
+            removed = self.signer.remove_key(pk)
+            out.append({"status": "deleted" if removed else "not_found"})
+        return out
+
+    def set_fee_recipient(self, pubkey: bytes, address: bytes) -> None:
+        self.proposer_configs.setdefault(bytes(pubkey), {})[
+            "fee_recipient"
+        ] = bytes(address)
+
+    def set_gas_limit(self, pubkey: bytes, gas_limit: int) -> None:
+        self.proposer_configs.setdefault(bytes(pubkey), {})[
+            "gas_limit"
+        ] = int(gas_limit)
+
+    def set_graffiti(self, pubkey: bytes, graffiti: bytes) -> None:
+        self.proposer_configs.setdefault(bytes(pubkey), {})[
+            "graffiti"
+        ] = bytes(graffiti)
+
+    def proposer_config(self, pubkey: bytes) -> dict:
+        return dict(self.proposer_configs.get(bytes(pubkey), {}))
+
+
+__all__ = [
+    "encrypt_keystore",
+    "decrypt_keystore",
+    "KeyManager",
+]

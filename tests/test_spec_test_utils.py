@@ -30,9 +30,15 @@ def test_snappy_raw_block_decode():
 
 def test_snappy_checksum_rejected():
     good = bytearray(frame_compress(b"payload"))
-    good[11] ^= 0xFF  # corrupt the CRC
-    with pytest.raises(ValueError, match="checksum|snappy"):
+    # layout: 10-byte stream id, 4-byte chunk header, then the 4-byte CRC
+    good[14] ^= 0xFF  # corrupt the CRC itself
+    with pytest.raises(ValueError, match="checksum mismatch"):
         frame_decompress(bytes(good))
+    # and corrupting the payload (after the CRC) must also be caught
+    bad = bytearray(frame_compress(b"payload"))
+    bad[-1] ^= 0xFF
+    with pytest.raises(ValueError, match="checksum mismatch"):
+        frame_decompress(bytes(bad))
 
 
 def test_case_loader(tmp_path):
