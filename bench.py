@@ -1,8 +1,11 @@
 """Headline benchmark: device RLC batch BLS verification throughput.
 
-Measures signatures/second through `multi_verify_kernel` (the 50k-validator
-attestation batch-verify plane, BASELINE.md config 2) on whatever accelerator
-JAX finds (the driver runs this on one real TPU chip).
+Measures signatures/second through the grouped RLC verify kernel (the
+50k-validator attestation batch-verify plane, BASELINE.md config 2: N
+signatures over BENCH_MSGS distinct attestation messages — the real shape
+of gossip/block traffic) on whatever accelerator JAX finds (the driver
+runs this on one real TPU chip). BENCH_GROUPED=0 falls back to the flat
+(one-Miller-loop-per-signature) kernel.
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "sigs/s", "vs_baseline": N}
@@ -106,8 +109,10 @@ def _enable_compilation_cache() -> None:
 
 
 def main() -> None:
-    n = int(os.environ.get("BENCH_N", "512"))
-    n_msgs = int(os.environ.get("BENCH_MSGS", "8"))
+    # defaults = the measured single-chip sweet spot (n=32768 regresses on
+    # HBM pressure, n=65536 crashes the worker; see README perf table)
+    n = int(os.environ.get("BENCH_N", "16384"))
+    n_msgs = int(os.environ.get("BENCH_MSGS", "64"))
     grouped = os.environ.get("BENCH_GROUPED", "1") != "0"
     try:
         import jax

@@ -111,15 +111,23 @@ def _node_once(args, cfg) -> int:
     metrics = Metrics()
     genesis = interop_genesis_state(args.validators, cfg)
 
-    try:
-        stored, _ = storage.load(anchor_state=genesis)
-    except ValueError:
-        stored = genesis
+    stored, unfinalized = storage.load(anchor_state=genesis)
 
     node = InProcessNode(stored, cfg, use_device_firehose=args.use_device)
     node.controller.storage = storage
     node.controller.store.pre_prune_hook = node.controller._persist_finalized
     node.controller.metrics = metrics
+    if unfinalized:
+        # crash-restart: replay the persisted unfinalized head so we don't
+        # regress to finality and double-propose already-signed slots
+        from grandine_tpu.fork_choice.store import Tick, TickKind
+
+        max_slot = max(int(b.message.slot) for b in unfinalized)
+        node.controller.on_tick(Tick(max_slot, TickKind.AGGREGATE))
+        for blk in unfinalized:
+            node.controller.on_requested_block(blk)
+        node.controller.wait()
+        print(f"restored {len(unfinalized)} unfinalized blocks from storage")
 
     server = None
     if args.http_port:
@@ -205,39 +213,36 @@ def cmd_import_interchange(args) -> int:
 
 
 def cmd_replay(args) -> int:
-    """Re-validate the stored finalized chain (the ad_hoc_bench shape)."""
-    from grandine_tpu.consensus.verifier import MultiVerifier
+    """Re-validate the stored finalized chain from its first anchor with
+    full batch signature verification (the ad_hoc_bench shape)."""
+    from grandine_tpu.consensus.verifier import MultiVerifier, TpuVerifier
     from grandine_tpu.storage import Database, Storage
-    from grandine_tpu.transition.combined import untrusted_state_transition
+    from grandine_tpu.transition.combined import custom_state_transition
 
     cfg = load_config(args)
     db = Database.persistent(os.path.join(args.data_dir, "chain.sqlite"))
     storage = Storage(db, cfg)
-    state = storage.load_anchor_state()
-    if state is None:
+    start_state = storage.load_genesis_state()
+    if start_state is None:
         print("no stored chain", file=sys.stderr)
         return 1
-    # walk the canonical slot index forward from the archival state
-    archival = storage.archival_state_at_or_before(0)
-    start_state = archival if archival is not None else state
+    latest = storage.latest_persisted_slot()
     n = 0
     t0 = time.time()
-    slot = int(start_state.slot) + 1
     cur = start_state
-    while True:
+    for slot in range(int(start_state.slot) + 1, latest + 1):
         root = storage.finalized_root_by_slot(slot)
         if root is None:
-            if slot > storage.latest_persisted_slot():
-                break
-            slot += 1
-            continue
+            continue  # empty slot
         blk = storage.finalized_block_by_root(root)
-        cur = untrusted_state_transition(cur, blk, cfg)
+        verifier = TpuVerifier() if args.use_device else MultiVerifier()
+        cur = custom_state_transition(cur, blk, cfg, verifier)
         n += 1
-        slot += 1
     dt = time.time() - t0
-    print(f"replayed {n} blocks in {dt:.1f}s "
-          f"({n / dt:.1f} blocks/s)" if n else "nothing to replay")
+    if n:
+        print(f"replayed {n} blocks in {dt:.1f}s ({n / dt:.1f} blocks/s)")
+    else:
+        print("nothing to replay")
     return 0
 
 

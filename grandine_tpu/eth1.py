@@ -1,0 +1,117 @@
+"""Eth1 deposit plane — reference: `eth1` crate (deposit/block cache +
+genesis detection, eth1/src/lib.rs) and `deposit_tree` (incremental
+Merkle tree the proposer proves deposits against), with the eth1 data
+voting helpers the validator uses.
+
+The JSON-RPC fetch boundary is injected (like the checkpoint-sync
+fetcher); everything else — the incremental tree, proof production for
+block inclusion, vote selection — is real.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+from grandine_tpu.ssz.merkle import MerkleTree
+from grandine_tpu.types.primitives import DEPOSIT_CONTRACT_TREE_DEPTH
+
+
+class DepositRecord:
+    __slots__ = ("index", "data", "block_number")
+
+    def __init__(self, index: int, data, block_number: int = 0) -> None:
+        self.index = index
+        self.data = data  # DepositData container
+        self.block_number = block_number
+
+
+class Eth1Cache:
+    """Deposit log cache + the incremental deposit tree
+    (eth1 crate + deposit_tree crate)."""
+
+    def __init__(self, cfg) -> None:
+        self.cfg = cfg
+        self.tree = MerkleTree(DEPOSIT_CONTRACT_TREE_DEPTH, track_leaves=True)
+        self.deposits: "list[DepositRecord]" = []
+
+    # ------------------------------------------------------------ ingest
+
+    def add_deposit(self, data, block_number: int = 0) -> DepositRecord:
+        """One deposit event from the contract log stream, in order."""
+        record = DepositRecord(len(self.deposits), data, block_number)
+        self.tree.push(data.hash_tree_root())
+        self.deposits.append(record)
+        return record
+
+    def follow(self, fetch_logs: "Callable[[int], Sequence]") -> int:
+        """Pull new logs via the injected fetcher (the eth1 JSON-RPC
+        boundary): fetch_logs(next_index) -> iterable of DepositData."""
+        added = 0
+        for data in fetch_logs(len(self.deposits)):
+            self.add_deposit(data)
+            added += 1
+        return added
+
+    # ------------------------------------------------------------- views
+
+    @property
+    def deposit_count(self) -> int:
+        return len(self.deposits)
+
+    def deposit_root(self) -> bytes:
+        """The deposit contract's root (length-mixed)."""
+        return self.tree.root_with_length()
+
+    def eth1_data(self, types_ns, block_hash: bytes = b"\x00" * 32):
+        return types_ns.Eth1Data(
+            deposit_root=self.deposit_root(),
+            deposit_count=self.deposit_count,
+            block_hash=block_hash,
+        )
+
+    # ---------------------------------------------------------- proposing
+
+    def deposits_for_block(self, state, types_ns) -> list:
+        """The deposits a proposer must include, with inclusion proofs
+        against the STATE's eth1_data (spec: min(MAX_DEPOSITS, pending)).
+        Proofs are built over the first `state.eth1_data.deposit_count`
+        leaves — the tree snapshot the state committed to, not the cache's
+        (possibly newer) tip."""
+        from grandine_tpu.ssz.merkle import merkle_branch
+
+        p = self.cfg.preset
+        start = int(state.eth1_deposit_index)
+        state_count = int(state.eth1_data.deposit_count)
+        want = min(p.MAX_DEPOSITS, max(0, state_count - start))
+        if want == 0:
+            return []
+        leaves = [r.data.hash_tree_root() for r in self.deposits[:state_count]]
+        out = []
+        for i in range(start, start + want):
+            proof = merkle_branch(
+                leaves, i, DEPOSIT_CONTRACT_TREE_DEPTH
+            ) + [state_count.to_bytes(32, "little")]
+            out.append(
+                types_ns.Deposit(proof=proof, data=self.deposits[i].data)
+            )
+        return out
+
+
+def select_eth1_vote(state, candidates, cfg):
+    """Majority vote selection from the state's current voting period
+    (validator/src/eth1_storage.rs shape): pick the candidate with the
+    most existing votes, defaulting to the state's current eth1_data."""
+    votes = list(state.eth1_data_votes)
+    counts: dict = {}
+    for v in votes:
+        counts[v.hash_tree_root()] = counts.get(v.hash_tree_root(), 0) + 1
+    best = None
+    best_count = 0
+    for cand in candidates:
+        c = counts.get(cand.hash_tree_root(), 0)
+        if c > best_count:
+            best, best_count = cand, c
+    return best if best is not None else state.eth1_data
+
+
+__all__ = ["Eth1Cache", "DepositRecord", "select_eth1_vote"]

@@ -234,11 +234,15 @@ def get_state_validators(ctx, params, query, body):
     p = ctx.cfg.preset
     epoch = accessors.get_current_epoch(state, p)
     ids = query.get("id")
-    indices = (
-        [int(i) for i in ids.split(",")]
-        if ids
-        else range(len(state.validators))
-    )
+    if ids:
+        try:
+            indices = [int(i) for i in ids.split(",")]
+        except ValueError:
+            raise ApiError(400, f"invalid validator id list {ids!r}") from None
+        if any(i < 0 for i in indices):
+            raise ApiError(400, "validator indices must be non-negative")
+    else:
+        indices = range(len(state.validators))
     rows = []
     for i in indices:
         if i >= len(state.validators):

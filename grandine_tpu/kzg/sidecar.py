@@ -33,24 +33,7 @@ def inclusion_proof_depth(body_cls, p) -> int:
     return body_depth + 1 + list_depth  # +1: list length mixin
 
 
-def _merkle_branch(leaves: "list[bytes]", index: int, depth: int) -> "list[bytes]":
-    """Sibling path for `leaves[index]` in a zero-padded depth-`depth` tree."""
-    branch = []
-    level = list(leaves)
-    idx = index
-    for d in range(depth):
-        sibling = idx ^ 1
-        branch.append(
-            level[sibling] if sibling < len(level) else hashing.ZERO_HASHES[d]
-        )
-        if len(level) % 2:
-            level = level + [hashing.ZERO_HASHES[d]]
-        level = [
-            hashing.hash_pair(level[i], level[i + 1])
-            for i in range(0, len(level), 2)
-        ]
-        idx >>= 1
-    return branch
+from grandine_tpu.ssz.merkle import merkle_branch as _merkle_branch  # noqa: E402
 
 
 def build_commitment_inclusion_proof(body, index: int, p) -> "list[bytes]":

@@ -1,0 +1,20 @@
+"""Networking — reference: `eth2_libp2p` (the Lighthouse-derived WAN stack:
+gossipsub, discv5, req/resp protocols) and the `p2p` crate (service loop
+`Network::run`, gossip dispatch, `BlockSyncService`/`SyncManager` range
+tracking, `back_sync`, `BlockVerificationPool`).
+
+The WAN transport is abstracted behind `Transport` (publish/subscribe +
+req/resp); `InMemoryHub` provides a process-local mesh so multi-node
+behavior is testable in-repo (the reference tests only at channel
+boundaries — SURVEY §4.3). Topic names and SSZ-snappy payload encoding
+follow the consensus network spec, so a real libp2p transport drops in
+behind the same interface.
+"""
+
+from grandine_tpu.p2p.network import (  # noqa: F401
+    GossipTopics,
+    InMemoryHub,
+    Network,
+    Transport,
+)
+from grandine_tpu.p2p.sync import BlockSyncService, SyncManager  # noqa: F401

@@ -1,0 +1,246 @@
+"""Gossip transport + network service — reference: p2p/src/network.rs
+(`Network::run` select loop :204, gossip dispatch :1411-1445, publishes
+:539-560) over the eth2_libp2p behaviours.
+
+`Transport` is the seam a libp2p backend implements; `InMemoryHub` is the
+in-process mesh used by tests and the devnet. Payloads on the wire are
+ssz_snappy (the real encoding), topics carry the fork digest.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Callable, Optional
+
+from grandine_tpu.consensus import misc
+from grandine_tpu.spec_tests.snappy import frame_compress, frame_decompress
+
+
+class GossipTopics:
+    """Topic name construction (consensus networking spec)."""
+
+    @staticmethod
+    def fork_digest(cfg, state) -> bytes:
+        return misc.compute_fork_digest(
+            bytes(state.fork.current_version),
+            bytes(state.genesis_validators_root),
+        )
+
+    @staticmethod
+    def beacon_block(digest: bytes) -> str:
+        return f"/eth2/{digest.hex()}/beacon_block/ssz_snappy"
+
+    @staticmethod
+    def beacon_attestation(digest: bytes, subnet: int) -> str:
+        return f"/eth2/{digest.hex()}/beacon_attestation_{subnet}/ssz_snappy"
+
+    @staticmethod
+    def aggregate_and_proof(digest: bytes) -> str:
+        return f"/eth2/{digest.hex()}/beacon_aggregate_and_proof/ssz_snappy"
+
+    @staticmethod
+    def voluntary_exit(digest: bytes) -> str:
+        return f"/eth2/{digest.hex()}/voluntary_exit/ssz_snappy"
+
+
+class Transport:
+    """What a WAN backend provides: pubsub + the BlocksByRange req/resp."""
+
+    def publish(self, topic: str, payload: bytes) -> None:
+        raise NotImplementedError
+
+    def subscribe(self, topic: str, handler: "Callable[[str, bytes], None]") -> None:
+        raise NotImplementedError
+
+    def peers(self) -> "list[str]":
+        raise NotImplementedError
+
+    def request_blocks_by_range(
+        self, peer: str, start_slot: int, count: int
+    ) -> "list[bytes]":
+        raise NotImplementedError
+
+    def request_status(self, peer: str) -> dict:
+        raise NotImplementedError
+
+
+class InMemoryHub:
+    """Process-local gossip mesh + req/resp: every joined transport sees
+    every publish (except its own); range/status requests are served by
+    peer-registered providers."""
+
+    def __init__(self) -> None:
+        self._subs: "dict[str, list[tuple[str, Callable]]]" = defaultdict(list)
+        self._providers: "dict[str, dict]" = {}
+        self._lock = threading.Lock()
+
+    def join(self, peer_id: str) -> "Transport":
+        return _HubTransport(self, peer_id)
+
+    def register_provider(
+        self, peer_id: str,
+        blocks_by_range: "Callable[[int, int], list[bytes]]",
+        status: "Callable[[], dict]",
+    ) -> None:
+        with self._lock:
+            self._providers[peer_id] = {
+                "blocks_by_range": blocks_by_range,
+                "status": status,
+            }
+
+    # -- hub internals ------------------------------------------------------
+
+    def _publish(self, sender: str, topic: str, payload: bytes) -> None:
+        with self._lock:
+            handlers = list(self._subs.get(topic, ()))
+        for peer_id, handler in handlers:
+            if peer_id != sender:
+                handler(topic, payload)
+
+    def _subscribe(self, peer_id: str, topic: str, handler) -> None:
+        with self._lock:
+            self._subs[topic].append((peer_id, handler))
+
+    def _peers(self, excluding: str) -> "list[str]":
+        with self._lock:
+            return [p for p in self._providers if p != excluding]
+
+    def _request(self, peer: str, what: str, *args):
+        with self._lock:
+            provider = self._providers.get(peer)
+        if provider is None:
+            raise ConnectionError(f"unknown peer {peer}")
+        return provider[what](*args)
+
+
+class _HubTransport(Transport):
+    def __init__(self, hub: InMemoryHub, peer_id: str) -> None:
+        self.hub = hub
+        self.peer_id = peer_id
+
+    def publish(self, topic, payload):
+        self.hub._publish(self.peer_id, topic, payload)
+
+    def subscribe(self, topic, handler):
+        self.hub._subscribe(self.peer_id, topic, handler)
+
+    def peers(self):
+        return self.hub._peers(self.peer_id)
+
+    def request_blocks_by_range(self, peer, start_slot, count):
+        return self.hub._request(peer, "blocks_by_range", start_slot, count)
+
+    def request_status(self, peer):
+        return self.hub._request(peer, "status")
+
+
+class Network:
+    """The service loop glue (network.rs): gossip in → controller /
+    attestation firehose; own objects → gossip out; serves BlocksByRange
+    and Status to peers from the store + storage."""
+
+    def __init__(
+        self,
+        transport: Transport,
+        controller,
+        cfg,
+        attestation_verifier=None,
+        storage=None,
+    ) -> None:
+        self.transport = transport
+        self.controller = controller
+        self.cfg = cfg
+        self.attestation_verifier = attestation_verifier
+        self.storage = storage
+        snap = controller.snapshot()
+        self.digest = GossipTopics.fork_digest(cfg, snap.head_state)
+        self.stats = defaultdict(int)
+
+        transport.subscribe(
+            GossipTopics.beacon_block(self.digest), self._on_gossip_block
+        )
+        p = cfg.preset
+        for subnet in range(min(cfg.attestation_subnet_count, 64)):
+            transport.subscribe(
+                GossipTopics.beacon_attestation(self.digest, subnet),
+                self._on_gossip_attestation,
+            )
+        if hasattr(transport, "hub"):
+            transport.hub.register_provider(
+                transport.peer_id, self._serve_blocks_by_range, self._serve_status
+            )
+
+    # ------------------------------------------------------------ inbound
+
+    def _on_gossip_block(self, topic: str, payload: bytes) -> None:
+        from grandine_tpu.types.combined import decode_signed_block
+
+        self.stats["blocks_in"] += 1
+        try:
+            block = decode_signed_block(frame_decompress(payload), self.cfg)
+        except Exception:
+            self.stats["decode_failures"] += 1
+            return
+        self.controller.on_gossip_block(block)
+
+    def _on_gossip_attestation(self, topic: str, payload: bytes) -> None:
+        from grandine_tpu.types.combined import decode_attestation
+
+        self.stats["attestations_in"] += 1
+        if self.attestation_verifier is None:
+            return
+        try:
+            slot = self.controller.snapshot().slot
+            att = decode_attestation(frame_decompress(payload), self.cfg, slot)
+        except Exception:
+            self.stats["decode_failures"] += 1
+            return
+        self.attestation_verifier.submit(att)
+
+    # ----------------------------------------------------------- outbound
+
+    def publish_block(self, signed_block) -> None:
+        self.stats["blocks_out"] += 1
+        self.transport.publish(
+            GossipTopics.beacon_block(self.digest),
+            frame_compress(signed_block.serialize()),
+        )
+
+    def publish_attestation(self, attestation, subnet: int = 0) -> None:
+        self.stats["attestations_out"] += 1
+        self.transport.publish(
+            GossipTopics.beacon_attestation(self.digest, subnet),
+            frame_compress(attestation.serialize()),
+        )
+
+    # ------------------------------------------------------------ serving
+
+    def _serve_blocks_by_range(self, start_slot: int, count: int) -> "list[bytes]":
+        out = []
+        store = self.controller.store
+        by_slot = {}
+        for node in store.blocks.values():
+            if hasattr(node.signed_block, "serialize"):
+                by_slot[node.slot] = node.signed_block
+        for slot in range(start_slot, start_slot + count):
+            block = by_slot.get(slot)
+            if block is None and self.storage is not None:
+                root = self.storage.finalized_root_by_slot(slot)
+                if root is not None:
+                    block = self.storage.finalized_block_by_root(root)
+            if block is not None:
+                out.append(block.serialize())
+        return out
+
+    def _serve_status(self) -> dict:
+        snap = self.controller.snapshot()
+        return {
+            "head_slot": int(snap.head_state.slot),
+            "head_root": snap.head_root.hex(),
+            "finalized_epoch": int(snap.finalized_checkpoint.epoch),
+            "fork_digest": self.digest.hex(),
+        }
+
+
+__all__ = ["GossipTopics", "Transport", "InMemoryHub", "Network"]

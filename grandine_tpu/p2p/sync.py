@@ -1,0 +1,175 @@
+"""Block sync — reference: p2p/src/block_sync_service.rs + sync_manager.rs
+(range/root request tracking), back_sync.rs (reverse fill to genesis with
+batch verification), block_verification_pool.rs:76-129 (two-epoch block
+batches verified against one head state).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from grandine_tpu.consensus.verifier import MultiVerifier, NullVerifier
+from grandine_tpu.types.combined import decode_signed_block
+
+
+class SyncManager:
+    """Tracks peer statuses and picks sync targets
+    (sync_manager.rs / range_and_root_requests.rs)."""
+
+    def __init__(self, transport) -> None:
+        self.transport = transport
+        self.peer_status: "dict[str, dict]" = {}
+
+    def refresh(self) -> None:
+        for peer in self.transport.peers():
+            try:
+                self.peer_status[peer] = self.transport.request_status(peer)
+            except ConnectionError:
+                self.peer_status.pop(peer, None)
+
+    def best_peer(self) -> "Optional[str]":
+        if not self.peer_status:
+            return None
+        return max(
+            self.peer_status, key=lambda p: self.peer_status[p]["head_slot"]
+        )
+
+    def target_slot(self) -> int:
+        return max(
+            (s["head_slot"] for s in self.peer_status.values()), default=0
+        )
+
+
+class BlockSyncService:
+    """Forward range sync: while the head lags the best peer, request
+    slot ranges and feed them through the controller's normal validation
+    (block_sync_service shape; the controller's delayed-maps handle
+    out-of-order arrival)."""
+
+    def __init__(self, transport, controller, cfg,
+                 batch_size: "Optional[int]" = None) -> None:
+        self.transport = transport
+        self.controller = controller
+        self.cfg = cfg
+        self.sync_manager = SyncManager(transport)
+        # two epochs per round, like the reference's verification pool
+        self.batch_size = batch_size or 2 * cfg.preset.SLOTS_PER_EPOCH
+        self.stats = {"requested": 0, "applied_batches": 0}
+
+    def sync_once(self) -> bool:
+        """One round: returns True when more work remains."""
+        self.sync_manager.refresh()
+        peer = self.sync_manager.best_peer()
+        if peer is None:
+            return False
+        snap = self.controller.snapshot()
+        head_slot = int(snap.head_state.slot)
+        target = self.sync_manager.target_slot()
+        if head_slot >= target:
+            return False
+        start = head_slot + 1
+        raw_blocks = self.transport.request_blocks_by_range(
+            peer, start, self.batch_size
+        )
+        self.stats["requested"] += len(raw_blocks)
+        # advance the local clock to the sync target so requested blocks
+        # aren't parked in the delayed-until-slot map
+        from grandine_tpu.fork_choice.store import Tick, TickKind
+
+        self.controller.on_tick(Tick(target, TickKind.AGGREGATE))
+        for raw in raw_blocks:
+            block = decode_signed_block(raw, self.cfg)
+            self.controller.on_requested_block(block)
+        self.controller.wait()
+        self.stats["applied_batches"] += 1
+        return int(self.controller.snapshot().head_state.slot) < target
+
+    def sync_to_head(self, max_rounds: int = 1000) -> None:
+        for _ in range(max_rounds):
+            if not self.sync_once():
+                return
+        raise TimeoutError("sync did not converge")
+
+
+def back_sync(storage, transport, cfg, anchor_slot: int,
+              peer: "Optional[str]" = None, batch_size: int = 64,
+              verify: bool = True) -> int:
+    """Reverse-fill history below a checkpoint anchor down to genesis
+    (back_sync.rs): request ranges below `anchor_slot`, check hash-chain
+    linkage child->parent, persist to the finalized schema. Returns the
+    number of blocks stored.
+
+    With verify=True the linkage to the trusted anchor root guards
+    integrity (the reference trusts back-synced signature batches behind
+    `TrustBackSyncBlocks`; full signature re-verification would need the
+    historical states)."""
+    from grandine_tpu.storage.storage import (
+        PREFIX_BLOCK,
+        PREFIX_SLOT_INDEX,
+        _slot_key,
+    )
+
+    if peer is None:
+        peers = transport.peers()
+        if not peers:
+            return 0
+        peer = peers[0]
+
+    stored = 0
+    # expected root of the next (lower) block comes from the anchor chain
+    anchor_root = storage.finalized_root_by_slot(anchor_slot)
+    expected_parent = None
+    if anchor_root is not None:
+        anchor_block = storage.finalized_block_by_root(anchor_root)
+        if anchor_block is not None:
+            expected_parent = bytes(anchor_block.message.parent_root)
+
+    slot_hi = anchor_slot - 1
+    while slot_hi >= 0:
+        start = max(0, slot_hi - batch_size + 1)
+        raws = transport.request_blocks_by_range(peer, start, slot_hi - start + 1)
+        if not raws:
+            break
+        blocks = [decode_signed_block(r, cfg) for r in raws]
+        blocks.sort(key=lambda b: -int(b.message.slot))  # high -> low
+        items = []
+        for block in blocks:
+            root = block.message.hash_tree_root()
+            if verify and expected_parent is not None and root != expected_parent:
+                continue  # not on the anchored chain
+            items.append((PREFIX_BLOCK + root, block.serialize()))
+            items.append(
+                (_slot_key(PREFIX_SLOT_INDEX, int(block.message.slot)), root)
+            )
+            expected_parent = bytes(block.message.parent_root)
+            stored += 1
+        storage.db.put_batch(items)
+        slot_hi = start - 1
+        if start == 0:
+            break
+    return stored
+
+
+def verify_block_batch(anchor_state, blocks, cfg, use_device: bool = False):
+    """Two-epoch batch verification against one base state
+    (block_verification_pool.rs:76-129): replay each block with a fresh
+    MultiVerifier (one RLC batch per block), returning the post states.
+    Raises on the first invalid block."""
+    from grandine_tpu.consensus.verifier import TpuVerifier
+    from grandine_tpu.transition.combined import custom_state_transition
+
+    state = anchor_state
+    posts = []
+    for block in blocks:
+        verifier = TpuVerifier() if use_device else MultiVerifier()
+        state = custom_state_transition(state, block, cfg, verifier)
+        posts.append(state)
+    return posts
+
+
+__all__ = [
+    "SyncManager",
+    "BlockSyncService",
+    "back_sync",
+    "verify_block_batch",
+]

@@ -1,0 +1,120 @@
+"""Slasher — reference: `slasher` crate (slasher/src/slasher.rs:50:
+surround/double-vote detection over mdbx DBs of indexed attestations and
+min/max target spans, plus proposer double-block detection; emits
+slashings toward the proposer pipeline).
+
+Detection model (per validator):
+  - double vote:    two distinct attestation data with the same target epoch
+  - surround vote:  recorded (s,t) surrounds or is surrounded by a new one
+  - double block:   two distinct block roots signed for the same slot
+
+Backed by the Database layer; bounded history window like the reference's
+pruned span DBs.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from grandine_tpu.storage.database import Database
+
+_PREFIX_ATT = b"sl:a:"    # validator_index_be8 -> json {target: [source, data_root, sig?]}
+_PREFIX_BLOCK = b"sl:b:"  # validator_index_be8 + slot_be8 -> header root
+
+
+class Slashing:
+    """A detected offense with the evidence needed to build the on-chain
+    operation."""
+
+    __slots__ = ("kind", "validator_index", "evidence")
+
+    def __init__(self, kind: str, validator_index: int, evidence: dict) -> None:
+        self.kind = kind
+        self.validator_index = validator_index
+        self.evidence = evidence
+
+    def __repr__(self) -> str:
+        return f"Slashing({self.kind}, validator={self.validator_index})"
+
+
+class Slasher:
+    def __init__(self, database: "Optional[Database]" = None,
+                 history_epochs: int = 4096) -> None:
+        self.db = database or Database.in_memory()
+        self.history_epochs = history_epochs
+        self.detected: "list[Slashing]" = []
+
+    # -------------------------------------------------------- attestations
+
+    def _key(self, index: int) -> bytes:
+        return _PREFIX_ATT + int(index).to_bytes(8, "big")
+
+    def _records(self, index: int) -> dict:
+        raw = self.db.get(self._key(index))
+        return json.loads(raw) if raw else {}
+
+    def on_attestation(
+        self, attesting_indices, source_epoch: int, target_epoch: int,
+        data_root: bytes,
+    ) -> "list[Slashing]":
+        """Record one indexed attestation; returns any detected offenses."""
+        out = []
+        for i in attesting_indices:
+            i = int(i)
+            records = self._records(i)
+            hit = self._check(i, records, source_epoch, target_epoch, data_root)
+            if hit is not None:
+                out.append(hit)
+            records[str(target_epoch)] = [source_epoch, data_root.hex()]
+            # prune outside the history window
+            floor = target_epoch - self.history_epochs
+            for k in [k for k in records if int(k) < floor]:
+                del records[k]
+            self.db.put(self._key(i), json.dumps(records).encode())
+        self.detected.extend(out)
+        return out
+
+    def _check(self, index, records, source, target, data_root):
+        existing = records.get(str(target))
+        if existing is not None and existing[1] != data_root.hex():
+            return Slashing("double_vote", index, {
+                "target_epoch": target,
+                "roots": [existing[1], data_root.hex()],
+            })
+        for t_str, (s, root_hex) in records.items():
+            t = int(t_str)
+            if s < source and target < t:
+                return Slashing("surrounded_vote", index, {
+                    "existing": [s, t], "new": [source, target],
+                })
+            if source < s and t < target:
+                return Slashing("surround_vote", index, {
+                    "existing": [s, t], "new": [source, target],
+                })
+        return None
+
+    # -------------------------------------------------------------- blocks
+
+    def on_block(self, proposer_index: int, slot: int,
+                 header_root: bytes) -> "Optional[Slashing]":
+        key = _PREFIX_BLOCK + int(proposer_index).to_bytes(8, "big") \
+            + int(slot).to_bytes(8, "big")
+        existing = self.db.get(key)
+        if existing is not None and bytes(existing) != bytes(header_root):
+            hit = Slashing("double_block", int(proposer_index), {
+                "slot": slot,
+                "roots": [bytes(existing).hex(), bytes(header_root).hex()],
+            })
+            self.detected.append(hit)
+            return hit
+        self.db.put(key, bytes(header_root))
+        return None
+
+    def drain(self) -> "list[Slashing]":
+        out = self.detected
+        self.detected = []
+        return out
+
+
+__all__ = ["Slasher", "Slashing"]

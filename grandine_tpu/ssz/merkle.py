@@ -10,6 +10,27 @@ from typing import Sequence
 from grandine_tpu.core import hashing
 
 
+def merkle_branch(leaves: Sequence[bytes], index: int, depth: int) -> list:
+    """Sibling path for `leaves[index]` in a zero-padded depth-`depth`
+    tree (proof production for deposit/commitment inclusion)."""
+    branch = []
+    level = list(leaves)
+    idx = index
+    for d in range(depth):
+        sibling = idx ^ 1
+        branch.append(
+            level[sibling] if sibling < len(level) else hashing.ZERO_HASHES[d]
+        )
+        if len(level) % 2:
+            level = level + [hashing.ZERO_HASHES[d]]
+        level = [
+            hashing.hash_pair(level[i], level[i + 1])
+            for i in range(0, len(level), 2)
+        ]
+        idx >>= 1
+    return branch
+
+
 def verify_merkle_proof(leaf: bytes, branch: Sequence[bytes], depth: int,
                         index: int, root: bytes) -> bool:
     """Spec `is_valid_merkle_branch`."""

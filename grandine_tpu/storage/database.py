@@ -57,7 +57,9 @@ class Database:
 
     def prev(self, prefix: bytes, upto: bytes) -> "Optional[Tuple[bytes, bytes]]":
         """Greatest key <= prefix+upto that still starts with `prefix`
-        (the reference's cursor-prev lookups for 'latest at or before')."""
+        (the reference's cursor-prev lookups for 'latest at or before').
+        Backends override with an indexed reverse lookup — the default
+        would decode every value under the prefix."""
         best = None
         limit = prefix + upto
         for k, v in self.iterate_prefix(prefix):
@@ -120,6 +122,18 @@ class _MemoryDatabase(Database):
             if v is not None:
                 yield k, v
 
+    def prev(self, prefix: bytes, upto: bytes):
+        """Bisect on the sorted key list; only the hit is decompressed."""
+        prefix = bytes(prefix)
+        limit = prefix + bytes(upto)
+        with self._lock:
+            i = bisect.bisect_right(self._keys, limit) - 1
+            key = self._keys[i] if 0 <= i < len(self._keys) else None
+        if key is None or not key.startswith(prefix):
+            return None
+        v = self.get(key)
+        return None if v is None else (key, v)
+
 
 class _SqliteDatabase(Database):
     def __init__(self, path: str) -> None:
@@ -180,6 +194,20 @@ class _SqliteDatabase(Database):
         for k, v in rows:
             if bytes(k).startswith(prefix):
                 yield bytes(k), frame_decompress(v)
+
+    def prev(self, prefix: bytes, upto: bytes):
+        """One indexed reverse lookup; only the hit is decompressed."""
+        prefix = bytes(prefix)
+        limit = prefix + bytes(upto)
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT key, value FROM kv WHERE key >= ? AND key <= ?"
+                " ORDER BY key DESC LIMIT 1",
+                (prefix, limit),
+            ).fetchone()
+        if row is None or not bytes(row[0]).startswith(prefix):
+            return None
+        return bytes(row[0]), frame_decompress(row[1])
 
     def close(self) -> None:
         with self._lock:

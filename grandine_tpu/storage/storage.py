@@ -27,6 +27,7 @@ DEFAULT_ARCHIVAL_EPOCH_INTERVAL = 32
 
 KEY_ANCHOR_STATE = b"cstate"
 KEY_ANCHOR_BLOCK = b"cblock"
+KEY_GENESIS_STATE = b"gstate"
 PREFIX_BLOCK = b"b"
 PREFIX_SLOT_INDEX = b"s"
 PREFIX_ARCHIVAL_STATE = b"t"
@@ -61,6 +62,10 @@ class Storage:
         self.db.put(KEY_ANCHOR_STATE, state.serialize())
         if signed_block is not None:
             self.db.put(KEY_ANCHOR_BLOCK, signed_block.serialize())
+        # the FIRST anchor (genesis / checkpoint start) is kept forever so
+        # `replay` has a state to replay the finalized chain from
+        if self.db.get(KEY_GENESIS_STATE) is None:
+            self.db.put(KEY_GENESIS_STATE, state.serialize())
 
     def persist_unfinalized_block(self, root: bytes, signed_block) -> None:
         """Every applied block is persisted immediately (the reference
@@ -127,6 +132,10 @@ class Storage:
 
     def load_anchor_state(self):
         raw = self.db.get(KEY_ANCHOR_STATE)
+        return None if raw is None else decode_state(raw, self.cfg)
+
+    def load_genesis_state(self):
+        raw = self.db.get(KEY_GENESIS_STATE)
         return None if raw is None else decode_state(raw, self.cfg)
 
     def load_unfinalized_blocks(self) -> list:

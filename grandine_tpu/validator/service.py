@@ -1,0 +1,336 @@
+"""Tick-driven validator service — reference: validator/src/validator.rs
+(`run` :290 / `handle_tick` :645-770: Propose/Attest/Aggregate branches;
+propose :1292 with pool-packed attestations and eth1 votes; attestation
+production :1492; aggregate publication :1646), threading the signer,
+slashing protection, operation pools and network publishing together.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from grandine_tpu.consensus import accessors, misc, signing
+from grandine_tpu.crypto import bls as A
+from grandine_tpu.fork_choice.store import Tick, TickKind
+from grandine_tpu.transition.fork_upgrade import state_phase
+from grandine_tpu.transition.slots import process_slots
+from grandine_tpu.types.containers import spec_types
+from grandine_tpu.validator.slashing_protection import (
+    SlashingProtection,
+    SlashingProtectionError,
+)
+
+
+class ValidatorService:
+    """Drives duties for every key in the signer registry."""
+
+    def __init__(
+        self,
+        controller,
+        signer,
+        cfg,
+        slashing_protection: "Optional[SlashingProtection]" = None,
+        attestation_pool=None,
+        operation_pool=None,
+        sync_pool=None,
+        eth1_cache=None,
+        network=None,
+    ) -> None:
+        self.controller = controller
+        self.signer = signer
+        self.cfg = cfg
+        self.p = cfg.preset
+        self.slashing_protection = slashing_protection or SlashingProtection()
+        self.attestation_pool = attestation_pool
+        self.operation_pool = operation_pool
+        self.sync_pool = sync_pool
+        self.eth1_cache = eth1_cache
+        self.network = network
+        self.stats = {"proposed": 0, "attested": 0, "aggregated": 0,
+                      "slashing_refusals": 0}
+
+    # -- index resolution ---------------------------------------------------
+
+    def _own_indices(self, state) -> "dict[int, bytes]":
+        cols = accessors.registry_columns(state)
+        owned = {}
+        for i, pk in enumerate(cols.pubkeys):
+            if self.signer.has_key(pk):
+                owned[i] = pk
+        return owned
+
+    # -- tick dispatch ------------------------------------------------------
+
+    def handle_tick(self, tick: Tick) -> None:
+        if tick.kind == TickKind.PROPOSE:
+            self.maybe_propose(tick.slot)
+        elif tick.kind == TickKind.ATTEST:
+            self.attest(tick.slot)
+        elif tick.kind == TickKind.AGGREGATE:
+            self.aggregate(tick.slot)
+
+    # -- propose ------------------------------------------------------------
+
+    def maybe_propose(self, slot: int):
+        """Build, protect, sign and submit a block if one of our keys is
+        the proposer (validator.rs propose :1292)."""
+        snapshot = self.controller.snapshot()
+        pre = snapshot.head_state
+        if int(pre.slot) < slot:
+            pre = process_slots(pre, slot, self.cfg)
+        proposer_index = accessors.get_beacon_proposer_index(pre, self.p)
+        owned = self._own_indices(pre)
+        pubkey = owned.get(proposer_index)
+        if pubkey is None:
+            return None
+        try:
+            self.slashing_protection.check_and_insert_block(pubkey, slot)
+        except SlashingProtectionError:
+            self.stats["slashing_refusals"] += 1
+            return None
+
+        signed_block = self._build_block(pre, slot, proposer_index, pubkey)
+        self.controller.on_own_block(signed_block)
+        if self.network is not None:
+            self.network.publish_block(signed_block)
+        self.stats["proposed"] += 1
+        return signed_block
+
+    def _build_block(self, pre, slot: int, proposer_index: int, pubkey: bytes):
+        """build_beacon_block (:1007): eth1 vote + pool ops + packed
+        attestations + payload + sync aggregate, then state root + sign."""
+        from grandine_tpu.consensus.mutators import StateDraft
+        from grandine_tpu.consensus.verifier import NullVerifier
+        from grandine_tpu.transition import block as block_mod
+        from grandine_tpu.transition.combined import custom_state_transition
+
+        phase = state_phase(pre, self.cfg)
+        ns = getattr(spec_types(self.p), phase.key)
+        epoch = accessors.get_current_epoch(pre, self.p)
+
+        reveal = self.signer.sign(
+            pubkey, signing.randao_signing_root(pre, epoch, self.cfg)
+        )
+
+        attestations = (
+            self.attestation_pool.pack_attestations(pre, self.cfg, slot=slot)
+            if self.attestation_pool is not None
+            else []
+        )
+        ops = (
+            self.operation_pool.pack(pre)
+            if self.operation_pool is not None
+            else {"proposer_slashings": [], "attester_slashings": [],
+                  "voluntary_exits": [], "bls_to_execution_changes": []}
+        )
+        eth1_data = (
+            self.eth1_cache.eth1_data(ns)
+            if self.eth1_cache is not None
+            and self.eth1_cache.deposit_count
+            > int(pre.eth1_data.deposit_count)
+            else pre.eth1_data
+        )
+        deposits = (
+            self.eth1_cache.deposits_for_block(pre, ns)
+            if self.eth1_cache is not None
+            else []
+        )
+
+        from grandine_tpu.types.primitives import Phase
+        from grandine_tpu.validator.duties import (
+            build_matching_payload,
+            empty_sync_aggregate,
+        )
+
+        body_fields = dict(
+            randao_reveal=reveal,
+            eth1_data=eth1_data,
+            proposer_slashings=ops["proposer_slashings"],
+            attester_slashings=ops["attester_slashings"],
+            attestations=attestations,
+            deposits=deposits,
+            voluntary_exits=ops["voluntary_exits"],
+        )
+        if phase >= Phase.ALTAIR:
+            prev_root = accessors.get_block_root_at_slot(
+                pre, max(slot, 1) - 1, self.p
+            ) if slot > 0 else b"\x00" * 32
+            body_fields["sync_aggregate"] = (
+                self.sync_pool.best_aggregate(max(slot, 1) - 1, prev_root, ns)
+                if self.sync_pool is not None
+                else empty_sync_aggregate(pre, self.cfg)
+            )
+        if phase >= Phase.BELLATRIX:
+            body_fields["execution_payload"] = build_matching_payload(
+                pre, self.cfg, ns, phase
+            )
+        if phase >= Phase.CAPELLA:
+            body_fields["bls_to_execution_changes"] = ops[
+                "bls_to_execution_changes"
+            ]
+
+        body = ns.BeaconBlockBody(**body_fields)
+        header = pre.latest_block_header
+        if bytes(header.state_root) == b"\x00" * 32:
+            header = header.replace(state_root=pre.hash_tree_root())
+        block = ns.BeaconBlock(
+            slot=slot,
+            proposer_index=proposer_index,
+            parent_root=header.hash_tree_root(),
+            state_root=b"\x00" * 32,
+            body=body,
+        )
+        post = custom_state_transition(
+            pre, ns.SignedBeaconBlock(message=block), self.cfg,
+            NullVerifier(), state_root_policy="trust",
+        )
+        block = block.replace(state_root=post.hash_tree_root())
+        sig = self.signer.sign(
+            pubkey, signing.block_signing_root(pre, block, self.cfg)
+        )
+        return ns.SignedBeaconBlock(message=block, signature=sig)
+
+    # -- attest -------------------------------------------------------------
+
+    def attest(self, slot: int) -> list:
+        """One attestation per owned committee member
+        (attest_and_start_aggregating :1492), batch-signed through the
+        signer (sign_triples — the device batch path when enabled)."""
+        snapshot = self.controller.snapshot()
+        state = snapshot.head_state
+        if int(state.slot) < slot:
+            return []  # head hasn't reached the slot; skip (no block yet)
+        p = self.p
+        epoch = misc.compute_epoch_at_slot(slot, p)
+        owned = self._own_indices(state)
+        if not owned:
+            return []
+
+        head_root = snapshot.head_root
+        target_slot = misc.compute_start_slot_at_epoch(epoch, p)
+        target_root = (
+            head_root
+            if target_slot >= int(state.slot)
+            else accessors.get_block_root_at_slot(state, target_slot, p)
+        )
+        phase = state_phase(state, self.cfg)
+        ns = getattr(spec_types(p), phase.key)
+        source = state.current_justified_checkpoint
+
+        count = accessors.get_committee_count_per_slot(state, epoch, p)
+        to_sign = []
+        pending = []
+        for index in range(count):
+            committee = accessors.get_beacon_committee(state, slot, index, p)
+            members = [
+                (pos, int(v)) for pos, v in enumerate(committee)
+                if int(v) in owned
+            ]
+            if not members:
+                continue
+            data = ns.AttestationData(
+                slot=slot, index=index, beacon_block_root=head_root,
+                source=source,
+                target=ns.Checkpoint(epoch=epoch, root=target_root),
+            )
+            root = signing.attestation_signing_root(state, data, self.cfg)
+            for pos, vi in members:
+                pubkey = owned[vi]
+                try:
+                    self.slashing_protection.check_and_insert_attestation(
+                        pubkey, int(data.source.epoch), epoch
+                    )
+                except SlashingProtectionError:
+                    self.stats["slashing_refusals"] += 1
+                    continue
+                to_sign.append((pubkey, root))
+                pending.append((data, committee, pos))
+
+        signatures = self.signer.sign_triples(to_sign)
+        out = []
+        for (data, committee, pos), sig in zip(pending, signatures):
+            bits = np.zeros(len(committee), dtype=bool)
+            bits[pos] = True
+            att = ns.Attestation(
+                aggregation_bits=bits, data=data, signature=sig
+            )
+            out.append(att)
+            if self.attestation_pool is not None:
+                self.attestation_pool.insert(att)
+            if self.network is not None:
+                self.network.publish_attestation(
+                    att, subnet=int(data.index) % self.cfg.attestation_subnet_count
+                )
+        self.stats["attested"] += len(out)
+        return out
+
+    # -- aggregate ----------------------------------------------------------
+
+    def aggregate(self, slot: int) -> list:
+        """Publish best-known aggregates for committees where an owned
+        validator is the selected aggregator (publish_aggregates_and_proofs
+        :1646 — selection via DOMAIN_SELECTION_PROOF hash modulo)."""
+        if self.attestation_pool is None:
+            return []
+        snapshot = self.controller.snapshot()
+        state = snapshot.head_state
+        if int(state.slot) < slot:
+            return []
+        p = self.p
+        epoch = misc.compute_epoch_at_slot(slot, p)
+        owned = self._own_indices(state)
+        phase = state_phase(state, self.cfg)
+        ns = getattr(spec_types(p), phase.key)
+        out = []
+        count = accessors.get_committee_count_per_slot(state, epoch, p)
+        for index in range(count):
+            committee = accessors.get_beacon_committee(state, slot, index, p)
+            members = [int(v) for v in committee if int(v) in owned]
+            for vi in members:
+                pubkey = owned[vi]
+                proof = self.signer.sign(
+                    pubkey,
+                    signing.selection_proof_signing_root(state, slot, self.cfg),
+                )
+                modulo = max(
+                    1,
+                    len(committee) // self.cfg.target_aggregators_per_committee,
+                )
+                if misc.bytes_to_uint64(misc.sha256(proof)[:8]) % modulo != 0:
+                    continue  # not the aggregator
+                # find the best aggregate for any data of this committee
+                best = None
+                for (s, i, root), entries in list(
+                    self.attestation_pool._by_key.items()
+                ):
+                    if s == slot and i == index and entries:
+                        cand = max(
+                            entries, key=lambda e: e.bits.count()
+                        ).attestation
+                        if best is None or (
+                            cand.aggregation_bits.count()
+                            > best.aggregation_bits.count()
+                        ):
+                            best = cand
+                if best is None:
+                    continue
+                aap = ns.AggregateAndProof(
+                    aggregator_index=vi, aggregate=best,
+                    selection_proof=proof,
+                )
+                sig = self.signer.sign(
+                    pubkey,
+                    signing.aggregate_and_proof_signing_root(
+                        state, aap, self.cfg
+                    ),
+                )
+                out.append(
+                    ns.SignedAggregateAndProof(message=aap, signature=sig)
+                )
+        self.stats["aggregated"] += len(out)
+        return out
+
+
+__all__ = ["ValidatorService"]

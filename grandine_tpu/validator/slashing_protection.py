@@ -81,20 +81,15 @@ class SlashingProtection:
             min_target = min(t for _, t in history)
             if source_epoch < min_source:
                 raise SlashingProtectionError("source below recorded minimum")
-            if target_epoch <= min_target and len(history) >= 1 and any(
-                t >= target_epoch for _, t in history
-            ):
-                # already rejected double/surround above; targets may only
-                # move forward
-                if target_epoch < min_target:
-                    raise SlashingProtectionError(
-                        "target below recorded minimum"
-                    )
+            if target_epoch < min_target:
+                raise SlashingProtectionError("target below recorded minimum")
         history.append([source_epoch, target_epoch])
-        # keep a bounded window (the two-epoch weak-subjectivity window of
-        # practical signing; minimums are preserved by keeping extremes)
+        # bounded history: keep the most RECENT targets (signing order is
+        # target-monotonic under the min-target guard above, so recency ==
+        # largest targets; dropping older pairs cannot un-detect a double
+        # vote for a still-reachable target)
         if len(history) > 1024:
-            history = sorted(history)[-1024:]
+            history = sorted(history, key=lambda st: st[1])[-1024:]
         self.db.put(
             _PREFIX_ATT + bytes(pubkey), json.dumps(history).encode()
         )

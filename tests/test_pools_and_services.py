@@ -213,3 +213,56 @@ def test_metrics_exposition():
     assert "head_slot 123.0" in text
     assert "block_processing_seconds_count 1" in text
     assert 'block_processing_seconds_bucket{le="+Inf"} 1' in text
+
+
+# -------------------------------------------------------------------- eth1
+
+
+def test_eth1_deposit_cache_to_block_flow():
+    """Deposit logs -> cache -> proposer inclusion proofs -> state
+    transition applies the new validator (the eth1/deposit_tree loop)."""
+    from grandine_tpu.consensus import signing as sgn
+    from grandine_tpu.eth1 import Eth1Cache, select_eth1_vote
+    from grandine_tpu.transition.combined import untrusted_state_transition
+    from grandine_tpu.transition.genesis import interop_genesis_state
+    from grandine_tpu.validator.duties import produce_block
+
+    genesis = interop_genesis_state(16, CFG)
+    cache = Eth1Cache(CFG)
+    # replay the genesis deposits into the cache (log order)
+    for v in genesis.validators:
+        dd = NS.DepositData(
+            pubkey=bytes(v.pubkey),
+            withdrawal_credentials=bytes(v.withdrawal_credentials),
+            amount=P.MAX_EFFECTIVE_BALANCE,
+        )
+        cache.add_deposit(dd)
+    # one new deposit arrives via the injected log fetcher
+    new_sk = interop_secret_key(500)
+    dd = NS.DepositData(
+        pubkey=new_sk.public_key().to_bytes(),
+        withdrawal_credentials=b"\x00" + b"\x09" * 31,
+        amount=P.MAX_EFFECTIVE_BALANCE,
+    )
+    dd = dd.replace(
+        signature=new_sk.sign(sgn.deposit_signing_root(dd, CFG)).to_bytes()
+    )
+    added = cache.follow(lambda next_index: [dd] if next_index == 16 else [])
+    assert added == 1 and cache.deposit_count == 17
+
+    # the chain adopts the cache's eth1 data, then the proposer must
+    # include the pending deposit with a valid proof
+    state = genesis.replace(eth1_data=cache.eth1_data(NS))
+    deposits = cache.deposits_for_block(state, NS)
+    assert len(deposits) == 1
+    blk, post = produce_block(
+        state, 1, CFG, deposits=deposits, full_sync_participation=False
+    )
+    v = untrusted_state_transition(state, blk, CFG)
+    assert v.hash_tree_root() == post.hash_tree_root()
+    assert len(post.validators) == 17
+    assert bytes(post.validators[16].pubkey) == new_sk.public_key().to_bytes()
+
+    # vote selection: majority among candidates
+    vote = select_eth1_vote(post, [post.eth1_data], CFG)
+    assert vote == post.eth1_data
