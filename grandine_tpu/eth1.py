@@ -85,6 +85,14 @@ class Eth1Cache:
         want = min(p.MAX_DEPOSITS, max(0, state_count - start))
         if want == 0:
             return []
+        if self.deposit_count < state_count:
+            # a rebuilt/lagging cache cannot produce the REQUIRED deposits
+            # (truncated leaves would yield invalid proofs) — the proposer
+            # must skip proposing rather than build an invalid block
+            raise LookupError(
+                f"deposit cache has {self.deposit_count} deposits, state "
+                f"requires {state_count}"
+            )
         leaves = [r.data.hash_tree_root() for r in self.deposits[:state_count]]
         out = []
         for i in range(start, start + want):
@@ -100,7 +108,9 @@ class Eth1Cache:
 def select_eth1_vote(state, candidates, cfg):
     """Majority vote selection from the state's current voting period
     (validator/src/eth1_storage.rs shape): pick the candidate with the
-    most existing votes, defaulting to the state's current eth1_data."""
+    most existing period votes; with no votes yet, vote our own view
+    (the first candidate); with no candidates, re-vote the state's
+    current eth1_data."""
     votes = list(state.eth1_data_votes)
     counts: dict = {}
     for v in votes:
@@ -111,7 +121,9 @@ def select_eth1_vote(state, candidates, cfg):
         c = counts.get(cand.hash_tree_root(), 0)
         if c > best_count:
             best, best_count = cand, c
-    return best if best is not None else state.eth1_data
+    if best is not None:
+        return best
+    return candidates[0] if candidates else state.eth1_data
 
 
 __all__ = ["Eth1Cache", "DepositRecord", "select_eth1_vote"]

@@ -72,17 +72,21 @@ class BlockSyncService:
             peer, start, self.batch_size
         )
         self.stats["requested"] += len(raw_blocks)
-        # advance the local clock to the sync target so requested blocks
-        # aren't parked in the delayed-until-slot map
-        from grandine_tpu.fork_choice.store import Tick, TickKind
+        blocks = [decode_signed_block(raw, self.cfg) for raw in raw_blocks]
+        if blocks:
+            # advance the local clock only to slots we actually RECEIVED
+            # blocks for — never to a peer's unverified head_slot claim
+            # (a malicious Status could fast-forward our clock arbitrarily)
+            from grandine_tpu.fork_choice.store import Tick, TickKind
 
-        self.controller.on_tick(Tick(target, TickKind.AGGREGATE))
-        for raw in raw_blocks:
-            block = decode_signed_block(raw, self.cfg)
+            max_received = max(int(b.message.slot) for b in blocks)
+            self.controller.on_tick(Tick(max_received, TickKind.AGGREGATE))
+        for block in blocks:
             self.controller.on_requested_block(block)
         self.controller.wait()
         self.stats["applied_batches"] += 1
-        return int(self.controller.snapshot().head_state.slot) < target
+        head = int(self.controller.snapshot().head_state.slot)
+        return bool(blocks) and head < target
 
     def sync_to_head(self, max_rounds: int = 1000) -> None:
         for _ in range(max_rounds):
@@ -123,13 +127,19 @@ def back_sync(storage, transport, cfg, anchor_slot: int,
         anchor_block = storage.finalized_block_by_root(anchor_root)
         if anchor_block is not None:
             expected_parent = bytes(anchor_block.message.parent_root)
+    if verify and expected_parent is None:
+        # without the anchor's parent root there is nothing to chain the
+        # fetched history to — refusing beats storing unverified blocks
+        # as finalized
+        raise LookupError(
+            f"no anchor block stored at slot {anchor_slot}; cannot verify "
+            "back-synced history"
+        )
 
     slot_hi = anchor_slot - 1
     while slot_hi >= 0:
         start = max(0, slot_hi - batch_size + 1)
         raws = transport.request_blocks_by_range(peer, start, slot_hi - start + 1)
-        if not raws:
-            break
         blocks = [decode_signed_block(r, cfg) for r in raws]
         blocks.sort(key=lambda b: -int(b.message.slot))  # high -> low
         items = []
@@ -144,6 +154,8 @@ def back_sync(storage, transport, cfg, anchor_slot: int,
             expected_parent = bytes(block.message.parent_root)
             stored += 1
         storage.db.put_batch(items)
+        # an empty window just moves the cursor down (long empty stretches
+        # are normal); the loop ends when the window reaches genesis
         slot_hi = start - 1
         if start == 0:
             break

@@ -95,6 +95,22 @@ class AttestationAggPool:
                 return None
             return max(entries, key=lambda e: e.bits.count()).attestation
 
+    def best_for_committee(self, slot: int, index: int):
+        """Widest aggregate across ALL attestation data of one committee
+        (what an aggregator publishes when it doesn't care which data)."""
+        with self._lock:
+            best = None
+            for (s, i, _root), entries in self._by_key.items():
+                if s != slot or i != index or not entries:
+                    continue
+                cand = max(entries, key=lambda e: e.bits.count()).attestation
+                if best is None or (
+                    cand.aggregation_bits.count()
+                    > best.aggregation_bits.count()
+                ):
+                    best = cand
+            return best
+
     def prune_before(self, slot: int) -> None:
         with self._lock:
             for k in [k for k in self._by_key if k[0] < slot]:

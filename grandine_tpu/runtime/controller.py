@@ -91,6 +91,7 @@ class Controller:
         self._delayed_by_slot: "dict[int, list]" = {}
         self._delayed_attestations: "list[ValidAttestation]" = []
         self._rejected: "list[tuple[bytes, str]]" = []
+        self._state_cache: "dict[tuple, object]" = {}
         self.on_head_change: "list[Callable[[Snapshot], None]]" = []
 
         self._snapshot = Snapshot(self.store)
@@ -123,6 +124,32 @@ class Controller:
 
     def snapshot(self) -> Snapshot:
         return self._snapshot
+
+    def state_at_slot(self, slot: int):
+        """Head state advanced through empty slots to `slot`, memoized —
+        the StateCache slot-advancer (fork_choice_control/src/
+        state_cache.rs:25-135): duties at tick boundaries all need the
+        same advanced state; compute it once per (head, slot)."""
+        from grandine_tpu.transition.slots import process_slots
+
+        snap = self._snapshot
+        state = snap.head_state
+        if int(state.slot) >= slot:
+            return state
+        key = (snap.head_root, slot)
+        cached = self._state_cache.get(key)
+        if cached is not None:
+            return cached
+        advanced = process_slots(state, slot, self.cfg)
+        # bounded: keep only the latest few advanced states (eviction is
+        # best-effort under concurrent callers — losing the race is fine)
+        try:
+            if len(self._state_cache) >= 4:
+                self._state_cache.pop(next(iter(self._state_cache)), None)
+        except (StopIteration, RuntimeError):
+            pass
+        self._state_cache[key] = advanced
+        return advanced
 
     # --------------------------------------------------------------- inputs
 

@@ -68,6 +68,7 @@ class ValidatorService:
             self.maybe_propose(tick.slot)
         elif tick.kind == TickKind.ATTEST:
             self.attest(tick.slot)
+            self.sync_committee_messages(tick.slot)
         elif tick.kind == TickKind.AGGREGATE:
             self.aggregate(tick.slot)
 
@@ -76,10 +77,7 @@ class ValidatorService:
     def maybe_propose(self, slot: int):
         """Build, protect, sign and submit a block if one of our keys is
         the proposer (validator.rs propose :1292)."""
-        snapshot = self.controller.snapshot()
-        pre = snapshot.head_state
-        if int(pre.slot) < slot:
-            pre = process_slots(pre, slot, self.cfg)
+        pre = self.controller.state_at_slot(slot)  # StateCache advancer
         proposer_index = accessors.get_beacon_proposer_index(pre, self.p)
         owned = self._own_indices(pre)
         pubkey = owned.get(proposer_index)
@@ -91,7 +89,15 @@ class ValidatorService:
             self.stats["slashing_refusals"] += 1
             return None
 
-        signed_block = self._build_block(pre, slot, proposer_index, pubkey)
+        try:
+            signed_block = self._build_block(pre, slot, proposer_index, pubkey)
+        except LookupError as e:
+            # e.g. the deposit cache is behind the state's required
+            # deposits: an invalid block would be worse than no block
+            self.stats["skipped_proposals"] = (
+                self.stats.get("skipped_proposals", 0) + 1
+            )
+            return None
         self.controller.on_own_block(signed_block)
         if self.network is not None:
             self.network.publish_block(signed_block)
@@ -125,13 +131,15 @@ class ValidatorService:
             else {"proposer_slashings": [], "attester_slashings": [],
                   "voluntary_exits": [], "bls_to_execution_changes": []}
         )
-        eth1_data = (
-            self.eth1_cache.eth1_data(ns)
-            if self.eth1_cache is not None
-            and self.eth1_cache.deposit_count
-            > int(pre.eth1_data.deposit_count)
-            else pre.eth1_data
-        )
+        from grandine_tpu.eth1 import select_eth1_vote
+
+        candidates = []
+        if (
+            self.eth1_cache is not None
+            and self.eth1_cache.deposit_count > int(pre.eth1_data.deposit_count)
+        ):
+            candidates.append(self.eth1_cache.eth1_data(ns))
+        eth1_data = select_eth1_vote(pre, candidates, self.cfg)
         deposits = (
             self.eth1_cache.deposits_for_block(pre, ns)
             if self.eth1_cache is not None
@@ -266,6 +274,43 @@ class ValidatorService:
         self.stats["attested"] += len(out)
         return out
 
+    # -- sync committee -----------------------------------------------------
+
+    def sync_committee_messages(self, slot: int) -> int:
+        """Every owned member of the current sync committee signs the head
+        root (validator.rs sync-committee duties :1751-2213), feeding the
+        contribution pool for the NEXT slot's proposer."""
+        if self.sync_pool is None:
+            return 0
+        snapshot = self.controller.snapshot()
+        state = snapshot.head_state
+        from grandine_tpu.types.primitives import Phase
+
+        if state_phase(state, self.cfg) < Phase.ALTAIR:
+            return 0
+        head_root = snapshot.head_root
+        epoch = misc.compute_epoch_at_slot(slot, self.p)
+        to_sign = []
+        positions = []
+        for pos, pk in enumerate(state.current_sync_committee.pubkeys):
+            pk = bytes(pk)
+            if not self.signer.has_key(pk):
+                continue
+            root = signing.sync_committee_message_signing_root(
+                state, head_root, epoch, self.cfg
+            )
+            to_sign.append((pk, root))
+            positions.append(pos)
+        if not to_sign:
+            return 0
+        signatures = self.signer.sign_triples(to_sign)
+        for pos, sig in zip(positions, signatures):
+            self.sync_pool.insert_message(slot, head_root, pos, sig)
+        self.stats["sync_messages"] = (
+            self.stats.get("sync_messages", 0) + len(positions)
+        )
+        return len(positions)
+
     # -- aggregate ----------------------------------------------------------
 
     def aggregate(self, slot: int) -> list:
@@ -288,6 +333,12 @@ class ValidatorService:
         for index in range(count):
             committee = accessors.get_beacon_committee(state, slot, index, p)
             members = [int(v) for v in committee if int(v) in owned]
+            if not members:
+                continue
+            # member-independent: one pool lookup per committee
+            best = self.attestation_pool.best_for_committee(slot, index)
+            if best is None:
+                continue
             for vi in members:
                 pubkey = owned[vi]
                 proof = self.signer.sign(
@@ -300,22 +351,6 @@ class ValidatorService:
                 )
                 if misc.bytes_to_uint64(misc.sha256(proof)[:8]) % modulo != 0:
                     continue  # not the aggregator
-                # find the best aggregate for any data of this committee
-                best = None
-                for (s, i, root), entries in list(
-                    self.attestation_pool._by_key.items()
-                ):
-                    if s == slot and i == index and entries:
-                        cand = max(
-                            entries, key=lambda e: e.bits.count()
-                        ).attestation
-                        if best is None or (
-                            cand.aggregation_bits.count()
-                            > best.aggregation_bits.count()
-                        ):
-                            best = cand
-                if best is None:
-                    continue
                 aap = ns.AggregateAndProof(
                     aggregator_index=vi, aggregate=best,
                     selection_proof=proof,

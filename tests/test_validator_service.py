@@ -9,7 +9,11 @@ from grandine_tpu.consensus.verifier import NullVerifier
 from grandine_tpu.eth1 import Eth1Cache
 from grandine_tpu.fork_choice.store import Tick, TickKind
 from grandine_tpu.p2p import InMemoryHub, Network
-from grandine_tpu.pools import AttestationAggPool, OperationPool, SyncCommitteeAggPool
+from grandine_tpu.pools import (
+    AttestationAggPool,
+    OperationPool,
+    SyncCommitteeAggPool,
+)
 from grandine_tpu.runtime import Controller
 from grandine_tpu.transition.genesis import interop_genesis_state, interop_secret_key
 from grandine_tpu.types.config import Config
@@ -35,6 +39,7 @@ def stack():
         CFG,
         attestation_pool=AttestationAggPool(CFG),
         operation_pool=OperationPool(CFG),
+        sync_pool=SyncCommitteeAggPool(CFG),
         eth1_cache=Eth1Cache(CFG),
         network=net,
     )
@@ -56,6 +61,21 @@ def test_full_epoch_of_duties(stack):
     assert service.stats["proposed"] == 9
     assert service.stats["attested"] >= 9  # >=1 committee/slot, all owned
     assert service.stats["aggregated"] >= 1
+    # every owned sync-committee member signed each slot, and the pool's
+    # contributions made it into later blocks' sync aggregates
+    assert service.stats.get("sync_messages", 0) >= 9
+    head = ctrl.store.blocks[snap.head_root]
+    assert head.signed_block.message.body.sync_aggregate.sync_committee_bits.count() > 0
+    # the pool-built sync aggregate (and every other signature) verifies
+    # under a full untrusted replay of the head block
+    from grandine_tpu.consensus.verifier import MultiVerifier
+    from grandine_tpu.transition.combined import untrusted_state_transition
+
+    parent = ctrl.store.blocks[head.parent_root]
+    replayed = untrusted_state_transition(
+        parent.state, head.signed_block, CFG
+    )
+    assert replayed.hash_tree_root() == head.state.hash_tree_root()
     assert service.stats["slashing_refusals"] == 0
     assert net.stats["blocks_out"] == 9
     assert net.stats["attestations_out"] >= 9

@@ -1,0 +1,79 @@
+"""50k-validator scale check (BASELINE's operating point): registry
+columns, committee shuffling, epoch processing, state hashing and block
+production at mainnet-preset registry scale.
+
+Usage: [N_VALIDATORS=50000] python tools/scale_check.py
+Prints per-stage wall times; exits nonzero on failure.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main() -> int:
+    n = int(os.environ.get("N_VALIDATORS", "50000"))
+    from grandine_tpu.consensus import accessors
+    from grandine_tpu.transition.epoch_altair import process_epoch
+    from grandine_tpu.transition.genesis import interop_genesis_state
+    from grandine_tpu.transition.slots import process_slots
+    from grandine_tpu.types.config import Config
+    from grandine_tpu.types.primitives import Phase
+
+    cfg = Config()  # mainnet preset, mainnet fork schedule -> phase0 at 0
+    # all forks at genesis for a deneb-scale state
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        cfg, altair_fork_epoch=0, bellatrix_fork_epoch=0,
+        capella_fork_epoch=0, deneb_fork_epoch=0,
+    )
+    p = cfg.preset
+
+    def stage(name, fn):
+        t0 = time.time()
+        out = fn()
+        print(f"{name:44s} {time.time() - t0:8.2f}s")
+        return out
+
+    print(f"n_validators={n} preset={p.name}")
+    state = stage(
+        f"interop genesis ({n} validators, sync committee)",
+        lambda: interop_genesis_state(n, cfg),
+    )
+    stage("state hash_tree_root (cold)", state.hash_tree_root)
+    stage("registry columns (cold)", lambda: accessors.registry_columns(state))
+    active = stage(
+        "active indices", lambda: accessors.get_active_validator_indices(state, 0)
+    )
+    assert len(active) == n
+    stage(
+        "epoch committee partition (90-round shuffle)",
+        lambda: accessors.get_beacon_committee(state, 0, 0, p),
+    )
+    stage(
+        "proposer index (rejection sampling)",
+        lambda: accessors.get_beacon_proposer_index(state, p),
+    )
+    s2 = stage("process_slots +1 (slot processing + HTR)",
+               lambda: process_slots(state, 1, cfg))
+    stage(
+        "epoch processing (vectorized, altair+)",
+        lambda: process_epoch(
+            process_slots(state, p.SLOTS_PER_EPOCH - 1, cfg), cfg, Phase.DENEB
+        ),
+    )
+    from grandine_tpu.validator.duties import produce_block
+
+    stage(
+        "produce + trusted-apply one block",
+        lambda: produce_block(s2, 2, cfg, full_sync_participation=False),
+    )
+    print("OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,14 +18,21 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 def main() -> None:
     n = int(os.environ.get("BENCH_N", "2048"))
+    n_msgs = int(os.environ.get("BENCH_MSGS", "8"))
+    grouped = os.environ.get("BENCH_GROUPED", "0") != "0"
     import jax
 
     import bench
-    from grandine_tpu.tpu.bls import multi_verify_kernel
+    from grandine_tpu.tpu.bls import (
+        grouped_multi_verify_kernel,
+        multi_verify_kernel,
+    )
 
     bench._enable_compilation_cache()
-    args = bench.build_batch(n)
-    fn = jax.jit(multi_verify_kernel)
+    args = bench.build_batch(n, n_msgs)
+    if grouped:
+        args = bench.regroup_batch(args, n_msgs)
+    fn = jax.jit(grouped_multi_verify_kernel if grouped else multi_verify_kernel)
     print("compiling…", file=sys.stderr)
     jax.block_until_ready(fn(*args))
 
