@@ -1,0 +1,98 @@
+"""Builder API (MEV relay client) — reference: `builder_api` crate
+(builder_api/src/api.rs: get execution payload header / submit blinded
+block, circuit-breaker config.rs).
+
+The HTTP boundary is an injected `relay` callable (like the eth1 fetcher
+and checkpoint-sync seams); the circuit breaker, bid validation, and
+blinded-block flow are real. A relay for tests just returns header dicts.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+
+class BuilderApiError(Exception):
+    pass
+
+
+class BuilderConfig:
+    """Circuit-breaker knobs (builder_api/src/config.rs)."""
+
+    def __init__(
+        self,
+        max_skipped_slots: int = 3,
+        max_skipped_slots_per_epoch: int = 8,
+        request_timeout_s: float = 1.0,
+    ) -> None:
+        self.max_skipped_slots = max_skipped_slots
+        self.max_skipped_slots_per_epoch = max_skipped_slots_per_epoch
+        self.request_timeout_s = request_timeout_s
+
+
+class BuilderApi:
+    """get_header / submit_blinded_block against an injected relay, with
+    the reference's missed-slot circuit breaker: when the chain recently
+    skipped slots, stop asking the relay and fall back to local building."""
+
+    def __init__(self, relay: "Callable[[str, dict], dict]",
+                 cfg: "Optional[BuilderConfig]" = None) -> None:
+        self.relay = relay
+        self.cfg = cfg or BuilderConfig()
+        self.stats = {"headers": 0, "submissions": 0, "circuit_breaks": 0}
+
+    # -- circuit breaker ----------------------------------------------------
+
+    def can_use_builder(self, controller, slot: int, slots_per_epoch: int) -> bool:
+        """False when recent missed slots exceed the breaker thresholds
+        (builder_api/src/api.rs circuit breaker)."""
+        store = self.controller_store(controller)
+        produced = {n.slot for n in store.blocks.values()}
+        recent = range(max(0, slot - self.cfg.max_skipped_slots), slot)
+        if sum(1 for s in recent if s not in produced) >= self.cfg.max_skipped_slots:
+            self.stats["circuit_breaks"] += 1
+            return False
+        epoch_window = range(max(0, slot - slots_per_epoch), slot)
+        missed = sum(1 for s in epoch_window if s not in produced)
+        if missed >= self.cfg.max_skipped_slots_per_epoch:
+            self.stats["circuit_breaks"] += 1
+            return False
+        return True
+
+    @staticmethod
+    def controller_store(controller):
+        return controller.store
+
+    # -- relay calls --------------------------------------------------------
+
+    def get_execution_payload_header(
+        self, slot: int, parent_hash: bytes, pubkey: bytes
+    ) -> dict:
+        """builder-specs getHeader: returns the relay's bid
+        {header: {...}, value: int}."""
+        bid = self.relay("get_header", {
+            "slot": slot,
+            "parent_hash": bytes(parent_hash).hex(),
+            "pubkey": bytes(pubkey).hex(),
+        })
+        if not isinstance(bid, dict) or "header" not in bid:
+            raise BuilderApiError("malformed bid")
+        if bid["header"].get("parent_hash") != bytes(parent_hash).hex():
+            raise BuilderApiError("bid parent hash mismatch")
+        self.stats["headers"] += 1
+        return bid
+
+    def submit_blinded_block(self, signed_blinded_block) -> dict:
+        """builder-specs submitBlindedBlock: relay unblinds and returns the
+        full payload."""
+        payload = self.relay("submit_blinded_block", {
+            "ssz": signed_blinded_block.serialize().hex(),
+        })
+        if not isinstance(payload, dict) or "execution_payload" not in payload:
+            raise BuilderApiError("relay did not return a payload")
+        self.stats["submissions"] += 1
+        return payload
+
+
+__all__ = ["BuilderApi", "BuilderApiError", "BuilderConfig"]

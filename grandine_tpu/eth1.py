@@ -16,6 +16,11 @@ from grandine_tpu.ssz.merkle import MerkleTree
 from grandine_tpu.types.primitives import DEPOSIT_CONTRACT_TREE_DEPTH
 
 
+class DepositCacheError(Exception):
+    """The deposit cache cannot serve what the state requires (proposers
+    must skip proposing rather than build an invalid block)."""
+
+
 class DepositRecord:
     __slots__ = ("index", "data", "block_number")
 
@@ -87,9 +92,8 @@ class Eth1Cache:
             return []
         if self.deposit_count < state_count:
             # a rebuilt/lagging cache cannot produce the REQUIRED deposits
-            # (truncated leaves would yield invalid proofs) — the proposer
-            # must skip proposing rather than build an invalid block
-            raise LookupError(
+            # (truncated leaves would yield invalid proofs)
+            raise DepositCacheError(
                 f"deposit cache has {self.deposit_count} deposits, state "
                 f"requires {state_count}"
             )
@@ -126,4 +130,9 @@ def select_eth1_vote(state, candidates, cfg):
     return candidates[0] if candidates else state.eth1_data
 
 
-__all__ = ["Eth1Cache", "DepositRecord", "select_eth1_vote"]
+__all__ = [
+    "Eth1Cache",
+    "DepositCacheError",
+    "DepositRecord",
+    "select_eth1_vote",
+]

@@ -112,6 +112,13 @@ def hex_(b: bytes) -> str:
     return "0x" + bytes(b).hex()
 
 
+def _parse_int(value, what: str) -> int:
+    try:
+        return int(value)
+    except (TypeError, ValueError):
+        raise ApiError(400, f"invalid {what}: {value!r}") from None
+
+
 # ------------------------------------------------------------------ router
 
 
@@ -407,6 +414,77 @@ def get_deposit_contract(ctx, params, query, body):
     }
 
 
+def get_proposer_duties(ctx, params, query, body):
+    """eth/v1/validator/duties/proposer/{epoch}: proposer per slot of the
+    epoch. One in-epoch state suffices — the proposer seed mixes the slot
+    into the epoch's RANDAO-derived seed (misc.proposer_seed), so all
+    SLOTS_PER_EPOCH proposers come from per-slot seeds over one shuffle."""
+    from grandine_tpu.consensus import misc
+
+    p = ctx.cfg.preset
+    epoch = _parse_int(params["epoch"], "epoch")
+    snap = ctx.snapshot()
+    state = snap.head_state
+    cur = accessors.get_current_epoch(state, p)
+    if epoch > cur + 1:
+        raise ApiError(400, f"epoch {epoch} beyond the lookahead window")
+    start = misc.compute_start_slot_at_epoch(epoch, p)
+    if epoch > cur:  # advance into the epoch (StateCache memoizes)
+        state = ctx.controller.state_at_slot(start)
+    cols = accessors.registry_columns(state)
+    active = cols.active_indices(epoch)
+    duties = []
+    for slot in range(start, start + p.SLOTS_PER_EPOCH):
+        seed = misc.proposer_seed(state, slot, p)
+        index = misc.compute_proposer_index(
+            cols.effective_balance, active, seed, p
+        )
+        duties.append({
+            "pubkey": hex_(cols.pubkeys[index]),
+            "validator_index": str(index),
+            "slot": str(slot),
+        })
+    return {"dependent_root": hex_(snap.head_root), "data": duties}
+
+
+def post_attester_duties(ctx, params, query, body):
+    """eth/v1/validator/duties/attester/{epoch} for the posted indices."""
+    from grandine_tpu.consensus import misc
+
+    p = ctx.cfg.preset
+    epoch = _parse_int(params["epoch"], "epoch")
+    snap = ctx.snapshot()
+    state = snap.head_state
+    cur = accessors.get_current_epoch(state, p)
+    if epoch > cur + 1:
+        raise ApiError(400, f"epoch {epoch} beyond the lookahead window")
+    want = {_parse_int(i, "validator index") for i in (body or [])}
+    if not want:
+        # Beacon API contract: duties only for the POSTED indices
+        return {"dependent_root": hex_(snap.head_root), "data": []}
+    cols = accessors.registry_columns(state)
+    duties = []
+    start = misc.compute_start_slot_at_epoch(epoch, p)
+    count = accessors.get_committee_count_per_slot(state, epoch, p)
+    for slot in range(start, start + p.SLOTS_PER_EPOCH):
+        for index in range(count):
+            committee = accessors.get_beacon_committee(state, slot, index, p)
+            for pos, vi in enumerate(committee):
+                vi = int(vi)
+                if vi not in want:
+                    continue
+                duties.append({
+                    "pubkey": hex_(cols.pubkeys[vi]),
+                    "validator_index": str(vi),
+                    "committee_index": str(index),
+                    "committee_length": str(len(committee)),
+                    "committees_at_slot": str(count),
+                    "validator_committee_index": str(pos),
+                    "slot": str(slot),
+                })
+    return {"dependent_root": hex_(snap.head_root), "data": duties}
+
+
 def post_validator_liveness(ctx, params, query, body):
     if ctx.liveness is None:
         raise ApiError(503, "liveness tracker not wired")
@@ -445,6 +523,8 @@ def build_router() -> Router:
     r.add("GET", "/eth/v1/config/spec", get_config_spec)
     r.add("GET", "/eth/v1/config/deposit_contract", get_deposit_contract)
     r.add("POST", "/eth/v1/validator/liveness/{epoch}", post_validator_liveness)
+    r.add("GET", "/eth/v1/validator/duties/proposer/{epoch}", get_proposer_duties)
+    r.add("POST", "/eth/v1/validator/duties/attester/{epoch}", post_attester_duties)
     r.add("GET", "/metrics", get_metrics)
     return r
 

@@ -67,12 +67,19 @@ class BlockSyncService:
         target = self.sync_manager.target_slot()
         if head_slot >= target:
             return False
+        # walk windows upward past empty stretches (a >= batch_size gap of
+        # empty slots must not stall the sync or fake completion)
         start = head_slot + 1
-        raw_blocks = self.transport.request_blocks_by_range(
-            peer, start, self.batch_size
-        )
-        self.stats["requested"] += len(raw_blocks)
-        blocks = [decode_signed_block(raw, self.cfg) for raw in raw_blocks]
+        blocks = []
+        while start <= target:
+            raw_blocks = self.transport.request_blocks_by_range(
+                peer, start, self.batch_size
+            )
+            self.stats["requested"] += len(raw_blocks)
+            blocks = [decode_signed_block(raw, self.cfg) for raw in raw_blocks]
+            if blocks:
+                break
+            start += self.batch_size
         if blocks:
             # advance the local clock only to slots we actually RECEIVED
             # blocks for — never to a peer's unverified head_slot claim

@@ -89,11 +89,14 @@ class ValidatorService:
             self.stats["slashing_refusals"] += 1
             return None
 
+        from grandine_tpu.eth1 import DepositCacheError
+
         try:
             signed_block = self._build_block(pre, slot, proposer_index, pubkey)
-        except LookupError as e:
-            # e.g. the deposit cache is behind the state's required
-            # deposits: an invalid block would be worse than no block
+        except DepositCacheError:
+            # the deposit cache is behind the state's required deposits: an
+            # invalid block would be worse than no block (any OTHER failure
+            # propagates — silent skipping would mask real bugs)
             self.stats["skipped_proposals"] = (
                 self.stats.get("skipped_proposals", 0) + 1
             )
@@ -283,22 +286,25 @@ class ValidatorService:
         if self.sync_pool is None:
             return 0
         snapshot = self.controller.snapshot()
-        state = snapshot.head_state
+        # advance to the duty slot: across a sync-committee period boundary
+        # the head state's current_sync_committee would be the OLD period's
+        state = self.controller.state_at_slot(slot)
         from grandine_tpu.types.primitives import Phase
 
         if state_phase(state, self.cfg) < Phase.ALTAIR:
             return 0
         head_root = snapshot.head_root
         epoch = misc.compute_epoch_at_slot(slot, self.p)
+        # loop-invariant: one signing root serves every member
+        root = signing.sync_committee_message_signing_root(
+            state, head_root, epoch, self.cfg
+        )
         to_sign = []
         positions = []
         for pos, pk in enumerate(state.current_sync_committee.pubkeys):
             pk = bytes(pk)
             if not self.signer.has_key(pk):
                 continue
-            root = signing.sync_committee_message_signing_root(
-                state, head_root, epoch, self.cfg
-            )
             to_sign.append((pk, root))
             positions.append(pos)
         if not to_sign:

@@ -1,76 +1,138 @@
 """Key registry + batch signing — reference: signer/src/signer.rs
-(`Signer` :40-49 key registry, `sign` :154, batch `sign_triples` :173-229).
+(`Signer` :40-49 key registry: local `SecretKey` OR remote Web3Signer per
+pubkey; `sign` :154, batch `sign_triples` :173-229 fanning local keys to
+rayon and remote keys to Web3Signer futures).
 
-Local keys sign either on host (anchor, one at a time) or as one device
-batch through `TpuBlsBackend.batch_sign` (the signer/src rayon fan-out
-mapped onto the accelerator's batch axis). Remote/Web3Signer keys are out
-of scope for this build (the registry records the kind for parity).
+Local keys sign on host (anchor) or as one device batch through
+`TpuBlsBackend.batch_sign`. Remote keys go through an injected Web3Signer
+client (`web3signer` callable: (pubkey_hex, signing_root_hex) -> sig_hex —
+the HTTP boundary, like every other I/O seam in this framework).
 """
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Callable, Optional, Sequence
 
 from grandine_tpu.crypto import bls as A
 
 
 class Signer:
-    """pubkey-bytes -> SecretKey registry with single and batch signing."""
+    """pubkey-bytes -> local SecretKey or remote Web3Signer registry."""
 
-    def __init__(self, use_device: bool = False, backend=None) -> None:
+    def __init__(self, use_device: bool = False, backend=None,
+                 web3signer: "Optional[Callable]" = None) -> None:
         self._keys: "dict[bytes, A.SecretKey]" = {}
+        self._remote: "set[bytes]" = set()
         self._use_device = use_device
         self._backend = backend
+        self._web3signer = web3signer
 
     # -- registry ----------------------------------------------------------
 
     def add_key(self, secret_key: "A.SecretKey") -> bytes:
         pk = secret_key.public_key().to_bytes()
         self._keys[pk] = secret_key
+        self._remote.discard(pk)  # local signing supersedes remote
         return pk
 
+    def add_remote_key(self, pubkey: bytes) -> None:
+        """Register a key signed by the Web3Signer client
+        (signer.rs KeyOrigin::Web3Signer). A key already registered
+        locally stays local (no double registration)."""
+        if self._web3signer is None:
+            raise ValueError("no web3signer client configured")
+        pubkey = bytes(pubkey)
+        if pubkey not in self._keys:
+            self._remote.add(pubkey)
+
     def remove_key(self, pubkey: bytes) -> bool:
-        return self._keys.pop(bytes(pubkey), None) is not None
+        pubkey = bytes(pubkey)
+        removed = self._keys.pop(pubkey, None) is not None
+        if pubkey in self._remote:
+            self._remote.discard(pubkey)
+            removed = True
+        return removed
 
     def has_key(self, pubkey: bytes) -> bool:
-        return bytes(pubkey) in self._keys
+        pubkey = bytes(pubkey)
+        return pubkey in self._keys or pubkey in self._remote
 
     def pubkeys(self) -> "list[bytes]":
-        return list(self._keys)
+        return list(self._keys) + sorted(self._remote)
 
     def __len__(self) -> int:
-        return len(self._keys)
+        return len(self._keys) + len(self._remote)
 
     # -- signing -----------------------------------------------------------
 
     def sign(self, pubkey: bytes, signing_root: bytes) -> bytes:
-        sk = self._keys.get(bytes(pubkey))
-        if sk is None:
-            raise KeyError(f"no key for {bytes(pubkey).hex()[:16]}…")
-        return sk.sign(signing_root).to_bytes()
+        pubkey = bytes(pubkey)
+        sk = self._keys.get(pubkey)
+        if sk is not None:
+            return sk.sign(signing_root).to_bytes()
+        if pubkey in self._remote:
+            return self._sign_remote(pubkey, signing_root)
+        raise KeyError(f"no key for {pubkey.hex()[:16]}…")
+
+    def _sign_remote(self, pubkey: bytes, signing_root: bytes) -> bytes:
+        sig_hex = self._web3signer(pubkey.hex(), bytes(signing_root).hex())
+        sig = bytes.fromhex(sig_hex.removeprefix("0x"))
+        if len(sig) != 96:
+            raise ValueError("web3signer returned a malformed signature")
+        return sig
 
     def sign_triples(
         self, items: "Sequence[tuple[bytes, bytes]]"
     ) -> "list[bytes]":
-        """Batch sign (pubkey, signing_root) pairs — signer.rs sign_triples.
-        Device path: ONE `batch_sign_kernel` launch for all N items."""
-        sks = []
-        for pubkey, _root in items:
-            sk = self._keys.get(bytes(pubkey))
-            if sk is None:
-                raise KeyError(f"no key for {bytes(pubkey).hex()[:16]}…")
-            sks.append(sk)
-        if self._use_device and len(items) > 1:
+        """Batch sign (pubkey, signing_root) pairs — signer.rs sign_triples:
+        local keys as ONE device batch (or host loop), remote keys fanned
+        out CONCURRENTLY to the Web3Signer client (the reference fans
+        remote signings into futures alongside the local batch);
+        results keep input order."""
+        local_idx, local_sks, out = [], [], [None] * len(items)
+        remote_idx = []
+        for i, (pubkey, root) in enumerate(items):
+            pubkey = bytes(pubkey)
+            sk = self._keys.get(pubkey)
+            if sk is not None:
+                local_idx.append(i)
+                local_sks.append(sk)
+            elif pubkey in self._remote:
+                remote_idx.append(i)
+            else:
+                raise KeyError(f"no key for {pubkey.hex()[:16]}…")
+        remote_futures = []
+        if remote_idx:
+            import concurrent.futures
+
+            pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=min(8, len(remote_idx))
+            )
+            remote_futures = [
+                (i, pool.submit(
+                    self._sign_remote, bytes(items[i][0]), items[i][1]
+                ))
+                for i in remote_idx
+            ]
+        if self._use_device and len(local_idx) > 1:
             backend = self._backend
             if backend is None:
                 from grandine_tpu.tpu.bls import TpuBlsBackend
 
                 backend = self._backend = TpuBlsBackend()
-            sigs = backend.batch_sign([root for _, root in items], sks)
-            return [s.to_bytes() for s in sigs]
-        return [
-            sk.sign(bytes(root)).to_bytes() for sk, (_, root) in zip(sks, items)
-        ]
+            sigs = backend.batch_sign(
+                [bytes(items[i][1]) for i in local_idx], local_sks
+            )
+            for i, s in zip(local_idx, sigs):
+                out[i] = s.to_bytes()
+        else:
+            for i, sk in zip(local_idx, local_sks):
+                out[i] = sk.sign(bytes(items[i][1])).to_bytes()
+        for i, future in remote_futures:
+            out[i] = future.result()
+        if remote_idx:
+            pool.shutdown(wait=False)
+        return out
 
 
 __all__ = ["Signer"]

@@ -131,3 +131,61 @@ def test_signer_batch_sign_host():
     sigs = signer.sign_triples(list(zip(pks, roots)))
     for sk, root, sig in zip(sks, roots, sigs):
         assert A.Signature.from_bytes(sig).verify(root, sk.public_key())
+
+
+def test_signer_remote_web3signer_path():
+    """Remote keys route through the injected Web3Signer client and mix
+    with local/device keys in sign_triples order."""
+    remote_sk = A.SecretKey.keygen(b"\x66" * 32)
+    remote_pk = remote_sk.public_key().to_bytes()
+
+    calls = []
+
+    def fake_web3signer(pubkey_hex, root_hex):
+        calls.append(pubkey_hex)
+        assert pubkey_hex == remote_pk.hex()
+        return remote_sk.sign(bytes.fromhex(root_hex)).to_bytes().hex()
+
+    signer = Signer(web3signer=fake_web3signer)
+    local_sk = A.SecretKey.keygen(b"\x67" * 32)
+    local_pk = signer.add_key(local_sk)
+    signer.add_remote_key(remote_pk)
+    assert signer.has_key(remote_pk) and len(signer) == 2
+
+    roots = [b"\x01" * 32, b"\x02" * 32]
+    sigs = signer.sign_triples([(local_pk, roots[0]), (remote_pk, roots[1])])
+    assert A.Signature.from_bytes(sigs[0]).verify(roots[0], local_sk.public_key())
+    assert A.Signature.from_bytes(sigs[1]).verify(roots[1], remote_sk.public_key())
+    assert calls == [remote_pk.hex()]
+    # no client configured -> registration refused
+    with pytest.raises(ValueError):
+        Signer().add_remote_key(remote_pk)
+
+
+def test_builder_api_flow():
+    from grandine_tpu.builder_api import BuilderApi, BuilderApiError, BuilderConfig
+
+    def relay(method, params):
+        if method == "get_header":
+            return {"header": {"parent_hash": params["parent_hash"]},
+                    "value": 123}
+        if method == "submit_blinded_block":
+            return {"execution_payload": {"ok": True}}
+        raise AssertionError(method)
+
+    api = BuilderApi(relay, BuilderConfig(max_skipped_slots=2))
+    bid = api.get_execution_payload_header(5, b"\xab" * 32, b"\xcd" * 48)
+    assert bid["value"] == 123
+    with pytest.raises(BuilderApiError):
+        bad_relay = lambda m, p: {"header": {"parent_hash": "00" * 32}}
+        BuilderApi(bad_relay).get_execution_payload_header(
+            5, b"\xab" * 32, b"\xcd" * 48
+        )
+
+    class FakeBlock:
+        def serialize(self):
+            return b"\x00" * 8
+
+    payload = api.submit_blinded_block(FakeBlock())
+    assert payload["execution_payload"] == {"ok": True}
+    assert api.stats == {"headers": 1, "submissions": 1, "circuit_breaks": 0}

@@ -169,3 +169,34 @@ def test_real_socket_roundtrip(ctx):
             assert b"head_slot" in resp.read()
     finally:
         server.shutdown()
+
+
+def test_duties_endpoints(router, ctx):
+    # proposer duties for the current epoch: one duty per slot, and the
+    # duty for an already-proposed slot matches the actual proposer
+    status, payload = get(router, ctx, "/eth/v1/validator/duties/proposer/0")
+    assert status == 200
+    duties = payload["data"]
+    assert len(duties) == CFG.preset.SLOTS_PER_EPOCH
+    head = ctx.controller.store.blocks[ctx.snapshot().head_root]
+    actual = int(head.signed_block.message.proposer_index)
+    slot2 = next(d for d in duties if d["slot"] == "2")
+    assert int(slot2["validator_index"]) == actual
+    assert get(router, ctx, "/eth/v1/validator/duties/proposer/99")[0] == 400
+
+    # attester duties: every validator appears exactly once per epoch
+    status, payload = build_router().dispatch(
+        ctx, "POST", "/eth/v1/validator/duties/attester/0", None, ["0", "5"]
+    )
+    assert status == 200
+    rows = payload["data"]
+    assert {r["validator_index"] for r in rows} == {"0", "5"}
+    for r in rows:
+        assert 0 <= int(r["slot"]) < CFG.preset.SLOTS_PER_EPOCH
+
+
+def test_validators_bad_id_is_400(router, ctx):
+    assert get(router, ctx, "/eth/v1/beacon/states/head/validators",
+               {"id": "abc"})[0] == 400
+    assert get(router, ctx, "/eth/v1/beacon/states/head/validators",
+               {"id": "-1"})[0] == 400
