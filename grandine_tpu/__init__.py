@@ -1,19 +1,35 @@
 """grandine-tpu: a TPU-native Ethereum consensus-layer framework.
 
 Brand-new implementation with the capabilities of the reference client
-(Grandine, Rust; see SURVEY.md) re-designed TPU-first: the BLS12-381
-signature plane (batch verification / aggregation / signing) runs as
-vmapped XLA kernels on TPU, while the consensus core (SSZ, state
-transition, fork choice, services) is a host-side framework feeding it.
+(Grandine, Rust; see SURVEY.md and COMPONENTS.md) re-designed TPU-first:
+the BLS12-381 signature plane (grouped RLC batch verification /
+aggregation / signing) runs as jitted XLA kernels sharded over device
+meshes, while the consensus core is a host framework feeding it through
+the Verifier seam.
 
-Layout mirrors SURVEY.md §2's component inventory:
-  crypto/     pure-Python BLS12-381 correctness anchor (replaces blst)
-  tpu/        JAX/XLA limb-vectorized batch crypto kernels
-  ssz/        SSZ serialization + merkleization
-  types/      spec containers for all forks, presets, config
-  transition/ state transition functions
-  fork_choice/ store + controller
-  services/   attestation verifier, validator duties, pools, signer...
+Layout (COMPONENTS.md maps every reference crate to these modules):
+  crypto/      pure-Python BLS12-381 correctness anchor (replaces blst)
+  tpu/         limb-vectorized batch crypto kernels + device backend
+  core/        hashing (SHA-NI native ext) + swap-or-not shuffle
+  ssz/         SSZ codec, merkleization, proofs
+  types/       spec containers x5 forks, presets, config, combined dispatch
+  consensus/   spec helpers, accessors, predicates, Verifier seam
+  transition/  state transition (slots/epoch/block/fork upgrades)
+  fork_choice/ LMD-GHOST + Casper FFG store
+  runtime/     clock, thread pool, controller, firehose, node, liveness
+  storage/     database (sqlite/memory) + persistence schema + resume
+  kzg/         EIP-4844 blob commitments over the shared pairing kernels
+  pools/       attestation/sync-committee/operation pools
+  validator/   duties, service, signer, slashing protection, keymanager
+  p2p/         transport seam, gossip service, sync, back-sync
+  execution/   execution-engine seam (Null/Mock)
+  http_api/    Beacon API subset + metrics exposition
+  spec_tests/  consensus-spec-tests case loader + snappy codec
+  eth1.py      deposit cache + incremental tree
+  slasher.py   double/surround detection
+  builder_api.py  MEV relay client seam
+  metrics.py / features.py / cli.py
 """
+
 
 __version__ = "0.1.0"
