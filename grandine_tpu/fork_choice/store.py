@@ -348,6 +348,10 @@ class Store:
         self.children.setdefault(node.parent_root, []).append(root)
         self.children.setdefault(root, [])
 
+        # spec on_block (v1.3+) gates the boost with is_first_block: only
+        # the FIRST timely block in the slot gets it — letting a second
+        # (equivocating) block overwrite the boost enables boost-stealing
+        # ex-ante reorgs. proposer_boost_root resets at each slot tick.
         if valid.is_timely and self.proposer_boost_root is None:
             self.proposer_boost_root = root
 

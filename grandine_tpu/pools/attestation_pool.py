@@ -132,12 +132,15 @@ class AttestationAggPool:
         packer fed the previous head state stays correct across epoch
         boundaries."""
         from grandine_tpu.consensus import accessors, misc
+        from grandine_tpu.transition.fork_upgrade import state_phase
+        from grandine_tpu.types.primitives import Phase
 
         p = cfg.preset
         max_count = max_count or p.MAX_ATTESTATIONS
         state_slot = int(state.slot) if slot is None else int(slot)
         cur = misc.compute_epoch_at_slot(state_slot, p)
         prev = max(0, cur - 1)
+        pre_deneb = state_phase(state, cfg) < Phase.DENEB
 
         candidates = []
         with self._lock:
@@ -146,6 +149,11 @@ class AttestationAggPool:
             ]
         for (slot, index, _root), e in items:
             if slot + p.MIN_ATTESTATION_INCLUSION_DELAY > state_slot:
+                continue
+            # pre-Deneb upper inclusion bound (EIP-7045 removed it): packing
+            # an aggregate older than one epoch would abort the proposal in
+            # process_block's "attestation: too old" check.
+            if pre_deneb and state_slot > slot + p.SLOTS_PER_EPOCH:
                 continue
             target_epoch = misc.compute_epoch_at_slot(slot, p)
             if target_epoch not in (cur, prev):

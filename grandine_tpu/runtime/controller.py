@@ -125,14 +125,19 @@ class Controller:
     def snapshot(self) -> Snapshot:
         return self._snapshot
 
-    def state_at_slot(self, slot: int):
+    def state_at_slot(self, slot: int, snapshot: "Snapshot | None" = None):
         """Head state advanced through empty slots to `slot`, memoized —
         the StateCache slot-advancer (fork_choice_control/src/
         state_cache.rs:25-135): duties at tick boundaries all need the
-        same advanced state; compute it once per (head, slot)."""
+        same advanced state; compute it once per (head, slot).
+
+        Pass the `snapshot` you already hold to keep (head_root, state)
+        coherent under concurrent head changes — the mutator thread may
+        swap `self._snapshot` between a caller's snapshot() read and
+        this call."""
         from grandine_tpu.transition.slots import process_slots
 
-        snap = self._snapshot
+        snap = snapshot if snapshot is not None else self._snapshot
         state = snap.head_state
         if int(state.slot) >= slot:
             return state

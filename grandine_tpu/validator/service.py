@@ -210,9 +210,11 @@ class ValidatorService:
         (attest_and_start_aggregating :1492), batch-signed through the
         signer (sign_triples — the device batch path when enabled)."""
         snapshot = self.controller.snapshot()
-        state = snapshot.head_state
-        if int(state.slot) < slot:
-            return []  # head hasn't reached the slot; skip (no block yet)
+        # On an empty/missed slot the head block is behind the duty slot;
+        # attest to the current head with the state *advanced* through the
+        # empty slots (StateCache advancer), as the reference does — never
+        # skip the duty (validator/src/validator.rs attest path).
+        state = self.controller.state_at_slot(slot, snapshot=snapshot)
         p = self.p
         epoch = misc.compute_epoch_at_slot(slot, p)
         owned = self._own_indices(state)
