@@ -131,8 +131,10 @@ def _msm_device(setup: TrustedSetup, scalars: "Sequence[int]") -> Point:
     def msm_kernel(px, py, p_inf, bits):
         import jax.numpy as jnp
 
-        jac = C.scalar_mul(px, py, p_inf, bits, C.FP_OPS)
-        return C.sum_points(jac, C.FP_OPS)
+        qx, qy = L.split(jnp.asarray(px)), L.split(jnp.asarray(py))
+        jac = C.scalar_mul(qx, qy, p_inf, jnp.transpose(bits), C.FP_OPS)
+        X, Y, Z = C.sum_points(jac, C.FP_OPS)
+        return L.merge(X), L.merge(Y), L.merge(Z)
 
     fn = _jitted_global(f"kzg_msm_{setup.width}", msm_kernel)
     bits = C.scalars_to_bits_msb([s % BLS_MODULUS for s in scalars], 255)

@@ -5,21 +5,30 @@ This is the TPU equivalent of the reference's `bls` crate hot surface
 bls/src/secret_key.rs:82-86 `sign`) re-designed for the accelerator:
 
   - `multi_verify_kernel` — random-linear-combination batch verification:
-    N (message, signature, pubkey) triples are checked with N+1 vmapped
-    Miller loops, a log-depth Fp12 product tree, and ONE shared final
+    N (message, signature, pubkey) triples are checked with batched Miller
+    loops, a log-depth Fp12 product tree, and ONE shared final
     exponentiation:  e(g1, Σ rᵢ·sigᵢ) == ∏ e(rᵢ·pkᵢ, H(mᵢ)).
+  - `grouped_multi_verify_kernel` — triples grouped by message, so Miller
+    loops collapse from N to the number of distinct messages.
   - `aggregate_fast_verify_kernel` — the gossip-attestation firehose shape:
     M attestations × K committee members; pubkey aggregation is a log-depth
-    complete-addition tree over the K axis, then the RLC check above.
+    complete-addition tree over the k-major flat batch, then the RLC check.
   - `batch_sign_kernel` / `batch_pubkey_kernel` — G2/G1 fixed-base scalar
     multiplications for multi-validator signing (signer/src/signer.rs:173-229).
 
+Kernel boundary: hosts speak the REST FORMAT — numpy arrays with a trailing
+limb axis (pk (N, 26), G2 coords (N, 2, 26), bool masks (N,), scalar bit
+arrays (N, nbits)) — which is layout-agnostic and cheap to assemble. The
+first traced ops of every kernel split rest-format arrays into the limb-list
+form the device plane computes in (see limbs.py for why), and outputs are
+merged back; XLA fuses both boundaries into the adjacent compute.
+
 All kernels are shape-static (host pads to power-of-two buckets), branchless,
-and carry a leading batch axis — the jit/vmap/shard-map compilation model.
-Padding slots are all-infinity triples, which are algebraically neutral in
-every reduction. Host-side policy checks (identity pubkey rejection, empty
-batches, subgroup checks on decompression) happen in `TpuBlsBackend` before
-data reaches the device, mirroring where the reference enforces them.
+and batched over the trailing axis of every limb array. Padding slots are
+all-infinity triples, which are algebraically neutral in every reduction.
+Host-side policy checks (identity pubkey rejection, empty batches, subgroup
+checks on decompression) happen in `TpuBlsBackend` before data reaches the
+device, mirroring where the reference enforces them.
 
 Multi-chip: the batch axis shards over a `jax.sharding.Mesh`; each chip
 reduces its local Fp12 product and the cross-chip product is a single
@@ -29,8 +38,7 @@ all-gather of one Fp12 element per chip (see __graft_entry__.py).
 from __future__ import annotations
 
 import secrets
-from functools import partial
-from typing import Optional, Sequence
+from typing import Sequence
 
 import jax
 import jax.numpy as jnp
@@ -50,16 +58,28 @@ from grandine_tpu.tpu import pairing as TP
 _NEG_G1_DEV = C.g1_point_to_dev(-G1)  # (x, y, inf=False)
 
 
-def _fp12_product_tree(f):
-    """Reduce a (N, …fp12) batch to one element by a log-depth product tree
-    (any N ≥ 1; an odd tail element rides along to the next level)."""
-    n = f.shape[0]
-    while n > 1:
-        h = n // 2
-        prod = F.fp12_mul_many(f[:h], f[h : 2 * h])
-        f = jnp.concatenate([prod, f[2 * h :]], axis=0) if n % 2 else prod
-        n = f.shape[0]
-    return f[0]
+# --- rest-format ↔ limb-list adapters (first/last traced ops of kernels) ---
+
+
+def _g1_in(x, y):
+    """(N, 26) coord arrays → affine G1 limb-list pair."""
+    return L.split(jnp.asarray(x)), L.split(jnp.asarray(y))
+
+
+def _g2_in(x, y):
+    return F.fp2_split(jnp.asarray(x)), F.fp2_split(jnp.asarray(y))
+
+
+def _bits_in(bits):
+    """(N, nbits) MSB-first → (nbits, N) scan order."""
+    return jnp.transpose(jnp.asarray(bits))
+
+
+def _flat_km(arr, m: int, k: int):
+    """(M, K, …) rest array → k-major flat (K·M, …) — the order
+    sum_points_grouped reduces over."""
+    a = jnp.asarray(arr)
+    return jnp.swapaxes(a, 0, 1).reshape((k * m,) + a.shape[2:])
 
 
 def _rlc_finish(f, sig_acc_jac):
@@ -68,54 +88,60 @@ def _rlc_finish(f, sig_acc_jac):
     and multi-chip) that evaluates the RLC product equation."""
     sig_inf = F.fp2_is_zero(sig_acc_jac[2])
     sig_h = TP.jacobian_to_homogeneous(sig_acc_jac)
-    neg_x = jnp.asarray(_NEG_G1_DEV[0]).astype(jnp.int32)[None]
-    neg_y = jnp.asarray(_NEG_G1_DEV[1]).astype(jnp.int32)[None]
-    neg_z = jnp.asarray(L.ONE_MONT).astype(jnp.int32)[None]
-    f_sig = TP.miller_loop(
-        (neg_x, neg_y, neg_z), tuple(c[None] for c in sig_h), sig_inf[None]
-    )
-    f_total = F.fp12_mul(f, f_sig[0])
+    neg_x = L.const_fp([int(d) for d in _NEG_G1_DEV[0]], (1,))
+    neg_y = L.const_fp([int(d) for d in _NEG_G1_DEV[1]], (1,))
+    neg_z = L.const_fp(L.ONE_MONT_DIGITS, (1,))
+    sig_h1 = tuple(F.lead2(c) for c in sig_h)
+    f_sig = TP.miller_loop((neg_x, neg_y, neg_z), sig_h1, sig_inf[None])
+    f_total = F.fp12_mul(f, tuple(F.take6(c, 0) for c in f_sig))
     return F.fp12_is_one(TP.final_exponentiation(f_total))
 
 
 def _rlc_pairing_check(rpk_jac, pair_inf, msg_x, msg_y, sig_acc_jac):
-    """Shared tail of both verify kernels: given rᵢ·pkᵢ (Jacobian G1), the
+    """Shared tail of the verify kernels: given rᵢ·pkᵢ (Jacobian G1), the
     per-pair infinity mask, affine message points H(mᵢ) on the twist, and
     Σ rᵢ·sigᵢ (Jacobian G2), evaluate
 
         ∏ e(rᵢ·pkᵢ, H(mᵢ)) · e(−g1, Σ rᵢ·sigᵢ) == 1
 
     with one shared final exponentiation."""
-    n = msg_x.shape[0]
+    n = msg_x[0].shape[1]
     # message points: affine → homogeneous projective on the twist
     msg_q = (msg_x, msg_y, F.fp2_one((n,)))
     f_msgs = TP.miller_loop(rpk_jac, msg_q, pair_inf)
-    return _rlc_finish(_fp12_product_tree(f_msgs), sig_acc_jac)
+    return _rlc_finish(TP.fp12_product_tree(f_msgs), sig_acc_jac)
 
 
 def multi_verify_kernel(
     pk_x, pk_y, pk_inf, sig_x, sig_y, sig_inf, msg_x, msg_y, msg_inf, r_bits
 ):
-    """RLC batch verify of N (msg, sig, pk) triples. Shapes:
+    """RLC batch verify of N (msg, sig, pk) triples. Rest-format shapes:
     pk_x/pk_y (N, L); sig/msg coords (N, 2, L); inf masks (N,) bool;
     r_bits (N, 64) MSB-first nonzero random scalars. N must be a power of
     two; padding slots are all-infinity (neutral). Returns a scalar bool.
 
     Algebraic twin of Signature::multi_verify (bls/src/signature.rs:96-129).
     """
-    rpk = C.scalar_mul(pk_x, pk_y, pk_inf, r_bits, C.FP_OPS)
-    rsig = C.scalar_mul(sig_x, sig_y, sig_inf, r_bits, C.FP2_OPS)
+    pk = _g1_in(pk_x, pk_y)
+    sig = _g2_in(sig_x, sig_y)
+    msg = _g2_in(msg_x, msg_y)
+    pk_inf = jnp.asarray(pk_inf)
+    sig_inf = jnp.asarray(sig_inf)
+    msg_inf = jnp.asarray(msg_inf)
+    bits = _bits_in(r_bits)
+    rpk = C.scalar_mul(pk[0], pk[1], pk_inf, bits, C.FP_OPS)
+    rsig = C.scalar_mul(sig[0], sig[1], sig_inf, bits, C.FP2_OPS)
     sig_acc = C.sum_points(rsig, C.FP2_OPS)
     pair_inf = pk_inf | msg_inf
-    return _rlc_pairing_check(rpk, pair_inf, msg_x, msg_y, sig_acc)
+    return _rlc_pairing_check(rpk, pair_inf, msg[0], msg[1], sig_acc)
 
 
 def grouped_multi_verify_kernel(
     pk_x, pk_y, pk_inf, sig_x, sig_y, sig_inf, msg_x, msg_y, msg_inf, r_bits
 ):
     """RLC batch verify with triples GROUPED BY MESSAGE: pk/sig/r have
-    shape (M, K, …) — M distinct messages × up to K triples each (padding
-    slots all-infinity) — msg has shape (M, …).
+    rest-format shape (M, K, …) — M distinct messages × up to K triples each
+    (padding slots all-infinity) — msg has shape (M, …).
 
     Algebraic identity:  ∏ᵢ e(rᵢ·pkᵢ, H(mᵢ)) = ∏ⱼ e(Σᵢ∈ⱼ rᵢ·pkᵢ, H(mⱼ)),
     so only M (+1) Miller loops run instead of N (+1) while every triple
@@ -125,20 +151,19 @@ def grouped_multi_verify_kernel(
     AttestationData values per many signatures (BASELINE configs 2–4).
     """
     m, k = pk_inf.shape
-
-    def flat(a):
-        return a.reshape((m * k,) + a.shape[2:])
-
-    rpk = C.scalar_mul(flat(pk_x), flat(pk_y), flat(pk_inf), flat(r_bits), C.FP_OPS)
-    rsig = C.scalar_mul(
-        flat(sig_x), flat(sig_y), flat(sig_inf), flat(r_bits), C.FP2_OPS
-    )
+    pk = _g1_in(_flat_km(pk_x, m, k), _flat_km(pk_y, m, k))
+    sig = _g2_in(_flat_km(sig_x, m, k), _flat_km(sig_y, m, k))
+    msg = _g2_in(msg_x, msg_y)
+    pk_inf_f = _flat_km(pk_inf, m, k)
+    sig_inf_f = _flat_km(sig_inf, m, k)
+    msg_inf = jnp.asarray(msg_inf)
+    bits = _bits_in(_flat_km(r_bits, m, k))
+    rpk = C.scalar_mul(pk[0], pk[1], pk_inf_f, bits, C.FP_OPS)
+    rsig = C.scalar_mul(sig[0], sig[1], sig_inf_f, bits, C.FP2_OPS)
     sig_acc = C.sum_points(rsig, C.FP2_OPS)
-    gpk = C.sum_points_axis1(
-        tuple(c.reshape((m, k) + c.shape[1:]) for c in rpk), C.FP_OPS
-    )
+    gpk = C.sum_points_grouped(rpk, k, C.FP_OPS)  # (M,) Jacobian, m-order
     pair_inf = L.is_zero_val(gpk[2]) | msg_inf
-    return _rlc_pairing_check(gpk, pair_inf, msg_x, msg_y, sig_acc)
+    return _rlc_pairing_check(gpk, pair_inf, msg[0], msg[1], sig_acc)
 
 
 def aggregate_fast_verify_kernel(
@@ -146,73 +171,93 @@ def aggregate_fast_verify_kernel(
     sig_x, sig_y, sig_inf, msg_x, msg_y, msg_inf, r_bits,
 ):
     """Firehose kernel: M aggregates (gossip attestations), each signed by up
-    to K committee members over one message. Shapes: mem_x/mem_y (M, K, L)
-    affine member pubkeys with mem_inf (M, K) padding mask; slot_pad (M,)
-    marks batch-padding slots; sig/msg per aggregate as in
+    to K committee members over one message. Rest-format shapes: mem_x/mem_y
+    (M, K, L) affine member pubkeys with mem_inf (M, K) padding mask;
+    slot_pad (M,) marks batch-padding slots; sig/msg per aggregate as in
     multi_verify_kernel; r_bits (M, 64).
 
-    Computes pkᵢ = Σₖ memᵢₖ (complete-add tree over K), then the RLC check.
-    A REAL slot whose members sum to the identity is rejected (matching the
-    anchor's fast_aggregate_verify: an adversary could pair a [P, −P]
-    committee with an infinity signature to fake participation); padding
-    slots stay algebraically neutral.
+    Computes pkᵢ = Σₖ memᵢₖ (complete-add tree over the k-major flat batch),
+    then the RLC check. A REAL slot whose members sum to the identity is
+    rejected (matching the anchor's fast_aggregate_verify: an adversary
+    could pair a [P, −P] committee with an infinity signature to fake
+    participation); padding slots stay algebraically neutral.
     Reference shape: attestation_batch_triples + MultiVerifier::finish
     (p2p/src/attestation_verifier.rs:431-457, helper_functions verifier.rs:302).
     """
-    one = C.FP_OPS.one_like(mem_x)
-    zero = C.FP_OPS.zeros_like(mem_x)
+    m, k = mem_inf.shape
+    mem = _g1_in(_flat_km(mem_x, m, k), _flat_km(mem_y, m, k))
+    mem_inf_f = _flat_km(mem_inf, m, k)
+    one = C.FP_OPS.one_like(mem[0])
+    zero = C.FP_OPS.zeros_like(mem[0])
     mem_jac = (
-        C.FP_OPS.select(mem_inf, one, mem_x),
-        C.FP_OPS.select(mem_inf, one, mem_y),
-        C.FP_OPS.select(mem_inf, zero, one),
+        C.FP_OPS.select(mem_inf_f, one, mem[0]),
+        C.FP_OPS.select(mem_inf_f, one, mem[1]),
+        C.FP_OPS.select(mem_inf_f, zero, one),
     )
-    agg_pk = C.sum_points_axis1(mem_jac, C.FP_OPS)  # (M,) Jacobian G1
+    agg_pk = C.sum_points_grouped(mem_jac, k, C.FP_OPS)  # (M,) Jacobian G1
     agg_inf = L.is_zero_val(agg_pk[2])
+    slot_pad = jnp.asarray(slot_pad)
     forged = jnp.any(jnp.logical_and(jnp.logical_not(slot_pad), agg_inf))
-    rpk = C.scalar_mul_jac(agg_pk, agg_inf, r_bits, C.FP_OPS)
-    rsig = C.scalar_mul(sig_x, sig_y, sig_inf, r_bits, C.FP2_OPS)
+    sig = _g2_in(sig_x, sig_y)
+    msg = _g2_in(msg_x, msg_y)
+    sig_inf = jnp.asarray(sig_inf)
+    msg_inf = jnp.asarray(msg_inf)
+    bits = _bits_in(r_bits)
+    rpk = C.scalar_mul_jac(agg_pk, agg_inf, bits, C.FP_OPS)
+    rsig = C.scalar_mul(sig[0], sig[1], sig_inf, bits, C.FP2_OPS)
     sig_acc = C.sum_points(rsig, C.FP2_OPS)
     pair_inf = agg_inf | msg_inf
-    ok = _rlc_pairing_check(rpk, pair_inf, msg_x, msg_y, sig_acc)
+    ok = _rlc_pairing_check(rpk, pair_inf, msg[0], msg[1], sig_acc)
     return jnp.logical_and(ok, jnp.logical_not(forged))
 
 
 def batch_sign_kernel(msg_x, msg_y, msg_inf, sk_bits):
     """N signatures: [skᵢ]·H(mᵢ) on the twist. sk_bits (N, 255) MSB-first.
-    Returns a Jacobian G2 batch (host normalizes/compresses).
+    Returns a Jacobian G2 batch in rest format (N, 2, 26) per coord.
 
     NOTE: secret scalars live on the accelerator; the kernel is branchless
     (fixed trip count, select-based) but NOT hardened against physical side
     channels — acceptable for benching, keep hot production signing host-side
     (SURVEY.md §7 risks)."""
-    return C.scalar_mul(msg_x, msg_y, msg_inf, sk_bits, C.FP2_OPS)
+    msg = _g2_in(msg_x, msg_y)
+    X, Y, Z = C.scalar_mul(
+        msg[0], msg[1], jnp.asarray(msg_inf), _bits_in(sk_bits), C.FP2_OPS
+    )
+    return F.fp2_merge(X), F.fp2_merge(Y), F.fp2_merge(Z)
 
 
 def g1_normalize_kernel(X, Y, Z):
     """Batched Jacobian → affine on device (one Fermat inversion scan for
-    the whole batch): (x, y, inf). Infinity rows return garbage coords
-    under a True mask."""
-    zinv = L.inv_mod(Z)
+    the whole batch): (x, y, inf) in rest format. Infinity rows return
+    garbage coords under a True mask."""
+    Xl, Yl, Zl = L.split(jnp.asarray(X)), L.split(jnp.asarray(Y)), L.split(jnp.asarray(Z))
+    zinv = L.inv_mod(Zl)
     zinv2 = L.montmul(zinv, zinv)
     zinv3 = L.montmul(zinv2, zinv)
-    return L.montmul(X, zinv2), L.montmul(Y, zinv3), L.is_zero_val(Z)
+    x = L.montmul(Xl, zinv2)
+    y = L.montmul(Yl, zinv3)
+    return L.merge(x), L.merge(y), L.is_zero_val(Zl)
 
 
 def g2_normalize_kernel(X, Y, Z):
-    zinv = F.fp2_inv(Z)
+    Xl, Yl, Zl = (F.fp2_split(jnp.asarray(c)) for c in (X, Y, Z))
+    zinv = F.fp2_inv(Zl)
     zinv2 = F.fp2_sq(zinv)
     zinv3 = F.fp2_mul(zinv2, zinv)
-    return F.fp2_mul(X, zinv2), F.fp2_mul(Y, zinv3), F.fp2_is_zero(Z)
+    x = F.fp2_mul(Xl, zinv2)
+    y = F.fp2_mul(Yl, zinv3)
+    return F.fp2_merge(x), F.fp2_merge(y), F.fp2_is_zero(Zl)
 
 
 def batch_pubkey_kernel(sk_bits):
-    """N public keys: [skᵢ]·g1. sk_bits (N, 255) MSB-first."""
+    """N public keys: [skᵢ]·g1. sk_bits (N, 255) MSB-first; rest-format out."""
     gx, gy, _ = C.g1_point_to_dev(G1)
     n = sk_bits.shape[0]
-    qx = jnp.broadcast_to(jnp.asarray(gx), (n,) + gx.shape).astype(jnp.int32)
-    qy = jnp.broadcast_to(jnp.asarray(gy), (n,) + gy.shape).astype(jnp.int32)
+    qx = L.const_fp([int(d) for d in gx], (n,))
+    qy = L.const_fp([int(d) for d in gy], (n,))
     q_inf = jnp.zeros((n,), bool)
-    return C.scalar_mul(qx, qy, q_inf, sk_bits, C.FP_OPS)
+    X, Y, Z = C.scalar_mul(qx, qy, q_inf, _bits_in(sk_bits), C.FP_OPS)
+    return L.merge(X), L.merge(Y), L.merge(Z)
 
 
 # --- multi-chip (SPMD over a device mesh) -----------------------------------
@@ -233,24 +278,37 @@ def make_sharded_multi_verify(mesh, axis: str = "batch"):
     from jax import lax
     from jax.sharding import PartitionSpec as P
 
+    n_dev = mesh.shape[axis]
+    assert n_dev & (n_dev - 1) == 0, (
+        "make_sharded_multi_verify requires a power-of-two device count"
+    )
+
+    def gather_tree(t):
+        # gather batchless (26,) limb-major leaves into (26, n_dev): the
+        # device axis becomes the batch axis (position 1)
+        return jax.tree.map(lambda x: lax.all_gather(x, axis, axis=1), t)
+
     def local_step(
         pk_x, pk_y, pk_inf, sig_x, sig_y, sig_inf, msg_x, msg_y, msg_inf, r_bits
     ):
-        rpk = C.scalar_mul(pk_x, pk_y, pk_inf, r_bits, C.FP_OPS)
-        rsig = C.scalar_mul(sig_x, sig_y, sig_inf, r_bits, C.FP2_OPS)
+        pk = _g1_in(pk_x, pk_y)
+        sig = _g2_in(sig_x, sig_y)
+        msg = _g2_in(msg_x, msg_y)
+        bits = _bits_in(r_bits)
+        rpk = C.scalar_mul(pk[0], pk[1], pk_inf, bits, C.FP_OPS)
+        rsig = C.scalar_mul(sig[0], sig[1], sig_inf, bits, C.FP2_OPS)
         sX, sY, sZ = C.sum_points(rsig, C.FP2_OPS)  # local G2 partial sum
         n = msg_x.shape[0]
-        msg_q = (msg_x, msg_y, F.fp2_one((n,)))
-        f_local = _fp12_product_tree(
+        msg_q = (msg[0], msg[1], F.fp2_one((n,)))
+        f_local = TP.fp12_product_tree(
             TP.miller_loop(rpk, msg_q, pk_inf | msg_inf)
         )
-        # cross-chip: gather the per-chip partials (tiny), finish replicated
-        f_all = lax.all_gather(f_local, axis)  # (n_dev, …fp12)
-        sig_all = tuple(
-            lax.all_gather(c, axis) for c in (sX, sY, sZ)
-        )  # (n_dev,) G2 points
+        # cross-chip: gather the per-chip partials (tiny), finish replicated.
+        # Each limb array is a scalar per chip → all_gather yields (n_dev,).
+        f_all = gather_tree(f_local)
+        sig_all = gather_tree((sX, sY, sZ))
         sig_acc = C.sum_points(sig_all, C.FP2_OPS)
-        return _rlc_finish(_fp12_product_tree(f_all), sig_acc)
+        return _rlc_finish(TP.fp12_product_tree(f_all), sig_acc)
 
     batch = P(axis)
     shardings = (
@@ -600,9 +658,11 @@ class TpuBlsBackend:
 __all__ = [
     "TpuBlsBackend",
     "multi_verify_kernel",
+    "grouped_multi_verify_kernel",
     "aggregate_fast_verify_kernel",
     "batch_sign_kernel",
     "batch_pubkey_kernel",
     "g1_normalize_kernel",
     "g2_normalize_kernel",
+    "make_sharded_multi_verify",
 ]

@@ -1,10 +1,12 @@
 """Batched Jacobian curve arithmetic on device, generic over the coordinate
-field (G1 over Fp, G2 over Fp2 on the twist).
+field (G1 over Fp, G2 over Fp2 on the twist), in limb-list form.
 
 Conventions:
-  - A point is a tuple (X, Y, Z) of field arrays; Z = 0 ⇒ infinity.
-  - Every formula groups its independent field multiplications into stacked
-    `mul_stack` calls (one montmul scan each) — see field.py.
+  - A point is a tuple (X, Y, Z) of field elements (limb-list pytrees);
+    Z = 0 ⇒ infinity.
+  - Every formula groups its independent field multiplications into
+    `mul_many` calls over element LISTS (one fused Montgomery product each —
+    see field.py on why this is about graph size, not lanes).
   - Branchless: degenerate cases are computed-and-selected, never branched.
     Doubling is complete for our curves (no 2-torsion: both cofactors are
     odd, so Y=0 never occurs on-curve and Z3=2YZ=0 only propagates infinity).
@@ -21,6 +23,7 @@ from dataclasses import dataclass
 from typing import Callable
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -28,11 +31,37 @@ from grandine_tpu.tpu import field as F
 from grandine_tpu.tpu import limbs as L
 
 
+def _fp_mul_many(aa, bb):
+    """Multiply paired Fp lists elementwise, fused into one montmul."""
+    r = L.montmul(L.stack_fp(aa), L.stack_fp(bb))
+    return L.unstack_fp(r, len(aa))
+
+
+def _fp2_mul_many(aa, bb):
+    return F.fp2_pair_products(list(zip(aa, bb)))
+
+
+def _fp_one_like(a):
+    return L.const_fp(L.ONE_MONT_DIGITS, a.shape[1:])
+
+
+def _fp_zeros_like(a):
+    return L.zeros_fp(a.shape[1:])
+
+
+def _fp2_one_like(a):
+    return F.fp2_one(a[0].shape[1:])
+
+
+def _fp2_zeros_like(a):
+    return F.fp2_zero(a[0].shape[1:])
+
+
 @dataclass(frozen=True)
 class FieldOps:
     """The field-op surface the curve formulas need."""
 
-    mul_stack: Callable  # (K, ..., elem), (K, ..., elem) -> (K, ..., elem)
+    mul_many: Callable  # ([elem], [elem]) -> [elem]
     add: Callable
     sub: Callable
     neg: Callable
@@ -40,36 +69,56 @@ class FieldOps:
     is_zero: Callable  # elem -> bool batch
     zeros_like: Callable
     one_like: Callable
+    index: Callable  # (elem, idx) -> elem (numpy-style batch index)
+    concat: Callable  # ([elem], axis) -> elem
+    batch_len: Callable  # elem -> size of the leading batch axis
 
 
-def _fp_one_like(a):
-    return jnp.broadcast_to(jnp.asarray(L.ONE_MONT), a.shape).astype(jnp.int32)
+def _fp_index(a, idx):
+    return L.index_fp(a, idx)
 
 
-def _fp2_one_like(a):
-    return F.fp2_one(a.shape[:-2])
+def _fp_concat(elems, axis=0):
+    return L.concat_fp(elems, axis=axis)
+
+
+def _fp2_index(a, idx):
+    return (L.index_fp(a[0], idx), L.index_fp(a[1], idx))
+
+
+def _fp2_concat(elems, axis=0):
+    return (
+        L.concat_fp([e[0] for e in elems], axis=axis),
+        L.concat_fp([e[1] for e in elems], axis=axis),
+    )
 
 
 FP_OPS = FieldOps(
-    mul_stack=L.montmul,
+    mul_many=_fp_mul_many,
     add=L.add_mod,
     sub=L.sub_mod,
     neg=L.neg_mod,
     select=L.select,
     is_zero=L.is_zero_val,
-    zeros_like=jnp.zeros_like,
+    zeros_like=_fp_zeros_like,
     one_like=_fp_one_like,
+    index=_fp_index,
+    concat=_fp_concat,
+    batch_len=lambda e: e.shape[1],
 )
 
 FP2_OPS = FieldOps(
-    mul_stack=F.fp2_mul_many,
+    mul_many=_fp2_mul_many,
     add=F.fp2_add,
     sub=F.fp2_sub,
     neg=F.fp2_neg,
     select=F.fp2_select,
     is_zero=F.fp2_is_zero,
-    zeros_like=jnp.zeros_like,
+    zeros_like=_fp2_zeros_like,
     one_like=_fp2_one_like,
+    index=_fp2_index,
+    concat=_fp2_concat,
+    batch_len=lambda e: e[0].shape[1],
 )
 
 
@@ -81,17 +130,15 @@ def point_infinity_like(x, ops: FieldOps):
 def point_double(p, ops: FieldOps):
     """dbl-2009-l (a=0): complete on our curves (see module docstring)."""
     X, Y, Z = p
-    m1 = ops.mul_stack(jnp.stack([X, Y, Y]), jnp.stack([X, Y, Z]))
-    A, Bq, YZ = m1[0], m1[1], m1[2]
+    A, Bq, YZ = ops.mul_many([X, Y, Y], [X, Y, Z])
     XB = ops.add(X, Bq)
-    m2 = ops.mul_stack(jnp.stack([Bq, XB]), jnp.stack([Bq, XB]))
-    C, T1 = m2[0], m2[1]
+    C, T1 = ops.mul_many([Bq, XB], [Bq, XB])
     D = ops.sub(T1, ops.add(A, C))
     D = ops.add(D, D)  # 2((X+B)² - A - C)
     E = ops.add(ops.add(A, A), A)
-    Fv = ops.mul_stack(E[None], E[None])[0]
+    (Fv,) = ops.mul_many([E], [E])
     X3 = ops.sub(Fv, ops.add(D, D))
-    t = ops.mul_stack(E[None], ops.sub(D, X3)[None])[0]
+    (t,) = ops.mul_many([E], [ops.sub(D, X3)])
     C2 = ops.add(C, C)
     C4 = ops.add(C2, C2)
     C8 = ops.add(C4, C4)
@@ -104,22 +151,20 @@ def point_madd_unsafe(p, qx, qy, ops: FieldOps):
     """Mixed add P(jacobian) + Q(affine) assuming P ≠ ±Q and P, Q ≠ ∞
     (madd-2007-bl). Degeneracies must be selected away by the caller."""
     X, Y, Z = p
-    Z2 = ops.mul_stack(Z[None], Z[None])[0]
-    m2 = ops.mul_stack(jnp.stack([qx, Z]), jnp.stack([Z2, Z2]))
-    U2, ZZZ = m2[0], m2[1]
+    (Z2,) = ops.mul_many([Z], [Z])
+    U2, ZZZ = ops.mul_many([qx, Z], [Z2, Z2])
     H = ops.sub(U2, X)
-    m3 = ops.mul_stack(jnp.stack([qy, H]), jnp.stack([ZZZ, H]))
-    S2, HH = m3[0], m3[1]
+    S2, HH = ops.mul_many([qy, H], [ZZZ, H])
     I = ops.add(HH, HH)
     I = ops.add(I, I)  # 4HH
     r = ops.sub(S2, Y)
     r = ops.add(r, r)
-    m4 = ops.mul_stack(jnp.stack([H, X, r]), jnp.stack([I, I, r]))
-    J, V, R2 = m4[0], m4[1], m4[2]
+    J, V, R2 = ops.mul_many([H, X, r], [I, I, r])
     X3 = ops.sub(R2, ops.add(J, ops.add(V, V)))
     ZH = ops.add(Z, H)
-    m5 = ops.mul_stack(jnp.stack([r, Y, ZH]), jnp.stack([ops.sub(V, X3), J, ZH]))
-    t, YJ, ZH2 = m5[0], m5[1], m5[2]
+    t, YJ, ZH2 = ops.mul_many(
+        [r, Y, ZH], [ops.sub(V, X3), J, ZH]
+    )
     Y3 = ops.sub(t, ops.add(YJ, YJ))
     Z3 = ops.sub(ZH2, ops.add(Z2, HH))
     return (X3, Y3, Z3)
@@ -131,31 +176,25 @@ def point_add_complete(p, q, ops: FieldOps):
     adversary-influenced points."""
     X1, Y1, Z1 = p
     X2, Y2, Z2 = q
-    m1 = ops.mul_stack(jnp.stack([Z1, Z2]), jnp.stack([Z1, Z2]))
-    Z1Z1, Z2Z2 = m1[0], m1[1]
-    m2 = ops.mul_stack(
-        jnp.stack([X1, X2, Z2, Z1]), jnp.stack([Z2Z2, Z1Z1, Z2Z2, Z1Z1])
+    Z1Z1, Z2Z2 = ops.mul_many([Z1, Z2], [Z1, Z2])
+    U1, U2, t1, t2 = ops.mul_many(
+        [X1, X2, Z2, Z1], [Z2Z2, Z1Z1, Z2Z2, Z1Z1]
     )
-    U1, U2, t1, t2 = m2[0], m2[1], m2[2], m2[3]
-    m3 = ops.mul_stack(jnp.stack([Y1, Y2]), jnp.stack([t1, t2]))
-    S1, S2 = m3[0], m3[1]
+    S1, S2 = ops.mul_many([Y1, Y2], [t1, t2])
     H = ops.sub(U2, U1)
     H2 = ops.add(H, H)
-    m4 = ops.mul_stack(H2[None], H2[None])
-    I = m4[0]
+    (I,) = ops.mul_many([H2], [H2])
     r = ops.sub(S2, S1)
     r = ops.add(r, r)
-    m5 = ops.mul_stack(jnp.stack([H, U1, r]), jnp.stack([I, I, r]))
-    J, V, R2 = m5[0], m5[1], m5[2]
+    J, V, R2 = ops.mul_many([H, U1, r], [I, I, r])
     X3 = ops.sub(R2, ops.add(J, ops.add(V, V)))
     Z12 = ops.add(Z1, Z2)
-    m6 = ops.mul_stack(
-        jnp.stack([r, S1, Z12]), jnp.stack([ops.sub(V, X3), J, Z12])
+    t, S1J, Z12sq = ops.mul_many(
+        [r, S1, Z12], [ops.sub(V, X3), J, Z12]
     )
-    t, S1J, Z12sq = m6[0], m6[1], m6[2]
     Y3 = ops.sub(t, ops.add(S1J, S1J))
     Zpre = ops.sub(Z12sq, ops.add(Z1Z1, Z2Z2))
-    Z3 = ops.mul_stack(Zpre[None], H[None])[0]
+    (Z3,) = ops.mul_many([Zpre], [H])
 
     dbl = point_double(p, ops)
     p_inf = ops.is_zero(Z1)
@@ -168,7 +207,14 @@ def point_add_complete(p, q, ops: FieldOps):
         return tuple(ops.select(cond, ai, bi) for ai, bi in zip(a, b))
 
     out = (X3, Y3, Z3)
-    out = sel3(eq_x & jnp.logical_not(eq_y) & jnp.logical_not(p_inf) & jnp.logical_not(q_inf), inf, out)
+    out = sel3(
+        eq_x
+        & jnp.logical_not(eq_y)
+        & jnp.logical_not(p_inf)
+        & jnp.logical_not(q_inf),
+        inf,
+        out,
+    )
     out = sel3(eq_x & eq_y, dbl, out)
     out = sel3(q_inf, p, out)
     out = sel3(p_inf, q, out)
@@ -177,11 +223,11 @@ def point_add_complete(p, q, ops: FieldOps):
 
 def scalar_mul(qx, qy, q_inf, bits_msb: jnp.ndarray, ops: FieldOps):
     """[k]Q for affine Q (batched), k given as an MSB-first bit array
-    (..., nbits) uint32. Returns a Jacobian point. Scalars must be < r
+    (nbits, *batch) int32. Returns a Jacobian point. Scalars must be < r
     (see module docstring for why mixed adds suffice)."""
     one = ops.one_like(qx)
     zero = ops.zeros_like(qx)
-    started0 = jnp.zeros(bits_msb.shape[:-1], bool)
+    started0 = jnp.zeros(bits_msb.shape[1:], bool)
     init = ((one, one, zero), started0)  # infinity, nothing accumulated yet
 
     def step(carry, bit):
@@ -195,7 +241,7 @@ def scalar_mul(qx, qy, q_inf, bits_msb: jnp.ndarray, ops: FieldOps):
         Z = ops.select(bitb, ops.select(started, added[2], one), st[2])
         return ((X, Y, Z), jnp.logical_or(started, bitb)), None
 
-    (st, _), _ = lax.scan(step, init, jnp.moveaxis(bits_msb, -1, 0))
+    (st, _), _ = lax.scan(step, init, bits_msb)
     # [k]∞ = ∞
     X = ops.select(q_inf, one, st[0])
     Y = ops.select(q_inf, one, st[1])
@@ -227,48 +273,67 @@ def scalar_mul_jac(q, q_inf, bits_msb: jnp.ndarray, ops: FieldOps):
         st = tuple(ops.select(bitb, a, s) for a, s in zip(added, st))
         return st, None
 
-    st, _ = lax.scan(step, init, jnp.moveaxis(bits_msb, -1, 0))
+    st, _ = lax.scan(step, init, bits_msb)
     X = ops.select(q_inf, one, st[0])
     Y = ops.select(q_inf, one, st[1])
     Z = ops.select(q_inf, zero, st[2])
     return (X, Y, Z)
 
 
+def _roll_elem(e, shift):
+    """Roll every component array of a field element by -shift along the
+    leading batch axis (shift may be a traced scalar)."""
+    return jax.tree.map(lambda x: jnp.roll(x, -shift, axis=1), e)
+
+
+def _tree_reduce_points(p, levels: int, stride0: int, ops: FieldOps):
+    """Pairwise reduction with a FIXED shape: `levels` iterations of
+    y <- y + roll(y, -s), s = stride0, stride0/2, ..., so index 0 of each
+    group accumulates its whole group sum. Tail positions compute garbage
+    (valid field elements, wrong points) that the shrinking valid prefix
+    never reads.
+
+    Why not a classic halving tree: each halving level is a DIFFERENT shape,
+    so XLA gets log2(N) copies of the complete-addition graph — measured
+    minutes of compile time (and tens of GB of compiler RSS on CPU) for what
+    this formulation compiles ONCE as a fori_loop body. The price is <=2x
+    more point additions (every level runs at full width), cheap next to the
+    montmul work it feeds.
+    """
+    if levels == 0:
+        return p
+
+    def body(_, carry):
+        y, s = carry
+        rolled = tuple(_roll_elem(e, s) for e in y)
+        y = point_add_complete(y, rolled, ops)
+        return (y, s // 2)
+
+    y, _ = lax.fori_loop(0, levels, body, (p, jnp.int32(stride0)))
+    return y
+
+
 def sum_points(p, ops: FieldOps):
-    """Reduce a batch of Jacobian points (leading axis) to a single point by
-    a binary tree of complete additions (any batch size ≥ 1; an odd tail
-    element rides along to the next level)."""
-    X, Y, Z = p
-    n = X.shape[0]
-    while n > 1:
-        h = n // 2
-        a = (X[:h], Y[:h], Z[:h])
-        b = (X[h : 2 * h], Y[h : 2 * h], Z[h : 2 * h])
-        Xs, Ys, Zs = point_add_complete(a, b, ops)
-        if n % 2:
-            Xs = jnp.concatenate([Xs, X[2 * h :]], axis=0)
-            Ys = jnp.concatenate([Ys, Y[2 * h :]], axis=0)
-            Zs = jnp.concatenate([Zs, Z[2 * h :]], axis=0)
-        X, Y, Z = Xs, Ys, Zs
-        n = X.shape[0]
-    return (X[0], Y[0], Z[0])
+    """Reduce a batch of Jacobian points (leading batch axis on every limb
+    array) to a single point. Batch must be a power of two (pad with
+    infinity — the identity is neutral in complete addition)."""
+    n = ops.batch_len(p[0])
+    assert n & (n - 1) == 0, "sum_points requires a power-of-two batch"
+    y = _tree_reduce_points(p, n.bit_length() - 1, n // 2, ops)
+    return tuple(ops.index(e, 0) for e in y)
 
 
-def sum_points_axis1(p, ops: FieldOps):
-    """Reduce axis 1 of a (M, K, …) batch of Jacobian points to (M, …) by a
-    binary tree of complete additions. K must be a power of two (pad with
-    infinity). This is the committee-aggregation kernel: M attestations ×
-    K member public keys → M aggregate keys."""
-    X, Y, Z = p
-    k = X.shape[1]
-    assert k & (k - 1) == 0, "sum_points_axis1 requires power-of-two K"
-    while k > 1:
-        h = k // 2
-        a = (X[:, :h], Y[:, :h], Z[:, :h])
-        b = (X[:, h:k], Y[:, h:k], Z[:, h:k])
-        X, Y, Z = point_add_complete(a, b, ops)
-        k = h
-    return (X[:, 0], Y[:, 0], Z[:, 0])
+def sum_points_grouped(p, k: int, ops: FieldOps):
+    """Reduce a k-major flat batch of M*K Jacobian points (index = j*M + m)
+    to M group sums (returned as the flat prefix): pairs (j, m) with
+    (j + K/2, m) each level. K must be a power of two (pad with infinity).
+    This is the committee-aggregation kernel: M attestations x K member
+    public keys -> M aggregate keys."""
+    assert k & (k - 1) == 0, "sum_points_grouped requires power-of-two K"
+    total = ops.batch_len(p[0])
+    m = total // k
+    y = _tree_reduce_points(p, k.bit_length() - 1, (k // 2) * m, ops)
+    return tuple(ops.index(e, slice(0, m)) for e in y)
 
 
 def scalars_to_bits_msb(scalars, nbits: int) -> np.ndarray:
@@ -290,6 +355,10 @@ def scalars_to_bits_msb(scalars, nbits: int) -> np.ndarray:
 
 
 # --- host conversions ------------------------------------------------------
+#
+# Rest format: G1 affine (x (…, 26), y (…, 26), inf bool); G2 affine with
+# (…, 2, 26) coords — identical to the array-form design, so the host prep
+# pipeline (batched inversions + one unpackbits pass) is unchanged.
 
 
 def g1_point_to_dev(pt) -> "tuple[np.ndarray, np.ndarray, np.ndarray]":
@@ -309,7 +378,7 @@ def g2_point_to_dev(pt):
 
 
 def dev_to_g1_point(X, Y, Z):
-    """Device Jacobian G1 → anchor Point."""
+    """Device Jacobian G1 (rest-format (26,) arrays) → anchor Point."""
     from grandine_tpu.crypto.curves import B1, Point, g1_infinity
     from grandine_tpu.crypto.fields import Fq
 

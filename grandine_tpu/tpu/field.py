@@ -1,19 +1,27 @@
-"""Device field tower on limb arrays.
+"""Device field tower on limb-list elements.
 
-Shapes (always trailing; any leading batch shape broadcasts):
-  Fp   (..., 24)
-  Fp2  (..., 2, 24)          c0 + c1·u
-  Fp6  (..., 3, 2, 24)       over Fp2, v³ = ξ = 1+u
-  Fp12 (..., 2, 3, 2, 24)    over Fp6, w² = v
+Structure (nested tuples of limb-major arrays — JAX pytrees):
+  Fp   one (26, *batch) int32 array (see limbs.py)
+  Fp2  (c0, c1)            c0 + c1·u
+  Fp6  (c0, c1, c2)        over Fp2, v³ = ξ = 1+u
+  Fp12 (c0, c1)            over Fp6, w² = v
+
+Every component array in one element shares one batch shape; functions
+accept any batch shape, including stacked batch axes (axis 1).
 
 Same tower and formulas as the anchor (grandine_tpu/crypto/fields.py); every
 function is differentially tested against it. Frobenius coefficients are
 imported from the anchor's derived values — a single source of truth.
 
-The `*_many` variants take a stacked leading axis of independent pairs and
-fold ALL their limb multiplications into a single wide montmul scan — one
-Fp12 multiplication is exactly one 54-wide montmul call. This is what keeps
-the Miller-loop XLA graph compilable and the VPU lanes full.
+The `*_many` variants take elements whose limb arrays carry a leading stack
+axis of independent pairs and fold ALL their limb multiplications into a
+single Montgomery-product call — one Fp12 multiplication is exactly one
+54-wide montmul: fewer scan instances in the graph and fewer kernel
+launches at runtime (the batch owns the vector lanes regardless — limbs.py
+module docstring).
+
+All products route through limbs.montmul — one implementation won on both
+compile time and runtime (limbs.py module docstring).
 """
 
 from __future__ import annotations
@@ -26,159 +34,228 @@ from grandine_tpu.tpu import limbs as L
 
 NL = L.NLIMBS
 
+
+# --- lead/unlead helpers (add/remove a length-1 leading stack axis) --------
+
+
+def lead_fp(a):
+    return a[:, None]
+
+
+def unlead_fp(a):
+    return a[:, 0]
+
+
+def lead2(a):
+    return (lead_fp(a[0]), lead_fp(a[1]))
+
+
+def unlead2(a):
+    return (unlead_fp(a[0]), unlead_fp(a[1]))
+
+
+def lead6(a):
+    return tuple(lead2(c) for c in a)
+
+
+def unlead6(a):
+    return tuple(unlead2(c) for c in a)
+
+
+def lead12(a):
+    return tuple(lead6(c) for c in a)
+
+
+def unlead12(a):
+    return tuple(unlead6(c) for c in a)
+
+
+def cat2(elems):
+    """Concatenate Fp2 elements along the leading stack axis."""
+    return (
+        L.concat_fp([e[0] for e in elems]),
+        L.concat_fp([e[1] for e in elems]),
+    )
+
+
+def slice2(a, lo, hi):
+    return (L.index_fp(a[0], slice(lo, hi)), L.index_fp(a[1], slice(lo, hi)))
+
+
+def take2(a, i):
+    return (L.index_fp(a[0], i), L.index_fp(a[1], i))
+
+
+def cat6(elems):
+    return tuple(cat2([e[i] for e in elems]) for i in range(3))
+
+
+def slice6(a, lo, hi):
+    return tuple(slice2(c, lo, hi) for c in a)
+
+
+def take6(a, i):
+    return tuple(take2(c, i) for c in a)
+
+
 # --- Fp2 -------------------------------------------------------------------
 
 
 def fp2_add(a, b):
-    return L.add_mod(a, b)
+    return (L.add_mod(a[0], b[0]), L.add_mod(a[1], b[1]))
 
 
 def fp2_sub(a, b):
-    return L.sub_mod(a, b)
+    return (L.sub_mod(a[0], b[0]), L.sub_mod(a[1], b[1]))
 
 
 def fp2_neg(a):
-    return L.neg_mod(a)
+    return (L.neg_mod(a[0]), L.neg_mod(a[1]))
+
+
+def fp2_double(a):
+    return (L.double_mod(a[0]), L.double_mod(a[1]))
 
 
 def fp2_mul_many(A, B):
-    """Multiply K independent Fp2 pairs: (K, ..., 2, 24) → (K, ..., 2, 24),
-    with all 3K limb products in one montmul call (Karatsuba)."""
-    a0, a1 = A[..., 0, :], A[..., 1, :]
-    b0, b1 = B[..., 0, :], B[..., 1, :]
+    """Multiply K independent Fp2 pairs (leading stack axis K on every limb
+    array) with all 3K limb products in one montmul call (Karatsuba)."""
+    a0, a1 = A
+    b0, b1 = B
     sa = L.add_mod(a0, a1)
     sb = L.add_mod(b0, b1)
-    s = jnp.concatenate([a0, a1, sa], axis=0)
-    t = jnp.concatenate([b0, b1, sb], axis=0)
+    s = L.concat_fp([a0, a1, sa])
+    t = L.concat_fp([b0, b1, sb])
     r = L.montmul(s, t)
-    k = A.shape[0]
-    r0, r1, r2 = r[:k], r[k : 2 * k], r[2 * k :]
+    k = a0.shape[1]
+    r0 = L.index_fp(r, slice(0, k))
+    r1 = L.index_fp(r, slice(k, 2 * k))
+    r2 = L.index_fp(r, slice(2 * k, 3 * k))
     c0 = L.sub_mod(r0, r1)
     c1 = L.sub_mod(r2, L.add_mod(r0, r1))
-    return jnp.stack([c0, c1], axis=-2)
+    return (c0, c1)
 
 
 def fp2_mul(a, b):
-    a, b = jnp.broadcast_arrays(a, b)
-    return fp2_mul_many(a[None], b[None])[0]
+    return unlead2(fp2_mul_many(lead2(a), lead2(b)))
+
+
+def fp2_pair_products(pairs):
+    """Run the listed independent Fp2 products in ONE fused montmul call;
+    pairs = [(x, y), …] of same-batch Fp2 elements. The shared fusion helper
+    behind the curve formulas and the Miller-loop steps."""
+    A = cat2([lead2(x) for x, _ in pairs])
+    B = cat2([lead2(y) for _, y in pairs])
+    T = fp2_mul_many(A, B)
+    return [unlead2(slice2(T, i, i + 1)) for i in range(len(pairs))]
 
 
 def fp2_sq_many(A):
-    """Square K independent Fp2 elements with 2K limb products in one call."""
-    a0, a1 = A[..., 0, :], A[..., 1, :]
-    s = jnp.concatenate([L.add_mod(a0, a1), a0], axis=0)
-    t = jnp.concatenate([L.sub_mod(a0, a1), a1], axis=0)
+    """Square K independent Fp2 elements with 2K limb products in one call:
+    (a0+a1)(a0-a1) and a0·a1."""
+    a0, a1 = A
+    s = L.concat_fp([L.add_mod(a0, a1), a0])
+    t = L.concat_fp([L.sub_mod(a0, a1), a1])
     r = L.montmul(s, t)
-    k = A.shape[0]
-    c0 = r[:k]
-    c1 = r[k:]
-    return jnp.stack([c0, L.add_mod(c1, c1)], axis=-2)
+    k = a0.shape[1]
+    c0 = L.index_fp(r, slice(0, k))
+    c1 = L.index_fp(r, slice(k, 2 * k))
+    return (c0, L.double_mod(c1))
 
 
 def fp2_sq(a):
-    return fp2_sq_many(a[None])[0]
+    return unlead2(fp2_sq_many(lead2(a)))
 
 
 def fp2_scale(a, k):
-    """Multiply Fp2 by an Fp scalar (shape broadcastable to (..., 24))."""
-    kk = jnp.broadcast_to(k, a[..., 0, :].shape)
-    r = L.montmul(jnp.stack([a[..., 0, :], a[..., 1, :]]), jnp.stack([kk, kk]))
-    return jnp.stack([r[0], r[1]], axis=-2)
+    """Multiply Fp2 by an Fp scalar (broadcastable batch shapes)."""
+    kk = jnp.broadcast_to(k, a[0].shape)
+    r = L.montmul(L.stack_fp([a[0], a[1]]), L.stack_fp([kk, kk]))
+    parts = L.unstack_fp(r, 2)
+    return (parts[0], parts[1])
 
 
 def fp2_conj(a):
-    return jnp.stack([a[..., 0, :], L.neg_mod(a[..., 1, :])], axis=-2)
+    return (a[0], L.neg_mod(a[1]))
 
 
 def fp2_mul_by_xi(a):
     """×(1+u): (c0 - c1, c0 + c1)."""
-    a0, a1 = a[..., 0, :], a[..., 1, :]
-    return jnp.stack([L.sub_mod(a0, a1), L.add_mod(a0, a1)], axis=-2)
+    return (L.sub_mod(a[0], a[1]), L.add_mod(a[0], a[1]))
 
 
 def fp2_inv(a):
-    a0, a1 = a[..., 0, :], a[..., 1, :]
-    sq = L.montmul(jnp.stack([a0, a1]), jnp.stack([a0, a1]))
-    norm = L.add_mod(sq[0], sq[1])
+    a0, a1 = a
+    sq = L.montmul(L.stack_fp([a0, a1]), L.stack_fp([a0, a1]))
+    sqs = L.unstack_fp(sq, 2)
+    norm = L.add_mod(sqs[0], sqs[1])
     ninv = L.inv_mod(norm)
-    prod = L.montmul(jnp.stack([a0, L.neg_mod(a1)]), ninv[None])
-    return jnp.stack([prod[0], prod[1]], axis=-2)
+    prod = L.montmul(
+        L.stack_fp([a0, L.neg_mod(a1)]), L.stack_fp([ninv, ninv])
+    )
+    parts = L.unstack_fp(prod, 2)
+    return (parts[0], parts[1])
 
 
 def fp2_is_zero(a):
-    """Value-level zero test (digits are redundant; |value| < 4p required)."""
-    return jnp.logical_and(
-        L.is_zero_val(a[..., 0, :]), L.is_zero_val(a[..., 1, :])
-    )
+    """Value-level zero test (digits are redundant; |value| < 8p required)."""
+    return jnp.logical_and(L.is_zero_val(a[0]), L.is_zero_val(a[1]))
 
 
 def fp2_select(cond, a, b):
-    return jnp.where(cond[..., None, None], a, b)
+    return (L.select(cond, a[0], b[0]), L.select(cond, a[1], b[1]))
 
 
 def fp2_zero(shape=()):
-    return jnp.zeros(shape + (2, NL), jnp.int32)
+    return (L.zeros_fp(shape), L.zeros_fp(shape))
 
 
 def fp2_one(shape=()):
-    one = jnp.asarray(np.stack([L.ONE_MONT, L.ZERO]))
-    return jnp.broadcast_to(one, shape + (2, NL)).astype(jnp.int32)
+    return (L.const_fp(L.ONE_MONT_DIGITS, shape), L.zeros_fp(shape))
 
 
 # --- Fp6 -------------------------------------------------------------------
 
 
 def fp6_add(a, b):
-    return L.add_mod(a, b)
+    return tuple(fp2_add(x, y) for x, y in zip(a, b))
 
 
 def fp6_sub(a, b):
-    return L.sub_mod(a, b)
+    return tuple(fp2_sub(x, y) for x, y in zip(a, b))
 
 
 def fp6_neg(a):
-    return L.neg_mod(a)
+    return tuple(fp2_neg(x) for x in a)
 
 
 def fp6_mul_many(A, B):
-    """Multiply K independent Fp6 pairs: (K, ..., 3, 2, 24); all 18K limb
-    products in one montmul call."""
-    a0, a1, a2 = A[..., 0, :, :], A[..., 1, :, :], A[..., 2, :, :]
-    b0, b1, b2 = B[..., 0, :, :], B[..., 1, :, :], B[..., 2, :, :]
-    # the six Fp2 products per pair (schoolbook-Karatsuba hybrid)
-    sums_a = L.add_mod(
-        jnp.concatenate([a1, a0, a0], axis=0), jnp.concatenate([a2, a1, a2], axis=0)
-    )
-    sums_b = L.add_mod(
-        jnp.concatenate([b1, b0, b0], axis=0), jnp.concatenate([b2, b1, b2], axis=0)
-    )
-    X = jnp.concatenate([a0, a1, a2, sums_a], axis=0)  # (6K, ..., 2, 24)
-    Y = jnp.concatenate([b0, b1, b2, sums_b], axis=0)
+    """Multiply K independent Fp6 pairs (leading stack axis K); all 18K limb
+    products in one montmul call (schoolbook-Karatsuba hybrid)."""
+    a0, a1, a2 = A
+    b0, b1, b2 = B
+    sums_a = cat2([fp2_add(a1, a2), fp2_add(a0, a1), fp2_add(a0, a2)])
+    sums_b = cat2([fp2_add(b1, b2), fp2_add(b0, b1), fp2_add(b0, b2)])
+    X = cat2([a0, a1, a2, sums_a])  # (6K, ...)
+    Y = cat2([b0, b1, b2, sums_b])
     T = fp2_mul_many(X, Y)
-    k = A.shape[0]
-    t0, t1, t2 = T[:k], T[k : 2 * k], T[2 * k : 3 * k]
-    t12, t01, t02 = T[3 * k : 4 * k], T[4 * k : 5 * k], T[5 * k :]
-    # c0 = t0 + ξ(t12 - t1 - t2); c1 = (t01 - t0 - t1) + ξ t2; c2 = (t02 - t0 - t2) + t1
-    d = L.sub_mod(
-        jnp.concatenate([t12, t01, t02], axis=0),
-        L.add_mod(
-            jnp.concatenate([t1, t0, t0], axis=0),
-            jnp.concatenate([t2, t1, t2], axis=0),
-        ),
-    )
-    d0, d1, d2 = d[:k], d[k : 2 * k], d[2 * k :]
-    xis = fp2_mul_by_xi(jnp.concatenate([d0, t2], axis=0))
-    xi_d0, xi_t2 = xis[:k], xis[k:]
-    c = L.add_mod(
-        jnp.concatenate([t0, d1, d2], axis=0),
-        jnp.concatenate([xi_d0, xi_t2, t1], axis=0),
-    )
-    return jnp.stack([c[:k], c[k : 2 * k], c[2 * k :]], axis=-3)
+    k = a0[0].shape[1]
+    t0 = slice2(T, 0, k)
+    t1 = slice2(T, k, 2 * k)
+    t2 = slice2(T, 2 * k, 3 * k)
+    t12 = slice2(T, 3 * k, 4 * k)
+    t01 = slice2(T, 4 * k, 5 * k)
+    t02 = slice2(T, 5 * k, 6 * k)
+    # c0 = t0 + ξ(t12 - t1 - t2); c1 = (t01 - t0 - t1) + ξ t2;
+    # c2 = (t02 - t0 - t2) + t1
+    c0 = fp2_add(t0, fp2_mul_by_xi(fp2_sub(t12, fp2_add(t1, t2))))
+    c1 = fp2_add(fp2_sub(t01, fp2_add(t0, t1)), fp2_mul_by_xi(t2))
+    c2 = fp2_add(fp2_sub(t02, fp2_add(t0, t2)), t1)
+    return (c0, c1, c2)
 
 
 def fp6_mul(a, b):
-    a, b = jnp.broadcast_arrays(a, b)
-    return fp6_mul_many(a[None], b[None])[0]
+    return unlead6(fp6_mul_many(lead6(a), lead6(b)))
 
 
 def fp6_sq(a):
@@ -186,67 +263,79 @@ def fp6_sq(a):
 
 
 def fp6_mul_by_v(a):
-    return jnp.stack(
-        [fp2_mul_by_xi(a[..., 2, :, :]), a[..., 0, :, :], a[..., 1, :, :]], axis=-3
-    )
+    return (fp2_mul_by_xi(a[2]), a[0], a[1])
 
 
 def fp6_scale2(a, k):
     """Multiply Fp6 by an Fp2 scalar."""
-    kk = jnp.broadcast_to(k, a[..., 0, :, :].shape)
-    stacked = fp2_mul_many(
-        jnp.stack([a[..., i, :, :] for i in range(3)]), jnp.stack([kk] * 3)
-    )
-    return jnp.stack([stacked[0], stacked[1], stacked[2]], axis=-3)
+    X = cat2([lead2(a[0]), lead2(a[1]), lead2(a[2])])
+    Y = cat2([lead2(k)] * 3)
+    r = fp2_mul_many(X, Y)
+    return tuple(unlead2(slice2(r, i, i + 1)) for i in range(3))
 
 
 def fp6_inv(a):
-    a0, a1, a2 = a[..., 0, :, :], a[..., 1, :, :], a[..., 2, :, :]
-    sqs = fp2_sq_many(jnp.stack([a0, a2, a1]))
-    prods = fp2_mul_many(jnp.stack([a1, a0, a0]), jnp.stack([a2, a1, a2]))
-    A = fp2_sub(sqs[0], fp2_mul_by_xi(prods[0]))
-    B = fp2_sub(fp2_mul_by_xi(sqs[1]), prods[1])
-    C = fp2_sub(sqs[2], prods[2])
-    inner = fp2_mul_many(jnp.stack([a0, a2, a1]), jnp.stack([A, B, C]))
-    F = fp2_add(inner[0], fp2_mul_by_xi(fp2_add(inner[1], inner[2])))
-    f_inv = fp2_inv(F)
-    outs = fp2_mul_many(jnp.stack([A, B, C]), jnp.stack([f_inv] * 3))
-    return jnp.stack([outs[0], outs[1], outs[2]], axis=-3)
+    a0, a1, a2 = a
+    sqs = fp2_sq_many(cat2([lead2(a0), lead2(a2), lead2(a1)]))
+    sq0 = unlead2(slice2(sqs, 0, 1))
+    sq2 = unlead2(slice2(sqs, 1, 2))
+    sq1 = unlead2(slice2(sqs, 2, 3))
+    prods = fp2_mul_many(
+        cat2([lead2(a1), lead2(a0), lead2(a0)]),
+        cat2([lead2(a2), lead2(a1), lead2(a2)]),
+    )
+    p12 = unlead2(slice2(prods, 0, 1))
+    p01 = unlead2(slice2(prods, 1, 2))
+    p02 = unlead2(slice2(prods, 2, 3))
+    A = fp2_sub(sq0, fp2_mul_by_xi(p12))
+    B = fp2_sub(fp2_mul_by_xi(sq2), p01)
+    C = fp2_sub(sq1, p02)
+    inner = fp2_mul_many(
+        cat2([lead2(a0), lead2(a2), lead2(a1)]),
+        cat2([lead2(A), lead2(B), lead2(C)]),
+    )
+    i0 = unlead2(slice2(inner, 0, 1))
+    i1 = unlead2(slice2(inner, 1, 2))
+    i2 = unlead2(slice2(inner, 2, 3))
+    Fv = fp2_add(i0, fp2_mul_by_xi(fp2_add(i1, i2)))
+    f_inv = fp2_inv(Fv)
+    outs = fp2_mul_many(
+        cat2([lead2(A), lead2(B), lead2(C)]),
+        cat2([lead2(f_inv)] * 3),
+    )
+    return tuple(unlead2(slice2(outs, i, i + 1)) for i in range(3))
 
 
 def fp6_zero(shape=()):
-    return jnp.zeros(shape + (3, 2, NL), jnp.int32)
+    return tuple(fp2_zero(shape) for _ in range(3))
 
 
 def fp6_one(shape=()):
-    z = np.zeros((3, 2, NL), dtype=np.uint32)
-    z[0, 0] = L.ONE_MONT
-    return jnp.broadcast_to(jnp.asarray(z), shape + (3, 2, NL)).astype(jnp.int32)
+    return (fp2_one(shape), fp2_zero(shape), fp2_zero(shape))
 
 
 # --- Fp12 ------------------------------------------------------------------
 
 
 def fp12_mul_many(A, B):
-    """K independent Fp12 products: (K, ..., 2, 3, 2, 24); all 54K limb
+    """K independent Fp12 products (leading stack axis K); all 54K limb
     products in one montmul call (Karatsuba over Fp6)."""
-    a0, a1 = A[..., 0, :, :, :], A[..., 1, :, :, :]
-    b0, b1 = B[..., 0, :, :, :], B[..., 1, :, :, :]
-    sa = L.add_mod(a0, a1)
-    sb = L.add_mod(b0, b1)
-    T = fp6_mul_many(
-        jnp.concatenate([a0, a1, sa], axis=0), jnp.concatenate([b0, b1, sb], axis=0)
-    )
-    k = A.shape[0]
-    t0, t1, t2 = T[:k], T[k : 2 * k], T[2 * k :]
-    c0 = L.add_mod(t0, fp6_mul_by_v(t1))
-    c1 = L.sub_mod(t2, L.add_mod(t0, t1))
-    return jnp.stack([c0, c1], axis=-4)
+    a0, a1 = A
+    b0, b1 = B
+    sa = fp6_add(a0, a1)
+    sb = fp6_add(b0, b1)
+    T = fp6_mul_many(cat6([a0, a1, sa]), cat6([b0, b1, sb]))
+    k = a0[0][0].shape[1]
+    t0 = slice6(T, 0, k)
+    t1 = slice6(T, k, 2 * k)
+    t2 = slice6(T, 2 * k, 3 * k)
+    c0 = fp6_add(t0, fp6_mul_by_v(t1))
+    c1 = fp6_sub(t2, fp6_add(t0, t1))
+    return (c0, c1)
 
 
 def fp12_mul(a, b):
-    a, b = jnp.broadcast_arrays(a, b)
-    return fp12_mul_many(a[None], b[None])[0]
+    return unlead12(fp12_mul_many(lead12(a), lead12(b)))
 
 
 def fp12_sq(a):
@@ -254,38 +343,59 @@ def fp12_sq(a):
 
 
 def fp12_conj(a):
-    return jnp.stack([a[..., 0, :, :, :], fp6_neg(a[..., 1, :, :, :])], axis=-4)
+    return (a[0], fp6_neg(a[1]))
 
 
 def fp12_inv(a):
-    a0, a1 = a[..., 0, :, :, :], a[..., 1, :, :, :]
-    sqs = fp6_mul_many(jnp.stack([a0, a1]), jnp.stack([a0, a1]))
-    denom = fp6_inv(fp6_sub(sqs[0], fp6_mul_by_v(sqs[1])))
-    outs = fp6_mul_many(jnp.stack([a0, fp6_neg(a1)]), jnp.stack([denom] * 2))
-    return jnp.stack([outs[0], outs[1]], axis=-4)
+    a0, a1 = a
+    sqs = fp6_mul_many(cat6([lead6(a0), lead6(a1)]),
+                       cat6([lead6(a0), lead6(a1)]))
+    sq0 = unlead6(slice6(sqs, 0, 1))
+    sq1 = unlead6(slice6(sqs, 1, 2))
+    denom = fp6_inv(fp6_sub(sq0, fp6_mul_by_v(sq1)))
+    outs = fp6_mul_many(
+        cat6([lead6(a0), lead6(fp6_neg(a1))]),
+        cat6([lead6(denom)] * 2),
+    )
+    return (unlead6(slice6(outs, 0, 1)), unlead6(slice6(outs, 1, 2)))
 
 
 def fp12_zero(shape=()):
-    return jnp.zeros(shape + (2, 3, 2, NL), jnp.int32)
+    return (fp6_zero(shape), fp6_zero(shape))
 
 
 def fp12_one(shape=()):
-    z = np.zeros((2, 3, 2, NL), dtype=np.uint32)
-    z[0, 0, 0] = L.ONE_MONT
-    return jnp.broadcast_to(jnp.asarray(z), shape + (2, 3, 2, NL)).astype(jnp.int32)
+    return (fp6_one(shape), fp6_zero(shape))
 
 
 def fp12_select(cond, a, b):
-    return jnp.where(cond[..., None, None, None, None], a, b)
+    return tuple(
+        tuple(fp2_select(cond, x, y) for x, y in zip(c6a, c6b))
+        for c6a, c6b in zip(a, b)
+    )
+
+
+def fp12_components(a):
+    """Flat list of the twelve Fp components."""
+    return [fp for c6 in a for c2 in c6 for fp in c2]
+
+
+def fp12_from_components(comps):
+    it = iter(comps)
+    return tuple(
+        tuple((next(it), next(it)) for _ in range(3)) for _ in range(2)
+    )
 
 
 def fp12_is_one(a):
-    """Value-level equality with 1 (shared canonicalization ripple over the
-    twelve Fp components)."""
-    flat = a.reshape(a.shape[:-4] + (12, L.NLIMBS))
-    one = fp12_one().reshape(12, L.NLIMBS)
-    comp_zero = L.is_zero_val(flat - one)
-    return jnp.all(comp_zero, axis=-1)
+    """Value-level equality with 1 (component-wise canonical zero tests)."""
+    comps = fp12_components(a)
+    ones = fp12_components(fp12_one(comps[0].shape[1:]))
+    ok = None
+    for fa, fo in zip(comps, ones):
+        z = L.is_zero_val(fa - fo)
+        ok = z if ok is None else (ok & z)
+    return ok
 
 
 # --- Frobenius -------------------------------------------------------------
@@ -293,32 +403,33 @@ def fp12_is_one(a):
 _coeffs = frobenius_coefficients()
 
 
-def _fp2_const(pair) -> np.ndarray:
-    return np.stack([L.to_mont(pair[0]), L.to_mont(pair[1])])
-
-
-_G1_6 = jnp.asarray(_fp2_const(_coeffs["fq6_g1"]))
-_G2_6 = jnp.asarray(_fp2_const(_coeffs["fq6_g2"]))
-_GW_12 = jnp.asarray(_fp2_const(_coeffs["fq12_gw"]))
+def _fp2_const(pair, shape=()):
+    return (
+        L.const_fp([int(d) for d in L.to_mont(pair[0])], shape),
+        L.const_fp([int(d) for d in L.to_mont(pair[1])], shape),
+    )
 
 
 def fp6_frobenius(a):
-    c0 = fp2_conj(a[..., 0, :, :])
+    shape = a[0][0].shape[1:]
+    g1 = _fp2_const(_coeffs["fq6_g1"], shape)
+    g2 = _fp2_const(_coeffs["fq6_g2"], shape)
+    c0 = fp2_conj(a[0])
     rest = fp2_mul_many(
-        jnp.stack([fp2_conj(a[..., 1, :, :]), fp2_conj(a[..., 2, :, :])]),
-        jnp.stack([jnp.broadcast_to(_G1_6, a[..., 1, :, :].shape),
-                   jnp.broadcast_to(_G2_6, a[..., 2, :, :].shape)]),
+        cat2([lead2(fp2_conj(a[1])), lead2(fp2_conj(a[2]))]),
+        cat2([lead2(g1), lead2(g2)]),
     )
-    return jnp.stack([c0, rest[0], rest[1]], axis=-3)
+    r1 = unlead2(slice2(rest, 0, 1))
+    r2 = unlead2(slice2(rest, 1, 2))
+    return (c0, r1, r2)
 
 
 def fp12_frobenius(a):
-    return jnp.stack(
-        [
-            fp6_frobenius(a[..., 0, :, :, :]),
-            fp6_scale2(fp6_frobenius(a[..., 1, :, :, :]), _GW_12),
-        ],
-        axis=-4,
+    shape = a[0][0][0].shape[1:]
+    gw = _fp2_const(_coeffs["fq12_gw"], shape)
+    return (
+        fp6_frobenius(a[0]),
+        fp6_scale2(fp6_frobenius(a[1]), gw),
     )
 
 
@@ -329,10 +440,14 @@ def fp12_frobenius_n(a, n: int):
 
 
 # --- host conversion helpers ----------------------------------------------
+#
+# Rest format (host numpy): Fp (..., 26); Fp2 (..., 2, 26); Fp6 (..., 3, 2, 26);
+# Fp12 (..., 2, 3, 2, 26) — unchanged from the array-form design, so all host
+# prep, caching, and serialization code is layout-agnostic.
 
 
 def fq2_to_dev(x) -> np.ndarray:
-    """Anchor Fq2 → Montgomery limb array (2, 24)."""
+    """Anchor Fq2 → Montgomery limb array (2, 26) (rest format)."""
     return np.stack([L.to_mont(x.c0.n), L.to_mont(x.c1.n)])
 
 
@@ -342,6 +457,42 @@ def fq6_to_dev(x) -> np.ndarray:
 
 def fq12_to_dev(x) -> np.ndarray:
     return np.stack([fq6_to_dev(x.c0), fq6_to_dev(x.c1)])
+
+
+def fp2_split(arr) -> tuple:
+    """(..., 2, 26) rest-format array → Fp2 limb-list element."""
+    return (L.split(arr[..., 0, :]), L.split(arr[..., 1, :]))
+
+
+def fp2_merge(a) -> jnp.ndarray:
+    """Fp2 limb-list element → (..., 2, 26) rest-format device array."""
+    return jnp.stack([L.merge(a[0]), L.merge(a[1])], axis=-2)
+
+
+def fp2_merge_np(a) -> np.ndarray:
+    return np.stack([L.merge_np(a[0]), L.merge_np(a[1])], axis=-2)
+
+
+def fp6_split(arr) -> tuple:
+    return tuple(fp2_split(arr[..., i, :, :]) for i in range(3))
+
+
+def fp6_merge_np(a) -> np.ndarray:
+    return np.stack([fp2_merge_np(c2) for c2 in a], axis=-3)
+
+
+def fp12_split(arr) -> tuple:
+    return tuple(fp6_split(arr[..., i, :, :, :]) for i in range(2))
+
+
+def fp12_merge_np(a) -> np.ndarray:
+    return np.stack(
+        [
+            np.stack([fp2_merge_np(c2) for c2 in c6], axis=-3)
+            for c6 in a
+        ],
+        axis=-4,
+    )
 
 
 def dev_to_fq2(a):

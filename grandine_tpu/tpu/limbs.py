@@ -1,24 +1,42 @@
 """Limb-decomposed Montgomery arithmetic for Fp (BLS12-381 base field) on TPU.
 
-Representation ("relaxed signed digits"): little-endian 26 × 15-bit digits in
-int32, shape (..., 26), Montgomery form (value·R mod p, R = 2³⁹⁰). Digits are
-redundant and signed: |digit| ≤ LMAX = 2¹⁵ + 256; values are only canonical
-modulo p at explicit canonicalization points (equality tests, host export).
+Representation ("relaxed signed digits", limb-major form): an Fp element is
+ONE int32 array of shape (26, *batch) — little-endian 15-bit digits along the
+LEADING axis, Montgomery form (value·R mod p, R = 2³⁹⁰). Digits are redundant
+and signed: |digit| ≤ LMAX = 2¹⁵ + 256; values are only canonical modulo p at
+explicit canonicalization points (equality tests, host export).
 
-Why this shape:
+Why the limb axis is LEADING (three designs were measured on v5e — see
+tools/kernel_microbench.py):
+  - Trailing limb axis (batch, 26): the minor axis maps to the 128 vector
+    lanes, so 26/128 lanes do work AND every shifted-column accumulation in
+    the Montgomery product is a cross-lane concatenate (a relayout of the
+    whole tensor): ~47 ns/montmul/element.
+  - One array per limb (pytree of 26 arrays): montmul becomes pure
+    elementwise code at full lane occupancy (~12 ns/element), but every
+    cheap op (add, select) costs ~100 HLO instructions, and an XLA
+    optimization pass that is quadratic in computation size pushes compiles
+    of real kernels into minutes (and tens of GB of compiler memory).
+  - Limb-major array (26, *batch) — this file: adds/selects are single HLO
+    ops (the batch owns the minor axes: full lanes), the carry-relaxation
+    shift moves whole batch planes along the major axis (a cheap copy, no
+    lane shuffles), and montmul internally scans over the leading limb axis
+    with its column accumulators as a 27-tuple carry that lives in VMEM —
+    keeping the ~12 ns/element speed with ~30 flat ops per call site.
+
+Why 15-bit signed digits:
   - products of two digits: ≤ LMAX² < 2³¹ — exact in int32;
   - CIOS column accumulators stay |·| < 2²² — no wide accumulator needed;
-  - add/sub/neg are a plain limbwise op plus ONE flat carry-relaxation round
-    (arithmetic shift + mask): no borrow ripples, no scans, no conditional
+  - add/sub/neg are a plain elementwise op plus ONE flat carry-relaxation
+    round (arithmetic shift + mask): no borrow ripples, no conditional
     subtracts. Signed digits are what make subtraction free.
   - value bounds are tracked statically: every intermediate stays |v| < 20p,
     montgomery products then stay < 2p (see montmul docstring), which keeps
     the dropped top carry of the relaxation round provably zero.
 
-The only sequential structures left are the 26-step CIOS scan inside montmul,
-the bit scans of fixed-exponent powering, and the canonicalization ripple
-used by equality tests. Everything else is flat vector code — the shape XLA
-compiles and fuses well.
+Reference counterpart: the blst field arithmetic behind
+bls/src/signature.rs:96-129 (multi_verify) — re-designed here for a vector
+unit instead of 64-bit scalar pipelines.
 """
 
 from __future__ import annotations
@@ -31,9 +49,8 @@ from jax import lax
 
 from grandine_tpu.crypto.constants import P
 
-#: lax.scan unroll factor for the CIOS inner loop (1 = plain while loop;
-#: larger values trade compile time for fused step bodies). Tunable via env
-#: for kernel experiments.
+#: lax.scan unroll factor for the CIOS loop. unroll=1 measured fastest on
+#: v5e with honest (host-fetch) timing; kept as an env knob for experiments.
 MONTMUL_UNROLL = int(os.environ.get("GT_MONTMUL_UNROLL", "1"))
 
 LIMB_BITS = 15
@@ -52,7 +69,7 @@ _DT = jnp.int32
 
 
 def int_to_limbs(v: int) -> np.ndarray:
-    """Canonical (non-Montgomery) digit decomposition."""
+    """Canonical (non-Montgomery) digit decomposition, (26,) int32."""
     assert 0 <= v < R_MONT
     return np.array(
         [(v >> (LIMB_BITS * i)) & MASK for i in range(NLIMBS)], dtype=np.int32
@@ -60,6 +77,7 @@ def int_to_limbs(v: int) -> np.ndarray:
 
 
 def limbs_to_int(a) -> int:
+    """(…, 26) trailing-limb REST-format array → int."""
     a = np.asarray(a)
     return sum(int(a[..., i]) << (LIMB_BITS * i) for i in range(NLIMBS))
 
@@ -69,8 +87,8 @@ def to_mont(v: int) -> np.ndarray:
 
 
 def from_mont(a) -> int:
-    """Host conversion out of Montgomery form (handles redundant/signed
-    digits and any value range via exact integer arithmetic)."""
+    """Host conversion out of Montgomery form (REST format — trailing limb
+    axis; handles redundant/signed digits via exact integer arithmetic)."""
     return limbs_to_int(a) * R_INV % P
 
 
@@ -83,81 +101,153 @@ EIGHT_P = int_to_limbs(8 * P)
 # canonical digit patterns of k·p, k = 0..15 (for is_zero after a +8p offset)
 _KP_PATTERNS = np.stack([int_to_limbs(k * P) for k in range(16)])  # (16, 26)
 
+# Python-int digit views for use as broadcast scalars in compute code.
+P_DIGITS = [int(x) for x in P_LIMBS]
+R_MOD_P_DIGITS = [int(x) for x in R_MOD_P]
+ONE_MONT_DIGITS = [int(x) for x in ONE_MONT]
+EIGHT_P_DIGITS = [int(x) for x in EIGHT_P]
+
+
+# --- structure helpers -----------------------------------------------------
+#
+# Device Fp = (26, *batch) int32. REST format (host buffers, kernel
+# boundaries) keeps the limb axis TRAILING (…, 26) — layout-agnostic and
+# cheap to assemble on host; `split`/`merge` move between the two (one
+# transpose, fused by XLA into adjacent compute).
+
+
+def split(arr) -> jnp.ndarray:
+    """REST (…, 26) → device (26, …)."""
+    return jnp.moveaxis(jnp.asarray(arr), -1, 0)
+
+
+def merge(fp) -> jnp.ndarray:
+    """Device (26, …) → REST (…, 26)."""
+    return jnp.moveaxis(fp, 0, -1)
+
+
+def merge_np(fp) -> np.ndarray:
+    return np.moveaxis(np.asarray(fp), 0, -1)
+
+
+def const_fp(digits, shape=()) -> jnp.ndarray:
+    """Digit vector (length 26, host ints) → (26, *shape) constant."""
+    d = jnp.asarray(np.asarray(digits, dtype=np.int32))
+    return jnp.broadcast_to(
+        d.reshape((NLIMBS,) + (1,) * len(shape)), (NLIMBS,) + tuple(shape)
+    )
+
+
+def zeros_fp(shape=()) -> jnp.ndarray:
+    return jnp.zeros((NLIMBS,) + tuple(shape), _DT)
+
+
+def stack_fp(elems, axis: int = 1) -> jnp.ndarray:
+    """Stack K independent Fp elements along a new batch axis (default:
+    right after the limb axis)."""
+    return jnp.stack(list(elems), axis=axis)
+
+
+def unstack_fp(fp, k: int, axis: int = 1) -> list:
+    return [jnp.take(fp, i, axis=axis) for i in range(k)]
+
+
+def concat_fp(elems, axis: int = 1) -> jnp.ndarray:
+    """Concatenate Fp elements along an existing batch axis."""
+    return jnp.concatenate(list(elems), axis=axis)
+
+
+def index_fp(fp, idx) -> jnp.ndarray:
+    """Index the leading batch axis (device axis 1)."""
+    return fp[:, idx]
+
+
+def batch_shape(fp):
+    return fp.shape[1:]
+
 
 # --- flat primitives -------------------------------------------------------
 
 
-def relax(s: jnp.ndarray) -> jnp.ndarray:
+def relax(s) -> jnp.ndarray:
     """One carry-relaxation round, exactly value-preserving: digits 0..24 go
     to [0,2¹⁵) + a signed carry into the next digit; the TOP digit is left
     unsplit (signed). Under the |value| < 20p invariant the top digit stays
-    |·| ≲ 2¹¹ (value/2³⁷⁵ plus ≤ 2 of lower-digit compensation), so products
-    involving it remain far below int32 overflow. No modular wrap ever
-    happens here — values are preserved as integers."""
-    hi = s >> LIMB_BITS  # arithmetic shift (floor division)
-    lo = s & MASK
-    low = lo[..., : NLIMBS - 1] + jnp.concatenate(
-        [jnp.zeros(s.shape[:-1] + (1,), _DT), hi[..., : NLIMBS - 2]], axis=-1
-    )
-    top = s[..., NLIMBS - 1 :] + hi[..., NLIMBS - 2 : NLIMBS - 1]
-    return jnp.concatenate([low, top], axis=-1)
+    |·| ≲ 2¹¹, so products involving it remain far below int32 overflow.
+    The carry shift moves batch planes along the major axis — no lane
+    shuffles."""
+    hi = s[: NLIMBS - 1] >> LIMB_BITS
+    lo = s[: NLIMBS - 1] & MASK
+    top = s[NLIMBS - 1 :] + hi[NLIMBS - 2 :]
+    shifted = jnp.concatenate([jnp.zeros_like(hi[:1]), hi[: NLIMBS - 2]], 0)
+    return jnp.concatenate([lo + shifted, top], axis=0)
 
 
-def add_mod(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+def add_mod(a, b) -> jnp.ndarray:
     return relax(a + b)
 
 
-def sub_mod(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+def sub_mod(a, b) -> jnp.ndarray:
     return relax(a - b)
 
 
-def neg_mod(a: jnp.ndarray) -> jnp.ndarray:
+def neg_mod(a) -> jnp.ndarray:
     return relax(-a)
 
 
-def montmul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """Montgomery product a·b·R⁻¹ mod p (CIOS over signed digits).
+def double_mod(a) -> jnp.ndarray:
+    return relax(a + a)
+
+
+def montmul(a, b) -> jnp.ndarray:
+    """Montgomery product a·b·R⁻¹ mod p: CIOS over signed digits, scanned
+    over the 26 limb rows of `a` with the 27 column accumulators as a tuple
+    carry (they live in VMEM — see module docstring).
 
     Value bound: for |a|,|b| < 20p, |a·b| < 400p² ≲ R·p, so the reduced value
     lies in (-0.1p, 2p) and the relaxed output digits are ≤ LMAX. Inputs are
     digit-bounded by LMAX (products < 2³¹) and value-bounded by callers.
     """
-    p_limbs = jnp.asarray(P_LIMBS)
-    batch = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
-    b = jnp.broadcast_to(b, batch + (NLIMBS,)).astype(_DT)
-    a = jnp.broadcast_to(a, batch + (NLIMBS,)).astype(_DT)
-    t0 = jnp.zeros(batch + (NLIMBS + 1,), _DT)
-    zpad1 = jnp.zeros(batch + (1,), _DT)
-    zpadN = jnp.zeros(batch + (NLIMBS - 1,), _DT)
+    shape = jnp.broadcast_shapes(a.shape[1:], b.shape[1:])
+    a = jnp.broadcast_to(a, (NLIMBS,) + shape).astype(_DT)
+    b = jnp.broadcast_to(b, (NLIMBS,) + shape).astype(_DT)
+    bl = [b[j] for j in range(NLIMBS)]
+    t0 = tuple(jnp.zeros(shape, _DT) for _ in range(NLIMBS + 1))
 
     def step(t, ai):
-        prod = ai[..., None] * b  # |·| < 2^31 exact
-        t = t + jnp.concatenate([prod & MASK, zpad1], axis=-1)
-        t = t + jnp.concatenate([zpad1, prod >> LIMB_BITS], axis=-1)
-        m = (t[..., 0] * N0_INV) & MASK
-        prod2 = m[..., None] * p_limbs
-        t = t + jnp.concatenate([prod2 & MASK, zpad1], axis=-1)
-        t = t + jnp.concatenate([zpad1, prod2 >> LIMB_BITS], axis=-1)
-        carry = t[..., 0] >> LIMB_BITS  # exact: t[...,0] ≡ 0 mod 2^15
-        t = jnp.concatenate([t[..., 1:], zpad1], axis=-1)
-        t = t + jnp.concatenate([carry[..., None], zpadN, zpad1], axis=-1)
-        return t, None
+        t = list(t)
+        for j in range(NLIMBS):
+            prod = ai * bl[j]  # |·| < 2^31 exact
+            t[j] = t[j] + (prod & MASK)
+            t[j + 1] = t[j + 1] + (prod >> LIMB_BITS)
+        m = (t[0] * N0_INV) & MASK
+        for j in range(NLIMBS):
+            prod2 = m * P_DIGITS[j]
+            t[j] = t[j] + (prod2 & MASK)
+            t[j + 1] = t[j + 1] + (prod2 >> LIMB_BITS)
+        carry = t[0] >> LIMB_BITS  # exact: t[0] ≡ 0 mod 2^15
+        t = t[1:] + [jnp.zeros(shape, _DT)]
+        t[0] = t[0] + carry
+        return tuple(t), None
 
-    t, _ = lax.scan(step, t0, jnp.moveaxis(a, -1, 0), unroll=MONTMUL_UNROLL)
-    # fold the 27th column (weight 2^390 = R) back in via R mod p
-    main = t[..., :NLIMBS] + t[..., NLIMBS : NLIMBS + 1] * jnp.asarray(R_MOD_P)
+    t, _ = lax.scan(step, t0, a, unroll=MONTMUL_UNROLL)
+    # fold the 27th column (weight 2^390 = R) back in via R mod p, relax
+    main = jnp.stack(
+        [t[j] + t[NLIMBS] * R_MOD_P_DIGITS[j] for j in range(NLIMBS)], 0
+    )
     return relax(main)
 
 
-def montsq(a: jnp.ndarray) -> jnp.ndarray:
+def montsq(a) -> jnp.ndarray:
     return montmul(a, a)
 
 
-def pow_fixed(a: jnp.ndarray, exponent: int) -> jnp.ndarray:
+def pow_fixed(a, exponent: int) -> jnp.ndarray:
     """a^e for a host-known exponent (LSB-first square-and-multiply scan)."""
     nbits = max(exponent.bit_length(), 1)
     bits = np.array([(exponent >> i) & 1 for i in range(nbits)], dtype=np.int32)
-    one = jnp.broadcast_to(jnp.asarray(ONE_MONT), a.shape).astype(_DT)
+    one = const_fp(ONE_MONT_DIGITS, a.shape[1:])
+    a = a.astype(_DT)
 
     def step(carry, bit):
         result, base = carry
@@ -170,7 +260,7 @@ def pow_fixed(a: jnp.ndarray, exponent: int) -> jnp.ndarray:
     return result
 
 
-def inv_mod(a: jnp.ndarray) -> jnp.ndarray:
+def inv_mod(a) -> jnp.ndarray:
     """a⁻¹ via Fermat (Montgomery in/out). inv(0) = 0."""
     return pow_fixed(a, P - 2)
 
@@ -178,34 +268,38 @@ def inv_mod(a: jnp.ndarray) -> jnp.ndarray:
 # --- canonicalization & predicates ----------------------------------------
 
 
-def canonical_digits(t: jnp.ndarray) -> jnp.ndarray:
+def canonical_digits(t) -> jnp.ndarray:
     """Full ripple to canonical digits in [0, 2¹⁵). Only correct for
-    non-negative values < 2³⁹⁰ — callers offset by +4p first."""
+    non-negative values < 2³⁹⁰ — callers offset by +8p first. lax.scan over
+    the limb axis (sequential carry chain — off the hot path)."""
 
     def step(c, v):
         s = v + c
         return s >> LIMB_BITS, s & MASK
 
-    xs = jnp.moveaxis(t, -1, 0)
-    _, ys = lax.scan(step, jnp.zeros(t.shape[:-1], _DT), xs)
-    return jnp.moveaxis(ys, 0, -1)
+    carry, ys = lax.scan(step, jnp.zeros(t.shape[1:], _DT), t[: NLIMBS - 1])
+    return jnp.concatenate([ys, t[NLIMBS - 1 :] + carry[None]], axis=0)
 
 
-def is_zero_val(a: jnp.ndarray) -> jnp.ndarray:
+def is_zero_val(a) -> jnp.ndarray:
     """value(a) ≡ 0 (mod p), for |value| < 8p (the widest bound any caller
     reaches — mixed-add Z outputs are < 6p): canonicalize a+8p and compare
-    against the digit patterns of k·p, k = 0..15."""
-    canon = canonical_digits(a + jnp.asarray(EIGHT_P))
-    pats = jnp.asarray(_KP_PATTERNS)  # (16, 26)
-    eq = jnp.all(canon[..., None, :] == pats, axis=-1)  # (..., 16)
-    return jnp.any(eq, axis=-1)
+    against the digit patterns of k·p, k = 0..15. Returns a bool array of
+    the batch shape."""
+    a = jnp.asarray(a)
+    canon = canonical_digits(a + const_fp(EIGHT_P_DIGITS, a.shape[1:]))
+    pats = jnp.asarray(np.ascontiguousarray(_KP_PATTERNS.T))  # (26, 16)
+    pats = pats.reshape((NLIMBS, 16) + (1,) * (canon.ndim - 1))
+    eq = canon[:, None] == pats  # (26, 16, *batch)
+    return jnp.any(jnp.all(eq, axis=0), axis=0)
 
 
-def is_one_mont(a: jnp.ndarray) -> jnp.ndarray:
+def is_one_mont(a) -> jnp.ndarray:
     """value(a) ≡ 1·R (mod p) — same bound discipline as is_zero_val."""
-    return is_zero_val(a - jnp.asarray(ONE_MONT))
+    a = jnp.asarray(a)
+    return is_zero_val(a - const_fp(ONE_MONT_DIGITS, a.shape[1:]))
 
 
-def select(cond: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """cond ? a : b, with cond shaped like the element's batch prefix."""
-    return jnp.where(cond[..., None], a, b)
+def select(cond, a, b) -> jnp.ndarray:
+    """cond ? a : b, with cond of the batch shape (broadcast over limbs)."""
+    return jnp.where(cond[None], a, b)

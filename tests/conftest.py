@@ -18,3 +18,13 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent XLA compilation cache (same dir as bench.py/__graft_entry__ —
+# CPU and TPU entries coexist under different keys, and the driver's dryrun
+# hits what the tests compiled).
+import sys as _sys  # noqa: E402
+
+_sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import bench  # noqa: E402
+
+bench._enable_compilation_cache()

@@ -15,26 +15,39 @@ from grandine_tpu.tpu import limbs as L
 rng = random.Random(0xC5E)
 
 
+from grandine_tpu.tpu import field as F
+
+
 def g1_batch(pts):
     devs = [C.g1_point_to_dev(p) for p in pts]
-    X = jnp.asarray(np.stack([d[0] for d in devs]))
-    Y = jnp.asarray(np.stack([d[1] for d in devs]))
-    Z = jnp.asarray(
-        np.stack(
-            [np.zeros(L.NLIMBS, np.int32) if d[2] else np.asarray(L.to_mont(1)) for d in devs]
-        )
-    )
+    X = L.split(jnp.asarray(np.stack([d[0] for d in devs])))
+    Y = L.split(jnp.asarray(np.stack([d[1] for d in devs])))
+    Z = L.split(jnp.asarray(np.stack(
+        [np.zeros(L.NLIMBS, np.int32) if d[2] else np.asarray(L.to_mont(1)) for d in devs]
+    )))
     return X, Y, Z
+
+
+def g1_out(p, i):
+    return C.dev_to_g1_point(
+        L.merge_np(p[0])[i], L.merge_np(p[1])[i], L.merge_np(p[2])[i]
+    )
 
 
 def g2_batch(pts):
     devs = [C.g2_point_to_dev(p) for p in pts]
-    X = jnp.asarray(np.stack([d[0] for d in devs]))
-    Y = jnp.asarray(np.stack([d[1] for d in devs]))
+    X = F.fp2_split(jnp.asarray(np.stack([d[0] for d in devs])))
+    Y = F.fp2_split(jnp.asarray(np.stack([d[1] for d in devs])))
     one2 = np.stack([L.to_mont(1), L.ZERO])
     zero2 = np.zeros((2, L.NLIMBS), np.int32)
-    Z = jnp.asarray(np.stack([zero2 if d[2] else one2 for d in devs]))
+    Z = F.fp2_split(jnp.asarray(np.stack([zero2 if d[2] else one2 for d in devs])))
     return X, Y, Z
+
+
+def g2_out(p, i):
+    return C.dev_to_g2_point(
+        F.fp2_merge_np(p[0])[i], F.fp2_merge_np(p[1])[i], F.fp2_merge_np(p[2])[i]
+    )
 
 
 def test_g1_double_and_add():
@@ -43,12 +56,15 @@ def test_g1_double_and_add():
     X, Y, Z = g1_batch(pts)
     dbl = jax.jit(lambda p: C.point_double(p, C.FP_OPS))((X, Y, Z))
     for i in range(4):
-        assert C.dev_to_g1_point(dbl[0][i], dbl[1][i], dbl[2][i]) == pts[i].double()
+        assert g1_out(dbl, i) == pts[i].double()
     add = jax.jit(lambda p, q: C.point_add_complete(p, q, C.FP_OPS))
-    rolled = (jnp.roll(X, 1, 0), jnp.roll(Y, 1, 0), jnp.roll(Z, 1, 0))
-    r = add((X, Y, Z), rolled)
+
+    def roll(e):
+        return jnp.roll(e, 1, axis=1)
+
+    r = add((X, Y, Z), (roll(X), roll(Y), roll(Z)))
     for i in range(4):
-        assert C.dev_to_g1_point(r[0][i], r[1][i], r[2][i]) == pts[i] + pts[(i - 1) % 4]
+        assert g1_out(r, i) == pts[i] + pts[(i - 1) % 4]
 
 
 def test_g1_complete_add_edge_cases():
@@ -58,22 +74,23 @@ def test_g1_complete_add_edge_cases():
     # P + P → double
     r = add((X, Y, Z), (X, Y, Z))
     for i in range(4):
-        assert C.dev_to_g1_point(r[0][i], r[1][i], r[2][i]) == pts[i].double()
+        assert g1_out(r, i) == pts[i].double()
     # P + (-P) → ∞
     r = add((X, Y, Z), (X, L.neg_mod(Y), Z))
     for i in range(4):
-        assert C.dev_to_g1_point(r[0][i], r[1][i], r[2][i]).is_infinity()
+        assert g1_out(r, i).is_infinity()
     # P + ∞ → P
-    one = jnp.asarray(np.stack([L.to_mont(1)] * 4))
-    r = add((X, Y, Z), (one, one, jnp.zeros_like(X)))
+    one = L.const_fp(L.ONE_MONT_DIGITS, (4,))
+    zero = L.zeros_fp((4,))
+    r = add((X, Y, Z), (one, one, zero))
     for i in range(4):
-        assert C.dev_to_g1_point(r[0][i], r[1][i], r[2][i]) == pts[i]
+        assert g1_out(r, i) == pts[i]
 
 
 def test_scalar_mul_both_groups():
     ks = [rng.randrange(1, R) for _ in range(4)]
     scs = [rng.randrange(1, 2**64) for _ in range(3)] + [1]
-    bits = jnp.asarray(C.scalars_to_bits_msb(scs, 64))
+    bits = jnp.asarray(C.scalars_to_bits_msb(scs, 64)).T
     infl = jnp.asarray(np.array([False] * 4))
     pts1 = [G1.mul(k) for k in ks]
     X, Y, _ = g1_batch(pts1)
@@ -81,28 +98,28 @@ def test_scalar_mul_both_groups():
         X, Y, infl, bits
     )
     for i in range(4):
-        assert C.dev_to_g1_point(sm[0][i], sm[1][i], sm[2][i]) == pts1[i].mul(scs[i])
+        assert g1_out(sm, i) == pts1[i].mul(scs[i])
     pts2 = [G2.mul(k) for k in ks]
     X2, Y2, _ = g2_batch(pts2)
     sm2 = jax.jit(lambda qx, qy, qi, b: C.scalar_mul(qx, qy, qi, b, C.FP2_OPS))(
         X2, Y2, infl, bits
     )
     for i in range(4):
-        assert C.dev_to_g2_point(sm2[0][i], sm2[1][i], sm2[2][i]) == pts2[i].mul(scs[i])
+        assert g2_out(sm2, i) == pts2[i].mul(scs[i])
 
 
 def test_scalar_mul_infinity_input():
     pts = [g1_infinity(), G1]
     devs = [C.g1_point_to_dev(p) for p in pts]
-    X = jnp.asarray(np.stack([d[0] for d in devs]))
-    Y = jnp.asarray(np.stack([d[1] for d in devs]))
+    X = L.split(jnp.asarray(np.stack([d[0] for d in devs])))
+    Y = L.split(jnp.asarray(np.stack([d[1] for d in devs])))
     infl = jnp.asarray(np.array([True, False]))
-    bits = jnp.asarray(C.scalars_to_bits_msb([7, 7], 64))
+    bits = jnp.asarray(C.scalars_to_bits_msb([7, 7], 64)).T
     sm = jax.jit(lambda qx, qy, qi, b: C.scalar_mul(qx, qy, qi, b, C.FP_OPS))(
         X, Y, infl, bits
     )
-    assert C.dev_to_g1_point(sm[0][0], sm[1][0], sm[2][0]).is_infinity()
-    assert C.dev_to_g1_point(sm[0][1], sm[1][1], sm[2][1]) == G1.mul(7)
+    assert g1_out(sm, 0).is_infinity()
+    assert g1_out(sm, 1) == G1.mul(7)
 
 
 def test_sum_tree_with_adversarial_duplicates():
@@ -113,4 +130,6 @@ def test_sum_tree_with_adversarial_duplicates():
     expect = g1_infinity()
     for q in p8:
         expect = expect + q
-    assert C.dev_to_g1_point(s[0], s[1], s[2]) == expect
+    assert C.dev_to_g1_point(
+        L.merge_np(s[0]), L.merge_np(s[1]), L.merge_np(s[2])
+    ) == expect

@@ -1,0 +1,32 @@
+"""Per-stage COMPILE-time profile of the verify pipeline (tiny batch).
+
+Usage: python tools/compile_profile.py   (runs on CPU mesh env)
+"""
+import os, sys, time
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np, jax.numpy as jnp
+from grandine_tpu.tpu import limbs as L, field as F, curve as C, pairing as TP
+
+N = int(os.environ.get("N", "4"))
+rng = np.random.default_rng(0)
+fp = lambda: jnp.asarray(rng.integers(0, L.MASK, (26, N), np.int32))
+fp2 = lambda: (fp(), fp())
+inf = jnp.zeros((N,), bool)
+bits = jnp.asarray(rng.integers(0, 2, (64, N), np.int32))
+
+def t(name, fn, *args):
+    t0 = time.time()
+    jax.jit(fn).lower(*args).compile()
+    print(f"{name:28s} compile={time.time()-t0:6.1f}s", flush=True)
+
+t("G1 scalar_mul", lambda qx, qy, qi, b: C.scalar_mul(qx, qy, qi, b, C.FP_OPS), fp(), fp(), inf, bits)
+t("G2 scalar_mul", lambda qx, qy, qi, b: C.scalar_mul(qx, qy, qi, b, C.FP2_OPS), fp2(), fp2(), inf, bits)
+t("G2 sum_points", lambda p: C.sum_points(p, C.FP2_OPS), (fp2(), fp2(), fp2()))
+t("miller_loop", TP.miller_loop, (fp(), fp(), fp()), (fp2(), fp2(), fp2()), inf)
+f12 = tuple(tuple((fp(), fp()) for _ in range(3)) for _ in range(2))
+t("fp12_product_tree", TP.fp12_product_tree, f12)
+f1 = jax.tree.map(lambda x: x[:, :1], f12)
+t("final_exponentiation", TP.final_exponentiation, f1)

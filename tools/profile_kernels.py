@@ -1,9 +1,10 @@
 """Stage-level timing of the multi_verify kernel on the current device.
 
-Times each pipeline stage separately (jit'd in isolation):
-  scalar_mul G1 (rlc), scalar_mul G2, sum_points G2, miller_loop,
-  fp12 product tree, final_exponentiation
-plus the fused multi_verify_kernel, at a given batch size.
+Times each pipeline stage separately (jit'd in isolation) with HONEST
+methodology — every measurement forces a host fetch, because the axon
+runtime's block_until_ready does not wait for execution:
+  scalar_mul G1 (rlc), scalar_mul G2, G2 rlc+sum tree, miller_loop,
+  miller+tree+final_exp, and the fused multi_verify_kernel.
 
 Usage: [BENCH_N=2048] python tools/profile_kernels.py
 """
@@ -25,11 +26,9 @@ def main() -> None:
     import bench
     from grandine_tpu.tpu import curve as C
     from grandine_tpu.tpu import field as F
+    from grandine_tpu.tpu import limbs as L
     from grandine_tpu.tpu import pairing as TP
-    from grandine_tpu.tpu.bls import (
-        _fp12_product_tree,
-        multi_verify_kernel,
-    )
+    from grandine_tpu.tpu.bls import multi_verify_kernel
 
     bench._enable_compilation_cache()
 
@@ -43,63 +42,65 @@ def main() -> None:
     def timed(name, fn, *xs, iters=5):
         f = jax.jit(fn)
         t0 = time.time()
-        for attempt in range(4):
-            try:
-                out = f(*xs)
-                jax.block_until_ready(out)
-                break
-            except Exception as e:  # flaky remote_compile tunnel: retry
-                if attempt == 3 or "remote_compile" not in repr(e):
-                    raise
-                print(f"{name}: retrying after {e!r}", file=sys.stderr)
-                time.sleep(3)
+        out = f(*xs)
+        np.asarray(jax.tree.leaves(out)[0])  # force execution
         compile_s = time.time() - t0
         t0 = time.time()
         for _ in range(iters):
             out = f(*xs)
-        jax.block_until_ready(out)
-        run = (time.time() - t0) / iters
-        print(f"{name:28s} compile={compile_s:7.1f}s run={run * 1000:9.1f}ms")
-        return out
+        np.asarray(jax.tree.leaves(out)[0])
+        wall = (time.time() - t0) / iters
+        print(f"{name:26s} compile={compile_s:7.1f}s run={wall * 1000:9.2f}ms",
+              file=sys.stderr)
 
-    rpk = timed(
-        "scalar_mul G1 (64b rlc)",
-        lambda: C.scalar_mul(pk_x, pk_y, pk_inf, r_bits, C.FP_OPS),
-    )
-    rsig = timed(
-        "scalar_mul G2 (64b rlc)",
-        lambda: C.scalar_mul(sig_x, sig_y, sig_inf, r_bits, C.FP2_OPS),
-    )
-    sig_acc = timed(
-        "sum_points G2 (tree)",
-        lambda: C.sum_points(
-            tuple(jnp.asarray(c) for c in rsig), C.FP2_OPS
-        ),
-    )
+    def g1_rlc(pk_x, pk_y, pk_inf, r_bits):
+        qx, qy = L.split(jnp.asarray(pk_x)), L.split(jnp.asarray(pk_y))
+        p = C.scalar_mul(qx, qy, pk_inf, jnp.transpose(r_bits), C.FP_OPS)
+        return L.merge(p[0])
 
-    rpk_h = tuple(np.asarray(c) for c in rpk)
-    pair_inf = np.asarray(pk_inf | msg_inf)
+    def g2_rlc(sig_x, sig_y, sig_inf, r_bits):
+        qx, qy = F.fp2_split(jnp.asarray(sig_x)), F.fp2_split(jnp.asarray(sig_y))
+        p = C.scalar_mul(qx, qy, sig_inf, jnp.transpose(r_bits), C.FP2_OPS)
+        return F.fp2_merge(p[0])
 
-    def miller(px, py, pz, mx, my, inf):
-        msg_q = (mx, my, F.fp2_one((mx.shape[0],)))
-        return TP.miller_loop((px, py, pz), msg_q, inf)
+    def g2_rlc_sum(sig_x, sig_y, sig_inf, r_bits):
+        qx, qy = F.fp2_split(jnp.asarray(sig_x)), F.fp2_split(jnp.asarray(sig_y))
+        p = C.scalar_mul(qx, qy, sig_inf, jnp.transpose(r_bits), C.FP2_OPS)
+        s = C.sum_points(p, C.FP2_OPS)
+        return F.fp2_merge(s[0])
 
-    f_msgs = timed(
-        "miller_loop (N pairs)", miller, *rpk_h, msg_x, msg_y, pair_inf
-    )
-    f_msgs_h = np.asarray(f_msgs)
-    ftree = timed("fp12 product tree", lambda x: _fp12_product_tree(x), f_msgs_h)
-    timed(
-        "final_exponentiation",
-        lambda x: TP.final_exponentiation(x),
-        np.asarray(ftree),
-    )
-    timed(
-        "FUSED multi_verify_kernel",
-        multi_verify_kernel,
-        *args,
-        iters=3,
-    )
+    def _pairs(pk_x, pk_y, pk_inf, msg_x, msg_y, msg_inf):
+        P = (
+            L.split(jnp.asarray(pk_x)),
+            L.split(jnp.asarray(pk_y)),
+            L.const_fp(L.ONE_MONT_DIGITS, (n,)),
+        )
+        Q = (
+            F.fp2_split(jnp.asarray(msg_x)),
+            F.fp2_split(jnp.asarray(msg_y)),
+            F.fp2_one((n,)),
+        )
+        return P, Q, jnp.asarray(pk_inf) | jnp.asarray(msg_inf)
+
+    def miller(*xs):
+        P, Q, inf = _pairs(*xs)
+        f = TP.miller_loop(P, Q, inf)
+        return F.fp2_merge(f[0][0])
+
+    def tree_and_fe(*xs):
+        P, Q, inf = _pairs(*xs)
+        f = TP.miller_loop(P, Q, inf)
+        e = TP.final_exponentiation(TP.fp12_product_tree(f))
+        return F.fp2_merge(e[0][0])
+
+    timed("scalar_mul G1 (64b rlc)", g1_rlc, pk_x, pk_y, pk_inf, r_bits)
+    timed("scalar_mul G2 (64b rlc)", g2_rlc, sig_x, sig_y, sig_inf, r_bits)
+    timed("G2 rlc + sum tree", g2_rlc_sum, sig_x, sig_y, sig_inf, r_bits)
+    timed("miller_loop (n pairs)", miller,
+          pk_x, pk_y, pk_inf, msg_x, msg_y, msg_inf)
+    timed("miller+tree+final_exp", tree_and_fe,
+          pk_x, pk_y, pk_inf, msg_x, msg_y, msg_inf)
+    timed("FUSED multi_verify", multi_verify_kernel, *args, iters=3)
 
 
 if __name__ == "__main__":
