@@ -44,25 +44,31 @@ def build_batch(n: int, n_msgs: int = 8):
         batch_sign_kernel,
         g1_normalize_kernel,
         g2_normalize_kernel,
+        rlc_bits_host,
+        sign_bits_host,
     )
 
     msgs = [b"bench-attestation-%d" % i for i in range(n_msgs)]
     mx, my, _minf = C.g2_points_to_dev([hash_to_g2(m) for m in msgs])
 
     sks = [(0x1357 + 0x2468ACE * i) % (1 << 200) + 3 for i in range(n)]
-    sk_bits = C.scalars_to_bits_msb(sks, 255)
+    sk_bits, sk_neg = sign_bits_host(sks, n)
 
-    pk_jac = jax.jit(batch_pubkey_kernel)(sk_bits)
+    pk_jac = jax.jit(batch_pubkey_kernel)(sk_bits, sk_neg)
     msg_x = np.ascontiguousarray(mx[np.arange(n) % n_msgs])
     msg_y = np.ascontiguousarray(my[np.arange(n) % n_msgs])
     msg_inf = np.zeros((n,), bool)
-    sig_jac = jax.jit(batch_sign_kernel)(msg_x, msg_y, msg_inf, sk_bits)
+    sig_jac = jax.jit(batch_sign_kernel)(msg_x, msg_y, msg_inf, sk_bits, sk_neg)
 
     pk_x, pk_y, _ = (np.asarray(a) for a in jax.jit(g1_normalize_kernel)(*pk_jac))
     sig_x, sig_y, _ = (np.asarray(a) for a in jax.jit(g2_normalize_kernel)(*sig_jac))
     inf = np.zeros((n,), bool)
-    scalars = [(0xDEADBEEF + 0x9E3779B9 * i) % (1 << 64) | 1 for i in range(n)]
-    r_bits = C.scalars_to_bits_msb(scalars, 64)
+    pairs = [
+        ((0xDEADBEEF + 0x9E3779B9 * i) % (1 << 32) | 1,
+         (0xBADC0DE + 0x85EBCA6B * i) % (1 << 32))
+        for i in range(n)
+    ]
+    r_bits = rlc_bits_host(pairs, n)
     return (pk_x, pk_y, inf, sig_x, sig_y, inf.copy(), msg_x, msg_y, inf.copy(), r_bits)
 
 
@@ -145,14 +151,15 @@ def main() -> None:
         # scalar result every time): the axon runtime dedupes repeated
         # identical executions, which silently inflates same-args loops —
         # fresh randomizers are also what a real verifier uses per batch.
-        from grandine_tpu.tpu import curve as _C
+        from grandine_tpu.tpu.bls import rlc_bits_host as _rlc_bits
 
         def fresh_bits(v: int):
-            scalars = [
-                (0xC0FFEE + 0x9E3779B9 * (i + 131 * v + 1)) % (1 << 64) | 1
+            pairs = [
+                ((0xC0FFEE + 0x9E3779B9 * (i + 131 * v + 1)) % (1 << 32) | 1,
+                 (0xFACE + 0xC2B2AE35 * (i + 977 * v + 7)) % (1 << 32))
                 for i in range(n)
             ]
-            bits = _C.scalars_to_bits_msb(scalars, 64)
+            bits = _rlc_bits(pairs, n)
             return bits.reshape(args[-1].shape) if grouped else bits
 
         t0 = time.time()

@@ -182,3 +182,80 @@ def clear_cofactor_g2(p: Point[Fq2]) -> Point[Fq2]:
     h_eff, and only that choice reproduces the published suite vectors.
     """
     return p.mul(constants.H_EFF_G2)
+
+
+# --- GLV / psi² endomorphism constants --------------------------------------
+#
+# Both curves admit a degree-1 endomorphism that acts on the prime-order
+# subgroup as multiplication by LAMBDA = x² mod r (x = the BLS parameter):
+#   - on G1 it is P = (px, py) ↦ (βᵢ·px, ±py) (a cube-root-of-unity twist of
+#     the classic GLV map — x² ≡ −λ² mod r for the cube root λ = x²−1);
+#   - on G2 it is ψ² (untwist-Frobenius-twist squared), which collapses to
+#     coordinate-wise Fp scalings because the Fp2 Frobenius squared is the
+#     identity.
+# The concrete constants are derived numerically below and asserted against
+# scalar multiplication, so there is no sign/root-choice ambiguity to trust.
+# They power the half-length dual-scalar ladders in the device kernels
+# (grandine_tpu/tpu/curve.py scalar_mul_glv) and the host-side 2D scalar
+# decomposition (decompose_glv).
+
+LAMBDA = (constants.X * constants.X) % constants.R
+
+
+def _derive_endo() -> "dict[str, tuple[int, int]]":
+    from .constants import P
+
+    # the two primitive cube roots of unity in Fp
+    c = pow(2, (P - 1) // 3, P)
+    while pow(c, 3, P) != 1 or c == 1:
+        c = pow(c + 1, (P - 1) // 3, P)
+    roots = [c, pow(c, 2, P)]
+    out: dict = {}
+    lam_g1 = G1.mul(LAMBDA).to_affine()
+    for bx in roots:
+        for by in (1, P - 1):
+            cand = (Fq(bx * G1.x.n % P), Fq(by * G1.y.n % P))
+            if (cand[0], cand[1]) == lam_g1:
+                out["g1"] = (bx, by)
+    lam_g2 = G2.mul(LAMBDA).to_affine()
+    for bx in roots:
+        for by in (1, P - 1):
+            cand = (G2.x.scale(Fq(bx)), G2.y.scale(Fq(by)))
+            if (cand[0], cand[1]) == lam_g2:
+                out["g2"] = (bx, by)
+    assert set(out) == {"g1", "g2"}, "endomorphism derivation failed"
+    return out
+
+
+_ENDO: "dict[str, tuple[int, int]] | None" = None
+
+
+def endo_constants() -> "dict[str, tuple[int, int]]":
+    """{'g1': (βx, βy), 'g2': (ωx, ωy)} with (βx·px, βy·py) = [LAMBDA]·P."""
+    global _ENDO
+    if _ENDO is None:
+        _ENDO = _derive_endo()
+    return _ENDO
+
+
+def decompose_glv(k: int) -> "tuple[int, int, int, int]":
+    """k ≡ k0 + k1·LAMBDA (mod r) with |k0|, |k1| < 2¹²⁹ (Babai rounding).
+
+    LAMBDA = x² is a primitive SIXTH root of unity mod r (λ² − λ + 1 =
+    x⁴ − x² + 1 = r exactly), so the lattice {(a, b) : a + b·λ ≡ 0 (mod r)}
+    has the short basis v1 = (λ, −1), v2 = (1, λ − 1) with determinant
+    exactly r. Returns (|k0|, sign0, |k1|, sign1) with signs ±1."""
+    from .constants import R
+
+    lam = LAMBDA
+
+    def rnd(num: int, den: int) -> int:  # round-half-up, exact integers
+        return (2 * num + den) // (2 * den)
+
+    c1 = rnd(k * (lam - 1), R)
+    c2 = rnd(k, R)
+    k0 = k - c1 * lam - c2
+    k1 = c1 - c2 * (lam - 1)
+    assert (k0 + k1 * LAMBDA - k) % R == 0
+    assert max(abs(k0), abs(k1)).bit_length() <= 129
+    return (abs(k0), 1 if k0 >= 0 else -1, abs(k1), 1 if k1 >= 0 else -1)

@@ -46,7 +46,7 @@ import numpy as np
 
 from grandine_tpu.crypto import constants
 from grandine_tpu.crypto import bls as A
-from grandine_tpu.crypto.curves import G1
+from grandine_tpu.crypto.curves import G1, LAMBDA, decompose_glv, endo_constants
 from grandine_tpu.crypto.hash_to_curve import hash_to_g2
 from grandine_tpu.tpu import curve as C
 from grandine_tpu.tpu import field as F
@@ -56,6 +56,61 @@ from grandine_tpu.tpu import pairing as TP
 # --- module constants (host, Montgomery limb form) -------------------------
 
 _NEG_G1_DEV = C.g1_point_to_dev(-G1)  # (x, y, inf=False)
+
+# GLV/ψ² endomorphism constants (derived + asserted in crypto/curves.py):
+# (cx·x, cy·y) = [LAMBDA]·(x, y) on the respective curve.
+_ENDO_HOST = endo_constants()
+
+
+def _g1_endo(n: int):
+    bx, by = _ENDO_HOST["g1"]
+    return (
+        L.const_fp([int(d) for d in L.to_mont(bx)], (n,)),
+        L.const_fp([int(d) for d in L.to_mont(by)], (n,)),
+    )
+
+
+def _g2_endo(n: int):
+    wx, wy = _ENDO_HOST["g2"]
+    z = L.zeros_fp((n,))
+    return (
+        (L.const_fp([int(d) for d in L.to_mont(wx)], (n,)), z),
+        (L.const_fp([int(d) for d in L.to_mont(wy)], (n,)), z),
+    )
+
+
+def rlc_bits_host(pairs, pad_to: int) -> np.ndarray:
+    """[(r0, r1), …] 32-bit RLC pairs → (pad_to, 64) rest-format bit array
+    ([r0 MSB-first 32 | r1 MSB-first 32]); padding rows are (1, 0).
+
+    The RLC scalar of a row is r0 + r1·LAMBDA (mod r) — a set of 2⁶⁴
+    distinct values (r0 + r1·λ < 2¹⁶⁰ < r, so the map is injective), so the
+    forgery bound of the random-linear-combination check is the same 2⁻⁶⁴
+    as uniform 64-bit scalars, while both scalar ladders run at half
+    length (curve.scalar_mul_glv)."""
+    n = len(pairs)
+    r0 = [p[0] for p in pairs] + [1] * (pad_to - n)
+    r1 = [p[1] for p in pairs] + [0] * (pad_to - n)
+    lo = C.scalars_to_bits_msb(r0, 32)
+    hi = C.scalars_to_bits_msb(r1, 32)
+    return np.concatenate([lo, hi], axis=1)
+
+
+def sign_bits_host(scalars, pad_to: int):
+    """Secret scalars → GLV-decomposed ((pad_to, 256) bits, (pad_to, 2) neg
+    masks) for batch_sign_kernel / batch_pubkey_kernel."""
+    decs = [decompose_glv(int(k)) for k in scalars]
+    decs += [(1, 1, 0, 1)] * (pad_to - len(decs))
+    lo = C.scalars_to_bits_msb([d[0] for d in decs], 128)
+    hi = C.scalars_to_bits_msb([d[2] for d in decs], 128)
+    neg = np.array([[d[1] < 0, d[3] < 0] for d in decs], dtype=bool)
+    return np.concatenate([lo, hi], axis=1), neg
+
+
+def _rlc_ladders(bits64):
+    """(N, 64) packed RLC bit rows → ((32, N) lo, (32, N) hi) scan arrays."""
+    b = jnp.asarray(bits64)
+    return jnp.transpose(b[:, :32]), jnp.transpose(b[:, 32:])
 
 
 # --- rest-format ↔ limb-list adapters (first/last traced ops of kernels) ---
@@ -68,11 +123,6 @@ def _g1_in(x, y):
 
 def _g2_in(x, y):
     return F.fp2_split(jnp.asarray(x)), F.fp2_split(jnp.asarray(y))
-
-
-def _bits_in(bits):
-    """(N, nbits) MSB-first → (nbits, N) scan order."""
-    return jnp.transpose(jnp.asarray(bits))
 
 
 def _flat_km(arr, m: int, k: int):
@@ -117,7 +167,8 @@ def multi_verify_kernel(
 ):
     """RLC batch verify of N (msg, sig, pk) triples. Rest-format shapes:
     pk_x/pk_y (N, L); sig/msg coords (N, 2, L); inf masks (N,) bool;
-    r_bits (N, 64) MSB-first nonzero random scalars. N must be a power of
+    r_bits (N, 64) packed RLC rows (rlc_bits_host — the scalar is
+    r0 + r1·LAMBDA, run as a half-length dual ladder). N must be a power of
     two; padding slots are all-infinity (neutral). Returns a scalar bool.
 
     Algebraic twin of Signature::multi_verify (bls/src/signature.rs:96-129).
@@ -128,9 +179,12 @@ def multi_verify_kernel(
     pk_inf = jnp.asarray(pk_inf)
     sig_inf = jnp.asarray(sig_inf)
     msg_inf = jnp.asarray(msg_inf)
-    bits = _bits_in(r_bits)
-    rpk = C.scalar_mul(pk[0], pk[1], pk_inf, bits, C.FP_OPS)
-    rsig = C.scalar_mul(sig[0], sig[1], sig_inf, bits, C.FP2_OPS)
+    n = pk_inf.shape[0]
+    lo, hi = _rlc_ladders(r_bits)
+    rpk = C.scalar_mul_glv(pk[0], pk[1], pk_inf, lo, hi, _g1_endo(n), C.FP_OPS)
+    rsig = C.scalar_mul_glv(
+        sig[0], sig[1], sig_inf, lo, hi, _g2_endo(n), C.FP2_OPS
+    )
     sig_acc = C.sum_points(rsig, C.FP2_OPS)
     pair_inf = pk_inf | msg_inf
     return _rlc_pairing_check(rpk, pair_inf, msg[0], msg[1], sig_acc)
@@ -157,9 +211,13 @@ def grouped_multi_verify_kernel(
     pk_inf_f = _flat_km(pk_inf, m, k)
     sig_inf_f = _flat_km(sig_inf, m, k)
     msg_inf = jnp.asarray(msg_inf)
-    bits = _bits_in(_flat_km(r_bits, m, k))
-    rpk = C.scalar_mul(pk[0], pk[1], pk_inf_f, bits, C.FP_OPS)
-    rsig = C.scalar_mul(sig[0], sig[1], sig_inf_f, bits, C.FP2_OPS)
+    lo, hi = _rlc_ladders(_flat_km(r_bits, m, k))
+    rpk = C.scalar_mul_glv(
+        pk[0], pk[1], pk_inf_f, lo, hi, _g1_endo(m * k), C.FP_OPS
+    )
+    rsig = C.scalar_mul_glv(
+        sig[0], sig[1], sig_inf_f, lo, hi, _g2_endo(m * k), C.FP2_OPS
+    )
     sig_acc = C.sum_points(rsig, C.FP2_OPS)
     gpk = C.sum_points_grouped(rpk, k, C.FP_OPS)  # (M,) Jacobian, m-order
     pair_inf = L.is_zero_val(gpk[2]) | msg_inf
@@ -202,26 +260,36 @@ def aggregate_fast_verify_kernel(
     msg = _g2_in(msg_x, msg_y)
     sig_inf = jnp.asarray(sig_inf)
     msg_inf = jnp.asarray(msg_inf)
-    bits = _bits_in(r_bits)
-    rpk = C.scalar_mul_jac(agg_pk, agg_inf, bits, C.FP_OPS)
-    rsig = C.scalar_mul(sig[0], sig[1], sig_inf, bits, C.FP2_OPS)
+    lo, hi = _rlc_ladders(r_bits)
+    rpk = C.scalar_mul_jac_glv(agg_pk, agg_inf, lo, hi, _g1_endo(m), C.FP_OPS)
+    rsig = C.scalar_mul_glv(
+        sig[0], sig[1], sig_inf, lo, hi, _g2_endo(m), C.FP2_OPS
+    )
     sig_acc = C.sum_points(rsig, C.FP2_OPS)
     pair_inf = agg_inf | msg_inf
     ok = _rlc_pairing_check(rpk, pair_inf, msg[0], msg[1], sig_acc)
     return jnp.logical_and(ok, jnp.logical_not(forged))
 
 
-def batch_sign_kernel(msg_x, msg_y, msg_inf, sk_bits):
-    """N signatures: [skᵢ]·H(mᵢ) on the twist. sk_bits (N, 255) MSB-first.
-    Returns a Jacobian G2 batch in rest format (N, 2, 26) per coord.
+def batch_sign_kernel(msg_x, msg_y, msg_inf, sk_bits, sk_neg):
+    """N signatures: [skᵢ]·H(mᵢ) on the twist. sk_bits (N, 256) packed GLV
+    halves with sk_neg (N, 2) sign masks (sign_bits_host): the 255-bit
+    ladder becomes a 128-step dual ladder. Returns a Jacobian G2 batch in
+    rest format (N, 2, 26) per coord.
 
     NOTE: secret scalars live on the accelerator; the kernel is branchless
     (fixed trip count, select-based) but NOT hardened against physical side
     channels — acceptable for benching, keep hot production signing host-side
     (SURVEY.md §7 risks)."""
     msg = _g2_in(msg_x, msg_y)
-    X, Y, Z = C.scalar_mul(
-        msg[0], msg[1], jnp.asarray(msg_inf), _bits_in(sk_bits), C.FP2_OPS
+    n = jnp.asarray(msg_inf).shape[0]
+    b = jnp.asarray(sk_bits)
+    neg = jnp.asarray(sk_neg)
+    X, Y, Z = C.scalar_mul_glv(
+        msg[0], msg[1], jnp.asarray(msg_inf),
+        jnp.transpose(b[:, :128]), jnp.transpose(b[:, 128:]),
+        _g2_endo(n), C.FP2_OPS,
+        neg_lo=neg[:, 0], neg_hi=neg[:, 1],
     )
     return F.fp2_merge(X), F.fp2_merge(Y), F.fp2_merge(Z)
 
@@ -249,14 +317,22 @@ def g2_normalize_kernel(X, Y, Z):
     return F.fp2_merge(x), F.fp2_merge(y), F.fp2_is_zero(Zl)
 
 
-def batch_pubkey_kernel(sk_bits):
-    """N public keys: [skᵢ]·g1. sk_bits (N, 255) MSB-first; rest-format out."""
+def batch_pubkey_kernel(sk_bits, sk_neg):
+    """N public keys: [skᵢ]·g1. sk_bits (N, 256) packed GLV halves with
+    sk_neg (N, 2) sign masks (sign_bits_host); rest-format out."""
     gx, gy, _ = C.g1_point_to_dev(G1)
     n = sk_bits.shape[0]
     qx = L.const_fp([int(d) for d in gx], (n,))
     qy = L.const_fp([int(d) for d in gy], (n,))
     q_inf = jnp.zeros((n,), bool)
-    X, Y, Z = C.scalar_mul(qx, qy, q_inf, _bits_in(sk_bits), C.FP_OPS)
+    b = jnp.asarray(sk_bits)
+    neg = jnp.asarray(sk_neg)
+    X, Y, Z = C.scalar_mul_glv(
+        qx, qy, q_inf,
+        jnp.transpose(b[:, :128]), jnp.transpose(b[:, 128:]),
+        _g1_endo(n), C.FP_OPS,
+        neg_lo=neg[:, 0], neg_hi=neg[:, 1],
+    )
     return L.merge(X), L.merge(Y), L.merge(Z)
 
 
@@ -294,9 +370,14 @@ def make_sharded_multi_verify(mesh, axis: str = "batch"):
         pk = _g1_in(pk_x, pk_y)
         sig = _g2_in(sig_x, sig_y)
         msg = _g2_in(msg_x, msg_y)
-        bits = _bits_in(r_bits)
-        rpk = C.scalar_mul(pk[0], pk[1], pk_inf, bits, C.FP_OPS)
-        rsig = C.scalar_mul(sig[0], sig[1], sig_inf, bits, C.FP2_OPS)
+        n_local = pk_inf.shape[0]
+        lo, hi = _rlc_ladders(r_bits)
+        rpk = C.scalar_mul_glv(
+            pk[0], pk[1], pk_inf, lo, hi, _g1_endo(n_local), C.FP_OPS
+        )
+        rsig = C.scalar_mul_glv(
+            sig[0], sig[1], sig_inf, lo, hi, _g2_endo(n_local), C.FP2_OPS
+        )
         sX, sY, sZ = C.sum_points(rsig, C.FP2_OPS)  # local G2 partial sum
         n = msg_x.shape[0]
         msg_q = (msg[0], msg[1], F.fp2_one((n,)))
@@ -476,8 +557,7 @@ class TpuBlsBackend:
         for i in range(n):
             x, y, inf = self._hash_to_g2_dev(messages[i], dst)
             msg_x[i], msg_y[i], msg_inf[i] = x, y, inf
-        scalars = [self._nonzero_u64(rng) for _ in range(n)] + [1] * (b - n)
-        r_bits = C.scalars_to_bits_msb(scalars, 64)
+        r_bits = rlc_bits_host([self._rlc_pair(rng) for _ in range(n)], b)
         fn = self._jitted("multi_verify", multi_verify_kernel)
         result = fn(
             pk_x, pk_y, pk_inf, sig_x, sig_y, sig_inf, msg_x, msg_y, msg_inf, r_bits
@@ -497,7 +577,7 @@ class TpuBlsBackend:
         msg_x = np.zeros((bm, 2, L.NLIMBS), np.int32)
         msg_y = np.zeros((bm, 2, L.NLIMBS), np.int32)
         msg_inf = np.ones((bm,), bool)
-        scalars = np.ones((bm, bk), dtype=object)
+        pairs = [(1, 0)] * (bm * bk)
         for j, (msg, idxs) in enumerate(groups.items()):
             x, y, inf = self._hash_to_g2_dev(msg, dst)
             msg_x[j], msg_y[j], msg_inf[j] = x, y, inf
@@ -506,10 +586,8 @@ class TpuBlsBackend:
                 sig_x[j, kk], sig_y[j, kk], sig_inf[j, kk] = (
                     g2x[i], g2y[i], g2inf[i],
                 )
-                scalars[j, kk] = self._nonzero_u64(rng)
-        r_bits = C.scalars_to_bits_msb(
-            [int(s) for s in scalars.reshape(-1)], 64
-        ).reshape(bm, bk, 64)
+                pairs[j * bk + kk] = self._rlc_pair(rng)
+        r_bits = rlc_bits_host(pairs, bm * bk).reshape(bm, bk, 64)
         fn = self._jitted("grouped_multi_verify", grouped_multi_verify_kernel)
         result = fn(
             pk_x, pk_y, pk_inf, sig_x, sig_y, sig_inf,
@@ -588,8 +666,7 @@ class TpuBlsBackend:
         for i in range(m):
             x, y, inf = self._hash_to_g2_dev(messages[i], dst)
             msg_x[i], msg_y[i], msg_inf[i] = x, y, inf
-        scalars = [self._nonzero_u64(rng) for _ in range(m)] + [1] * (bm - m)
-        r_bits = C.scalars_to_bits_msb(scalars, 64)
+        r_bits = rlc_bits_host([self._rlc_pair(rng) for _ in range(m)], bm)
         fn = self._jitted("agg_fast_verify", aggregate_fast_verify_kernel)
         return bool(
             fn(
@@ -640,23 +717,25 @@ class TpuBlsBackend:
         for i in range(n):
             x, y, inf = self._hash_to_g2_dev(messages[i], dst)
             msg_x[i], msg_y[i], msg_inf[i] = x, y, inf
-        scalars = [sk.scalar for sk in secret_keys] + [1] * (b - n)
-        sk_bits = C.scalars_to_bits_msb(scalars, 255)
+        sk_bits, sk_neg = sign_bits_host([sk.scalar for sk in secret_keys], b)
         fn = self._jitted("batch_sign", batch_sign_kernel)
-        X, Y, Z = fn(msg_x, msg_y, msg_inf, sk_bits)
+        X, Y, Z = fn(msg_x, msg_y, msg_inf, sk_bits, sk_neg)
         X, Y, Z = np.asarray(X), np.asarray(Y), np.asarray(Z)
         return [A.Signature(C.dev_to_g2_point(X[i], Y[i], Z[i])) for i in range(n)]
 
     @staticmethod
-    def _nonzero_u64(rng) -> int:
-        s = 0
-        while s == 0:
-            s = rng.randbits(64)
-        return s
+    def _rlc_pair(rng) -> "tuple[int, int]":
+        """A nonzero (r0, r1) 32-bit RLC pair (see rlc_bits_host)."""
+        a, b = 0, 0
+        while a == 0 and b == 0:
+            a, b = rng.randbits(32), rng.randbits(32)
+        return a, b
 
 
 __all__ = [
     "TpuBlsBackend",
+    "rlc_bits_host",
+    "sign_bits_host",
     "multi_verify_kernel",
     "grouped_multi_verify_kernel",
     "aggregate_fast_verify_kernel",

@@ -495,3 +495,86 @@ def g2_points_to_dev(points):
     flat = [c for quad in coords for c in quad]
     limbs = ints_to_mont_limbs(flat).reshape(n, 2, 2, L.NLIMBS)
     return limbs[:, 0], limbs[:, 1], inf
+
+
+def scalar_mul_glv(
+    qx, qy, q_inf, bits_lo, bits_hi, endo, ops: FieldOps,
+    neg_lo=None, neg_hi=None,
+):
+    """[k]Q for affine Q (batched) with k = k0 + k1·LAMBDA given as TWO
+    MSB-first bit arrays (nbits, *batch) — the dual-scalar GLV/ψ² ladder:
+    half the doubles of the single 2·nbits ladder.
+
+    `endo` = (cx, cy): field constants with (cx·x, cy·y) = [LAMBDA]·(x, y)
+    (crypto/curves.py endo_constants — derived and asserted numerically).
+    Optional neg_lo/neg_hi bool masks negate the respective slot's scalar
+    (the base's y is negated), for signed GLV decompositions.
+
+    Degeneracy safety (mixed adds): the accumulator is [a + b·LAMBDA]Q with
+    partial a, b < 2¹²⁹; T = ±(slot base) requires (a∓1, b) or (a, b∓1) in
+    the LAMBDA-lattice, whose nonzero vectors have a coordinate ≥ λ−1 ≈
+    2¹²⁷·⁷ in absolute value in any combination reachable here — impossible
+    for in-range partials except the handled first-set-bit embedding (same
+    argument family as scalar_mul; LAMBDA structure in crypto/curves.py).
+    """
+    ex, ey = endo
+    q2x, q2y = ops.mul_many([qx, qy], [ex, ey])
+    if neg_lo is not None:
+        qy = ops.select(neg_lo, ops.neg(qy), qy)
+    if neg_hi is not None:
+        q2y = ops.select(neg_hi, ops.neg(q2y), q2y)
+    one = ops.one_like(qx)
+    zero = ops.zeros_like(qx)
+    started0 = jnp.zeros(bits_lo.shape[1:], bool)
+    init = ((one, one, zero), started0)  # infinity, nothing accumulated yet
+
+    def slot(st, started, bit, bx, by):
+        added = point_madd_unsafe(st, bx, by, ops)
+        bitb = bit.astype(bool)
+        X = ops.select(bitb, ops.select(started, added[0], bx), st[0])
+        Y = ops.select(bitb, ops.select(started, added[1], by), st[1])
+        Z = ops.select(bitb, ops.select(started, added[2], one), st[2])
+        return (X, Y, Z), jnp.logical_or(started, bitb)
+
+    def step(carry, bits):
+        st, started = carry
+        b0, b1 = bits
+        st = point_double(st, ops)
+        st, started = slot(st, started, b0, qx, qy)
+        st, started = slot(st, started, b1, q2x, q2y)
+        return (st, started), None
+
+    (st, _), _ = lax.scan(step, init, (bits_lo, bits_hi))
+    X = ops.select(q_inf, one, st[0])
+    Y = ops.select(q_inf, one, st[1])
+    Z = ops.select(q_inf, zero, st[2])
+    return (X, Y, Z)
+
+
+def scalar_mul_jac_glv(q, q_inf, bits_lo, bits_hi, endo, ops: FieldOps):
+    """GLV ladder for a Jacobian (possibly adversarial) base — complete
+    additions throughout, so no degeneracy preconditions (the firehose
+    kernel's aggregated-pubkey path)."""
+    ex, ey = endo
+    one = ops.one_like(q[0])
+    zero = ops.zeros_like(q[0])
+    Qx = ops.select(q_inf, one, q[0])
+    Qy = ops.select(q_inf, one, q[1])
+    Qz = ops.select(q_inf, zero, q[2])
+    e2x, e2y = ops.mul_many([Qx, Qy], [ex, ey])
+    init = (one, one, zero)  # infinity
+
+    def step(st, bits):
+        b0, b1 = bits
+        st = point_double(st, ops)
+        a1 = point_add_complete(st, (Qx, Qy, Qz), ops)
+        st = tuple(ops.select(b0.astype(bool), a, s) for a, s in zip(a1, st))
+        a2 = point_add_complete(st, (e2x, e2y, Qz), ops)
+        st = tuple(ops.select(b1.astype(bool), a, s) for a, s in zip(a2, st))
+        return st, None
+
+    st, _ = lax.scan(step, init, (bits_lo, bits_hi))
+    X = ops.select(q_inf, one, st[0])
+    Y = ops.select(q_inf, one, st[1])
+    Z = ops.select(q_inf, zero, st[2])
+    return (X, Y, Z)

@@ -253,14 +253,17 @@ _ABS_X_BITS_MSB = np.array(
 
 
 def expx_abs(m):
-    """m^|x| (square-and-multiply, MSB-first, seeded with m for the MSB)."""
-    shape = m[0][0][0].shape[1:]
+    """m^|x| (square-and-multiply, MSB-first, seeded with m for the MSB).
+    |x| has only six set bits, so the multiply is gated behind lax.cond —
+    5 of 63 steps pay it instead of all (the step bit is a scan-carried
+    scalar, so cond executes one branch)."""
 
     def step(acc, bit):
         acc = fp12_sq_fast(acc)
-        taken = F.fp12_mul(acc, m)
-        cond = jnp.broadcast_to(bit.astype(bool), shape)
-        return F.fp12_select(cond, taken, acc), None
+        acc = lax.cond(
+            bit.astype(bool), lambda a: F.fp12_mul(a, m), lambda a: a, acc
+        )
+        return acc, None
 
     acc, _ = lax.scan(step, m, jnp.asarray(_ABS_X_BITS_MSB[1:]))
     return acc

@@ -1,0 +1,142 @@
+"""Differential tests: GLV/ψ² dual-scalar ladders vs anchor scalar mul."""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from grandine_tpu.crypto.constants import P, R
+from grandine_tpu.crypto.curves import (
+    G1, G2, LAMBDA, decompose_glv, endo_constants, g1_infinity,
+)
+from grandine_tpu.tpu import curve as C
+from grandine_tpu.tpu import field as F
+from grandine_tpu.tpu import limbs as L
+
+rng = random.Random(0x61F)
+
+
+def _g1_endo(n):
+    bx, by = endo_constants()["g1"]
+    return (
+        L.const_fp([int(d) for d in L.to_mont(bx)], (n,)),
+        L.const_fp([int(d) for d in L.to_mont(by)], (n,)),
+    )
+
+
+def _g2_endo(n):
+    wx, wy = endo_constants()["g2"]
+    zx = L.zeros_fp((n,))
+    return (
+        (L.const_fp([int(d) for d in L.to_mont(wx)], (n,)), zx),
+        (L.const_fp([int(d) for d in L.to_mont(wy)], (n,)), zx),
+    )
+
+
+def test_glv_scalar_mul_both_groups():
+    n = 4
+    ks = [rng.randrange(1, R) for _ in range(n)]
+    r0s = [rng.randrange(1, 1 << 32) for _ in range(n)]
+    r1s = [rng.randrange(0, 1 << 32) for _ in range(n)]
+    scalars = [(a + b * LAMBDA) % R for a, b in zip(r0s, r1s)]
+    bits_lo = jnp.asarray(C.scalars_to_bits_msb(r0s, 32)).T
+    bits_hi = jnp.asarray(C.scalars_to_bits_msb(r1s, 32)).T
+    infl = jnp.zeros((n,), bool)
+
+    pts1 = [G1.mul(k) for k in ks]
+    devs = [C.g1_point_to_dev(p) for p in pts1]
+    X = L.split(jnp.asarray(np.stack([d[0] for d in devs])))
+    Y = L.split(jnp.asarray(np.stack([d[1] for d in devs])))
+    fn = jax.jit(
+        lambda qx, qy, qi, b0, b1: C.scalar_mul_glv(
+            qx, qy, qi, b0, b1, _g1_endo(n), C.FP_OPS
+        )
+    )
+    sm = fn(X, Y, infl, bits_lo, bits_hi)
+    for i in range(n):
+        got = C.dev_to_g1_point(
+            L.merge_np(sm[0])[i], L.merge_np(sm[1])[i], L.merge_np(sm[2])[i]
+        )
+        assert got == pts1[i].mul(scalars[i])
+
+    pts2 = [G2.mul(k) for k in ks]
+    devs2 = [C.g2_point_to_dev(p) for p in pts2]
+    X2 = F.fp2_split(jnp.asarray(np.stack([d[0] for d in devs2])))
+    Y2 = F.fp2_split(jnp.asarray(np.stack([d[1] for d in devs2])))
+    fn2 = jax.jit(
+        lambda qx, qy, qi, b0, b1: C.scalar_mul_glv(
+            qx, qy, qi, b0, b1, _g2_endo(n), C.FP2_OPS
+        )
+    )
+    sm2 = fn2(X2, Y2, infl, bits_lo, bits_hi)
+    for i in range(n):
+        got = C.dev_to_g2_point(
+            F.fp2_merge_np(sm2[0])[i],
+            F.fp2_merge_np(sm2[1])[i],
+            F.fp2_merge_np(sm2[2])[i],
+        )
+        assert got == pts2[i].mul(scalars[i])
+
+
+def test_glv_signed_decomposition_g2():
+    """The batch-sign path: full-width scalars via decompose_glv with signs."""
+    n = 4
+    ks = [rng.randrange(1, R) for _ in range(n)]
+    decs = [decompose_glv(k) for k in ks]
+    bits_lo = jnp.asarray(C.scalars_to_bits_msb([d[0] for d in decs], 128)).T
+    bits_hi = jnp.asarray(C.scalars_to_bits_msb([d[2] for d in decs], 128)).T
+    neg_lo = jnp.asarray(np.array([d[1] < 0 for d in decs]))
+    neg_hi = jnp.asarray(np.array([d[3] < 0 for d in decs]))
+    base_ks = [rng.randrange(1, R) for _ in range(n)]
+    pts = [G2.mul(k) for k in base_ks]
+    devs = [C.g2_point_to_dev(p) for p in pts]
+    X = F.fp2_split(jnp.asarray(np.stack([d[0] for d in devs])))
+    Y = F.fp2_split(jnp.asarray(np.stack([d[1] for d in devs])))
+    infl = jnp.zeros((n,), bool)
+    fn = jax.jit(
+        lambda qx, qy, qi, b0, b1, n0, n1: C.scalar_mul_glv(
+            qx, qy, qi, b0, b1, _g2_endo(n), C.FP2_OPS, neg_lo=n0, neg_hi=n1
+        )
+    )
+    sm = fn(X, Y, infl, bits_lo, bits_hi, neg_lo, neg_hi)
+    for i in range(n):
+        got = C.dev_to_g2_point(
+            F.fp2_merge_np(sm[0])[i],
+            F.fp2_merge_np(sm[1])[i],
+            F.fp2_merge_np(sm[2])[i],
+        )
+        assert got == pts[i].mul(ks[i])
+
+
+def test_glv_jacobian_and_infinity():
+    n = 4
+    base = [G1.mul(rng.randrange(1, R)) for _ in range(2)]
+    pts = [base[0], base[1], g1_infinity(), base[0]]
+    r0s = [3, 1, 7, 0]
+    r1s = [0, 5, 2, 4]
+    scalars = [(a + b * LAMBDA) % R for a, b in zip(r0s, r1s)]
+    devs = [C.g1_point_to_dev(p) for p in pts]
+    one = np.asarray(L.to_mont(1))
+    X = L.split(jnp.asarray(np.stack([d[0] for d in devs])))
+    Y = L.split(jnp.asarray(np.stack([d[1] for d in devs])))
+    Z = L.split(jnp.asarray(np.stack(
+        [np.zeros(L.NLIMBS, np.int32) if d[2] else one for d in devs]
+    )))
+    infl = jnp.asarray(np.array([False, False, True, False]))
+    bits_lo = jnp.asarray(C.scalars_to_bits_msb(r0s, 32)).T
+    bits_hi = jnp.asarray(C.scalars_to_bits_msb(r1s, 32)).T
+    fn = jax.jit(
+        lambda q, qi, b0, b1: C.scalar_mul_jac_glv(
+            q, qi, b0, b1, _g1_endo(4), C.FP_OPS
+        )
+    )
+    sm = fn((X, Y, Z), infl, bits_lo, bits_hi)
+    for i in range(4):
+        got = C.dev_to_g1_point(
+            L.merge_np(sm[0])[i], L.merge_np(sm[1])[i], L.merge_np(sm[2])[i]
+        )
+        if pts[i].is_infinity():
+            assert got.is_infinity()
+        else:
+            assert got == pts[i].mul(scalars[i])

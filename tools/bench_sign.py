@@ -26,7 +26,7 @@ def main() -> None:
     import bench
     from grandine_tpu.crypto.hash_to_curve import hash_to_g2
     from grandine_tpu.tpu import curve as C
-    from grandine_tpu.tpu.bls import batch_sign_kernel
+    from grandine_tpu.tpu.bls import batch_sign_kernel, sign_bits_host
 
     bench._enable_compilation_cache()
 
@@ -44,20 +44,20 @@ def main() -> None:
             (0x1111 + v * 0x9E37 + 0x2468ACE * i) % (1 << 200) + 5
             for i in range(n)
         ]
-        return C.scalars_to_bits_msb(sks, 255)
+        return sign_bits_host(sks, n)
 
     prep_s = time.time() - t0
 
     fn = jax.jit(batch_sign_kernel)
     t0 = time.time()
-    out = fn(msg_x, msg_y, msg_inf, fresh_bits(0))
+    out = fn(msg_x, msg_y, msg_inf, *fresh_bits(0))
     np.asarray(out[0])
     compile_s = time.time() - t0
 
     t0 = time.time()
     iters = 0
     while True:
-        out = fn(msg_x, msg_y, msg_inf, fresh_bits(iters + 1))
+        out = fn(msg_x, msg_y, msg_inf, *fresh_bits(iters + 1))
         np.asarray(out[0])
         iters += 1
         if time.time() - t0 > 15 or iters >= 5:

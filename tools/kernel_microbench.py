@@ -87,5 +87,35 @@ def main():
     timeit("G1 sum_points tree", f2, ((qx, qy, qx),), 3, N)
 
 
+
+
+def extra_adds():
+    """Cost of the elementwise ops between montmuls at kernel shapes."""
+    rng = np.random.default_rng(1)
+    a = rand_fp(rng, (2, N))
+    b = rand_fp(rng, (2, N))
+
+    def chain_add(x, y):
+        def body(c, _):
+            return L.add_mod(c, y), None
+        out, _ = lax.scan(body, x, None, length=64)
+        return out
+
+    timeit("add_mod chain64 (2,N)", jax.jit(chain_add), (a, b), 10, 64 * N)
+
+    def chain_select(x, y):
+        cond = x[0] > y[0]
+        def body(c, _):
+            return L.select(cond[0], L.add_mod(c, y), c), None
+        out, _ = lax.scan(body, x, None, length=64)
+        return out
+
+    timeit("add+select chain64 (2,N)", jax.jit(chain_select), (a, b), 10, 64 * N)
+
+
 if __name__ == "__main__":
-    main()
+    if os.environ.get("EXTRA"):
+        print(f"platform={jax.devices()[0].platform} N={N}")
+        extra_adds()
+    else:
+        main()
