@@ -47,6 +47,28 @@ def build_parser() -> argparse.ArgumentParser:
                      help="serve the Beacon API on this port (0 = off)")
     run.add_argument("--no-restart", action="store_true",
                      help="disable the crash-restart supervisor")
+    run.add_argument("--engine-url", default=None,
+                     help="execution-engine JSON-RPC endpoint "
+                          "(requires --jwt-secret)")
+    run.add_argument("--jwt-secret", default=None,
+                     help="path to the hex-encoded engine-API JWT secret")
+    run.add_argument("--web3signer-url", default=None,
+                     help="remote signer (Web3Signer REST) endpoint")
+    run.add_argument("--checkpoint-sync-url", default=None,
+                     help="Beacon API to checkpoint-sync the anchor state from")
+    run.add_argument("--builder-url", default=None,
+                     help="MEV builder relay endpoint")
+    run.add_argument("--listen-port", type=int, default=None,
+                     help="serve p2p (TCP gossip + req/resp) on this port "
+                          "(0 = pick a free port)")
+    run.add_argument("--peer", action="append", default=[],
+                     help="host:port of a peer to dial (repeatable)")
+    run.add_argument("--follow", action="store_true",
+                     help="run no duties; range-sync + gossip-follow peers "
+                          "until --until-finalized is reached")
+    run.add_argument("--until-finalized", type=int, default=1,
+                     help="--follow exits 0 once finalized epoch reaches this")
+    run.add_argument("--follow-timeout", type=float, default=300.0)
 
     sub.add_parser("info", help="print the resolved configuration")
 
@@ -109,11 +131,60 @@ def _node_once(args, cfg) -> int:
     db = Database.persistent(os.path.join(args.data_dir, "chain.sqlite"))
     storage = Storage(db, cfg)
     metrics = Metrics()
-    genesis = interop_genesis_state(args.validators, cfg)
 
-    stored, unfinalized = storage.load(anchor_state=genesis)
+    # concrete HTTP clients behind the seams (http_clients.py); absent
+    # flags keep the Null/Mock/injected defaults the tests use
+    engine = None
+    if getattr(args, "engine_url", None):
+        from grandine_tpu.http_clients import EngineApiClient
 
-    node = InProcessNode(stored, cfg, use_device_firehose=args.use_device)
+        if not args.jwt_secret:
+            raise SystemExit("--engine-url requires --jwt-secret")
+        with open(args.jwt_secret) as f:
+            secret = bytes.fromhex(f.read().strip().removeprefix("0x"))
+        engine = EngineApiClient(args.engine_url, secret)
+
+    if getattr(args, "checkpoint_sync_url", None) and (
+        storage.load_anchor_state() is None
+    ):
+        # remote checkpoint only on FIRST start: a restart must resume from
+        # the locally persisted anchor + unfinalized replay, not re-download
+        # and discard local progress (reference StateLoadStrategy::Auto
+        # prefers the local DB once one exists)
+        from grandine_tpu.http_clients import checkpoint_fetcher
+        from grandine_tpu.storage import StateLoadStrategy
+
+        stored, unfinalized = storage.load(
+            StateLoadStrategy.REMOTE,
+            fetcher=checkpoint_fetcher(args.checkpoint_sync_url),
+        )
+    else:
+        genesis = interop_genesis_state(args.validators, cfg)
+        stored, unfinalized = storage.load(anchor_state=genesis)
+
+    node = InProcessNode(
+        stored, cfg, use_device_firehose=args.use_device,
+        execution_engine=engine,
+    )
+    if getattr(args, "web3signer_url", None):
+        # remote-signer registry for a ValidatorService embedding; the
+        # list_keys round-trip also fail-fasts on a bad endpoint
+        from grandine_tpu.http_clients import Web3SignerClient
+        from grandine_tpu.validator.signer import Signer
+
+        client = Web3SignerClient(args.web3signer_url)
+        remote_signer = Signer(web3signer=client)
+        keys = client.list_keys()
+        for pk_hex in keys:
+            remote_signer.add_remote_key(bytes.fromhex(pk_hex))
+        node.remote_signer = remote_signer
+        print(f"web3signer: {len(keys)} remote keys at {args.web3signer_url}")
+    if getattr(args, "builder_url", None):
+        from grandine_tpu.builder_api import BuilderApi
+        from grandine_tpu.http_clients import BuilderRelayClient
+
+        node.builder_api = BuilderApi(BuilderRelayClient(args.builder_url))
+        print(f"builder relay: {args.builder_url}")
     node.controller.storage = storage
     node.controller.store.pre_prune_hook = node.controller._persist_finalized
     node.controller.metrics = metrics
@@ -129,6 +200,28 @@ def _node_once(args, cfg) -> int:
         node.controller.wait()
         print(f"restored {len(unfinalized)} unfinalized blocks from storage")
 
+    network = transport = None
+    if getattr(args, "listen_port", None) is not None or getattr(args, "peer", None):
+        from grandine_tpu.p2p.network import GossipTopics, Network
+        from grandine_tpu.p2p.tcp import TcpTransport
+
+        head_state = node.controller.snapshot().head_state
+        transport = TcpTransport(
+            peer_id=f"node-{os.getpid()}",
+            fork_digest=GossipTopics.fork_digest(cfg, head_state),
+            listen_port=args.listen_port or 0,
+        )
+        network = Network(
+            transport, node.controller, cfg,
+            attestation_verifier=node.attestation_verifier,
+            storage=storage,
+        )
+        print(f"p2p listening on 127.0.0.1:{transport.port}", flush=True)
+        for addr in args.peer:
+            host, port = addr.rsplit(":", 1)
+            pid = transport.connect(host, int(port))
+            print(f"p2p connected to {pid} ({addr})", flush=True)
+
     server = None
     if args.http_port:
         ctx = ApiContext(
@@ -141,25 +234,68 @@ def _node_once(args, cfg) -> int:
         server, _thread = serve(ctx, port=args.http_port)
         print(f"Beacon API on http://127.0.0.1:{args.http_port}")
 
-    start = int(node.controller.snapshot().slot) + 1
-    stop = start + args.slots if args.slots else None
-    slot = start
     try:
+        if getattr(args, "follow", False):
+            return _follow_loop(args, node, transport)
+        start = int(node.controller.snapshot().slot) + 1
+        stop = start + args.slots if args.slots else None
+        slot = start
+        published = 0
         while stop is None or slot < stop:
             node.run_slot(slot)
+            if network is not None:
+                while published < len(node.produced_blocks):
+                    network.publish_block(node.produced_blocks[published])
+                    published += 1
             snap = node.head()
             print(
                 f"slot {slot}: head={snap.head_root.hex()[:12]} "
                 f"justified={int(snap.justified_checkpoint.epoch)} "
-                f"finalized={int(snap.finalized_checkpoint.epoch)}"
+                f"finalized={int(snap.finalized_checkpoint.epoch)}",
+                flush=True,
             )
             slot += 1
     finally:
+        if transport is not None:
+            transport.close()
         if server is not None:
             server.shutdown()
         node.stop()
         db.close()
     return 0
+
+
+def _follow_loop(args, node, transport) -> int:
+    """Dutiless follower: range-sync from peers (gossip rides alongside)
+    until the finalized epoch reaches the target (two-process devnet)."""
+    from grandine_tpu.p2p.sync import BlockSyncService
+
+    if transport is None:
+        raise SystemExit("--follow requires --peer/--listen-port")
+    sync = BlockSyncService(transport, node.controller, node.cfg)
+    deadline = time.time() + args.follow_timeout
+    last_print = 0.0
+    while time.time() < deadline:
+        try:
+            progress = sync.sync_once()
+        except (ConnectionError, TimeoutError):
+            progress = False
+        snap = node.controller.snapshot()
+        fin = int(snap.finalized_checkpoint.epoch)
+        if time.time() - last_print > 1.0:
+            print(
+                f"follow: head_slot={int(snap.head_state.slot)} "
+                f"finalized={fin} peers={len(transport.peers())}",
+                flush=True,
+            )
+            last_print = time.time()
+        if fin >= args.until_finalized:
+            print(f"follow: finalized epoch {fin} reached", flush=True)
+            return 0
+        if not progress:
+            time.sleep(0.25)
+    print("follow: timeout before reaching finality target", file=sys.stderr)
+    return 1
 
 
 def cmd_run(args) -> int:

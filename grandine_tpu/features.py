@@ -19,6 +19,10 @@ class Feature(enum.Enum):
     DISABLE_DEVICE_BACKEND = "DisableDeviceBackend"
     DISABLE_PROPOSER_BOOST = "DisableProposerBoost"
     ALWAYS_PREPROCESS_NEXT_SLOT = "AlwaysPreprocessNextSlot"
+    # revert block packing to the pure greedy packer (the default is the
+    # max-clique + branch-and-bound packer, pools/packer.py; reference
+    # attestation_packer.rs ships ILP-on-by-default with greedy fallback)
+    GREEDY_ATTESTATION_PACKING = "GreedyAttestationPacking"
 
 
 _STATE: "dict[Feature, bool]" = {f: False for f in Feature}

@@ -185,9 +185,17 @@ class Store:
         self.blocks[anchor_root] = node
         self.children[anchor_root] = []
 
-        # latest messages: validator -> (epoch, block root)
-        self.latest_message_epoch: "dict[int, int]" = {}
-        self.latest_message_root: "dict[int, bytes]" = {}
+        # latest messages, COLUMNAR (one row per validator index): the
+        # 50k-scale get_head weight pass is a single np.bincount over these
+        # instead of a Python dict walk (reference keeps incremental
+        # segment weights — fork_choice_store/src/store.rs; here the
+        # columnar pass is ≲ms at 50k so recompute-per-head stays simple).
+        # Roots are interned to small ints (_id_roots) so the columns stay
+        # fixed-width int32/int64.
+        self._lm_epoch = np.full(0, -1, dtype=np.int64)
+        self._lm_root_id = np.full(0, -1, dtype=np.int32)
+        self._block_ids: "dict[bytes, int]" = {}
+        self._id_roots: "list[bytes]" = []
         self.equivocating: "set[int]" = set()
 
         self.proposer_boost_root: "Optional[bytes]" = None
@@ -348,10 +356,13 @@ class Store:
         self.children.setdefault(node.parent_root, []).append(root)
         self.children.setdefault(root, [])
 
-        # spec on_block (v1.3+) gates the boost with is_first_block: only
-        # the FIRST timely block in the slot gets it — letting a second
+        # spec on_block (v1.3+) gates the boost with
+        # is_first_block = (proposer_boost_root == Root()): only the FIRST
+        # timely block in the slot gets it — letting a second
         # (equivocating) block overwrite the boost enables boost-stealing
         # ex-ante reorgs. proposer_boost_root resets at each slot tick.
+        # Matches the reference exactly: store.rs:1878-1887 (is_first_block)
+        # and store.rs:1803-1804 (per-slot reset).
         if valid.is_timely and self.proposer_boost_root is None:
             self.proposer_boost_root = root
 
@@ -373,23 +384,55 @@ class Store:
         if int(uf.epoch) > int(self.unrealized_finalized.epoch):
             self.unrealized_finalized = uf
 
+    @property
+    def latest_message_root(self) -> "dict[int, bytes]":
+        """Diagnostic dict view (validator → latest-vote block root) of the
+        columnar latest-message store; built on demand, not the hot path."""
+        idx = np.nonzero(self._lm_root_id >= 0)[0]
+        return {int(i): self._id_roots[self._lm_root_id[i]] for i in idx}
+
+    def _intern_root(self, root: bytes) -> int:
+        rid = self._block_ids.get(root)
+        if rid is None:
+            rid = len(self._id_roots)
+            self._block_ids[root] = rid
+            self._id_roots.append(root)
+        return rid
+
+    def _ensure_lm_capacity(self, n: int) -> None:
+        if len(self._lm_epoch) < n:
+            grow = max(n, 2 * len(self._lm_epoch))
+            e = np.full(grow, -1, dtype=np.int64)
+            r = np.full(grow, -1, dtype=np.int32)
+            e[: len(self._lm_epoch)] = self._lm_epoch
+            r[: len(self._lm_root_id)] = self._lm_root_id
+            self._lm_epoch, self._lm_root_id = e, r
+
     def apply_attestation(self, valid: ValidAttestation) -> None:
-        """Mutator-only (store.rs:2022): LMD latest-message updates."""
-        root = valid.beacon_block_root
-        epoch = valid.epoch
-        for i in valid.indices:
-            if i in self.equivocating:
-                continue
-            if self.latest_message_epoch.get(i, -1) < epoch:
-                self.latest_message_epoch[i] = epoch
-                self.latest_message_root[i] = root
+        """Mutator-only (store.rs:2022): LMD latest-message updates —
+        one vectorized compare-and-set over the attestation's indices."""
+        rid = self._intern_root(valid.beacon_block_root)
+        epoch = int(valid.epoch)
+        idx = np.asarray(valid.indices, dtype=np.int64)
+        if idx.size == 0:
+            return
+        self._ensure_lm_capacity(int(idx.max()) + 1)
+        newer = self._lm_epoch[idx] < epoch
+        if self.equivocating:
+            eq = np.fromiter(self.equivocating, np.int64)
+            newer &= ~np.isin(idx, eq)
+        upd = idx[newer]
+        self._lm_epoch[upd] = epoch
+        self._lm_root_id[upd] = rid
 
     def apply_attester_slashing(self, indices: "Sequence[int]") -> None:
         """Equivocating validators never count toward weights again."""
         for i in indices:
-            self.equivocating.add(int(i))
-            self.latest_message_epoch.pop(int(i), None)
-            self.latest_message_root.pop(int(i), None)
+            i = int(i)
+            self.equivocating.add(i)
+            if i < len(self._lm_epoch):
+                self._lm_epoch[i] = -1
+                self._lm_root_id[i] = -1
 
     def _update_checkpoints(self, justified, finalized) -> None:
         if int(justified.epoch) > int(self.justified_checkpoint.epoch):
@@ -505,21 +548,31 @@ class Store:
         n = len(cols)
 
         own: "dict[bytes, int]" = {}
-        if self.latest_message_root:
-            idx = np.fromiter(self.latest_message_epoch.keys(), np.int64)
-            idx = idx[idx < n]
-            active = cols.active_indices(
-                accessors.get_current_epoch(jstate, p)
-            )
-            active_mask = np.zeros(n, dtype=bool)
-            active_mask[active] = True
-            for i in idx:
-                i = int(i)
-                if not active_mask[i] or bool(cols.slashed[i]):
-                    continue
-                root = self.latest_message_root[i]
-                if root in self.blocks:
-                    own[root] = own.get(root, 0) + int(cols.effective_balance[i])
+        m = min(len(self._lm_root_id), n)
+        if m and self._id_roots:
+            ids = self._lm_root_id[:m]
+            mask = ids >= 0
+            if mask.any():
+                active = cols.active_indices(
+                    accessors.get_current_epoch(jstate, p)
+                )
+                active_mask = np.zeros(n, dtype=bool)
+                active_mask[active] = True
+                mask &= active_mask[:m]
+                mask &= ~np.asarray(cols.slashed[:m], dtype=bool)
+                sel = ids[mask]
+                # balances < 2⁵³ gwei total: float64 bincount is exact
+                w = np.bincount(
+                    sel,
+                    weights=np.asarray(
+                        cols.effective_balance[:m], dtype=np.float64
+                    )[mask],
+                    minlength=len(self._id_roots),
+                )
+                for rid in np.nonzero(w)[0]:
+                    root = self._id_roots[rid]
+                    if root in self.blocks:
+                        own[root] = int(w[rid])
 
         if self.proposer_boost_root and self.proposer_boost_root in self.blocks:
             total_active = accessors.get_total_active_balance(jstate, p)

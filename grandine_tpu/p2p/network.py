@@ -64,6 +64,10 @@ class Transport:
     def request_status(self, peer: str) -> dict:
         raise NotImplementedError
 
+    def register_provider(self, blocks_by_range, status) -> None:
+        """Install the local node's req/resp serving callbacks."""
+        raise NotImplementedError
+
 
 class InMemoryHub:
     """Process-local gossip mesh + req/resp: every joined transport sees
@@ -134,6 +138,9 @@ class _HubTransport(Transport):
     def request_status(self, peer):
         return self.hub._request(peer, "status")
 
+    def register_provider(self, blocks_by_range, status):
+        self.hub.register_provider(self.peer_id, blocks_by_range, status)
+
 
 class Network:
     """The service loop glue (network.rs): gossip in → controller /
@@ -166,10 +173,12 @@ class Network:
                 GossipTopics.beacon_attestation(self.digest, subnet),
                 self._on_gossip_attestation,
             )
-        if hasattr(transport, "hub"):
-            transport.hub.register_provider(
-                transport.peer_id, self._serve_blocks_by_range, self._serve_status
+        try:
+            transport.register_provider(
+                self._serve_blocks_by_range, self._serve_status
             )
+        except NotImplementedError:
+            pass
 
     # ------------------------------------------------------------ inbound
 

@@ -1,0 +1,339 @@
+"""Socket-real transport: gossip + req/resp over TCP with length-prefixed
+ssz_snappy framing — the second `Transport` implementation (the first,
+InMemoryHub, stays for unit tests).
+
+Reference shape: p2p/src/network.rs over eth2_libp2p (gossipsub + req/resp
+protocols `/eth2/beacon_chain/req/{status,beacon_blocks_by_range}/…`,
+ssz_snappy payloads, ENR fork-digest gating). This implementation keeps the
+consensus-networking SEMANTICS — topic strings with fork digest, ssz_snappy
+gossip payloads, Status/BlocksByRange verbs, digest-gated handshake,
+seen-cache flood relay — over plain TCP framing instead of libp2p's
+noise/yamux stack (vendoring libp2p is out of scope; the `Transport` seam
+is exactly where a full libp2p backend would drop in).
+
+Wire format (all integers big-endian):
+  frame   := kind:u8 len:u32 body[len]
+  kinds   : 1 HELLO   body = JSON {peer_id, fork_digest}
+            2 GOSSIP  body = tlen:u16 topic[tlen] payload  (payload ssz_snappy)
+            3 REQ     body = id:u32 mlen:u16 method[mlen] params-JSON
+            4 RESP    body = id:u32 status:u8 chunks       (chunk := len:u32 ssz)
+Req/resp methods mirror the consensus spec protocol ids:
+  /eth2/beacon_chain/req/status/1         params {} → one JSON chunk
+  /eth2/beacon_chain/req/beacon_blocks_by_range/2
+                                          params {start_slot, count} → ssz chunks
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import socket
+import struct
+import threading
+import time
+from collections import OrderedDict, defaultdict
+from typing import Callable, Optional
+
+from grandine_tpu.p2p.network import Transport
+
+KIND_HELLO = 1
+KIND_GOSSIP = 2
+KIND_REQ = 3
+KIND_RESP = 4
+
+METHOD_STATUS = "/eth2/beacon_chain/req/status/1"
+METHOD_BLOCKS_BY_RANGE = "/eth2/beacon_chain/req/beacon_blocks_by_range/2"
+
+_MAX_FRAME = 1 << 26  # 64 MiB: a full minimal-preset state fits with margin
+
+
+class _Conn:
+    """One peer connection: framed writer (locked) + reader thread."""
+
+    def __init__(self, sock: socket.socket, transport: "TcpTransport") -> None:
+        self.sock = sock
+        self.transport = transport
+        self.peer_id: "Optional[str]" = None
+        self.alive = True
+        self._wlock = threading.Lock()
+        self.thread = threading.Thread(target=self._read_loop, daemon=True)
+
+    # -- framing ----------------------------------------------------------
+
+    def send(self, kind: int, body: bytes) -> None:
+        frame = struct.pack(">BI", kind, len(body)) + body
+        try:
+            with self._wlock:
+                self.sock.sendall(frame)
+        except OSError:
+            self.close()
+
+    def _recv_exact(self, n: int) -> "Optional[bytes]":
+        buf = bytearray()
+        while len(buf) < n:
+            try:
+                chunk = self.sock.recv(n - len(buf))
+            except OSError:
+                return None
+            if not chunk:
+                return None
+            buf += chunk
+        return bytes(buf)
+
+    def _read_loop(self) -> None:
+        while self.alive:
+            head = self._recv_exact(5)
+            if head is None:
+                break
+            kind, length = struct.unpack(">BI", head)
+            if length > _MAX_FRAME:
+                break  # protocol violation: drop the peer
+            body = self._recv_exact(length)
+            if body is None:
+                break
+            try:
+                self.transport._on_frame(self, kind, body)
+            except Exception:
+                self.transport.stats["handler_errors"] += 1
+        self.close()
+
+    def close(self) -> None:
+        if not self.alive:
+            return
+        self.alive = False
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        self.transport._drop(self)
+
+
+class TcpTransport(Transport):
+    """TCP mesh node. `listen_port=0` picks a free port (see `.port`)."""
+
+    def __init__(
+        self,
+        peer_id: str,
+        fork_digest: bytes,
+        listen_port: int = 0,
+        request_timeout: float = 10.0,
+    ) -> None:
+        self.peer_id = peer_id
+        self.fork_digest = fork_digest
+        self.request_timeout = request_timeout
+        self.stats = defaultdict(int)
+        self._subs: "dict[str, list[Callable]]" = defaultdict(list)
+        self._conns: "dict[str, _Conn]" = {}
+        self._pending: "dict[int, tuple[threading.Event, list]]" = {}
+        self._req_id = 0
+        self._seen: "OrderedDict[bytes, None]" = OrderedDict()
+        self._lock = threading.Lock()
+        self._blocks_by_range = None
+        self._status = None
+
+        self._server = socket.create_server(("127.0.0.1", listen_port))
+        self.port = self._server.getsockname()[1]
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True
+        )
+        self._accept_thread.start()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                sock, _addr = self._server.accept()
+            except OSError:
+                return
+            self._start_conn(sock)
+
+    def _start_conn(self, sock: socket.socket) -> "_Conn":
+        conn = _Conn(sock, self)
+        conn.send(KIND_HELLO, json.dumps({
+            "peer_id": self.peer_id,
+            "fork_digest": self.fork_digest.hex(),
+        }).encode())
+        conn.thread.start()
+        return conn
+
+    def connect(self, host: str, port: int, wait: float = 5.0) -> str:
+        """Dial a peer; returns its peer_id after the HELLO handshake."""
+        sock = socket.create_connection((host, port), timeout=wait)
+        sock.settimeout(None)
+        conn = self._start_conn(sock)
+        deadline = time.time() + wait
+        while conn.peer_id is None and conn.alive and time.time() < deadline:
+            time.sleep(0.01)
+        if conn.peer_id is None:
+            conn.close()
+            raise ConnectionError(f"handshake with {host}:{port} failed")
+        return conn.peer_id
+
+    def close(self) -> None:
+        try:
+            self._server.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns = list(self._conns.values())
+        for c in conns:
+            c.close()
+
+    def _drop(self, conn: "_Conn") -> None:
+        with self._lock:
+            if conn.peer_id and self._conns.get(conn.peer_id) is conn:
+                del self._conns[conn.peer_id]
+
+    # -- frame dispatch ----------------------------------------------------
+
+    def _on_frame(self, conn: "_Conn", kind: int, body: bytes) -> None:
+        if kind == KIND_HELLO:
+            hello = json.loads(body)
+            if hello.get("fork_digest") != self.fork_digest.hex():
+                self.stats["digest_rejects"] += 1
+                conn.close()  # wrong fork: the ENR fork-id gate equivalent
+                return
+            conn.peer_id = hello["peer_id"]
+            with self._lock:
+                self._conns[conn.peer_id] = conn
+        elif kind == KIND_GOSSIP:
+            (tlen,) = struct.unpack(">H", body[:2])
+            topic = body[2 : 2 + tlen].decode()
+            payload = body[2 + tlen :]
+            self._deliver(topic, payload, exclude=conn)
+        elif kind == KIND_REQ:
+            req_id, = struct.unpack(">I", body[:4])
+            (mlen,) = struct.unpack(">H", body[4:6])
+            method = body[6 : 6 + mlen].decode()
+            params = json.loads(body[6 + mlen :] or b"{}")
+            self._serve(conn, req_id, method, params)
+        elif kind == KIND_RESP:
+            req_id, = struct.unpack(">I", body[:4])
+            ok = body[4]
+            chunks, pos = [], 5
+            while pos < len(body):
+                (clen,) = struct.unpack(">I", body[pos : pos + 4])
+                chunks.append(body[pos + 4 : pos + 4 + clen])
+                pos += 4 + clen
+            with self._lock:
+                pending = self._pending.pop(req_id, None)
+            if pending is not None:
+                event, out = pending
+                out.append((ok, chunks))
+                event.set()
+        else:
+            self.stats["unknown_frames"] += 1
+
+    # -- gossip ------------------------------------------------------------
+
+    def _deliver(
+        self, topic: str, payload: bytes, exclude=None, local: bool = True
+    ) -> None:
+        """Seen-cache dedup, local handler delivery (inbound only — a
+        publisher does not hear its own gossip, matching InMemoryHub), and
+        flood relay to every other peer (gossipsub-lite: full fanout, the
+        seen cache breaks cycles)."""
+        digest = hashlib.sha256(topic.encode() + b"\x00" + payload).digest()
+        with self._lock:
+            if digest in self._seen:
+                return
+            self._seen[digest] = None
+            while len(self._seen) > 4096:
+                self._seen.popitem(last=False)
+            handlers = list(self._subs.get(topic, ())) if local else []
+            conns = [c for c in self._conns.values() if c is not exclude]
+        for handler in handlers:
+            try:
+                handler(topic, payload)
+            except Exception:
+                self.stats["handler_errors"] += 1
+        body = struct.pack(">H", len(topic)) + topic.encode() + payload
+        for c in conns:
+            c.send(KIND_GOSSIP, body)
+
+    def publish(self, topic: str, payload: bytes) -> None:
+        self.stats["published"] += 1
+        self._deliver(topic, payload, local=False)
+
+    def subscribe(self, topic: str, handler) -> None:
+        with self._lock:
+            self._subs[topic].append(handler)
+
+    def peers(self) -> "list[str]":
+        with self._lock:
+            return list(self._conns)
+
+    # -- req/resp ----------------------------------------------------------
+
+    def register_provider(self, blocks_by_range, status) -> None:
+        self._blocks_by_range = blocks_by_range
+        self._status = status
+
+    def _serve(self, conn: "_Conn", req_id: int, method: str, params: dict):
+        try:
+            if method == METHOD_STATUS:
+                if self._status is None:
+                    raise RuntimeError("no status provider")
+                chunks = [json.dumps(self._status()).encode()]
+            elif method == METHOD_BLOCKS_BY_RANGE:
+                if self._blocks_by_range is None:
+                    raise RuntimeError("no blocks provider")
+                chunks = self._blocks_by_range(
+                    int(params["start_slot"]), int(params["count"])
+                )
+            else:
+                raise RuntimeError(f"unknown method {method}")
+            ok = 1
+        except Exception as e:
+            self.stats["serve_errors"] += 1
+            chunks, ok = [str(e).encode()], 0
+        body = struct.pack(">IB", req_id, ok) + b"".join(
+            struct.pack(">I", len(c)) + c for c in chunks
+        )
+        conn.send(KIND_RESP, body)
+
+    def _request(self, peer: str, method: str, params: dict) -> "list[bytes]":
+        with self._lock:
+            conn = self._conns.get(peer)
+            self._req_id += 1
+            req_id = self._req_id
+            event, out = threading.Event(), []
+            self._pending[req_id] = (event, out)
+        if conn is None:
+            with self._lock:
+                self._pending.pop(req_id, None)
+            raise ConnectionError(f"unknown peer {peer}")
+        body = (
+            struct.pack(">IH", req_id, len(method))
+            + method.encode()
+            + json.dumps(params).encode()
+        )
+        conn.send(KIND_REQ, body)
+        if not event.wait(self.request_timeout):
+            with self._lock:
+                self._pending.pop(req_id, None)
+            raise TimeoutError(f"{method} to {peer} timed out")
+        ok, chunks = out[0]
+        if not ok:
+            detail = chunks[0].decode(errors="replace") if chunks else "?"
+            raise ConnectionError(f"{method} failed: {detail}")
+        return chunks
+
+    def request_status(self, peer: str) -> dict:
+        chunks = self._request(peer, METHOD_STATUS, {})
+        if not chunks:  # peer protocol violation, not a local crash
+            raise ConnectionError("empty status response")
+        try:
+            return json.loads(chunks[0])
+        except ValueError as e:
+            raise ConnectionError("malformed status response") from e
+
+    def request_blocks_by_range(self, peer, start_slot, count) -> "list[bytes]":
+        return self._request(
+            peer, METHOD_BLOCKS_BY_RANGE,
+            {"start_slot": int(start_slot), "count": int(count)},
+        )
+
+
+__all__ = ["TcpTransport", "METHOD_STATUS", "METHOD_BLOCKS_BY_RANGE"]

@@ -169,6 +169,13 @@ class AttestationAggPool:
                 continue
             candidates.append(e)
 
+        from grandine_tpu import features
+
+        if not features.is_enabled(features.Feature.GREEDY_ATTESTATION_PACKING):
+            from grandine_tpu.pools.packer import pack_optimized
+
+            return pack_optimized(candidates, max_count, self._merge)
+
         seen: "dict[tuple, set]" = {}
         packed = []
         # widest-first greedy with incremental coverage accounting

@@ -1,0 +1,155 @@
+"""Attestation packing beyond greedy: max-clique pre-merge + exact
+branch-and-bound selection.
+
+Reference: operation_pools/src/attestation_packer.rs (ILP via HiGHS with a
+greedy fallback) + max_clique.rs (Bron-Kerbosch). Same two phases here,
+with the ILP replaced by a bounded branch-and-bound over the (small,
+pool-frontier) candidate set — exact on real pool shapes, never worse
+than greedy (the greedy solution seeds the incumbent), and dependency-free.
+
+Phase 1 — max-clique merge: aggregates with IDENTICAL AttestationData and
+pairwise-DISJOINT aggregation bits can be merged into one aggregate
+(union bits, aggregated signature). Maximal cliques of the disjointness
+graph yield the widest mergeable super-aggregates (max_clique.rs's role).
+
+Phase 2 — selection: pick ≤ max_count aggregates maximizing the number of
+distinct (committee, bit) inclusions — weighted max-coverage under a
+cardinality constraint. Greedy is only (1−1/e)-optimal; the reference
+bought exactness with an ILP, this module with DFS branch-and-bound using
+the top-k residual bound, capped at `node_budget` expansions (fallback =
+incumbent, which starts at greedy).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+
+def bron_kerbosch_disjoint(
+    bitsets: "Sequence[frozenset]", max_cliques: int = 64
+) -> "list[list[int]]":
+    """Maximal cliques of the DISJOINTNESS graph (vertices = aggregates,
+    edge ⟺ bit-disjoint), with pivoting, truncated at max_cliques."""
+    n = len(bitsets)
+    adj = [set() for _ in range(n)]
+    for i in range(n):
+        for j in range(i + 1, n):
+            if not (bitsets[i] & bitsets[j]):
+                adj[i].add(j)
+                adj[j].add(i)
+    out: "list[list[int]]" = []
+
+    def expand(r: "list[int]", p: set, x: set) -> bool:
+        if len(out) >= max_cliques:
+            return False
+        if not p and not x:
+            out.append(list(r))
+            return True
+        pivot = max(p | x, key=lambda v: len(adj[v] & p))
+        for v in list(p - adj[pivot]):
+            if not expand(r + [v], p & adj[v], x & adj[v]):
+                return False
+            p.discard(v)
+            x.add(v)
+        return True
+
+    expand([], set(range(n)), set())
+    return out
+
+
+def select_max_coverage(
+    element_sets: "Sequence[frozenset]",
+    max_count: int,
+    node_budget: int = 20000,
+) -> "list[int]":
+    """Indices of ≤ max_count sets maximizing |union| — exact within
+    node_budget branch-and-bound expansions, else best-found (≥ greedy)."""
+    n = len(element_sets)
+    if n == 0 or max_count <= 0:
+        return []
+    order = sorted(range(n), key=lambda i: -len(element_sets[i]))
+
+    # greedy incumbent
+    best_sel: "list[int]" = []
+    covered: set = set()
+    for i in order:
+        new = element_sets[i] - covered
+        if not new:
+            continue
+        best_sel.append(i)
+        covered |= new
+        if len(best_sel) >= max_count:
+            break
+    best_val = len(covered)
+
+    sizes = [len(element_sets[i]) for i in order]
+    state = {"nodes": 0, "best_val": best_val, "best_sel": list(best_sel)}
+
+    def dfs(pos: int, chosen: "list[int]", cov: set) -> None:
+        if state["nodes"] >= node_budget:
+            return
+        state["nodes"] += 1
+        if len(cov) > state["best_val"]:
+            state["best_val"] = len(cov)
+            state["best_sel"] = list(chosen)
+        if len(chosen) >= max_count or pos >= n:
+            return
+        # admissible bound: ignore overlaps among the remaining top sets
+        remaining = max_count - len(chosen)
+        bound = len(cov) + sum(sizes[pos : pos + remaining])
+        if bound <= state["best_val"]:
+            return
+        i = order[pos]
+        new = element_sets[i] - cov
+        if new:
+            dfs(pos + 1, chosen + [i], cov | new)
+        dfs(pos + 1, chosen, cov)
+
+    dfs(0, [], set())
+    return state["best_sel"]
+
+
+def pack_optimized(
+    entries,
+    max_count: int,
+    merge: "Callable",
+    max_cliques: int = 64,
+):
+    """Full packer: entries are pool `_Entry`-likes (`.attestation`,
+    `.bits`); `merge(a, b) -> entry` merges two same-data entries.
+    Returns the packed attestation list."""
+    # phase 1: per-data clique merge
+    by_data: "dict[tuple, list]" = {}
+    for e in entries:
+        d = e.attestation.data
+        key = (int(d.slot), int(d.index), d.hash_tree_root())
+        by_data.setdefault(key, []).append(e)
+
+    candidates = list(entries)
+    for _key, group in by_data.items():
+        if len(group) < 2:
+            continue
+        bitsets = [
+            frozenset(int(i) for i in e.bits.nonzero_indices()) for e in group
+        ]
+        for clique in bron_kerbosch_disjoint(bitsets, max_cliques):
+            if len(clique) < 2:
+                continue
+            acc = group[clique[0]]
+            for v in clique[1:]:
+                acc = merge(acc, group[v])
+            candidates.append(acc)
+
+    # phase 2: exact-within-budget selection over (committee, bit) elements
+    element_sets = []
+    for e in candidates:
+        d = e.attestation.data
+        cov_key = (int(d.slot), int(d.index))
+        element_sets.append(frozenset(
+            (cov_key, int(i)) for i in e.bits.nonzero_indices()
+        ))
+    chosen = select_max_coverage(element_sets, max_count)
+    return [candidates[i].attestation for i in chosen]
+
+
+__all__ = ["bron_kerbosch_disjoint", "select_max_coverage", "pack_optimized"]

@@ -72,6 +72,8 @@ class FieldOps:
     index: Callable  # (elem, idx) -> elem (numpy-style batch index)
     concat: Callable  # ([elem], axis) -> elem
     batch_len: Callable  # elem -> size of the leading batch axis
+    make_zero: Callable  # batch shape -> zero elem
+    make_one: Callable  # batch shape -> Montgomery-one elem
 
 
 def _fp_index(a, idx):
@@ -105,6 +107,8 @@ FP_OPS = FieldOps(
     index=_fp_index,
     concat=_fp_concat,
     batch_len=lambda e: e.shape[1],
+    make_zero=lambda shape: L.zeros_fp(tuple(shape)),
+    make_one=lambda shape: L.const_fp(L.ONE_MONT_DIGITS, tuple(shape)),
 )
 
 FP2_OPS = FieldOps(
@@ -119,6 +123,8 @@ FP2_OPS = FieldOps(
     index=_fp2_index,
     concat=_fp2_concat,
     batch_len=lambda e: e[0].shape[1],
+    make_zero=lambda shape: F.fp2_zero(tuple(shape)),
+    make_one=lambda shape: F.fp2_one(tuple(shape)),
 )
 
 

@@ -53,6 +53,15 @@ from grandine_tpu.crypto.constants import P
 #: v5e with honest (host-fetch) timing; kept as an env knob for experiments.
 MONTMUL_UNROLL = int(os.environ.get("GT_MONTMUL_UNROLL", "1"))
 
+#: Below this static batch size the CIOS loop would be FULLY unrolled:
+#: narrow-width products (final exponentiation at width ≤54) are
+#: latency-bound on the 26-iteration inner scan. Disabled by default (0):
+#: measured on the axon TPU platform, the unrolled bodies push XLA compile
+#: past 10 minutes while the no-inversion final exp (pairing.py
+#: final_exp_is_one) already removes most narrow-width latency. Kept as an
+#: experiment knob.
+MONTMUL_UNROLL_NUMEL = int(os.environ.get("GT_MONTMUL_UNROLL_NUMEL", "0"))
+
 LIMB_BITS = 15
 NLIMBS = 26
 MASK = (1 << LIMB_BITS) - 1
@@ -230,7 +239,11 @@ def montmul(a, b) -> jnp.ndarray:
         t[0] = t[0] + carry
         return tuple(t), None
 
-    t, _ = lax.scan(step, t0, a, unroll=MONTMUL_UNROLL)
+    numel = 1
+    for d in shape:
+        numel *= int(d)
+    unroll = NLIMBS if numel <= MONTMUL_UNROLL_NUMEL else MONTMUL_UNROLL
+    t, _ = lax.scan(step, t0, a, unroll=unroll)
     # fold the 27th column (weight 2^390 = R) back in via R mod p, relax
     main = jnp.stack(
         [t[j] + t[NLIMBS] * R_MOD_P_DIGITS[j] for j in range(NLIMBS)], 0

@@ -122,6 +122,25 @@ def test_proposer_boost_prefers_timely_block(genesis):
     assert store3.get_head() == vb.root
 
 
+def test_two_timely_blocks_one_slot_first_keeps_boost(genesis):
+    """Spec on_block (v1.3+) / reference store.rs:1878: is_first_block —
+    when TWO timely blocks arrive in the same slot (an equivocation or a
+    late-propagating competitor), the FIRST keeps the proposer boost; the
+    second must not steal it (boost-stealing enables ex-ante reorgs)."""
+    store = make_store(genesis)
+    ra, _ = add_block(store, genesis, 1, timely=True, graffiti=b"a")
+    assert store.proposer_boost_root == ra
+    rb, _ = add_block(store, genesis, 1, timely=True, graffiti=b"b")
+    assert store.proposer_boost_root == ra  # unchanged: first block wins
+    # boost is the tiebreak: head must be the boosted first block even
+    # though rb sorts higher lexically or equal by weight
+    head = store.get_head()
+    assert head == ra
+    # next slot's tick resets the boost (store.rs:1803)
+    tick_to(store, 2, TickKind.PROPOSE)
+    assert store.proposer_boost_root is None
+
+
 def test_lmd_votes_drive_reorg(genesis):
     """Fork at slot 1: chain A extends to slot 2 (longer), but all
     validators vote for chain B's head — B must win despite being shorter."""

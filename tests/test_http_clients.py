@@ -1,0 +1,298 @@
+"""Wire tests for the four HTTP seam clients (grandine_tpu/http_clients.py)
+against real local HTTP servers — framing, JWT auth, error mapping and
+timeouts are exercised over actual sockets, not injected callables.
+"""
+
+import base64
+import hashlib
+import hmac
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from grandine_tpu import http_clients as H
+from grandine_tpu.execution.engine import PayloadStatus
+
+JWT_SECRET = b"\x42" * 32
+
+
+def _serve(handler_cls):
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), handler_cls)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv, f"http://127.0.0.1:{srv.server_address[1]}"
+
+
+def _check_jwt(token: str) -> dict:
+    head, payload, sig = token.split(".")
+    signing_input = f"{head}.{payload}".encode()
+    want = hmac.new(JWT_SECRET, signing_input, hashlib.sha256).digest()
+    got = base64.urlsafe_b64decode(sig + "=" * (-len(sig) % 4))
+    assert hmac.compare_digest(want, got), "bad JWT signature"
+    claims = json.loads(
+        base64.urlsafe_b64decode(payload + "=" * (-len(payload) % 4))
+    )
+    assert abs(claims["iat"] - time.time()) < 60
+    return claims
+
+
+class EngineHandler(BaseHTTPRequestHandler):
+    """Mock execution engine: JWT-checked JSON-RPC."""
+
+    def log_message(self, *a):
+        pass
+
+    def do_POST(self):
+        auth = self.headers.get("Authorization", "")
+        if not auth.startswith("Bearer "):
+            self.send_response(401)
+            self.end_headers()
+            return
+        _check_jwt(auth[len("Bearer "):])
+        req = json.loads(self.rfile.read(int(self.headers["Content-Length"])))
+        method = req["method"]
+        if method.startswith("engine_newPayload"):
+            status = "VALID"
+            payload = req["params"][0]
+            if payload.get("blockHash", "").endswith("bad"):
+                status = "INVALID"
+            result = {"status": status, "latestValidHash": None}
+        elif method.startswith("engine_forkchoiceUpdated"):
+            result = {
+                "payloadStatus": {"status": "VALID"},
+                "payloadId": "0x0102030405060708"
+                if req["params"][1] else None,
+            }
+        elif method == "engine_exchangeCapabilities":
+            result = ["engine_newPayloadV2"]
+        else:
+            resp = {"jsonrpc": "2.0", "id": req["id"],
+                    "error": {"code": -32601, "message": "unknown method"}}
+            self._reply(resp)
+            return
+        self._reply({"jsonrpc": "2.0", "id": req["id"], "result": result})
+
+    def _reply(self, obj):
+        data = json.dumps(obj).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    srv, url = _serve(EngineHandler)
+    yield url
+    srv.shutdown()
+
+
+@pytest.fixture(scope="module")
+def types():
+    from grandine_tpu.types.config import Config
+    from grandine_tpu.types.containers import spec_types
+
+    return spec_types(Config.minimal().preset)
+
+
+def test_engine_new_payload_valid(engine, types):
+    client = H.EngineApiClient(engine, JWT_SECRET)
+    payload = types.bellatrix.ExecutionPayload(block_hash=b"\x01" * 32)
+    assert client.notify_new_payload(payload) is PayloadStatus.VALID
+
+
+def test_engine_payload_version_dispatch(engine, types):
+    client = H.EngineApiClient(engine, JWT_SECRET)
+    p2 = types.capella.ExecutionPayload()
+    assert client.notify_new_payload(p2) is PayloadStatus.VALID
+    p3 = types.deneb.ExecutionPayload()
+    assert client.notify_new_payload(p3, versioned_hashes=[b"\x03" * 32],
+                                     parent_beacon_block_root=b"\x04" * 32) \
+        is PayloadStatus.VALID
+
+
+def test_engine_forkchoice_updated_and_payload_id(engine):
+    client = H.EngineApiClient(engine, JWT_SECRET)
+    st = client.notify_forkchoice_updated(b"\x01" * 32, b"\x02" * 32, b"\x03" * 32)
+    assert st is PayloadStatus.VALID
+    st = client.notify_forkchoice_updated(
+        b"\x01" * 32, b"\x02" * 32, b"\x03" * 32,
+        payload_attributes={"timestamp": "0x1", "withdrawals": []},
+    )
+    assert st is PayloadStatus.VALID
+    assert client.last_payload_id == "0x0102030405060708"
+
+
+def test_engine_error_mapping(engine):
+    client = H.EngineApiClient(engine, JWT_SECRET)
+    with pytest.raises(H.HttpClientError) as ei:
+        client.call("engine_bogus", [])
+    assert "-32601" in str(ei.value) or "unknown" in str(ei.value)
+
+
+def test_engine_connection_refused():
+    client = H.EngineApiClient("http://127.0.0.1:1", JWT_SECRET, timeout=0.5)
+    with pytest.raises(H.HttpClientError):
+        client.call("engine_exchangeCapabilities", [])
+
+
+def test_jwt_shape():
+    tok = H.jwt_hs256(JWT_SECRET)
+    claims = _check_jwt(tok)
+    assert set(claims) == {"iat"}
+
+
+class Web3SignerHandler(BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+
+    def do_POST(self):
+        assert self.path.startswith("/api/v1/eth2/sign/0x")
+        body = json.loads(self.rfile.read(int(self.headers["Content-Length"])))
+        assert body["signing_root"].startswith("0x")
+        data = json.dumps({"signature": "0x" + "ab" * 96}).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):
+        assert self.path == "/api/v1/eth2/publicKeys"
+        data = json.dumps(["0x" + "cd" * 48]).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+
+def test_web3signer_sign_and_list():
+    srv, url = _serve(Web3SignerHandler)
+    try:
+        client = H.Web3SignerClient(url)
+        sig = client("aa" * 48, "11" * 32)
+        assert sig == "ab" * 96
+        assert client.list_keys() == ["cd" * 48]
+    finally:
+        srv.shutdown()
+
+
+def test_web3signer_plugs_into_signer():
+    """End to end through validator.signer.Signer's remote path."""
+    from grandine_tpu.validator.signer import Signer
+
+    srv, url = _serve(Web3SignerHandler)
+    try:
+        s = Signer(web3signer=H.Web3SignerClient(url))
+        pk = bytes.fromhex("aa" * 48)
+        s.add_remote_key(pk)
+        sig = s.sign(pk, b"\x11" * 32)
+        assert sig == bytes.fromhex("ab" * 96)
+    finally:
+        srv.shutdown()
+
+
+def test_checkpoint_sync_remote_load():
+    """Storage.load(REMOTE) with the real fetcher against a mock Beacon
+    API serving a genuine SSZ state."""
+    from grandine_tpu.storage.database import Database
+    from grandine_tpu.storage.storage import StateLoadStrategy, Storage
+    from grandine_tpu.transition.genesis import interop_genesis_state
+    from grandine_tpu.types.config import Config
+
+    cfg = Config.minimal()
+    state = interop_genesis_state(8, cfg)
+    ssz_bytes = state.serialize()
+
+    class CheckpointHandler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            assert self.path == "/eth/v2/debug/beacon/states/finalized"
+            assert self.headers.get("Accept") == "application/octet-stream"
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(ssz_bytes)))
+            self.end_headers()
+            self.wfile.write(ssz_bytes)
+
+    srv, url = _serve(CheckpointHandler)
+    try:
+        storage = Storage(Database.in_memory(), cfg)
+        loaded, _source = Storage.load(
+            storage, StateLoadStrategy.REMOTE,
+            fetcher=H.checkpoint_fetcher(url),
+        )
+        assert loaded.hash_tree_root() == state.hash_tree_root()
+    finally:
+        srv.shutdown()
+
+
+def test_devnet_run_hits_engine_end_to_end(tmp_path):
+    """VERDICT r3 #3 done-criterion: a devnet run with --engine-url drives
+    engine_newPayload against a live mock server (JWT-authenticated) for
+    every produced block."""
+    calls = []
+
+    class CountingEngine(EngineHandler):
+        def do_POST(self):
+            calls.append(self.path)
+            EngineHandler.do_POST(self)
+
+    srv, url = _serve(CountingEngine)
+    secret_path = tmp_path / "jwt.hex"
+    secret_path.write_text(JWT_SECRET.hex())
+    try:
+        from grandine_tpu import cli
+
+        rc = cli.main([
+            "--data-dir", str(tmp_path / "data"), "run",
+            "--validators", "8", "--slots", "3", "--no-restart",
+            "--engine-url", url, "--jwt-secret", str(secret_path),
+        ])
+        assert rc == 0
+        assert len(calls) >= 3  # one newPayload per produced block
+    finally:
+        srv.shutdown()
+
+
+def test_builder_relay_roundtrip():
+    class BuilderHandler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            assert self.path.startswith("/eth/v1/builder/header/5/0x")
+            data = json.dumps({"data": {
+                "header": {"parent_hash": "11" * 32}, "value": 123,
+            }}).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_POST(self):
+            assert self.path == "/eth/v1/builder/blinded_blocks"
+            _ = self.rfile.read(int(self.headers["Content-Length"]))
+            data = json.dumps(
+                {"data": {"execution_payload": {"block_hash": "22" * 32}}}
+            ).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+    srv, url = _serve(BuilderHandler)
+    try:
+        relay = H.BuilderRelayClient(url)
+        bid = relay("get_header", {
+            "slot": 5, "parent_hash": "11" * 32, "pubkey": "aa" * 48,
+        })
+        assert bid["header"]["parent_hash"] == "11" * 32
+        payload = relay("submit_blinded_block", {"ssz": "00"})
+        assert "execution_payload" in payload
+    finally:
+        srv.shutdown()

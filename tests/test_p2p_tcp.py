@@ -1,0 +1,176 @@
+"""Socket-real p2p tests: TcpTransport framing/handshake/gossip/req-resp
+over real TCP, and the two-process devnet reaching finality through the
+CLI (VERDICT r3 #4 done-criterion).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from grandine_tpu.p2p.tcp import TcpTransport
+
+DIGEST = b"\x01\x02\x03\x04"
+
+
+def _mk(digest=DIGEST):
+    return TcpTransport("t-%d" % id(object()), digest, listen_port=0)
+
+
+def _connect(a, b):
+    return a.connect("127.0.0.1", b.port)
+
+
+def test_handshake_and_peers():
+    a, b = _mk(), _mk()
+    try:
+        pid = _connect(a, b)
+        assert pid == b.peer_id
+        deadline = time.time() + 2
+        while a.peer_id not in b.peers() and time.time() < deadline:
+            time.sleep(0.01)
+        assert b.peers() == [a.peer_id]
+        assert a.peers() == [b.peer_id]
+    finally:
+        a.close()
+        b.close()
+
+
+def test_fork_digest_mismatch_rejected():
+    a, b = _mk(b"\xaa\xbb\xcc\xdd"), _mk()
+    try:
+        with pytest.raises(ConnectionError):
+            _connect(a, b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_gossip_fanout_and_relay():
+    """a → b → c: c receives a's publish via b's flood relay; a does not
+    hear its own message; the seen-cache kills the echo loop."""
+    a, b, c = _mk(), _mk(), _mk()
+    got = {"a": [], "b": [], "c": []}
+    for name, t in (("a", a), ("b", b), ("c", c)):
+        t.subscribe("topic/x", lambda _t, p, n=name: got[n].append(p))
+    try:
+        _connect(a, b)
+        _connect(c, b)
+        time.sleep(0.1)
+        a.publish("topic/x", b"hello")
+        deadline = time.time() + 3
+        while (not got["b"] or not got["c"]) and time.time() < deadline:
+            time.sleep(0.01)
+        assert got["b"] == [b"hello"]
+        assert got["c"] == [b"hello"]
+        assert got["a"] == []  # publisher does not hear itself
+    finally:
+        for t in (a, b, c):
+            t.close()
+
+
+def test_req_resp_roundtrip_and_errors():
+    a, b = _mk(), _mk()
+    b.register_provider(
+        blocks_by_range=lambda start, count: [
+            b"block-%d" % s for s in range(start, start + min(count, 2))
+        ],
+        status=lambda: {"head_slot": 7, "finalized_epoch": 1},
+    )
+    try:
+        peer = _connect(a, b)
+        st = a.request_status(peer)
+        assert st == {"head_slot": 7, "finalized_epoch": 1}
+        blocks = a.request_blocks_by_range(peer, 5, 10)
+        assert blocks == [b"block-5", b"block-6"]
+        # a has no provider: b's request must fail cleanly, not hang
+        deadline = time.time() + 2
+        while b.peers() != [a.peer_id] and time.time() < deadline:
+            time.sleep(0.01)
+        with pytest.raises(ConnectionError):
+            b.request_status(a.peer_id)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_request_unknown_peer():
+    a = _mk()
+    try:
+        with pytest.raises(ConnectionError):
+            a.request_status("nobody")
+    finally:
+        a.close()
+
+
+def test_network_over_tcp_syncs_blocks():
+    """Network + BlockSyncService over TCP: a fresh node range-syncs real
+    blocks from a producing node (in one process, two transports)."""
+    from grandine_tpu.p2p.network import GossipTopics, Network
+    from grandine_tpu.p2p.sync import BlockSyncService
+    from grandine_tpu.runtime import InProcessNode
+    from grandine_tpu.transition.genesis import interop_genesis_state
+    from grandine_tpu.types.config import Config
+
+    cfg = Config.minimal()
+    genesis = interop_genesis_state(8, cfg)
+    node_a = InProcessNode(genesis, cfg)
+    node_b = InProcessNode(genesis, cfg)
+    digest = GossipTopics.fork_digest(cfg, genesis)
+    ta = TcpTransport("a", digest)
+    tb = TcpTransport("b", digest)
+    try:
+        Network(ta, node_a.controller, cfg)
+        Network(tb, node_b.controller, cfg,
+                attestation_verifier=node_b.attestation_verifier)
+        tb.connect("127.0.0.1", ta.port)
+        node_a.run_until(4)
+        sync = BlockSyncService(tb, node_b.controller, cfg)
+        sync.sync_to_head()
+        assert (
+            node_b.controller.snapshot().head_root
+            == node_a.controller.snapshot().head_root
+        )
+    finally:
+        ta.close()
+        tb.close()
+        node_a.stop()
+        node_b.stop()
+
+
+@pytest.mark.slow
+def test_two_process_devnet_reaches_finality(tmp_path):
+    """Two OS processes form a chain over TCP: A proposes, B follows via
+    range-sync + gossip and exits 0 once its own state finalizes epoch 1."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proposer = subprocess.Popen(
+        [sys.executable, "-m", "grandine_tpu.cli",
+         "--data-dir", str(tmp_path / "a"), "run",
+         "--validators", "8", "--slots", "0", "--no-restart",
+         "--listen-port", str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env, text=True,
+    )
+    try:
+        follower = subprocess.run(
+            [sys.executable, "-m", "grandine_tpu.cli",
+             "--data-dir", str(tmp_path / "b"), "run",
+             "--validators", "8", "--no-restart",
+             "--follow", "--peer", f"127.0.0.1:{port}",
+             "--until-finalized", "1", "--follow-timeout", "240"],
+            capture_output=True, text=True, timeout=280, env=env,
+        )
+        assert follower.returncode == 0, (
+            f"follower failed:\n{follower.stdout}\n{follower.stderr}"
+        )
+        assert "finalized epoch" in follower.stdout
+    finally:
+        proposer.kill()
+        proposer.wait()

@@ -12,6 +12,8 @@ scaled across devices instead of rayon threads.
 import jax
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.kernel
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from __graft_entry__ import _example_batch

@@ -9,6 +9,8 @@ import random
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.kernel
+
 from grandine_tpu.crypto import bls as A
 from grandine_tpu.tpu.bls import TpuBlsBackend
 

@@ -8,6 +8,8 @@ policy equivalence with the flat path / anchor."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.kernel
+
 from grandine_tpu.crypto import bls as A
 from grandine_tpu.tpu.bls import TpuBlsBackend
 

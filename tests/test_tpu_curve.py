@@ -1,6 +1,10 @@
 """Differential tests: device curve ops vs anchor curves, including the
 adversarial edge cases the branchless selects must handle."""
 
+import pytest
+
+pytestmark = pytest.mark.kernel
+
 import random
 
 import jax

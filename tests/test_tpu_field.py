@@ -13,6 +13,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.kernel
+
 from grandine_tpu.crypto.constants import P
 from grandine_tpu.crypto.fields import Fq, Fq2, Fq6, Fq12
 from grandine_tpu.tpu import field as F

@@ -1,5 +1,9 @@
 """Differential tests: GLV/ψ² dual-scalar ladders vs anchor scalar mul."""
 
+import pytest
+
+pytestmark = pytest.mark.kernel
+
 import random
 
 import jax

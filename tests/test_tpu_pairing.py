@@ -10,6 +10,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.kernel
+
 from grandine_tpu.crypto import pairing as AP
 from grandine_tpu.crypto.constants import P, R, X
 from grandine_tpu.crypto.curves import G1, G2, g1_infinity

@@ -1,0 +1,112 @@
+"""Diagnose the MSM verify kernels at the bench shape on the live device.
+
+Uses the bench's arithmetic-progression structure: sk_i = a + b·i, so each
+expected group sum is ONE host scalar mul — [Σᵢ∈ⱼ rᵢ·skᵢ]·G (pk side) or
+[Σᵢ∈ⱼ rᵢ·skᵢ]·H_j (sig side) — comparable against the device MSM output
+at full batch size in seconds.
+
+Usage: [BENCH_N=16384] [BENCH_MSGS=64] python tools/debug_msm_bench.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+import bench
+from grandine_tpu.crypto.constants import R
+from grandine_tpu.crypto.curves import G1, LAMBDA
+from grandine_tpu.crypto.hash_to_curve import hash_to_g2
+
+
+def main() -> None:
+    n = int(os.environ.get("BENCH_N", "16384"))
+    m = int(os.environ.get("BENCH_MSGS", "64"))
+    import jax
+    import jax.numpy as jnp
+
+    bench._enable_compilation_cache()
+    from grandine_tpu.tpu import bls as B
+    from grandine_tpu.tpu import curve as C
+    from grandine_tpu.tpu import field as F
+    from grandine_tpu.tpu import limbs as L
+    from grandine_tpu.tpu import msm as M
+
+    flat = bench.build_batch(n, m)
+    args = bench.regroup_batch(flat, m)
+    (pk_x, pk_y, pk_inf, sig_x, sig_y, sig_inf, msg_x, msg_y, msg_inf) = args
+
+    r_lo, r_hi = bench.draw_rlc(n, 1)
+    groups = np.arange(n) % m
+    inf = np.zeros(n, bool)
+    a = 0x1357_0000_DEAD_BEEF_1234_5678_9ABC_DEF0
+    b = 0x2468_ACE0_2468_ACE0_2468_ACE1
+    sks = [(a + b * i) % R for i in range(n)]
+    coeff = [0] * m
+    for i in range(n):
+        r = (int(r_lo[i]) + int(r_hi[i]) * LAMBDA) % R
+        coeff[i % m] = (coeff[i % m] + r * sks[i]) % R
+
+    g1_w = B.pick_msm_window(n, m)
+    g1_plan = M.plan_msm(r_lo, r_hi, inf, groups, m, window_bits=g1_w)
+    g2_w = B.pick_msm_window(n, 1)
+    g2_plan = M.plan_msm(r_lo, r_hi, inf, None, 1, window_bits=g2_w)
+    print(f"g1 w={g1_w} S,T={g1_plan.point_idx.shape} J={g1_plan.gather_idx.shape[0]}",
+          file=sys.stderr)
+    print(f"g2 w={g2_w} S,T={g2_plan.point_idx.shape} J={g2_plan.gather_idx.shape[0]}",
+          file=sys.stderr)
+
+    k = n // m
+
+    def g1_kernel(pk_x, pk_y, pk_inf, *arrs):
+        pk = B._g1_in(B._flat_km(pk_x, m, k), B._flat_km(pk_y, m, k))
+        pk_inf_f = jnp.asarray(B._flat_km(pk_inf, m, k))
+        epx, epy, el = M.expand_glv_points(
+            pk[0], pk[1], pk_inf_f, B._g1_endo(n), C.FP_OPS
+        )
+        out = M.msm_bucket_scan(
+            epx, epy, el, *arrs,
+            windows=g1_plan.windows, window_bits=g1_plan.window_bits,
+            n_groups=m, ops=C.FP_OPS,
+        )
+        return tuple(L.merge(e) for e in out)
+
+    X, Y, Z = jax.jit(g1_kernel)(pk_x, pk_y, pk_inf, *g1_plan.arrays)
+    X, Y, Z = np.asarray(X), np.asarray(Y), np.asarray(Z)
+    bad = []
+    for j in range(m):
+        got = C.dev_to_g1_point(X[j], Y[j], Z[j])
+        want = G1.mul(coeff[j])
+        if got != want:
+            bad.append(j)
+    print(f"G1 grouped MSM mismatches: {len(bad)} {bad[:8]}")
+
+    def g2_kernel(sig_x, sig_y, sig_inf, *arrs):
+        sig = B._g2_in(B._flat_km(sig_x, m, k), B._flat_km(sig_y, m, k))
+        sig_inf_f = jnp.asarray(B._flat_km(sig_inf, m, k))
+        esx, esy, el = M.expand_glv_points(
+            sig[0], sig[1], sig_inf_f, B._g2_endo(n), C.FP2_OPS
+        )
+        out = M.msm_bucket_scan(
+            esx, esy, el, *arrs,
+            windows=g2_plan.windows, window_bits=g2_plan.window_bits,
+            n_groups=1, ops=C.FP2_OPS,
+        )
+        return tuple(F.fp2_merge(e) for e in out)
+
+    X2, Y2, Z2 = jax.jit(g2_kernel)(sig_x, sig_y, sig_inf, *g2_plan.arrays)
+    got2 = C.dev_to_g2_point(
+        np.asarray(X2)[0], np.asarray(Y2)[0], np.asarray(Z2)[0]
+    )
+    from grandine_tpu.crypto.curves import g2_infinity
+
+    want2 = g2_infinity()
+    for j in range(m):
+        want2 = want2 + hash_to_g2(b"bench-attestation-%d" % j).mul(coeff[j])
+    print(f"G2 MSM match: {got2 == want2}")
+
+
+if __name__ == "__main__":
+    main()

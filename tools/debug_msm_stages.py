@@ -1,0 +1,203 @@
+"""Stage-wise G2 MSM diagnostic at the failing bench shape.
+
+All points are multiples of ONE base H (values in arithmetic progression),
+so every device intermediate — bucket sums, suffix sums, window totals,
+final — equals a host-computable [integer]·H. Dumps the first stage that
+diverges. Usage: [BENCH_N=16384] python tools/debug_msm_stages.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+import bench
+from grandine_tpu.crypto.constants import R
+from grandine_tpu.crypto.curves import LAMBDA, g2_infinity
+from grandine_tpu.crypto.hash_to_curve import hash_to_g2
+
+
+def main() -> None:
+    n = int(os.environ.get("BENCH_N", "16384"))
+    import jax
+    import jax.numpy as jnp
+
+    bench._enable_compilation_cache()
+    from grandine_tpu.tpu import bls as B
+    from grandine_tpu.tpu import curve as C
+    from grandine_tpu.tpu import field as F
+    from grandine_tpu.tpu import msm as M
+
+    H = hash_to_g2(b"stage-base")
+    v0, dv = 0xABCDEF1234567, 0x13572468
+    vals = [(v0 + dv * i) % R for i in range(n)]
+    pts = []
+    acc = H.mul(v0)
+    step = H.mul(dv)
+    for _ in range(n):
+        pts.append(acc)
+        acc = acc + step
+    sx, sy, sinf = C.g2_points_to_dev(pts)
+
+    r_lo, r_hi = bench.draw_rlc(n, 1)
+    plan = M.plan_msm(
+        r_lo, r_hi, np.zeros(n, bool), None, 1,
+        window_bits=B.pick_msm_window(n, 1),
+    )
+    W, w = plan.windows, plan.window_bits
+    J, n_sec, Bk = plan.gather_idx.shape
+    print(f"S,T={plan.point_idx.shape} J={J} n_sec={n_sec} B={Bk}", file=sys.stderr)
+
+    # host integer model of every stage
+    scal = np.concatenate([r_lo, r_hi]).astype(np.uint64)
+    host_vals = vals + [(v * LAMBDA) % R for v in vals]
+    buckets_int = np.zeros((n_sec, Bk), dtype=object)
+    for e in range(2 * n):
+        for win in range(W):
+            d = (int(scal[e]) >> (win * w)) & (Bk - 1)
+            if d:
+                buckets_int[win, d] = (
+                    buckets_int[win, d] + host_vals[e]
+                ) % R
+
+    def kern(sx, sy, sinf, *arrs):
+        sig = B._g2_in(sx, sy)
+        esx, esy, el = M.expand_glv_points(
+            sig[0], sig[1], jnp.asarray(sinf), B._g2_endo(n), C.FP2_OPS
+        )
+        # inline copy of msm_bucket_scan with stage outputs
+        from jax import lax
+
+        point_idx, valid, flush, gather_idx, gather_valid = arrs
+        S, T = point_idx.shape
+        flat = jnp.asarray(point_idx).reshape(-1)
+        gx = M._gather(esx, flat)
+        gy = M._gather(esy, flat)
+        glive = jnp.take(el, flat) & jnp.asarray(valid).reshape(-1)
+
+        def to_scan_layout(e):
+            return jax.tree.map(
+                lambda a: jnp.moveaxis(a.reshape(a.shape[0], S, T), 1, 0), e
+            )
+
+        gx, gy = to_scan_layout(gx), to_scan_layout(gy)
+        glive_st = glive.reshape(S, T)
+        ops = C.FP2_OPS
+        inf_T = M._point_inf(ops, (T,))
+        one_T, zero_T = inf_T[0], inf_T[2]
+
+        def stepf(acc, xs):
+            sxr, syr, lv, fl = xs
+            pt = (sxr, syr, ops.select(lv, one_T, zero_T))
+            new = C.point_add_complete(acc, pt, ops)
+            nxt = M._sel3(ops, fl, inf_T, new)
+            return nxt, new
+
+        _, emits = lax.scan(stepf, inf_T, (gx, gy, glive_st, jnp.asarray(flush)))
+        emits = tuple(
+            jax.tree.map(
+                lambda a: jnp.moveaxis(a, 0, 1).reshape(a.shape[1], S * T), e
+            )
+            for e in emits
+        )
+        gidx = jnp.asarray(gather_idx).reshape(-1)
+        pieces = tuple(
+            jax.tree.map(
+                lambda a: jnp.moveaxis(
+                    jnp.take(a, gidx, axis=1).reshape(a.shape[0], J, n_sec, Bk),
+                    1, 0,
+                ),
+                e,
+            )
+            for e in emits
+        )
+        gv = jnp.asarray(gather_valid)
+        inf_secB = M._point_inf(ops, (n_sec, Bk))
+
+        def fold(acc, xs):
+            pc, vmask = xs
+            pc = M._sel3(ops, vmask, pc, inf_secB)
+            return C.point_add_complete(acc, pc, ops), None
+
+        buckets, _ = lax.scan(fold, inf_secB, (pieces, gv))
+
+        # stage 3: suffix weight
+        idx_b = jnp.arange(Bk)
+        U = buckets
+        kk = 1
+        while kk < Bk:
+            rolled = tuple(
+                jax.tree.map(lambda a: jnp.roll(a, -kk, axis=-1), e) for e in U
+            )
+            rolled = M._sel3(ops, idx_b < (Bk - kk), rolled, inf_secB)
+            U = C.point_add_complete(U, rolled, ops)
+            kk <<= 1
+        U = M._sel3(ops, idx_b >= 1, U, inf_secB)
+        totals = M._reduce_last_axis(U, Bk, ops)
+        return (
+            tuple(F.fp2_merge(e) for e in buckets),
+            tuple(F.fp2_merge(e) for e in U),
+            tuple(F.fp2_merge(e) for e in totals),
+        )
+
+    bk_dev, u_dev, tot_dev = jax.jit(kern)(sx, sy, sinf, *plan.arrays)
+    X, Y, Z = (np.asarray(a) for a in bk_dev)
+    bad = []
+    for sec in range(n_sec):
+        for d in range(Bk):
+            got = C.dev_to_g2_point(X[sec, d], Y[sec, d], Z[sec, d])
+            want = H.mul(int(buckets_int[sec, d])) if buckets_int[sec, d] else g2_infinity()
+            if got != want:
+                bad.append((sec, d))
+    print(f"bucket mismatches: {len(bad)} / {n_sec * Bk}; first: {bad[:10]}")
+
+    # host: suffix (weighted) and totals
+    U_int = np.zeros((n_sec, Bk), dtype=object)
+    for sec in range(n_sec):
+        run = 0
+        for d in range(Bk - 1, -1, -1):
+            run = (run + buckets_int[sec, d]) % R
+            U_int[sec, d] = run
+    tot_int = [
+        sum(int(d) * int(buckets_int[sec, d]) for d in range(1, Bk)) % R
+        for sec in range(n_sec)
+    ]
+    UX, UY, UZ = (np.asarray(a) for a in u_dev)
+    badu = []
+    for sec in range(n_sec):
+        for d in range(1, Bk):
+            got = C.dev_to_g2_point(UX[sec, d], UY[sec, d], UZ[sec, d])
+            want = H.mul(int(U_int[sec, d])) if U_int[sec, d] else g2_infinity()
+            if got != want:
+                badu.append((sec, d))
+    print(f"suffix mismatches: {len(badu)} / {n_sec * (Bk-1)}; first: {badu[:10]}")
+    TX, TY, TZ = (np.asarray(a) for a in tot_dev)
+    badt = []
+    for sec in range(n_sec):
+        got = C.dev_to_g2_point(TX[sec], TY[sec], TZ[sec])
+        want = H.mul(int(tot_int[sec])) if tot_int[sec] else g2_infinity()
+        if got != want:
+            badt.append(sec)
+    print(f"totals mismatches: {len(badt)} / {n_sec}: {badt}")
+
+    # probe: what IS the device suffix value at (0, d)? test candidate
+    # integer combinations
+    import itertools
+
+    sec = 0
+    for d in [1, 64, 200, 254]:
+        got = C.dev_to_g2_point(UX[sec, d], UY[sec, d], UZ[sec, d])
+        cands = {}
+        for lo_incl in range(max(0, d - 3), min(Bk, d + 4)):
+            run = 0
+            for e in range(lo_incl, Bk):
+                run = (run + buckets_int[sec, e]) % R
+                cands[f"sum[{lo_incl}..{e}]"] = run
+        hit = [k2 for k2, v in cands.items() if got == (H.mul(int(v)) if v else g2_infinity())]
+        print(f"  (0,{d}) matches: {hit[:3]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,106 @@
+"""Stage timing for the MSM-backed grouped verify at the bench shape:
+host plan build, G1 grouped MSM, G2 MSM, and the fused kernel.
+
+Usage: [BENCH_N=16384] [BENCH_MSGS=64] python tools/profile_msm.py
+"""
+
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+import bench
+
+
+def main() -> None:
+    n = int(os.environ.get("BENCH_N", "16384"))
+    m = int(os.environ.get("BENCH_MSGS", "64"))
+    import jax
+    import jax.numpy as jnp
+
+    bench._enable_compilation_cache()
+    from grandine_tpu.tpu import bls as B
+    from grandine_tpu.tpu import curve as C
+    from grandine_tpu.tpu import field as F
+    from grandine_tpu.tpu import limbs as L
+    from grandine_tpu.tpu import msm as M
+
+    flat = bench.build_batch(n, m)
+    args = bench.regroup_batch(flat, m)
+    (pk_x, pk_y, pk_inf, sig_x, sig_y, sig_inf, msg_x, msg_y, msg_inf) = args
+    groups = np.arange(n) % m
+    inf = np.zeros(n, bool)
+    k = n // m
+    g1_w = B.pick_msm_window(n, m)
+    g2_w = B.pick_msm_window(n, 1)
+
+    t0 = time.time()
+    iters = 5
+    for i in range(iters):
+        r_lo, r_hi = bench.draw_rlc(n, i)
+        g1_plan = M.plan_msm(r_lo, r_hi, inf, groups, m, window_bits=g1_w)
+        g2_plan = M.plan_msm(r_lo, r_hi, inf, None, 1, window_bits=g2_w)
+    print(f"host plan build (both): {(time.time()-t0)/iters*1000:.0f}ms",
+          file=sys.stderr)
+
+    def timed(name, f, *xs, iters=4):
+        t0 = time.time()
+        out = f(*xs)
+        np.asarray(jax.tree.leaves(out)[0])
+        compile_s = time.time() - t0
+        t0 = time.time()
+        for _ in range(iters):
+            out = f(*xs)
+            np.asarray(jax.tree.leaves(out)[0])
+        wall = (time.time() - t0) / iters
+        print(f"{name:26s} compile={compile_s:7.1f}s run={wall*1000:9.2f}ms",
+              file=sys.stderr)
+
+    def g1_kernel(pk_x, pk_y, pk_inf, *arrs):
+        pk = B._g1_in(B._flat_km(pk_x, m, k), B._flat_km(pk_y, m, k))
+        pk_inf_f = jnp.asarray(B._flat_km(pk_inf, m, k))
+        epx, epy, el = M.expand_glv_points(
+            pk[0], pk[1], pk_inf_f, B._g1_endo(n), C.FP_OPS
+        )
+        out = M.msm_bucket_scan(
+            epx, epy, el, *arrs,
+            windows=g1_plan.windows, window_bits=g1_plan.window_bits,
+            n_groups=m, ops=C.FP_OPS,
+        )
+        return tuple(L.merge(e) for e in out)
+
+    def g2_kernel(sig_x, sig_y, sig_inf, *arrs):
+        sig = B._g2_in(B._flat_km(sig_x, m, k), B._flat_km(sig_y, m, k))
+        sig_inf_f = jnp.asarray(B._flat_km(sig_inf, m, k))
+        esx, esy, el = M.expand_glv_points(
+            sig[0], sig[1], sig_inf_f, B._g2_endo(n), C.FP2_OPS
+        )
+        out = M.msm_bucket_scan(
+            esx, esy, el, *arrs,
+            windows=g2_plan.windows, window_bits=g2_plan.window_bits,
+            n_groups=1, ops=C.FP2_OPS,
+        )
+        return tuple(F.fp2_merge(e) for e in out)
+
+    timed("G1 grouped MSM", jax.jit(g1_kernel), pk_x, pk_y, pk_inf,
+          *g1_plan.arrays)
+    timed("G2 MSM", jax.jit(g2_kernel), sig_x, sig_y, sig_inf,
+          *g2_plan.arrays)
+
+    fused = jax.jit(
+        functools.partial(
+            B.grouped_multi_verify_msm_kernel,
+            g1_windows=g1_plan.windows, g1_wbits=g1_plan.window_bits,
+            g2_windows=g2_plan.windows, g2_wbits=g2_plan.window_bits,
+        )
+    )
+    timed("FUSED grouped MSM kernel", fused, *args, *g1_plan.arrays,
+          *g2_plan.arrays, iters=3)
+
+
+if __name__ == "__main__":
+    main()
