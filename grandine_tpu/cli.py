@@ -224,12 +224,42 @@ def _node_once(args, cfg) -> int:
 
     server = None
     if args.http_port:
+        from grandine_tpu.http_api.events import (
+            EventBus,
+            wire_controller_events,
+        )
+        from grandine_tpu.p2p.subnets import SubnetService
+        from grandine_tpu.pools.sync_committee_pool import SyncCommitteeAggPool
+        from grandine_tpu.validator.keymanager import KeyManager
+        from grandine_tpu.validator.signer import Signer
+        from grandine_tpu.validator.slashing_protection import (
+            SlashingProtection,
+        )
+
+        bus = EventBus()
+        wire_controller_events(node.controller, bus)
+        # Keymanager backing registry: the Web3Signer-backed registry when
+        # --web3signer-url is set, else a local-only Signer. NOTE: the
+        # synthetic devnet driver (InProcessNode) signs duties with
+        # interop keys; keys managed here drive a ValidatorService
+        # embedding (validator/service.py), not the devnet loop — the
+        # same split as the reference's validator-vs-node processes.
+        km_signer = getattr(node, "remote_signer", None) or Signer()
+        node.api_signer = km_signer
         ctx = ApiContext(
             node.controller, cfg,
             attestation_pool=AttestationAggPool(cfg),
             operation_pool=OperationPool(cfg),
             liveness=LivenessTracker(args.validators),
             metrics=metrics,
+            sync_pool=SyncCommitteeAggPool(cfg),
+            keymanager=KeyManager(
+                km_signer,
+                slashing_protection=SlashingProtection(db),
+            ),
+            event_bus=bus,
+            network=network,
+            subnet_service=SubnetService(cfg, network=network),
         )
         server, _thread = serve(ctx, port=args.http_port)
         print(f"Beacon API on http://127.0.0.1:{args.http_port}")

@@ -1,0 +1,244 @@
+"""Server-sent Beacon API event stream — reference: http_api/src/events.rs
+(per-topic broadcast channels with bounded lagging receivers; topics
+head/block/attestation/voluntary_exit/finalized_checkpoint/chain_reorg/…)
+and the EventChannels the controller publishes into.
+
+Design: one `EventBus` with per-subscriber bounded queues (a lagging
+subscriber drops its OLDEST pending event, like a tokio broadcast channel,
+so one stalled SSE client can never back-pressure the mutator thread).
+`wire_controller_events` installs publication callbacks on a live
+`Controller` — block/head/chain_reorg/finalized_checkpoint payloads are
+built from the post-mutation snapshot on the mutator thread (cheap dict
+construction only; the wire encode happens on the subscriber's thread).
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+from typing import Iterable, Optional
+
+#: Beacon API event topics served by `/eth/v1/events?topics=…`
+#: (events.rs TopicKind).
+TOPICS = (
+    "head",
+    "block",
+    "attestation",
+    "voluntary_exit",
+    "proposer_slashing",
+    "attester_slashing",
+    "bls_to_execution_change",
+    "finalized_checkpoint",
+    "chain_reorg",
+    "contribution_and_proof",
+    "blob_sidecar",
+)
+
+
+class Subscription:
+    """One SSE client's bounded event queue."""
+
+    def __init__(self, topics: "frozenset[str]", capacity: int) -> None:
+        self.topics = topics
+        self._q: "queue.Queue" = queue.Queue(maxsize=capacity)
+        self.dropped = 0
+
+    def push(self, topic: str, data: dict) -> None:
+        while True:
+            try:
+                self._q.put_nowait((topic, data))
+                return
+            except queue.Full:
+                # broadcast lag: shed the oldest event, keep the stream live
+                try:
+                    self._q.get_nowait()
+                    self.dropped += 1
+                except queue.Empty:
+                    pass
+
+    def next(self, timeout: "Optional[float]" = None):
+        """Blocking pop; returns (topic, data) or None on timeout."""
+        try:
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+
+class EventBus:
+    def __init__(self, capacity: int = 256) -> None:
+        self._lock = threading.Lock()
+        self._subs: "list[Subscription]" = []
+        self.capacity = capacity
+
+    def subscribe(self, topics: "Iterable[str]") -> Subscription:
+        topics = frozenset(topics)
+        unknown = topics - set(TOPICS)
+        if unknown:
+            raise ValueError(f"unknown event topics: {sorted(unknown)}")
+        sub = Subscription(topics or frozenset(TOPICS), self.capacity)
+        with self._lock:
+            self._subs.append(sub)
+        return sub
+
+    def unsubscribe(self, sub: Subscription) -> None:
+        with self._lock:
+            if sub in self._subs:
+                self._subs.remove(sub)
+
+    def subscriber_count(self) -> int:
+        with self._lock:
+            return len(self._subs)
+
+    def publish(self, topic: str, data: dict) -> None:
+        with self._lock:
+            subs = list(self._subs)
+        for sub in subs:
+            if topic in sub.topics:
+                sub.push(topic, data)
+
+
+def sse_frame(topic: str, data: dict) -> bytes:
+    """One `text/event-stream` frame."""
+    payload = json.dumps(data, separators=(",", ":"))
+    return f"event: {topic}\ndata: {payload}\n\n".encode()
+
+
+# ------------------------------------------------------- controller wiring
+
+
+def _hex(b) -> str:
+    return "0x" + bytes(b).hex()
+
+
+def _ancestor_at_slot(store, root: bytes, slot: int):
+    """Walk parents until the chain reaches `slot` (insert-only dict read:
+    safe off-thread). Returns the node, or None if pruned past it."""
+    node = store.blocks.get(root)
+    while node is not None and node.slot > slot:
+        node = store.blocks.get(node.parent_root)
+    return node
+
+
+def _common_ancestor(store, a: bytes, b: bytes):
+    """Lowest common ancestor of two block roots by slot-levelling."""
+    na, nb = store.blocks.get(a), store.blocks.get(b)
+    while na is not None and nb is not None and na.root != nb.root:
+        if na.slot >= nb.slot:
+            na = store.blocks.get(na.parent_root)
+        else:
+            nb = store.blocks.get(nb.parent_root)
+    return na if (nb is not None and na is not None) else None
+
+
+def _duty_dependent_roots(store, head_root: bytes, slots_per_epoch: int):
+    """(previous, current) duty dependent roots: the block root as of the
+    last slot of epoch-2 / epoch-1 relative to the head's epoch."""
+    head = store.blocks.get(head_root)
+    if head is None:
+        return _hex(head_root), _hex(head_root)
+    epoch_start = (head.slot // slots_per_epoch) * slots_per_epoch
+    cur = _ancestor_at_slot(store, head_root, max(0, epoch_start - 1))
+    prev = _ancestor_at_slot(
+        store, head_root, max(0, epoch_start - slots_per_epoch - 1)
+    )
+    cur_root = cur.root if cur is not None else head_root
+    prev_root = prev.root if prev is not None else cur_root
+    return _hex(prev_root), _hex(cur_root)
+
+
+def wire_controller_events(controller, bus: EventBus) -> None:
+    """Publish block / head / chain_reorg / finalized_checkpoint events
+    from a Controller's mutator-thread callbacks (events.rs publication
+    points in the reference's mutator: on_block, head change, finality)."""
+    slots_per_epoch = controller.cfg.preset.SLOTS_PER_EPOCH
+    last_finalized = [int(controller.snapshot().finalized_checkpoint.epoch)]
+
+    def check_finality(snap) -> None:
+        fin = int(snap.finalized_checkpoint.epoch)
+        if fin <= last_finalized[0]:
+            return
+        last_finalized[0] = fin
+        fin_root = bytes(snap.finalized_checkpoint.root)
+        fin_node = controller.store.blocks.get(fin_root)
+        bus.publish(
+            "finalized_checkpoint",
+            {
+                "block": _hex(fin_root),
+                "state": _hex(fin_node.state.hash_tree_root())
+                if fin_node is not None
+                else _hex(b"\x00" * 32),
+                "epoch": str(fin),
+                "execution_optimistic": False,
+            },
+        )
+
+    def on_head_change(old_head_root, snap) -> None:
+        store = controller.store
+        head_node = store.blocks.get(snap.head_root)
+        old_node = store.blocks.get(old_head_root)
+        prev_dep, cur_dep = _duty_dependent_roots(
+            store, snap.head_root, slots_per_epoch
+        )
+        epoch_transition = (
+            head_node is not None
+            and old_node is not None
+            and head_node.slot // slots_per_epoch
+            != old_node.slot // slots_per_epoch
+        )
+        bus.publish(
+            "head",
+            {
+                "slot": str(snap.slot),
+                "block": _hex(snap.head_root),
+                "state": _hex(snap.head_state.hash_tree_root()),
+                "epoch_transition": epoch_transition,
+                "previous_duty_dependent_root": prev_dep,
+                "current_duty_dependent_root": cur_dep,
+                "execution_optimistic": False,
+            },
+        )
+        # a reorg is a head change whose old head is NOT an ancestor of
+        # the new head (events.rs chain_reorg)
+        if old_node is not None and head_node is not None:
+            lca = _common_ancestor(store, old_head_root, snap.head_root)
+            if lca is not None and lca.root != old_head_root:
+                bus.publish(
+                    "chain_reorg",
+                    {
+                        "slot": str(snap.slot),
+                        "depth": str(old_node.slot - lca.slot),
+                        "old_head_block": _hex(old_head_root),
+                        "new_head_block": _hex(snap.head_root),
+                        "old_head_state": _hex(old_node.state.hash_tree_root()),
+                        "new_head_state": _hex(
+                            snap.head_state.hash_tree_root()
+                        ),
+                        "epoch": str(snap.slot // slots_per_epoch),
+                        "execution_optimistic": False,
+                    },
+                )
+        check_finality(snap)
+
+    def on_block_applied(valid, old_head_root, snap) -> None:
+        bus.publish(
+            "block",
+            {
+                "slot": str(int(valid.signed_block.message.slot)),
+                "block": _hex(valid.root),
+                "execution_optimistic": False,
+            },
+        )
+        check_finality(snap)
+
+    controller.on_head_change.append(on_head_change)
+    controller.on_block_applied.append(on_block_applied)
+
+
+__all__ = [
+    "TOPICS",
+    "EventBus",
+    "Subscription",
+    "sse_frame",
+    "wire_controller_events",
+]

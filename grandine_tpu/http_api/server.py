@@ -1,5 +1,7 @@
 """Threaded HTTP server over the router — the serving half of the
-reference's http_api (axum server) using only the stdlib.
+reference's http_api (axum server) using only the stdlib. Serves JSON
+routes through `Router.dispatch` and the `/eth/v1/events` SSE stream
+(http_api/src/events.rs) as a long-lived chunked response per client.
 """
 
 from __future__ import annotations
@@ -9,13 +11,20 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qsl, urlsplit
 
+from grandine_tpu.http_api.events import TOPICS, sse_frame
 from grandine_tpu.http_api.routing import ApiContext, build_router
+
+#: dead-client detection cadence for idle event streams (a keepalive
+#: comment forces a write, surfacing BrokenPipe on closed sockets)
+SSE_KEEPALIVE_SECONDS = 5.0
 
 
 def serve(ctx: ApiContext, host: str = "127.0.0.1", port: int = 5052):
     """Start the API server on a daemon thread; returns (server, thread).
-    `server.shutdown()` stops it."""
+    `server.shutdown()` stops it (event streams notice within one
+    keepalive interval via the stopping flag)."""
     router = build_router()
+    stopping = threading.Event()
 
     class Handler(BaseHTTPRequestHandler):
         def _dispatch(self, body=None):
@@ -36,26 +45,86 @@ def serve(ctx: ApiContext, host: str = "127.0.0.1", port: int = 5052):
             self.end_headers()
             self.wfile.write(raw)
 
+        def _stream_events(self, split) -> None:
+            query = dict(parse_qsl(split.query))
+            topics = [t for t in query.get("topics", "").split(",") if t]
+            try:
+                sub = ctx.event_bus.subscribe(topics or TOPICS)
+            except ValueError as e:
+                raw = json.dumps({"code": 400, "message": str(e)}).encode()
+                self.send_response(400)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(raw)))
+                self.end_headers()
+                self.wfile.write(raw)
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Connection", "close")
+            self.end_headers()
+            try:
+                idle = 0.0
+                while not stopping.is_set():
+                    item = sub.next(timeout=0.25)
+                    if item is None:
+                        idle += 0.25
+                        if idle >= SSE_KEEPALIVE_SECONDS:
+                            self.wfile.write(b": keepalive\n\n")
+                            self.wfile.flush()
+                            idle = 0.0
+                        continue
+                    idle = 0.0
+                    self.wfile.write(sse_frame(*item))
+                    self.wfile.flush()
+            except (BrokenPipeError, ConnectionError, OSError):
+                pass  # client went away
+            finally:
+                ctx.event_bus.unsubscribe(sub)
+
         def do_GET(self):  # noqa: N802
+            split = urlsplit(self.path)
+            if split.path == "/eth/v1/events" and ctx.event_bus is not None:
+                self._stream_events(split)
+                return
             self._dispatch()
 
-        def do_POST(self):  # noqa: N802
+        def _read_body(self):
             length = int(self.headers.get("Content-Length", 0))
             raw = self.rfile.read(length) if length else b""
+            if not raw:
+                return None, True
             try:
-                body = json.loads(raw) if raw else None
+                return json.loads(raw), True
             except json.JSONDecodeError:
                 self.send_response(400)
                 self.end_headers()
-                return
-            self._dispatch(body)
+                return None, False
+
+        def do_POST(self):  # noqa: N802
+            body, ok = self._read_body()
+            if ok:
+                self._dispatch(body)
+
+        def do_DELETE(self):  # noqa: N802
+            body, ok = self._read_body()
+            if ok:
+                self._dispatch(body)
 
         def log_message(self, *args):  # quiet
             pass
 
     server = ThreadingHTTPServer((host, port), Handler)
+    server.daemon_threads = True
     thread = threading.Thread(target=server.serve_forever, daemon=True)
     thread.start()
+    inner_shutdown = server.shutdown
+
+    def shutdown():
+        stopping.set()
+        inner_shutdown()
+
+    server.shutdown = shutdown
     return server, thread
 
 

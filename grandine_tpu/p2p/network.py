@@ -163,9 +163,21 @@ class Network:
         snap = controller.snapshot()
         self.digest = GossipTopics.fork_digest(cfg, snap.head_state)
         self.stats = defaultdict(int)
+        #: None = all subnets (no SubnetService wired, the historical
+        #: behavior); otherwise the active set maintained by SubnetService
+        #: (attestation_subnets.rs) — gossip on other subnets is dropped
+        self.active_attestation_subnets: "Optional[set[int]]" = None
 
         transport.subscribe(
             GossipTopics.beacon_block(self.digest), self._on_gossip_block
+        )
+        # the GLOBAL aggregate topic is never subnet-gated — it is the
+        # always-on fork-choice vote feed that makes per-subnet gating
+        # safe (network.rs subscribes beacon_aggregate_and_proof
+        # unconditionally)
+        transport.subscribe(
+            GossipTopics.aggregate_and_proof(self.digest),
+            self._on_gossip_aggregate,
         )
         p = cfg.preset
         for subnet in range(min(cfg.attestation_subnet_count, 64)):
@@ -193,9 +205,33 @@ class Network:
             return
         self.controller.on_gossip_block(block)
 
+    def set_attestation_subnets(self, subnets: "set[int]") -> None:
+        """SubnetService push: which beacon_attestation_{n} topics this
+        node is currently joined to (transports without unsubscribe keep
+        the topic; the gate below drops off-subnet traffic)."""
+        self.active_attestation_subnets = set(subnets)
+
+    @staticmethod
+    def _subnet_of_topic(topic: str) -> "Optional[int]":
+        marker = "/beacon_attestation_"
+        if marker not in topic:
+            return None
+        try:
+            return int(topic.split(marker, 1)[1].split("/", 1)[0])
+        except ValueError:
+            return None
+
     def _on_gossip_attestation(self, topic: str, payload: bytes) -> None:
         from grandine_tpu.types.combined import decode_attestation
 
+        subnet = self._subnet_of_topic(topic)
+        if (
+            self.active_attestation_subnets is not None
+            and subnet is not None
+            and subnet not in self.active_attestation_subnets
+        ):
+            self.stats["attestations_off_subnet"] += 1
+            return
         self.stats["attestations_in"] += 1
         if self.attestation_verifier is None:
             return
@@ -207,7 +243,30 @@ class Network:
             return
         self.attestation_verifier.submit(att)
 
+    def _on_gossip_aggregate(self, topic: str, payload: bytes) -> None:
+        from grandine_tpu.types.combined import decode_signed_aggregate
+
+        self.stats["aggregates_in"] += 1
+        if self.attestation_verifier is None:
+            return
+        try:
+            slot = self.controller.snapshot().slot
+            signed = decode_signed_aggregate(
+                frame_decompress(payload), self.cfg, slot
+            )
+        except Exception:
+            self.stats["decode_failures"] += 1
+            return
+        self.attestation_verifier.submit(signed.message.aggregate)
+
     # ----------------------------------------------------------- outbound
+
+    def publish_aggregate(self, signed_aggregate_and_proof) -> None:
+        self.stats["aggregates_out"] += 1
+        self.transport.publish(
+            GossipTopics.aggregate_and_proof(self.digest),
+            frame_compress(signed_aggregate_and_proof.serialize()),
+        )
 
     def publish_block(self, signed_block) -> None:
         self.stats["blocks_out"] += 1

@@ -1,0 +1,204 @@
+"""Subnet subscription state machines — reference:
+p2p/src/attestation_subnets.rs (short-lived per-duty subscriptions +
+node-id-seeded persistent subnets), p2p/src/sync_committee_subnets.rs
+(per-period subscriptions until an expiry epoch), and the `SubnetService`
+that folds both into the gossip layer's active topic set.
+
+The gossip layer asks `active_attestation_subnets(slot)` /
+`active_sync_subnets(epoch)` each tick; everything else is bookkeeping
+driven by the Beacon API subscription routes and the validator service's
+own duties (own_*_subscriptions.rs).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from grandine_tpu.core.hashing import hash_bytes
+from grandine_tpu.core.shuffling import compute_shuffled_index
+
+#: consensus networking spec constants
+SUBNETS_PER_NODE = 2
+EPOCHS_PER_SUBNET_SUBSCRIPTION = 256
+ATTESTATION_SUBNET_PREFIX_BITS = 6
+SYNC_COMMITTEE_SUBNET_COUNT = 4
+#: keep a short-lived subscription this many slots past the duty slot
+#: (aggregation happens within the duty slot; one slot of slack absorbs
+#: late gossip, attestation_subnets.rs keeps the same window)
+SUBSCRIPTION_SLACK_SLOTS = 1
+
+
+def compute_subnet_id(
+    committee_index: int,
+    slot: int,
+    committees_at_slot: int,
+    preset,
+    subnet_count: int = 64,
+) -> int:
+    """Spec `compute_subnet_for_attestation` (subnet_count is
+    ATTESTATION_SUBNET_COUNT, configurable like cfg.attestation_subnet_count)."""
+    slots_since_epoch_start = slot % preset.SLOTS_PER_EPOCH
+    committees_since_epoch_start = committees_at_slot * slots_since_epoch_start
+    return (committees_since_epoch_start + committee_index) % subnet_count
+
+
+def compute_subscribed_subnets(
+    node_id: int, epoch: int, subnet_count: int = 64
+) -> "list[int]":
+    """Spec `compute_subscribed_subnets`: the node's persistent subnets,
+    rotated every EPOCHS_PER_SUBNET_SUBSCRIPTION epochs by a shuffled
+    permutation of the node-id prefix."""
+    node_id_prefix = node_id >> (256 - ATTESTATION_SUBNET_PREFIX_BITS)
+    node_offset = node_id % EPOCHS_PER_SUBNET_SUBSCRIPTION
+    period = (epoch + node_offset) // EPOCHS_PER_SUBNET_SUBSCRIPTION
+    seed = hash_bytes(period.to_bytes(8, "little"))
+    out = []
+    for index in range(SUBNETS_PER_NODE):
+        permutated = compute_shuffled_index(
+            node_id_prefix, 1 << ATTESTATION_SUBNET_PREFIX_BITS, seed
+        )
+        out.append((permutated + index) % subnet_count)
+    return out
+
+
+def sync_subnets_for_positions(positions, preset) -> "set[int]":
+    """Committee positions -> sync committee subnet ids."""
+    sub_size = preset.SYNC_COMMITTEE_SIZE // SYNC_COMMITTEE_SUBNET_COUNT
+    return {int(p) // sub_size for p in positions}
+
+
+class SubnetService:
+    """Tracks which attestation / sync-committee subnets this node must
+    be joined to, from both API subscriptions and own-validator duties.
+    Thread-safe: API handlers, the validator service, and the network
+    tick all touch it."""
+
+    def __init__(self, cfg, node_id: int = 0, network=None) -> None:
+        self.cfg = cfg
+        self.p = cfg.preset
+        self.node_id = node_id
+        self.network = network
+        self._lock = threading.Lock()
+        #: subnet -> latest slot it is needed through (short-lived subs)
+        self._att_until_slot: "dict[int, int]" = {}
+        #: subnet -> latest epoch it is needed through (sync committee)
+        self._sync_until_epoch: "dict[int, int]" = {}
+        #: (validator_index, slot) -> subnet, for aggregator lookups
+        self._aggregator_duties: "dict[tuple[int, int], int]" = {}
+
+    # ------------------------------------------------------ subscriptions
+
+    def subscribe_attestation(
+        self,
+        validator_index: int,
+        committee_index: int,
+        committees_at_slot: int,
+        slot: int,
+        is_aggregator: bool = False,
+    ) -> int:
+        """Beacon API beacon_committee_subscriptions handler + the
+        validator service's own attester duties. Returns the subnet."""
+        subnet = compute_subnet_id(
+            committee_index,
+            slot,
+            committees_at_slot,
+            self.p,
+            self.cfg.attestation_subnet_count,
+        )
+        until = slot + SUBSCRIPTION_SLACK_SLOTS
+        with self._lock:
+            if until > self._att_until_slot.get(subnet, -1):
+                self._att_until_slot[subnet] = until
+            if is_aggregator:
+                self._aggregator_duties[(validator_index, slot)] = subnet
+        self._push_to_network()
+        return subnet
+
+    def subscribe_sync_committee(
+        self,
+        validator_index: int,
+        sync_committee_indices,
+        until_epoch: int,
+    ) -> "set[int]":
+        """sync_committee_subscriptions handler: positions are committee
+        indices of the validator; subnets derive from positions."""
+        subnets = sync_subnets_for_positions(
+            sync_committee_indices, self.p
+        )
+        with self._lock:
+            for subnet in subnets:
+                if until_epoch > self._sync_until_epoch.get(subnet, -1):
+                    self._sync_until_epoch[subnet] = until_epoch
+        self._push_to_network()
+        return subnets
+
+    # ------------------------------------------------------------- ticks
+
+    def on_slot(self, slot: int) -> None:
+        """Expire finished short-lived subscriptions (the state-machine
+        tick of attestation_subnets.rs)."""
+        epoch = slot // self.p.SLOTS_PER_EPOCH
+        with self._lock:
+            self._att_until_slot = {
+                s: u for s, u in self._att_until_slot.items() if u >= slot
+            }
+            self._sync_until_epoch = {
+                s: u for s, u in self._sync_until_epoch.items() if u >= epoch
+            }
+            self._aggregator_duties = {
+                k: v
+                for k, v in self._aggregator_duties.items()
+                if k[1] + SUBSCRIPTION_SLACK_SLOTS >= slot
+            }
+        self._push_to_network(slot)
+
+    # ------------------------------------------------------------- views
+
+    def active_attestation_subnets(self, slot: int) -> "set[int]":
+        """Short-lived + persistent subnets for `slot`."""
+        epoch = slot // self.p.SLOTS_PER_EPOCH
+        with self._lock:
+            short = {
+                s for s, u in self._att_until_slot.items() if u >= slot
+            }
+        return short | set(
+            compute_subscribed_subnets(
+                self.node_id, epoch, self.cfg.attestation_subnet_count
+            )
+        )
+
+    def active_sync_subnets(self, epoch: int) -> "set[int]":
+        with self._lock:
+            return {
+                s for s, u in self._sync_until_epoch.items() if u >= epoch
+            }
+
+    def aggregator_subnet(
+        self, validator_index: int, slot: int
+    ) -> "Optional[int]":
+        with self._lock:
+            return self._aggregator_duties.get((validator_index, slot))
+
+    # ---------------------------------------------------------- network
+
+    def _push_to_network(self, slot: "Optional[int]" = None) -> None:
+        if self.network is None:
+            return
+        if slot is None:
+            with self._lock:
+                slot = max(self._att_until_slot.values(), default=0)
+        self.network.set_attestation_subnets(
+            self.active_attestation_subnets(slot)
+        )
+
+
+__all__ = [
+    "SUBNETS_PER_NODE",
+    "EPOCHS_PER_SUBNET_SUBSCRIPTION",
+    "SYNC_COMMITTEE_SUBNET_COUNT",
+    "compute_subnet_id",
+    "compute_subscribed_subnets",
+    "sync_subnets_for_positions",
+    "SubnetService",
+]

@@ -95,6 +95,32 @@ class AttestationAggPool:
                 return None
             return max(entries, key=lambda e: e.bits.count()).attestation
 
+    def best_by_data_root(self, slot: int, data_root: bytes):
+        """Widest aggregate for (slot, data) across committees — the
+        Beacon API `aggregate_attestation` lookup (slot + data root)."""
+        data_root = bytes(data_root)
+        with self._lock:
+            best = None
+            for (s, _i, root), entries in self._by_key.items():
+                if s != slot or root != data_root or not entries:
+                    continue
+                cand = max(entries, key=lambda e: e.bits.count()).attestation
+                if best is None or (
+                    cand.aggregation_bits.count()
+                    > best.aggregation_bits.count()
+                ):
+                    best = cand
+            return best
+
+    def all_attestations(self) -> list:
+        """Every pooled aggregate (GET /eth/v1/beacon/pool/attestations)."""
+        with self._lock:
+            return [
+                e.attestation
+                for entries in self._by_key.values()
+                for e in entries
+            ]
+
     def best_for_committee(self, slot: int, index: int):
         """Widest aggregate across ALL attestation data of one committee
         (what an aggregator publishes when it doesn't care which data)."""

@@ -72,9 +72,13 @@ class OperationPool:
     # ------------------------------------------------------------- packing
 
     def pack(self, state) -> dict:
-        """Block-sized op sets, filtered to those still applicable to
-        `state` (exited validators drop out, already-slashed proposers
-        drop out)."""
+        """Block-sized op sets, filtered to those still APPLICABLE to
+        `state` — every spec applicability condition except signatures
+        (a produced block must survive its own transition; the reference
+        guarantees this by gossip-verifying at insert, we re-check the
+        content rules at pack time)."""
+        import hashlib
+
         from grandine_tpu.consensus import accessors, predicates
         from grandine_tpu.types.primitives import FAR_FUTURE_EPOCH
 
@@ -90,13 +94,25 @@ class OperationPool:
                 < int(cols.withdrawable_epoch[i])
             )
 
-        proposer_slashings = [
-            s for s in ops["proposer_slashings"]
-            if slashable(int(s.signed_header_1.message.proposer_index))
-        ][: p.MAX_PROPOSER_SLASHINGS]
+        proposer_slashings = []
+        for s in ops["proposer_slashings"]:
+            h1, h2 = s.signed_header_1.message, s.signed_header_2.message
+            if (
+                int(h1.slot) == int(h2.slot)
+                and int(h1.proposer_index) == int(h2.proposer_index)
+                and h1.hash_tree_root() != h2.hash_tree_root()
+                and slashable(int(h1.proposer_index))
+            ):
+                proposer_slashings.append(s)
+            if len(proposer_slashings) >= p.MAX_PROPOSER_SLASHINGS:
+                break
 
         attester_slashings = []
         for s in ops["attester_slashings"]:
+            if not predicates.is_slashable_attestation_data(
+                s.attestation_1.data, s.attestation_2.data
+            ):
+                continue
             common = set(map(int, s.attestation_1.attesting_indices)) & set(
                 map(int, s.attestation_2.attesting_indices)
             )
@@ -112,6 +128,10 @@ class OperationPool:
                 i < n
                 and int(cols.exit_epoch[i]) == FAR_FUTURE_EPOCH
                 and int(cols.activation_epoch[i]) <= epoch
+                and epoch >= int(e.message.epoch)
+                and epoch
+                >= int(cols.activation_epoch[i])
+                + self.cfg.shard_committee_period
             ):
                 exits.append(e)
             if len(exits) >= p.MAX_VOLUNTARY_EXITS:
@@ -120,7 +140,17 @@ class OperationPool:
         changes = []
         for c in ops["bls_to_execution_changes"]:
             i = int(c.message.validator_index)
-            if i < n and cols.withdrawal_credentials[i][:1] == b"\x00":
+            creds = (
+                bytes(cols.withdrawal_credentials[i]) if i < n else b""
+            )
+            if (
+                i < n
+                and creds[:1] == b"\x00"
+                and hashlib.sha256(bytes(c.message.from_bls_pubkey)).digest()[
+                    1:
+                ]
+                == creds[1:]
+            ):
                 changes.append(c)
             if len(changes) >= p.MAX_BLS_TO_EXECUTION_CHANGES:
                 break

@@ -92,7 +92,14 @@ class Controller:
         self._delayed_attestations: "list[ValidAttestation]" = []
         self._rejected: "list[tuple[bytes, str]]" = []
         self._state_cache: "dict[tuple, object]" = {}
-        self.on_head_change: "list[Callable[[Snapshot], None]]" = []
+        #: called on the mutator thread with (old_head_root, snapshot)
+        #: whenever ANY mutation (block, attestation batch, tick) moves
+        #: the head — the head/chain_reorg event publication point
+        self.on_head_change: "list[Callable]" = []
+        #: called on the mutator thread after EVERY applied block with
+        #: (valid_block, old_head_root, snapshot) — the event-stream
+        #: publication point (http_api events.rs)
+        self.on_block_applied: "list[Callable]" = []
 
         self._snapshot = Snapshot(self.store)
         self._mutations: "queue.Queue" = queue.Queue()
@@ -341,11 +348,8 @@ class Controller:
             self.metrics.finalized_epoch.set(
                 int(self.store.finalized_checkpoint.epoch)
             )
-        if self._snapshot.head_root != old_head:
-            if self.metrics is not None:
-                self.metrics.fc_head_changes.inc()
-            for cb in self.on_head_change:
-                cb(self._snapshot)
+        for cb in self.on_block_applied:
+            cb(valid, old_head, self._snapshot)
 
     #: caps for the retry/reject books (delayed blocks from parents that
     #: never arrive would otherwise grow without bound under gossip spam)
@@ -393,7 +397,13 @@ class Controller:
         self._delayed_attestations = still
 
     def _refresh_snapshot(self) -> None:
+        old = self._snapshot
         self._snapshot = Snapshot(self.store)
+        if self._snapshot.head_root != old.head_root:
+            if self.metrics is not None:
+                self.metrics.fc_head_changes.inc()
+            for cb in self.on_head_change:
+                cb(old.head_root, self._snapshot)
 
 
 __all__ = ["Controller", "Snapshot"]

@@ -80,6 +80,11 @@ def decode_attestation(data: bytes, cfg: Config, slot: int):
     return fork_namespace(cfg, phase).Attestation.deserialize(data)
 
 
+def decode_signed_aggregate(data: bytes, cfg: Config, slot: int):
+    phase = cfg.phase_at_slot(slot)
+    return fork_namespace(cfg, phase).SignedAggregateAndProof.deserialize(data)
+
+
 __all__ = [
     "fork_namespace",
     "state_phase_of",
@@ -87,4 +92,5 @@ __all__ = [
     "decode_state",
     "decode_signed_block",
     "decode_attestation",
+    "decode_signed_aggregate",
 ]

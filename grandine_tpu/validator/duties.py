@@ -169,10 +169,11 @@ def build_matching_payload(state, cfg, ns, phase: Phase):
 # -------------------------------------------------------------------- block
 
 
-def produce_block(
+def produce_block_unsigned(
     state,
     slot: int,
     cfg,
+    randao_reveal: bytes,
     keys: KeyProvider = _interop_keys,
     attestations: "Sequence" = (),
     full_sync_participation: bool = True,
@@ -183,9 +184,11 @@ def produce_block(
     bls_to_execution_changes: "Sequence" = (),
     graffiti: bytes = b"",
 ):
-    """Produce a valid SignedBeaconBlock for `slot` on top of `state`
-    (validator.rs propose :1292 → build_beacon_block :1007). Returns
-    (signed_block, post_state)."""
+    """Build an UNSIGNED BeaconBlock for `slot` with a caller-provided
+    `randao_reveal` — the Beacon API produce-block path
+    (validator.rs:1007 build_beacon_block; the API hands us the reveal,
+    the caller signs the block). Returns (block, pre_state, post_state):
+    `block` carries the computed post-state root."""
     from grandine_tpu.transition.combined import custom_state_transition
 
     p = cfg.preset
@@ -195,15 +198,9 @@ def produce_block(
     ns = getattr(spec_types(p), phase.key)
 
     proposer_index = accessors.get_beacon_proposer_index(state, p)
-    proposer_key = keys(proposer_index)
-    epoch = accessors.get_current_epoch(state, p)
-
-    reveal = proposer_key.sign(
-        signing.randao_signing_root(state, epoch, cfg)
-    ).to_bytes()
 
     body_fields = dict(
-        randao_reveal=reveal,
+        randao_reveal=bytes(randao_reveal),
         eth1_data=state.eth1_data,
         graffiti=graffiti.ljust(32, b"\x00")[:32],
         proposer_slashings=proposer_slashings,
@@ -245,8 +242,35 @@ def produce_block(
         state, unsigned, cfg, NullVerifier(), state_root_policy="trust"
     )
     block = block.replace(state_root=post.hash_tree_root())
+    return block, state, post
+
+
+def produce_block(
+    state,
+    slot: int,
+    cfg,
+    keys: KeyProvider = _interop_keys,
+    **kwargs,
+):
+    """Produce a valid SignedBeaconBlock for `slot` on top of `state`
+    (validator.rs propose :1292 → build_beacon_block :1007). Returns
+    (signed_block, post_state)."""
+    p = cfg.preset
+    if int(state.slot) < slot:
+        state = process_slots(state, slot, cfg)
+    proposer_index = accessors.get_beacon_proposer_index(state, p)
+    proposer_key = keys(proposer_index)
+    epoch = accessors.get_current_epoch(state, p)
+    reveal = proposer_key.sign(
+        signing.randao_signing_root(state, epoch, cfg)
+    ).to_bytes()
+    block, pre, post = produce_block_unsigned(
+        state, slot, cfg, reveal, keys=keys, **kwargs
+    )
+    phase = state_phase(pre, cfg)
+    ns = getattr(spec_types(p), phase.key)
     signature = proposer_key.sign(
-        signing.block_signing_root(state, block, cfg)
+        signing.block_signing_root(pre, block, cfg)
     ).to_bytes()
     return ns.SignedBeaconBlock(message=block, signature=signature), post
 
@@ -256,5 +280,6 @@ __all__ = [
     "produce_sync_aggregate",
     "empty_sync_aggregate",
     "build_matching_payload",
+    "produce_block_unsigned",
     "produce_block",
 ]

@@ -230,6 +230,39 @@ class KeyManager:
             out.append({"status": "deleted" if removed else "not_found"})
         return out
 
+    # -- remote (Web3Signer) keys — keymanager remote_keys.rs ---------------
+
+    def list_remote_keys(self) -> "list[dict]":
+        return [
+            {"pubkey": "0x" + pk.hex(), "url": "", "readonly": False}
+            for pk in self.signer.remote_pubkeys()
+        ]
+
+    def import_remote_keys(self, remote_keys: "list[dict]") -> "list[dict]":
+        out = []
+        for entry in remote_keys:
+            try:
+                pk = bytes.fromhex(entry["pubkey"].removeprefix("0x"))
+                if len(pk) != 48:
+                    raise ValueError("pubkey must be 48 bytes")
+                already = self.signer.has_key(pk)
+                self.signer.add_remote_key(pk)
+                out.append({"status": "duplicate" if already else "imported"})
+            except Exception as e:
+                out.append({"status": "error", "message": repr(e)})
+        return out
+
+    def delete_remote_keys(self, pubkeys: "list[bytes]") -> "list[dict]":
+        out = []
+        for pk in pubkeys:
+            pk = bytes(pk)
+            if pk in self.signer.remote_pubkeys():
+                self.signer.remove_key(pk)
+                out.append({"status": "deleted"})
+            else:
+                out.append({"status": "not_found"})
+        return out
+
     def set_fee_recipient(self, pubkey: bytes, address: bytes) -> None:
         self.proposer_configs.setdefault(bytes(pubkey), {})[
             "fee_recipient"
@@ -247,6 +280,15 @@ class KeyManager:
 
     def proposer_config(self, pubkey: bytes) -> dict:
         return dict(self.proposer_configs.get(bytes(pubkey), {}))
+
+    def delete_proposer_field(self, pubkey: bytes, field: str) -> bool:
+        cfg = self.proposer_configs.get(bytes(pubkey))
+        if cfg is None or field not in cfg:
+            return False
+        del cfg[field]
+        if not cfg:
+            del self.proposer_configs[bytes(pubkey)]
+        return True
 
 
 __all__ = [

@@ -37,6 +37,7 @@ class ValidatorService:
         sync_pool=None,
         eth1_cache=None,
         network=None,
+        subnet_service=None,
     ) -> None:
         self.controller = controller
         self.signer = signer
@@ -48,6 +49,7 @@ class ValidatorService:
         self.sync_pool = sync_pool
         self.eth1_cache = eth1_cache
         self.network = network
+        self.subnet_service = subnet_service
         self.stats = {"proposed": 0, "attested": 0, "aggregated": 0,
                       "slashing_refusals": 0}
 
@@ -65,6 +67,8 @@ class ValidatorService:
 
     def handle_tick(self, tick: Tick) -> None:
         if tick.kind == TickKind.PROPOSE:
+            if self.subnet_service is not None:
+                self.subnet_service.on_slot(tick.slot)
             self.maybe_propose(tick.slot)
         elif tick.kind == TickKind.ATTEST:
             self.attest(tick.slot)
@@ -243,6 +247,16 @@ class ValidatorService:
             ]
             if not members:
                 continue
+            if self.subnet_service is not None:
+                # own-duty subscription (own_attestation_subscriptions.rs)
+                for _pos, vi in members:
+                    self.subnet_service.subscribe_attestation(
+                        validator_index=vi,
+                        committee_index=index,
+                        committees_at_slot=count,
+                        slot=slot,
+                        is_aggregator=True,
+                    )
             data = ns.AttestationData(
                 slot=slot, index=index, beacon_block_root=head_root,
                 source=source,
@@ -273,8 +287,14 @@ class ValidatorService:
             if self.attestation_pool is not None:
                 self.attestation_pool.insert(att)
             if self.network is not None:
+                from grandine_tpu.p2p.subnets import compute_subnet_id
+
                 self.network.publish_attestation(
-                    att, subnet=int(data.index) % self.cfg.attestation_subnet_count
+                    att,
+                    subnet=compute_subnet_id(
+                        int(data.index), slot, count, p,
+                        self.cfg.attestation_subnet_count,
+                    ),
                 )
         self.stats["attested"] += len(out)
         return out
@@ -311,6 +331,16 @@ class ValidatorService:
             positions.append(pos)
         if not to_sign:
             return 0
+        if self.subnet_service is not None:
+            # own sync-committee subscription until the period's end
+            # (own_sync_committee_subscriptions.rs)
+            period_epochs = self.p.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+            until = (epoch // period_epochs + 1) * period_epochs
+            self.subnet_service.subscribe_sync_committee(
+                validator_index=-1,
+                sync_committee_indices=positions,
+                until_epoch=until,
+            )
         signatures = self.signer.sign_triples(to_sign)
         for pos, sig in zip(positions, signatures):
             self.sync_pool.insert_message(slot, head_root, pos, sig)
@@ -369,9 +399,10 @@ class ValidatorService:
                         state, aap, self.cfg
                     ),
                 )
-                out.append(
-                    ns.SignedAggregateAndProof(message=aap, signature=sig)
-                )
+                signed = ns.SignedAggregateAndProof(message=aap, signature=sig)
+                out.append(signed)
+                if self.network is not None:
+                    self.network.publish_aggregate(signed)
         self.stats["aggregated"] += len(out)
         return out
 

@@ -60,6 +60,9 @@ class Signer:
     def pubkeys(self) -> "list[bytes]":
         return list(self._keys) + sorted(self._remote)
 
+    def remote_pubkeys(self) -> "list[bytes]":
+        return sorted(self._remote)
+
     def __len__(self) -> int:
         return len(self._keys) + len(self._remote)
 

@@ -1,0 +1,244 @@
+"""SSE event stream tests — reference: http_api/src/events.rs (topic
+filtering, lagging receivers) and the controller's publication points
+(block / head / chain_reorg / finalized_checkpoint).
+"""
+
+import http.client
+import json
+import threading
+
+import pytest
+
+from grandine_tpu.consensus.verifier import NullVerifier
+from grandine_tpu.fork_choice.store import Tick, TickKind
+from grandine_tpu.http_api import ApiContext, serve
+from grandine_tpu.http_api.events import (
+    EventBus,
+    sse_frame,
+    wire_controller_events,
+)
+from grandine_tpu.runtime import Controller
+from grandine_tpu.transition.genesis import interop_genesis_state
+from grandine_tpu.types.config import Config
+from grandine_tpu.validator.duties import produce_attestations, produce_block
+
+CFG = Config.minimal()
+
+
+# ------------------------------------------------------------------- bus
+
+
+def test_bus_topic_filter_and_fanout():
+    bus = EventBus()
+    all_sub = bus.subscribe(["head", "block"])
+    head_sub = bus.subscribe(["head"])
+    bus.publish("block", {"slot": "1"})
+    bus.publish("head", {"slot": "1"})
+    assert all_sub.next(0.1) == ("block", {"slot": "1"})
+    assert all_sub.next(0.1) == ("head", {"slot": "1"})
+    assert head_sub.next(0.1) == ("head", {"slot": "1"})
+    assert head_sub.next(0.01) is None
+    bus.unsubscribe(head_sub)
+    bus.publish("head", {"slot": "2"})
+    assert head_sub.next(0.01) is None
+    assert bus.subscriber_count() == 1
+
+
+def test_bus_rejects_unknown_topic():
+    with pytest.raises(ValueError):
+        EventBus().subscribe(["head", "bogus"])
+
+
+def test_lagging_subscriber_drops_oldest():
+    bus = EventBus(capacity=4)
+    sub = bus.subscribe(["block"])
+    for i in range(10):
+        bus.publish("block", {"slot": str(i)})
+    assert sub.dropped == 6
+    got = [sub.next(0.01)[1]["slot"] for _ in range(4)]
+    assert got == ["6", "7", "8", "9"]  # newest survive, oldest shed
+
+
+def test_sse_frame_format():
+    frame = sse_frame("head", {"slot": "3"})
+    assert frame == b'event: head\ndata: {"slot":"3"}\n\n'
+
+
+# ------------------------------------------------- controller publication
+
+
+def drain(sub):
+    out = []
+    while True:
+        item = sub.next(0.05)
+        if item is None:
+            return out
+        out.append(item)
+
+
+def test_controller_publishes_block_and_head_events():
+    genesis = interop_genesis_state(16, CFG)
+    ctrl = Controller(genesis, CFG, verifier_factory=NullVerifier)
+    bus = EventBus()
+    wire_controller_events(ctrl, bus)
+    sub = bus.subscribe(["head", "block", "chain_reorg"])
+    try:
+        state = genesis
+        for slot in (1, 2):
+            blk, state = produce_block(
+                state, slot, CFG, full_sync_participation=False
+            )
+            ctrl.on_tick(Tick(slot, TickKind.PROPOSE))
+            ctrl.on_own_block(blk)
+            ctrl.wait()
+        events = drain(sub)
+        kinds = [k for k, _ in events]
+        assert kinds.count("block") == 2
+        assert kinds.count("head") == 2
+        assert "chain_reorg" not in kinds
+        head = [d for k, d in events if k == "head"][-1]
+        assert head["slot"] == "2"
+        assert head["block"].startswith("0x")
+        assert head["current_duty_dependent_root"].startswith("0x")
+    finally:
+        ctrl.stop()
+
+
+def test_controller_publishes_chain_reorg():
+    """Chain A reaches slot 2; LMD votes flip the head to sibling B —
+    the head change must carry a chain_reorg event of depth 2."""
+    from grandine_tpu.consensus import accessors
+
+    genesis = interop_genesis_state(32, CFG)
+    ctrl = Controller(genesis, CFG, verifier_factory=NullVerifier)
+    bus = EventBus()
+    wire_controller_events(ctrl, bus)
+    sub = bus.subscribe(["chain_reorg", "head"])
+    try:
+        a1, post_a1 = produce_block(
+            genesis, 1, CFG, full_sync_participation=False, graffiti=b"a"
+        )
+        b1, post_b1 = produce_block(
+            genesis, 1, CFG, full_sync_participation=False, graffiti=b"b"
+        )
+        ctrl.on_tick(Tick(1, TickKind.ATTEST))
+        ctrl.on_requested_block(a1)
+        ctrl.wait()
+        a2, post_a2 = produce_block(
+            post_a1, 2, CFG, full_sync_participation=False, graffiti=b"aa"
+        )
+        ctrl.on_tick(Tick(2, TickKind.ATTEST))
+        ctrl.on_requested_block(a2)
+        ctrl.on_requested_block(b1)
+        ctrl.wait()
+        assert ctrl.snapshot().head_root == a2.message.hash_tree_root()
+        # every validator votes for B's head at slot 1
+        atts = produce_attestations(post_b1, CFG, slot=1)
+        for att in atts:
+            indices = accessors.get_attesting_indices(
+                post_b1, att.data, att.aggregation_bits, CFG.preset
+            )
+            ctrl.on_gossip_attestation(
+                int(att.data.slot),
+                int(att.data.index),
+                int(att.data.target.epoch),
+                bytes(att.data.beacon_block_root),
+                bytes(att.data.target.root),
+                [int(i) for i in indices],
+            )
+        ctrl.on_tick(Tick(3, TickKind.PROPOSE))
+        ctrl.wait()
+        assert ctrl.snapshot().head_root == b1.message.hash_tree_root()
+        reorgs = [d for k, d in drain(sub) if k == "chain_reorg"]
+        assert len(reorgs) == 1
+        assert reorgs[0]["depth"] == "2"
+        assert reorgs[0]["new_head_block"] == (
+            "0x" + b1.message.hash_tree_root().hex()
+        )
+    finally:
+        ctrl.stop()
+
+
+def test_controller_publishes_finalized_checkpoint():
+    genesis = interop_genesis_state(16, CFG)
+    ctrl = Controller(genesis, CFG, verifier_factory=NullVerifier)
+    bus = EventBus()
+    wire_controller_events(ctrl, bus)
+    sub = bus.subscribe(["finalized_checkpoint"])
+    try:
+        state = genesis
+        for slot in range(1, 34):
+            atts = (
+                produce_attestations(state, CFG, slot=slot - 1)
+                if slot > 1
+                else []
+            )
+            blk, state = produce_block(
+                state,
+                slot,
+                CFG,
+                full_sync_participation=False,
+                attestations=atts,
+            )
+            ctrl.on_tick(Tick(slot, TickKind.PROPOSE))
+            ctrl.on_own_block(blk)
+            ctrl.wait()
+        events = drain(sub)
+        assert events, "no finalized_checkpoint event after 4 epochs"
+        epochs = [int(d["epoch"]) for _, d in events]
+        assert epochs == sorted(epochs)
+        assert epochs[-1] >= 2
+    finally:
+        ctrl.stop()
+
+
+# ------------------------------------------------------------ wire (SSE)
+
+
+def test_sse_stream_over_socket():
+    genesis = interop_genesis_state(16, CFG)
+    ctrl = Controller(genesis, CFG, verifier_factory=NullVerifier)
+    bus = EventBus()
+    ctx = ApiContext(ctrl, CFG, event_bus=bus)
+    server, thread = serve(ctx, port=0)
+    host, port = server.server_address
+    try:
+        conn = http.client.HTTPConnection(host, port, timeout=5)
+        conn.request("GET", "/eth/v1/events?topics=head,block")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.headers["Content-Type"] == "text/event-stream"
+        # wait for the subscriber to register, then publish
+        for _ in range(100):
+            if bus.subscriber_count():
+                break
+            threading.Event().wait(0.01)
+        bus.publish("block", {"slot": "7", "block": "0x00"})
+        line = resp.fp.readline()
+        assert line == b"event: block\n"
+        data = resp.fp.readline()
+        assert json.loads(data.decode().removeprefix("data: ")) == {
+            "slot": "7",
+            "block": "0x00",
+        }
+        conn.close()
+    finally:
+        server.shutdown()
+        ctrl.stop()
+
+
+def test_sse_stream_rejects_unknown_topic():
+    genesis = interop_genesis_state(16, CFG)
+    ctrl = Controller(genesis, CFG, verifier_factory=NullVerifier)
+    ctx = ApiContext(ctrl, CFG, event_bus=EventBus())
+    server, thread = serve(ctx, port=0)
+    host, port = server.server_address
+    try:
+        conn = http.client.HTTPConnection(host, port, timeout=5)
+        conn.request("GET", "/eth/v1/events?topics=nope")
+        resp = conn.getresponse()
+        assert resp.status == 400
+        conn.close()
+    finally:
+        server.shutdown()
+        ctrl.stop()

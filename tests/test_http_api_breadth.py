@@ -1,0 +1,440 @@
+"""Beacon API breadth tests: state/pool/validator/node route groups plus
+block production + publish — reference: http_api/src/routing.rs:221-410.
+"""
+
+import json
+
+import pytest
+
+from grandine_tpu.consensus.verifier import NullVerifier
+from grandine_tpu.fork_choice.store import Tick, TickKind
+from grandine_tpu.http_api import ApiContext
+from grandine_tpu.http_api.routing import build_router
+from grandine_tpu.pools import AttestationAggPool, OperationPool
+from grandine_tpu.pools.sync_committee_pool import SyncCommitteeAggPool
+from grandine_tpu.runtime import Controller
+from grandine_tpu.transition.genesis import interop_genesis_state
+from grandine_tpu.types.config import Config
+from grandine_tpu.validator.duties import produce_attestations, produce_block
+
+CFG = Config.minimal()
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    genesis = interop_genesis_state(16, CFG)
+    ctrl = Controller(genesis, CFG, verifier_factory=NullVerifier)
+    state = genesis
+    blocks = []
+    for slot in (1, 2):
+        atts = (
+            produce_attestations(state, CFG, slot=slot - 1)
+            if slot > 1
+            else []
+        )
+        blk, state = produce_block(
+            state, slot, CFG, full_sync_participation=False,
+            attestations=atts,
+        )
+        blocks.append(blk)
+        ctrl.on_tick(Tick(slot, TickKind.PROPOSE))
+        ctrl.on_own_block(blk)
+        ctrl.wait()
+    context = ApiContext(
+        ctrl,
+        CFG,
+        attestation_pool=AttestationAggPool(CFG),
+        operation_pool=OperationPool(CFG),
+        sync_pool=SyncCommitteeAggPool(CFG),
+    )
+    context.test_blocks = blocks
+    context.test_state = state
+    yield context
+    ctrl.stop()
+
+
+@pytest.fixture(scope="module")
+def router():
+    return build_router()
+
+
+# ------------------------------------------------------------ state group
+
+
+def test_committees_route(router, ctx):
+    status, payload = router.dispatch(
+        ctx, "GET", "/eth/v1/beacon/states/head/committees"
+    )
+    assert status == 200
+    rows = payload["data"]
+    assert rows and all(r["validators"] for r in rows)
+    # filtered by slot: subset of the full listing
+    slot = rows[0]["slot"]
+    status, filtered = router.dispatch(
+        ctx, "GET", "/eth/v1/beacon/states/head/committees", {"slot": slot}
+    )
+    assert status == 200
+    assert all(r["slot"] == slot for r in filtered["data"])
+
+
+def test_sync_committees_route(router, ctx):
+    status, payload = router.dispatch(
+        ctx, "GET", "/eth/v1/beacon/states/head/sync_committees"
+    )
+    assert status == 200
+    data = payload["data"]
+    assert len(data["validators"]) == CFG.preset.SYNC_COMMITTEE_SIZE
+    assert len(data["validator_aggregates"]) == 4
+    # epoch beyond both known periods is a 400
+    status, _ = router.dispatch(
+        ctx,
+        "GET",
+        "/eth/v1/beacon/states/head/sync_committees",
+        {"epoch": "4096"},
+    )
+    assert status == 400
+
+
+def test_validator_balances_route(router, ctx):
+    status, payload = router.dispatch(
+        ctx,
+        "GET",
+        "/eth/v1/beacon/states/head/validator_balances",
+        {"id": "0,3"},
+    )
+    assert status == 200
+    assert [r["index"] for r in payload["data"]] == ["0", "3"]
+    assert all(int(r["balance"]) > 0 for r in payload["data"])
+
+
+def test_single_validator_route(router, ctx):
+    status, payload = router.dispatch(
+        ctx, "GET", "/eth/v1/beacon/states/head/validators/3"
+    )
+    assert status == 200
+    pk = payload["data"]["validator"]["pubkey"]
+    # lookup by pubkey resolves to the same row
+    status, by_pk = router.dispatch(
+        ctx, "GET", f"/eth/v1/beacon/states/head/validators/{pk}"
+    )
+    assert status == 200 and by_pk["data"]["index"] == "3"
+    assert router.dispatch(
+        ctx, "GET", "/eth/v1/beacon/states/head/validators/9999"
+    )[0] == 404
+
+
+def test_header_and_block_attestations(router, ctx):
+    status, payload = router.dispatch(
+        ctx, "GET", "/eth/v1/beacon/headers/head"
+    )
+    assert status == 200
+    assert payload["data"]["canonical"] is True
+    assert payload["data"]["header"]["message"]["slot"] == "2"
+
+    status, payload = router.dispatch(
+        ctx, "GET", "/eth/v1/beacon/blocks/2/attestations"
+    )
+    assert status == 200
+    atts = payload["data"]
+    assert atts and atts[0]["data"]["slot"] == "1"
+    assert atts[0]["aggregation_bits"].startswith("0x")
+
+
+# ------------------------------------------------------------- pool group
+
+
+def test_pool_proposer_slashing_roundtrip(router, ctx):
+    header = {
+        "message": {
+            "slot": "1",
+            "proposer_index": "5",
+            "parent_root": "0x" + "11" * 32,
+            "state_root": "0x" + "22" * 32,
+            "body_root": "0x" + "33" * 32,
+        },
+        "signature": "0x" + "44" * 96,
+    }
+    header2 = json.loads(json.dumps(header))
+    header2["message"]["body_root"] = "0x" + "55" * 32
+    status, _ = router.dispatch(
+        ctx,
+        "POST",
+        "/eth/v1/beacon/pool/proposer_slashings",
+        body={"signed_header_1": header, "signed_header_2": header2},
+    )
+    assert status == 200
+    status, payload = router.dispatch(
+        ctx, "GET", "/eth/v1/beacon/pool/proposer_slashings"
+    )
+    assert status == 200
+    assert payload["data"][0]["signed_header_1"]["message"]["proposer_index"] == "5"
+
+
+def test_pool_attester_slashing_roundtrip(router, ctx):
+    data = {
+        "slot": "1",
+        "index": "0",
+        "beacon_block_root": "0x" + "aa" * 32,
+        "source": {"epoch": "0", "root": "0x" + "bb" * 32},
+        "target": {"epoch": "1", "root": "0x" + "cc" * 32},
+    }
+    data2 = json.loads(json.dumps(data))
+    data2["beacon_block_root"] = "0x" + "dd" * 32
+    att = lambda d: {  # noqa: E731
+        "attesting_indices": ["2", "4"],
+        "data": d,
+        "signature": "0x" + "ee" * 96,
+    }
+    status, _ = router.dispatch(
+        ctx,
+        "POST",
+        "/eth/v1/beacon/pool/attester_slashings",
+        body={"attestation_1": att(data), "attestation_2": att(data2)},
+    )
+    assert status == 200
+    status, payload = router.dispatch(
+        ctx, "GET", "/eth/v1/beacon/pool/attester_slashings"
+    )
+    assert payload["data"][0]["attestation_1"]["attesting_indices"] == ["2", "4"]
+
+
+def test_pool_exit_and_bls_change(router, ctx):
+    status, _ = router.dispatch(
+        ctx,
+        "POST",
+        "/eth/v1/beacon/pool/voluntary_exits",
+        body={
+            "message": {"epoch": "0", "validator_index": "7"},
+            "signature": "0x" + "12" * 96,
+        },
+    )
+    assert status == 200
+    status, payload = router.dispatch(
+        ctx, "GET", "/eth/v1/beacon/pool/voluntary_exits"
+    )
+    assert any(
+        e["message"]["validator_index"] == "7" for e in payload["data"]
+    )
+
+    status, _ = router.dispatch(
+        ctx,
+        "POST",
+        "/eth/v1/beacon/pool/bls_to_execution_changes",
+        body=[{
+            "message": {
+                "validator_index": "6",
+                "from_bls_pubkey": "0x" + "ab" * 48,
+                "to_execution_address": "0x" + "cd" * 20,
+            },
+            "signature": "0x" + "ef" * 96,
+        }],
+    )
+    assert status == 200
+    status, payload = router.dispatch(
+        ctx, "GET", "/eth/v1/beacon/pool/bls_to_execution_changes"
+    )
+    assert payload["data"][0]["message"]["validator_index"] == "6"
+
+
+def test_pool_sync_committee_messages(router, ctx):
+    from grandine_tpu.validator.duties import _interop_keys
+
+    state = ctx.snapshot().head_state
+    # validator 0's real position(s); signature content is not verified
+    # by the pool, but must be a valid G2 point to aggregate
+    sig = _interop_keys(0).sign(b"\x01" * 32).to_bytes()
+    status, _ = router.dispatch(
+        ctx,
+        "POST",
+        "/eth/v1/beacon/pool/sync_committees",
+        body=[{
+            "slot": "2",
+            "beacon_block_root": "0x" + "00" * 32,
+            "validator_index": "0",
+            "signature": "0x" + sig.hex(),
+        }],
+    )
+    assert status == 200
+    # unknown validator index -> 400 with failure detail
+    status, payload = router.dispatch(
+        ctx,
+        "POST",
+        "/eth/v1/beacon/pool/sync_committees",
+        body=[{
+            "slot": "2",
+            "beacon_block_root": "0x" + "00" * 32,
+            "validator_index": "99999",
+            "signature": "0x" + sig.hex(),
+        }],
+    )
+    assert status == 400
+
+
+def test_aggregate_and_proofs_and_lookup(router, ctx):
+    # take a real attestation from block 2 and submit it as an aggregate
+    status, payload = router.dispatch(
+        ctx, "GET", "/eth/v1/beacon/blocks/2/attestations"
+    )
+    att_json = payload["data"][0]
+    status, _ = router.dispatch(
+        ctx,
+        "POST",
+        "/eth/v1/validator/aggregate_and_proofs",
+        body=[{
+            "message": {
+                "aggregator_index": "0",
+                "aggregate": att_json,
+                "selection_proof": "0x" + "00" * 96,
+            },
+            "signature": "0x" + "00" * 96,
+        }],
+    )
+    assert status == 200
+    # recover it through the aggregate_attestation lookup
+    from grandine_tpu.types.combined import fork_namespace, state_phase_of
+
+    state = ctx.snapshot().head_state
+    ns = fork_namespace(CFG, state_phase_of(state, CFG))
+    data = ns.AttestationData(
+        slot=int(att_json["data"]["slot"]),
+        index=int(att_json["data"]["index"]),
+        beacon_block_root=bytes.fromhex(
+            att_json["data"]["beacon_block_root"][2:]
+        ),
+        source=ns.Checkpoint(
+            epoch=int(att_json["data"]["source"]["epoch"]),
+            root=bytes.fromhex(att_json["data"]["source"]["root"][2:]),
+        ),
+        target=ns.Checkpoint(
+            epoch=int(att_json["data"]["target"]["epoch"]),
+            root=bytes.fromhex(att_json["data"]["target"]["root"][2:]),
+        ),
+    )
+    status, payload = router.dispatch(
+        ctx,
+        "GET",
+        "/eth/v1/validator/aggregate_attestation",
+        {
+            "slot": att_json["data"]["slot"],
+            "attestation_data_root": "0x" + data.hash_tree_root().hex(),
+        },
+    )
+    assert status == 200
+    assert payload["data"]["data"]["slot"] == att_json["data"]["slot"]
+
+
+# ------------------------------------------- production / publish group
+
+
+def test_produce_and_publish_block(router, ctx):
+    status, payload = router.dispatch(
+        ctx,
+        "GET",
+        "/eth/v3/validator/blocks/3",
+        {"randao_reveal": "0x" + "11" * 96},
+    )
+    assert status == 200
+    assert payload["execution_payload_blinded"] is False
+    assert payload["data"]["slot"] == "3"
+    assert payload["data"]["ssz"].startswith("0x")
+
+    # produce a SIGNED block with the duty engine and publish it
+    signed, _post = produce_block(
+        ctx.test_state, 3, CFG, full_sync_participation=False
+    )
+    ctx.controller.on_tick(Tick(3, TickKind.PROPOSE))
+    status, _ = router.dispatch(
+        ctx,
+        "POST",
+        "/eth/v1/beacon/blocks",
+        body={"ssz": "0x" + signed.serialize().hex()},
+    )
+    assert status == 200
+    ctx.controller.wait()
+    assert ctx.snapshot().head_root == signed.message.hash_tree_root()
+    ctx.test_state = _post
+
+
+def test_produce_block_requires_reveal_and_future_slot(router, ctx):
+    assert router.dispatch(
+        ctx, "GET", "/eth/v3/validator/blocks/9"
+    )[0] == 400
+    assert router.dispatch(
+        ctx,
+        "GET",
+        "/eth/v3/validator/blocks/1",
+        {"randao_reveal": "0x" + "11" * 96},
+    )[0] == 400
+
+
+def test_publish_malformed_block_is_400(router, ctx):
+    assert router.dispatch(
+        ctx, "POST", "/eth/v1/beacon/blocks", body={"ssz": "0x0102"}
+    )[0] == 400
+    assert router.dispatch(
+        ctx, "POST", "/eth/v1/beacon/blocks", body=["nope"]
+    )[0] == 400
+
+
+# --------------------------------------------------- validator/node group
+
+
+def test_sync_duties_route(router, ctx):
+    status, payload = router.dispatch(
+        ctx, "POST", "/eth/v1/validator/duties/sync/0",
+        body=[str(i) for i in range(16)],
+    )
+    assert status == 200
+    # minimal preset: every validator appears in the 32-wide committee
+    assert payload["data"]
+    row = payload["data"][0]
+    assert row["validator_sync_committee_indices"]
+
+
+def test_prepare_and_register(router, ctx):
+    status, _ = router.dispatch(
+        ctx,
+        "POST",
+        "/eth/v1/validator/prepare_beacon_proposer",
+        body=[{
+            "validator_index": "4",
+            "fee_recipient": "0x" + "aa" * 20,
+        }],
+    )
+    assert status == 200
+    assert ctx.prepared_proposers[4] == "0x" + "aa" * 20
+
+    status, _ = router.dispatch(
+        ctx,
+        "POST",
+        "/eth/v1/validator/register_validator",
+        body=[{
+            "message": {
+                "fee_recipient": "0x" + "bb" * 20,
+                "gas_limit": "30000000",
+                "timestamp": "0",
+                "pubkey": "0x" + "cc" * 48,
+            },
+            "signature": "0x" + "dd" * 96,
+        }],
+    )
+    assert status == 200
+    assert "0x" + "cc" * 48 in ctx.validator_registrations
+
+
+def test_subscriptions_require_subnet_service(router, ctx):
+    assert router.dispatch(
+        ctx,
+        "POST",
+        "/eth/v1/validator/beacon_committee_subscriptions",
+        body=[],
+    )[0] == 503
+
+
+def test_node_identity_and_peers(router, ctx):
+    status, payload = router.dispatch(ctx, "GET", "/eth/v1/node/identity")
+    assert status == 200 and "peer_id" in payload["data"]
+    status, payload = router.dispatch(ctx, "GET", "/eth/v1/node/peers")
+    assert status == 200 and payload["meta"]["count"] == 0
+    status, payload = router.dispatch(ctx, "GET", "/eth/v1/node/peer_count")
+    assert status == 200 and payload["data"]["connected"] == "0"

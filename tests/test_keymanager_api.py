@@ -1,0 +1,176 @@
+"""Keymanager REST API tests — reference: the keymanager crate's routes
+(keystores / remotekeys / per-validator feerecipient, gas_limit, graffiti)
+served through http_api. Handlers are driven in-process through the same
+Router.dispatch the live server uses.
+"""
+
+import json
+
+import pytest
+
+from grandine_tpu.consensus.verifier import NullVerifier
+from grandine_tpu.crypto import bls as A
+from grandine_tpu.http_api import ApiContext
+from grandine_tpu.http_api.routing import build_router
+from grandine_tpu.runtime import Controller
+from grandine_tpu.storage.database import Database
+from grandine_tpu.transition.genesis import interop_genesis_state
+from grandine_tpu.types.config import Config
+from grandine_tpu.validator.keymanager import KeyManager, encrypt_keystore
+from grandine_tpu.validator.signer import Signer
+from grandine_tpu.validator.slashing_protection import SlashingProtection
+
+CFG = Config.minimal()
+
+
+@pytest.fixture()
+def km_ctx():
+    genesis = interop_genesis_state(16, CFG)
+    ctrl = Controller(genesis, CFG, verifier_factory=NullVerifier)
+    signer = Signer(web3signer=lambda pk, root: "0x" + "11" * 96)
+    protection = SlashingProtection(Database.in_memory())
+    km = KeyManager(signer, slashing_protection=protection)
+    ctx = ApiContext(ctrl, CFG, keymanager=km)
+    yield ctx, km, signer
+    ctrl.stop()
+
+
+@pytest.fixture()
+def router():
+    return build_router()
+
+
+SK = A.SecretKey.from_bytes((7777).to_bytes(32, "big"))
+PK_HEX = "0x" + SK.public_key().to_bytes().hex()
+
+
+def test_keystore_import_list_delete(router, km_ctx):
+    ctx, km, signer = km_ctx
+    keystore = encrypt_keystore(SK, "hunter2", kdf="pbkdf2")
+    status, payload = router.dispatch(
+        ctx,
+        "POST",
+        "/eth/v1/keystores",
+        body={
+            "keystores": [json.dumps(keystore)],
+            "passwords": ["hunter2"],
+        },
+    )
+    assert status == 200
+    assert payload["data"][0]["status"] == "imported"
+    assert signer.has_key(SK.public_key().to_bytes())
+
+    status, payload = router.dispatch(ctx, "GET", "/eth/v1/keystores")
+    assert status == 200
+    assert payload["data"] == [
+        {"validating_pubkey": PK_HEX, "derivation_path": "", "readonly": False}
+    ]
+
+    status, payload = router.dispatch(
+        ctx, "DELETE", "/eth/v1/keystores", body={"pubkeys": [PK_HEX]}
+    )
+    assert status == 200
+    assert payload["data"][0]["status"] == "deleted"
+    # DELETE must ship the EIP-3076 interchange for migration
+    interchange = json.loads(payload["slashing_protection"])
+    assert interchange["metadata"]["interchange_format_version"] == "5"
+    assert not signer.has_key(SK.public_key().to_bytes())
+
+
+def test_keystore_import_bad_password(router, km_ctx):
+    ctx, km, signer = km_ctx
+    keystore = encrypt_keystore(SK, "right", kdf="pbkdf2")
+    status, payload = router.dispatch(
+        ctx,
+        "POST",
+        "/eth/v1/keystores",
+        body={"keystores": [json.dumps(keystore)], "passwords": ["wrong"]},
+    )
+    assert status == 200
+    assert payload["data"][0]["status"] == "error"
+    assert len(signer) == 0
+
+
+def test_remote_keys_roundtrip(router, km_ctx):
+    ctx, km, signer = km_ctx
+    status, payload = router.dispatch(
+        ctx,
+        "POST",
+        "/eth/v1/remotekeys",
+        body={"remote_keys": [{"pubkey": PK_HEX, "url": "http://w3s"}]},
+    )
+    assert status == 200
+    assert payload["data"][0]["status"] == "imported"
+
+    status, payload = router.dispatch(ctx, "GET", "/eth/v1/remotekeys")
+    assert payload["data"][0]["pubkey"] == PK_HEX
+
+    # re-import reports duplicate, not error
+    status, payload = router.dispatch(
+        ctx,
+        "POST",
+        "/eth/v1/remotekeys",
+        body={"remote_keys": [{"pubkey": PK_HEX}]},
+    )
+    assert payload["data"][0]["status"] == "duplicate"
+
+    status, payload = router.dispatch(
+        ctx, "DELETE", "/eth/v1/remotekeys", body={"pubkeys": [PK_HEX]}
+    )
+    assert payload["data"][0]["status"] == "deleted"
+    assert router.dispatch(ctx, "GET", "/eth/v1/remotekeys")[1]["data"] == []
+
+
+def test_fee_recipient_routes(router, km_ctx):
+    ctx, km, signer = km_ctx
+    path = f"/eth/v1/validator/{PK_HEX}/feerecipient"
+    assert router.dispatch(ctx, "GET", path)[0] == 404
+    addr = "0x" + "ab" * 20
+    status, _ = router.dispatch(ctx, "POST", path, body={"ethaddress": addr})
+    assert status == 200
+    status, payload = router.dispatch(ctx, "GET", path)
+    assert status == 200 and payload["data"]["ethaddress"] == addr
+    assert router.dispatch(ctx, "DELETE", path)[0] == 200
+    assert router.dispatch(ctx, "GET", path)[0] == 404
+
+
+def test_gas_limit_and_graffiti_routes(router, km_ctx):
+    ctx, km, signer = km_ctx
+    gas_path = f"/eth/v1/validator/{PK_HEX}/gas_limit"
+    status, _ = router.dispatch(
+        ctx, "POST", gas_path, body={"gas_limit": "30000000"}
+    )
+    assert status == 200
+    status, payload = router.dispatch(ctx, "GET", gas_path)
+    assert payload["data"]["gas_limit"] == "30000000"
+
+    graffiti_path = f"/eth/v1/validator/{PK_HEX}/graffiti"
+    status, _ = router.dispatch(
+        ctx, "POST", graffiti_path, body={"graffiti": "tpu"}
+    )
+    assert status == 200
+    status, payload = router.dispatch(ctx, "GET", graffiti_path)
+    assert payload["data"]["graffiti"] == "tpu"
+    # the stored value feeds block production as padded bytes32
+    assert km.proposer_config(bytes.fromhex(PK_HEX[2:]))["graffiti"] == (
+        b"tpu" + b"\x00" * 29
+    )
+    assert router.dispatch(ctx, "DELETE", graffiti_path)[0] == 200
+    assert router.dispatch(ctx, "GET", graffiti_path)[0] == 404
+
+
+def test_bad_pubkey_is_400(router, km_ctx):
+    ctx, km, signer = km_ctx
+    assert router.dispatch(
+        ctx, "GET", "/eth/v1/validator/0x1234/feerecipient"
+    )[0] == 400
+
+
+def test_keymanager_unwired_is_503(router):
+    genesis = interop_genesis_state(16, CFG)
+    ctrl = Controller(genesis, CFG, verifier_factory=NullVerifier)
+    try:
+        ctx = ApiContext(ctrl, CFG)
+        assert router.dispatch(ctx, "GET", "/eth/v1/keystores")[0] == 503
+    finally:
+        ctrl.stop()

@@ -106,6 +106,8 @@ def test_sync_pool_merges_messages():
 
 
 def test_operation_pool_dedup_and_pack():
+    import dataclasses
+
     genesis = interop_genesis_state(16, CFG)
     pool = OperationPool(CFG)
     exit_ = NS.SignedVoluntaryExit(
@@ -113,12 +115,21 @@ def test_operation_pool_dedup_and_pack():
     )
     assert pool.insert_voluntary_exit(exit_)
     assert not pool.insert_voluntary_exit(exit_)  # dedup by validator
-    packed = pool.pack(genesis)
+    # at genesis the exit is NOT includable (spec "exit: too young":
+    # activation_epoch + shard_committee_period > current epoch) — pack
+    # must exclude it or the produced block fails its own transition
+    assert pool.pack(genesis)["voluntary_exits"] == []
+    # with the age gate lifted the same exit packs
+    young_ok = OperationPool(
+        dataclasses.replace(CFG, shard_committee_period=0)
+    )
+    young_ok.insert_voluntary_exit(exit_)
+    packed = young_ok.pack(genesis)
     assert len(packed["voluntary_exits"]) == 1
     # consumed on block application
     body = NS.BeaconBlockBody(voluntary_exits=[exit_])
-    pool.on_block_applied(NS.BeaconBlock(body=body))
-    assert pool.pack(genesis)["voluntary_exits"] == []
+    young_ok.on_block_applied(NS.BeaconBlock(body=body))
+    assert young_ok.pack(genesis)["voluntary_exits"] == []
 
 
 # ---------------------------------------------------- slashing protection

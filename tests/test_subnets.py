@@ -1,0 +1,181 @@
+"""Subnet service tests — reference: p2p/src/attestation_subnets.rs,
+p2p/src/sync_committee_subnets.rs (subscription state machines) and the
+Beacon API subscription routes that drive them.
+"""
+
+import pytest
+
+from grandine_tpu.p2p.subnets import (
+    EPOCHS_PER_SUBNET_SUBSCRIPTION,
+    SUBNETS_PER_NODE,
+    SubnetService,
+    compute_subnet_id,
+    compute_subscribed_subnets,
+    sync_subnets_for_positions,
+)
+from grandine_tpu.types.config import Config
+
+CFG = Config.minimal()
+P = CFG.preset
+
+
+def test_compute_subnet_id_spec_shape():
+    # slot 0: subnet == committee index
+    assert compute_subnet_id(3, 0, 4, P) == 3
+    # later slots advance by committees_at_slot per slot
+    slot = 2
+    assert compute_subnet_id(1, slot, 4, P) == (4 * (slot % P.SLOTS_PER_EPOCH) + 1) % 64
+    # wraps at 64
+    assert 0 <= compute_subnet_id(63, 31, 64, P) < 64
+
+
+def test_persistent_subnets_are_stable_within_period():
+    node_id = 0xDEADBEEF << 200
+    subs0 = compute_subscribed_subnets(node_id, epoch=0)
+    assert len(subs0) == SUBNETS_PER_NODE
+    assert all(0 <= s < 64 for s in subs0)
+    # unchanged within a subscription period
+    assert compute_subscribed_subnets(node_id, epoch=5) == subs0
+    # rotates across periods (different permutation seed)
+    far = compute_subscribed_subnets(
+        node_id, epoch=2 * EPOCHS_PER_SUBNET_SUBSCRIPTION
+    )
+    assert len(far) == SUBNETS_PER_NODE
+
+
+def test_sync_subnets_from_positions():
+    sub_size = P.SYNC_COMMITTEE_SIZE // 4
+    assert sync_subnets_for_positions([0, 1], P) == {0}
+    assert sync_subnets_for_positions([0, sub_size, 3 * sub_size], P) == {0, 1, 3}
+
+
+def test_short_lived_subscription_lifecycle():
+    svc = SubnetService(CFG, node_id=123)
+    subnet = svc.subscribe_attestation(
+        validator_index=7,
+        committee_index=2,
+        committees_at_slot=4,
+        slot=10,
+        is_aggregator=True,
+    )
+    assert subnet == compute_subnet_id(2, 10, 4, P)
+    assert subnet in svc.active_attestation_subnets(10)
+    assert svc.aggregator_subnet(7, 10) == subnet
+    # persistent subnets are always present
+    persistent = set(compute_subscribed_subnets(123, 10 // P.SLOTS_PER_EPOCH))
+    assert persistent <= svc.active_attestation_subnets(10)
+    # expires after the duty slot + slack
+    svc.on_slot(12)
+    assert subnet not in svc.active_attestation_subnets(12) or subnet in persistent
+    assert svc.aggregator_subnet(7, 10) is None
+
+
+def test_sync_committee_subscription_until_epoch():
+    svc = SubnetService(CFG)
+    svc.subscribe_sync_committee(
+        validator_index=3, sync_committee_indices=[0], until_epoch=5
+    )
+    assert svc.active_sync_subnets(4) == {0}
+    assert svc.active_sync_subnets(5) == {0}
+    svc.on_slot(6 * P.SLOTS_PER_EPOCH)  # epoch 6 > until_epoch
+    assert svc.active_sync_subnets(6) == set()
+
+
+def test_network_gates_off_subnet_gossip():
+    """A Network with a SubnetService drops attestations on subnets the
+    node is not joined to (the unsubscribe-less transport gate)."""
+    from grandine_tpu.consensus.verifier import NullVerifier
+    from grandine_tpu.p2p.network import GossipTopics, InMemoryHub, Network
+    from grandine_tpu.runtime import Controller
+    from grandine_tpu.transition.genesis import interop_genesis_state
+
+    genesis = interop_genesis_state(16, CFG)
+    ctrl = Controller(genesis, CFG, verifier_factory=NullVerifier)
+    hub = InMemoryHub()
+    try:
+        net = Network(hub.join("a"), ctrl, CFG)
+        sender = hub.join("b")
+        digest = net.digest
+        net.set_attestation_subnets({1})
+        sender.publish(
+            GossipTopics.beacon_attestation(digest, 5), b"\x00"
+        )
+        assert net.stats["attestations_off_subnet"] == 1
+        assert net.stats["attestations_in"] == 0
+        sender.publish(
+            GossipTopics.beacon_attestation(digest, 1), b"\x00"
+        )
+        assert net.stats["attestations_in"] == 1
+    finally:
+        ctrl.stop()
+
+
+def test_api_subscription_routes_drive_service():
+    from grandine_tpu.consensus.verifier import NullVerifier
+    from grandine_tpu.http_api import ApiContext
+    from grandine_tpu.http_api.routing import build_router
+    from grandine_tpu.runtime import Controller
+    from grandine_tpu.transition.genesis import interop_genesis_state
+
+    genesis = interop_genesis_state(16, CFG)
+    ctrl = Controller(genesis, CFG, verifier_factory=NullVerifier)
+    svc = SubnetService(CFG)
+    try:
+        ctx = ApiContext(ctrl, CFG, subnet_service=svc)
+        router = build_router()
+        status, _ = router.dispatch(
+            ctx,
+            "POST",
+            "/eth/v1/validator/beacon_committee_subscriptions",
+            body=[{
+                "validator_index": "1",
+                "committee_index": "0",
+                "committees_at_slot": "4",
+                "slot": "3",
+                "is_aggregator": True,
+            }],
+        )
+        assert status == 200
+        assert compute_subnet_id(0, 3, 4, P) in svc.active_attestation_subnets(3)
+        status, _ = router.dispatch(
+            ctx,
+            "POST",
+            "/eth/v1/validator/sync_committee_subscriptions",
+            body=[{
+                "validator_index": "1",
+                "sync_committee_indices": ["0", "8"],
+                "until_epoch": "2",
+            }],
+        )
+        assert status == 200
+        assert svc.active_sync_subnets(1)
+    finally:
+        ctrl.stop()
+
+
+def test_validator_service_subscribes_own_duties():
+    from grandine_tpu.consensus.verifier import NullVerifier
+    from grandine_tpu.fork_choice.store import Tick, TickKind
+    from grandine_tpu.runtime import Controller
+    from grandine_tpu.transition.genesis import interop_genesis_state
+    from grandine_tpu.validator.duties import _interop_keys
+    from grandine_tpu.validator.service import ValidatorService
+    from grandine_tpu.validator.signer import Signer
+
+    genesis = interop_genesis_state(16, CFG)
+    ctrl = Controller(genesis, CFG, verifier_factory=NullVerifier)
+    signer = Signer()
+    for i in range(4):
+        signer.add_key(_interop_keys(i))
+    svc = SubnetService(CFG)
+    vs = ValidatorService(ctrl, signer, CFG, subnet_service=svc)
+    try:
+        ctrl.on_tick(Tick(1, TickKind.ATTEST))
+        ctrl.wait()
+        atts = vs.attest(1)
+        assert atts
+        active = svc.active_attestation_subnets(1)
+        persistent = set(compute_subscribed_subnets(0, 0))
+        assert active - persistent, "attesting must add short-lived subnets"
+    finally:
+        ctrl.stop()
