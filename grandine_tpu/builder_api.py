@@ -78,7 +78,10 @@ class BuilderApi:
         })
         if not isinstance(bid, dict) or "header" not in bid:
             raise BuilderApiError("malformed bid")
-        if bid["header"].get("parent_hash") != bytes(parent_hash).hex():
+        bid_parent = str(bid["header"].get("parent_hash", "")).removeprefix(
+            "0x"
+        )
+        if bid_parent != bytes(parent_hash).hex():
             raise BuilderApiError("bid parent hash mismatch")
         self.stats["headers"] += 1
         return bid

@@ -14,18 +14,35 @@ from typing import Iterable, Optional
 
 from grandine_tpu.crypto import bls as A
 
-_CACHE: dict = {}
+#: key bytes -> (PublicKey, subgroup_checked) — a trusted decompression
+#: may later be upgraded by an untrusted caller
+_CACHE: "dict[bytes, tuple]" = {}
 
 
-def decompress_pubkey(pubkey_bytes: bytes) -> "A.PublicKey":
-    """Decompressed, subgroup-checked, non-identity public key.
-    Raises BlsError on invalid encodings (never cached)."""
+def decompress_pubkey(
+    pubkey_bytes: bytes, trusted: bool = False
+) -> "A.PublicKey":
+    """Decompressed, non-identity public key; subgroup-checked unless
+    `trusted`. Raises BlsError on invalid encodings (never cached).
+
+    trusted=True is for keys sourced from the VALIDATOR REGISTRY: they
+    passed KeyValidate at deposit time, and re-running the subgroup
+    scalar-mul per decompression (~30 ms host) made cold-cache block
+    replay O(committee·30ms). This mirrors the reference's
+    CachedPublicKey (bls/src/cached_public_key.rs), which also
+    decompresses registry keys without re-validating."""
     key = bytes(pubkey_bytes)
     hit = _CACHE.get(key)
-    if hit is None:
-        hit = A.PublicKey.from_bytes(key)
-        _CACHE[key] = hit
-    return hit
+    if hit is not None:
+        pk, checked = hit
+        if checked or trusted:
+            return pk
+    point = A.g1_from_bytes(key, subgroup_check=not trusted)
+    if point.is_infinity():
+        raise A.BlsError("identity public key is invalid")
+    pk = A.PublicKey(point)
+    _CACHE[key] = (pk, not trusted)
+    return pk
 
 
 def try_decompress_pubkey(pubkey_bytes: bytes) -> "Optional[A.PublicKey]":

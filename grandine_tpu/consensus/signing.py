@@ -34,7 +34,8 @@ from grandine_tpu.types.primitives import (
 def _pubkey(state, index: int):
     cols = accessors.registry_columns(state)
     try:
-        return keys.decompress_pubkey(cols.pubkeys[index])
+        # registry keys passed KeyValidate at deposit: trusted decompress
+        return keys.decompress_pubkey(cols.pubkeys[index], trusted=True)
     except Exception as e:
         raise SignatureInvalid(f"invalid registry pubkey at {index}: {e}") from e
 
@@ -170,7 +171,9 @@ def extend_with_sync_aggregate(v: Verifier, state, sync_aggregate, cfg) -> None:
     bits = sync_aggregate.sync_committee_bits
     sig = bytes(sync_aggregate.sync_committee_signature)
     participants = [
-        keys.decompress_pubkey(bytes(state.current_sync_committee.pubkeys[i]))
+        keys.decompress_pubkey(
+            bytes(state.current_sync_committee.pubkeys[i]), trusted=True
+        )
         for i in bits.nonzero_indices()
     ]
     if not participants:

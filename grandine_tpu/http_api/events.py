@@ -189,7 +189,11 @@ def wire_controller_events(controller, bus: EventBus) -> None:
         bus.publish(
             "head",
             {
-                "slot": str(snap.slot),
+                # the HEAD BLOCK's slot, not the wall-clock store slot
+                # (they differ after a missed slot)
+                "slot": str(
+                    head_node.slot if head_node is not None else snap.slot
+                ),
                 "block": _hex(snap.head_root),
                 "state": _hex(snap.head_state.hash_tree_root()),
                 "epoch_transition": epoch_transition,

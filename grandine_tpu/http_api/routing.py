@@ -355,32 +355,13 @@ def post_pool_attestations(ctx, params, query, body):
 
 
 def _attestation_from_json(ctx, j):
-    from grandine_tpu.types.combined import fork_namespace
-
-    snap = ctx.snapshot()
-    phase = state_phase_of(snap.head_state, ctx.cfg)
-    ns = fork_namespace(ctx.cfg, phase)
-    d = j["data"]
-    bits_hex = j["aggregation_bits"]
-    bitlist_bytes = bytes.fromhex(bits_hex[2:])
-    typ = ns.Attestation.FIELDS[0][1]
-    bits = typ.deserialize(bitlist_bytes)
+    ns = _ns_of_head(ctx)
+    bits_type = _field_type(ns.Attestation, "aggregation_bits")
+    bits = bits_type.deserialize(_b(j["aggregation_bits"]))
     return ns.Attestation(
         aggregation_bits=bits,
-        data=ns.AttestationData(
-            slot=int(d["slot"]),
-            index=int(d["index"]),
-            beacon_block_root=bytes.fromhex(d["beacon_block_root"][2:]),
-            source=ns.Checkpoint(
-                epoch=int(d["source"]["epoch"]),
-                root=bytes.fromhex(d["source"]["root"][2:]),
-            ),
-            target=ns.Checkpoint(
-                epoch=int(d["target"]["epoch"]),
-                root=bytes.fromhex(d["target"]["root"][2:]),
-            ),
-        ),
-        signature=bytes.fromhex(j["signature"][2:]),
+        data=_json_to_attestation_data(ns, j["data"]),
+        signature=_b(j["signature"], 96),
     )
 
 
@@ -568,7 +549,8 @@ def _attestation_data_to_json(d) -> dict:
 
 
 def _field_type(container, name: str):
-    for n, t in type(container).FIELDS:
+    cls = container if isinstance(container, type) else type(container)
+    for n, t in cls.FIELDS:
         if n == name:
             return t
     raise KeyError(name)
@@ -1024,7 +1006,7 @@ def produce_block_v3(ctx, params, query, body):
         raise ApiError(400, f"slot {slot} is not beyond the head")
     state = ctx.controller.state_at_slot(slot, snap)
     attestations = (
-        ctx.attestation_pool.pack_attestations(state, ctx.cfg, slot=slot - 1)
+        ctx.attestation_pool.pack_attestations(state, ctx.cfg, slot=slot)
         if ctx.attestation_pool is not None
         else []
     )

@@ -88,6 +88,8 @@ def _bind():
             L.gt_merkleize_many.restype = ctypes.c_int
             L.gt_mix_in_length.argtypes = [cp, ctypes.c_uint64, cp]
             L.gt_zero_hash.argtypes = [ctypes.c_int, cp]
+            L.gt_crc32c.argtypes = [cp, ctypes.c_uint64]
+            L.gt_crc32c.restype = ctypes.c_uint32
             shani = bool(L.gt_init())
         except (OSError, AttributeError):
             # missing/stale-ABI cached .so: degrade to hashlib fallback

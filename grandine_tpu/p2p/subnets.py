@@ -53,13 +53,13 @@ def compute_subscribed_subnets(
     node_offset = node_id % EPOCHS_PER_SUBNET_SUBSCRIPTION
     period = (epoch + node_offset) // EPOCHS_PER_SUBNET_SUBSCRIPTION
     seed = hash_bytes(period.to_bytes(8, "little"))
-    out = []
-    for index in range(SUBNETS_PER_NODE):
-        permutated = compute_shuffled_index(
-            node_id_prefix, 1 << ATTESTATION_SUBNET_PREFIX_BITS, seed
-        )
-        out.append((permutated + index) % subnet_count)
-    return out
+    permutated = compute_shuffled_index(
+        node_id_prefix, 1 << ATTESTATION_SUBNET_PREFIX_BITS, seed
+    )
+    return [
+        (permutated + index) % subnet_count
+        for index in range(SUBNETS_PER_NODE)
+    ]
 
 
 def sync_subnets_for_positions(positions, preset) -> "set[int]":
@@ -80,6 +80,8 @@ class SubnetService:
         self.node_id = node_id
         self.network = network
         self._lock = threading.Lock()
+        #: latest slot seen via on_slot (for persistent-subnet epochs)
+        self._current_slot = 0
         #: subnet -> latest slot it is needed through (short-lived subs)
         self._att_until_slot: "dict[int, int]" = {}
         #: subnet -> latest epoch it is needed through (sync committee)
@@ -140,6 +142,7 @@ class SubnetService:
         tick of attestation_subnets.rs)."""
         epoch = slot // self.p.SLOTS_PER_EPOCH
         with self._lock:
+            self._current_slot = max(self._current_slot, slot)
             self._att_until_slot = {
                 s: u for s, u in self._att_until_slot.items() if u >= slot
             }
@@ -183,13 +186,23 @@ class SubnetService:
     # ---------------------------------------------------------- network
 
     def _push_to_network(self, slot: "Optional[int]" = None) -> None:
+        """Push the union of ALL live short-lived subscriptions plus the
+        persistent subnets — a subscription for a FUTURE duty must never
+        gate out a subnet still needed for an imminent one, so the set is
+        not evaluated at any single subscription's expiry slot."""
         if self.network is None:
             return
-        if slot is None:
-            with self._lock:
-                slot = max(self._att_until_slot.values(), default=0)
+        with self._lock:
+            cur = self._current_slot if slot is None else slot
+            live = set(self._att_until_slot)
+        epoch = cur // self.p.SLOTS_PER_EPOCH
         self.network.set_attestation_subnets(
-            self.active_attestation_subnets(slot)
+            live
+            | set(
+                compute_subscribed_subnets(
+                    self.node_id, epoch, self.cfg.attestation_subnet_count
+                )
+            )
         )
 
 

@@ -191,7 +191,10 @@ class AttestationVerifier:
         )
         root = signing.attestation_signing_root(state, data, self.cfg)
         cols = accessors.registry_columns(state)
-        members = [keys.decompress_pubkey(cols.pubkeys[int(i)]) for i in indices]
+        members = [
+            keys.decompress_pubkey(cols.pubkeys[int(i)], trusted=True)
+            for i in indices
+        ]
         return root, bytes(attestation.signature), members, valid
 
     def _batch_check(self, messages, signatures, members) -> bool:
@@ -202,9 +205,22 @@ class AttestationVerifier:
 
                 backend = self.backend = TpuBlsBackend()
             try:
-                sigs = [A.Signature.from_bytes(s) for s in signatures]
+                # decompress WITHOUT the per-signature host subgroup
+                # scalar-mul (~9 ms each — it dominated batch latency);
+                # the device checks the whole batch in one ψ ladder.
+                # A failed batch falls to the singular path, which uses
+                # the fully-checked from_bytes and isolates the item.
+                points = [
+                    A.g2_from_bytes(bytes(s), subgroup_check=False)
+                    for s in signatures
+                ]
             except A.BlsError:
                 return False
+            if any(p.is_infinity() for p in points):
+                return False
+            if not bool(backend.g2_subgroup_check_batch(points).all()):
+                return False
+            sigs = [A.Signature(p) for p in points]
             return backend.fast_aggregate_verify_batch(messages, sigs, members)
         # host anchor path (small batches / tests)
         try:

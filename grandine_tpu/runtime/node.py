@@ -49,6 +49,9 @@ class InProcessNode:
         )
         self.full_sync_participation = full_sync_participation
         self.produced_blocks: list = []
+        #: optional BuilderApi (cli --builder-url): when set, _propose
+        #: tries the blinded/builder flow before local building
+        self.builder_api = None
 
     # ------------------------------------------------------------- driving
 
@@ -73,16 +76,81 @@ class InProcessNode:
     def _propose(self, slot: int) -> None:
         self.controller.wait()  # head must reflect everything applied
         snapshot = self.controller.snapshot()
-        signed_block, _post = produce_block(
-            snapshot.head_state,
-            slot,
-            self.cfg,
-            full_sync_participation=self.full_sync_participation,
-            attestations=self._pool_attestations(snapshot, slot),
-        )
+        signed_block = None
+        if self.builder_api is not None and self.builder_api.can_use_builder(
+            self.controller, slot, self.cfg.preset.SLOTS_PER_EPOCH
+        ):
+            aborted, signed_block = self._propose_via_builder(snapshot, slot)
+            if aborted:
+                return  # post-sign failure: never sign a second block
+        if signed_block is None:
+            signed_block, _post = produce_block(
+                snapshot.head_state,
+                slot,
+                self.cfg,
+                full_sync_participation=self.full_sync_participation,
+                attestations=self._pool_attestations(snapshot, slot),
+            )
         self.produced_blocks.append(signed_block)
         self.controller.on_own_block(signed_block)
         self.controller.wait()
+
+    def _propose_via_builder(self, snapshot, slot: int):
+        """Builder flow with the devnet's interop proposer key; returns
+        (aborted, signed_block_or_None). Pre-sign failures fall back to
+        local building; post-sign failures abort the slot (the relay may
+        hold the signature — equivocation risk)."""
+        from grandine_tpu.consensus import accessors, signing
+        from grandine_tpu.transition.slots import process_slots
+        from grandine_tpu.types.combined import fork_namespace, state_phase_of
+        from grandine_tpu.validator import blinded as blinded_mod
+        from grandine_tpu.validator.duties import _interop_keys
+
+        p = self.cfg.preset
+        state = snapshot.head_state
+        try:
+            if int(state.slot) < slot:
+                state = process_slots(state, slot, self.cfg)
+            ns = fork_namespace(self.cfg, state_phase_of(state, self.cfg))
+            proposer = accessors.get_beacon_proposer_index(state, p)
+            key = _interop_keys(proposer)
+            pubkey = key.public_key().to_bytes()
+            bid = self.builder_api.get_execution_payload_header(
+                slot,
+                bytes(state.latest_execution_payload_header.block_hash),
+                pubkey,
+            )
+            header = blinded_mod.header_from_bid(ns, bid["header"])
+            reveal = key.sign(
+                signing.randao_signing_root(
+                    state, accessors.get_current_epoch(state, p), self.cfg
+                )
+            ).to_bytes()
+            block, pre, _post = blinded_mod.produce_blinded_block(
+                state, slot, self.cfg, header, reveal,
+                attestations=self._pool_attestations(snapshot, slot),
+            )
+        except Exception:
+            return False, None  # pre-sign: local fallback is safe
+        try:
+            sig = key.sign(
+                signing.block_signing_root(pre, block, self.cfg)
+            ).to_bytes()
+            signed_blinded = ns.SignedBlindedBeaconBlock(
+                message=block, signature=sig
+            )
+            response = self.builder_api.submit_blinded_block(signed_blinded)
+            raw = response["execution_payload"]
+            payload = ns.ExecutionPayload.deserialize(
+                bytes.fromhex(raw.removeprefix("0x"))
+                if isinstance(raw, str)
+                else bytes(raw)
+            )
+            return False, blinded_mod.unblind_signed_block(
+                signed_blinded, payload, self.cfg
+            )
+        except Exception:
+            return True, None  # post-sign: abort the slot
 
     def _pool_attestations(self, snapshot, slot: int):
         """Previous-slot attestations for inclusion (a stand-in for the

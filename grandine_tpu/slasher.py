@@ -1,26 +1,44 @@
 """Slasher — reference: `slasher` crate (slasher/src/slasher.rs:50:
 surround/double-vote detection over mdbx DBs of indexed attestations and
-min/max target spans, plus proposer double-block detection; emits
+chunked min/max target spans, plus proposer double-block detection; emits
 slashings toward the proposer pipeline).
 
-Detection model (per validator):
-  - double vote:    two distinct attestation data with the same target epoch
-  - surround vote:  recorded (s,t) surrounds or is surrounded by a new one
-  - double block:   two distinct block roots signed for the same slot
+Scale design (the reference's chunked span scheme, numpy-native):
+for validator v and epoch e,
+  min_targets[v][e] = min target among v's attestations with source > e
+  max_targets[v][e] = max target among v's attestations with source < e
+Both are MONOTONE non-decreasing in e (larger e → smaller source set for
+min / larger for max), which makes range updates amortized O(1): walking
+away from the new attestation's source, the update stops at the first
+chunk it doesn't change.
 
-Backed by the Database layer; bounded history window like the reference's
-pruned span DBs.
+Detection per new attestation (s, t) of validator v is O(1) chunk reads:
+  min_targets[v][s] < t  →  the new vote SURROUNDS a recorded one
+  max_targets[v][s] > t  →  the new vote IS SURROUNDED by a recorded one
+  a recorded (v, t) with a different data root  →  double vote
+
+Storage: (VALIDATORS_PER_CHUNK × CHUNK_EPOCHS) uint64 arrays in the K-V
+store (the reference's mdbx chunk tables), an in-memory dirty-chunk cache
+flushed per call, and per-(validator, target) attestation records for
+evidence retrieval.
 """
 
 from __future__ import annotations
 
-import json
 from typing import Optional
+
+import numpy as np
 
 from grandine_tpu.storage.database import Database
 
-_PREFIX_ATT = b"sl:a:"    # validator_index_be8 -> json {target: [source, data_root, sig?]}
-_PREFIX_BLOCK = b"sl:b:"  # validator_index_be8 + slot_be8 -> header root
+CHUNK_EPOCHS = 16
+VALIDATORS_PER_CHUNK = 256
+_UNSET_MIN = np.uint64(0xFFFF_FFFF_FFFF_FFFF)
+
+_PREFIX_MIN = b"sl:m:"    # vchunk_be8 + echunk_be8 -> uint64[VPC, CE]
+_PREFIX_MAX = b"sl:x:"
+_PREFIX_REC = b"sl:r:"    # validator_be8 + target_be8 -> source_be8 + root32
+_PREFIX_BLOCK = b"sl:b:"  # validator_be8 + slot_be8 -> header root
 
 
 class Slashing:
@@ -44,55 +62,177 @@ class Slasher:
         self.db = database or Database.in_memory()
         self.history_epochs = history_epochs
         self.detected: "list[Slashing]" = []
+        #: (kind, vchunk, echunk) -> uint64[VPC, CE]; dirty set flushed
+        #: back to the K-V store at the end of every mutating call
+        self._chunks: "dict[tuple, np.ndarray]" = {}
+        self._dirty: "set[tuple]" = set()
+
+    # ------------------------------------------------------------- chunks
+
+    def _chunk_key(self, kind: str, vchunk: int, echunk: int) -> bytes:
+        prefix = _PREFIX_MIN if kind == "min" else _PREFIX_MAX
+        return prefix + vchunk.to_bytes(8, "big") + echunk.to_bytes(8, "big")
+
+    def _chunk(self, kind: str, vchunk: int, echunk: int) -> np.ndarray:
+        key = (kind, vchunk, echunk)
+        arr = self._chunks.get(key)
+        if arr is None:
+            raw = self.db.get(self._chunk_key(kind, vchunk, echunk))
+            if raw is not None:
+                arr = (
+                    np.frombuffer(bytes(raw), dtype=np.uint64)
+                    .reshape(VALIDATORS_PER_CHUNK, CHUNK_EPOCHS)
+                    .copy()
+                )
+            else:
+                fill = _UNSET_MIN if kind == "min" else np.uint64(0)
+                arr = np.full(
+                    (VALIDATORS_PER_CHUNK, CHUNK_EPOCHS), fill, np.uint64
+                )
+            # bound the cache: evict clean chunks beyond ~4k (64 MB)
+            if len(self._chunks) > 4096:
+                for k in [
+                    k for k in self._chunks if k not in self._dirty
+                ][:1024]:
+                    del self._chunks[k]
+            self._chunks[key] = arr
+        return arr
+
+    def flush(self) -> None:
+        for kind, vchunk, echunk in self._dirty:
+            self.db.put(
+                self._chunk_key(kind, vchunk, echunk),
+                self._chunks[(kind, vchunk, echunk)].tobytes(),
+            )
+        self._dirty.clear()
+
+    # ------------------------------------------------------------ records
+
+    def _rec_key(self, index: int, target: int) -> bytes:
+        return (
+            _PREFIX_REC
+            + int(index).to_bytes(8, "big")
+            + int(target).to_bytes(8, "big")
+        )
+
+    def _record(self, index: int, target: int):
+        raw = self.db.get(self._rec_key(index, target))
+        if raw is None:
+            return None
+        raw = bytes(raw)
+        return int.from_bytes(raw[:8], "big"), raw[8:40]
 
     # -------------------------------------------------------- attestations
-
-    def _key(self, index: int) -> bytes:
-        return _PREFIX_ATT + int(index).to_bytes(8, "big")
-
-    def _records(self, index: int) -> dict:
-        raw = self.db.get(self._key(index))
-        return json.loads(raw) if raw else {}
 
     def on_attestation(
         self, attesting_indices, source_epoch: int, target_epoch: int,
         data_root: bytes,
     ) -> "list[Slashing]":
-        """Record one indexed attestation; returns any detected offenses."""
+        """Record one indexed attestation; returns any detected offenses.
+        Chunk reads/updates are shared across the aggregate's validators."""
+        s, t = int(source_epoch), int(target_epoch)
+        data_root = bytes(data_root)
         out = []
         for i in attesting_indices:
             i = int(i)
-            records = self._records(i)
-            hit = self._check(i, records, source_epoch, target_epoch, data_root)
+            hit = self._check_one(i, s, t, data_root)
             if hit is not None:
                 out.append(hit)
-            records[str(target_epoch)] = [source_epoch, data_root.hex()]
-            # prune outside the history window
-            floor = target_epoch - self.history_epochs
-            for k in [k for k in records if int(k) < floor]:
-                del records[k]
-            self.db.put(self._key(i), json.dumps(records).encode())
+            self.db.put(
+                self._rec_key(i, t),
+                s.to_bytes(8, "big") + data_root,
+            )
+            self._update_spans(i, s, t)
+        self.flush()
         self.detected.extend(out)
         return out
 
-    def _check(self, index, records, source, target, data_root):
-        existing = records.get(str(target))
-        if existing is not None and existing[1] != data_root.hex():
-            return Slashing("double_vote", index, {
-                "target_epoch": target,
-                "roots": [existing[1], data_root.hex()],
+    def _check_one(self, i: int, s: int, t: int, data_root: bytes):
+        existing = self._record(i, t)
+        if existing is not None and existing[1] != data_root:
+            return Slashing("double_vote", i, {
+                "target_epoch": t,
+                "roots": [existing[1].hex(), data_root.hex()],
             })
-        for t_str, (s, root_hex) in records.items():
-            t = int(t_str)
-            if s < source and target < t:
-                return Slashing("surrounded_vote", index, {
-                    "existing": [s, t], "new": [source, target],
-                })
-            if source < s and t < target:
-                return Slashing("surround_vote", index, {
-                    "existing": [s, t], "new": [source, target],
-                })
+        vchunk, row = divmod(i, VALIDATORS_PER_CHUNK)
+        echunk, col = divmod(s, CHUNK_EPOCHS)
+        min_t = int(self._chunk("min", vchunk, echunk)[row, col])
+        if min_t != int(_UNSET_MIN) and min_t < t:
+            rec = self._record(i, min_t)
+            return Slashing("surround_vote", i, {
+                "existing": [rec[0] if rec else -1, min_t],
+                "new": [s, t],
+            })
+        max_t = int(self._chunk("max", vchunk, echunk)[row, col])
+        if max_t > t:
+            rec = self._record(i, max_t)
+            return Slashing("surrounded_vote", i, {
+                "existing": [rec[0] if rec else -1, max_t],
+                "new": [s, t],
+            })
         return None
+
+    def _update_spans(self, i: int, s: int, t: int) -> None:
+        """Amortized range update: min_targets over e ∈ [floor, s),
+        max_targets over e ∈ (s, t], early-exiting on the first unchanged
+        chunk (valid by monotonicity, see module docstring)."""
+        vchunk, row = divmod(i, VALIDATORS_PER_CHUNK)
+        tval = np.uint64(t)
+
+        # ---- min_targets: epochs below the source
+        floor = max(0, s - self.history_epochs)
+        e_hi = s - 1  # inclusive
+        while e_hi >= floor:
+            echunk = e_hi // CHUNK_EPOCHS
+            e_lo = max(floor, echunk * CHUNK_EPOCHS)
+            arr = self._chunk("min", vchunk, echunk)
+            sl = arr[row, e_lo - echunk * CHUNK_EPOCHS : e_hi - echunk * CHUNK_EPOCHS + 1]
+            if not (sl > tval).any():
+                break  # monotone: everything below is already ≤ t
+            np.minimum(sl, tval, out=sl)
+            self._dirty.add(("min", vchunk, echunk))
+            e_hi = e_lo - 1
+
+        # ---- max_targets: epochs above the source, bounded by the target
+        # (an attestation with source past the target cannot be surrounded
+        # by this one — target ≥ source always)
+        e_lo = s + 1
+        while e_lo <= t:
+            echunk = e_lo // CHUNK_EPOCHS
+            e_hi2 = min(t, echunk * CHUNK_EPOCHS + CHUNK_EPOCHS - 1)
+            arr = self._chunk("max", vchunk, echunk)
+            sl = arr[row, e_lo - echunk * CHUNK_EPOCHS : e_hi2 - echunk * CHUNK_EPOCHS + 1]
+            if not (sl < tval).any():
+                break  # monotone: everything above is already ≥ t
+            np.maximum(sl, tval, out=sl)
+            self._dirty.add(("max", vchunk, echunk))
+            e_lo = e_hi2 + 1
+
+    # ------------------------------------------------------------- pruning
+
+    def prune(self, finalized_epoch: int) -> int:
+        """Drop span chunks and records wholly below the history window
+        (the reference prunes its span DBs at finalization)."""
+        floor = max(0, finalized_epoch - self.history_epochs)
+        floor_chunk = floor // CHUNK_EPOCHS
+        dropped = 0
+        for prefix in (_PREFIX_MIN, _PREFIX_MAX):
+            for key, _ in list(self.db.iterate_prefix(prefix)):
+                echunk = int.from_bytes(key[len(prefix) + 8 :], "big")
+                if echunk < floor_chunk:
+                    self.db.delete(key)
+                    dropped += 1
+        for key, _ in list(self.db.iterate_prefix(_PREFIX_REC)):
+            target = int.from_bytes(key[len(_PREFIX_REC) + 8 :], "big")
+            if target < floor:
+                self.db.delete(key)
+                dropped += 1
+        self._chunks = {
+            k: v
+            for k, v in self._chunks.items()
+            if k[2] >= floor_chunk or k in self._dirty
+        }
+        return dropped
 
     # -------------------------------------------------------------- blocks
 
@@ -117,4 +257,4 @@ class Slasher:
         return out
 
 
-__all__ = ["Slasher", "Slashing"]
+__all__ = ["Slasher", "Slashing", "CHUNK_EPOCHS", "VALIDATORS_PER_CHUNK"]

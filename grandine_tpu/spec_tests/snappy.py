@@ -36,11 +36,22 @@ def _crc32c_table():
 _TABLE = _crc32c_table()
 
 
-def _crc32c(data: bytes) -> int:
+def _crc32c_py(data: bytes) -> int:
     crc = 0xFFFFFFFF
     for b in data:
         crc = _TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
     return crc ^ 0xFFFFFFFF
+
+
+def _crc32c(data: bytes) -> int:
+    """CRC-32C via the native extension (SSE4.2 hardware instruction)
+    when available; table-driven Python fallback otherwise. Every DB put
+    runs through here, so the native path is load-bearing at scale."""
+    from grandine_tpu import native
+
+    if native.lib is not None:
+        return native.lib.gt_crc32c(bytes(data), len(data))
+    return _crc32c_py(data)
 
 
 def _masked_crc(data: bytes) -> int:

@@ -103,7 +103,7 @@ def _registry_pubkey(state, index: int):
     cols = accessors.registry_columns(state)
     if index >= len(cols):
         raise TransitionError(f"validator index {index} out of range")
-    return decompress_pubkey(cols.pubkeys[index])
+    return decompress_pubkey(cols.pubkeys[index], trusted=True)
 
 
 # ============================================================= block header
@@ -556,13 +556,17 @@ def _is_merge_transition_complete(state) -> bool:
 def process_withdrawals(draft: StateDraft, payload, types_ns) -> None:
     """Capella `process_withdrawals`: sweep, compare against payload, debit."""
     state = object.__getattribute__(draft, "base")
-    p = draft.p
     expected = get_expected_withdrawals(state, draft, types_ns)
     got = list(payload.withdrawals)
     _require(
         len(got) == len(expected) and all(a == b for a, b in zip(got, expected)),
         "withdrawals: payload does not match expected sweep",
     )
+    _apply_withdrawals_sweep(draft, state, expected)
+
+
+def _apply_withdrawals_sweep(draft: StateDraft, state, expected) -> None:
+    p = draft.p
     for w in expected:
         mutators.decrease_balance(draft, int(w.validator_index), int(w.amount))
     if expected:
@@ -658,7 +662,18 @@ def process_execution_payload(
         f"payload rejected by execution engine: {status}",
     )
 
-    header_fields = dict(
+    draft.set(
+        "latest_execution_payload_header",
+        types_ns.ExecutionPayloadHeader(
+            **payload_header_fields(payload, phase)
+        ),
+    )
+
+
+def payload_header_fields(payload, phase: Phase) -> dict:
+    """ExecutionPayload → ExecutionPayloadHeader field dict (shared by
+    payload processing and the builder/blinded flow)."""
+    fields = dict(
         parent_hash=bytes(payload.parent_hash),
         fee_recipient=bytes(payload.fee_recipient),
         state_root=bytes(payload.state_root),
@@ -675,14 +690,86 @@ def process_execution_payload(
         transactions_root=payload.transactions.hash_tree_root(),
     )
     if phase >= Phase.CAPELLA:
-        header_fields["withdrawals_root"] = payload.withdrawals.hash_tree_root()
+        fields["withdrawals_root"] = payload.withdrawals.hash_tree_root()
     if phase >= Phase.DENEB:
-        header_fields["blob_gas_used"] = int(payload.blob_gas_used)
-        header_fields["excess_blob_gas"] = int(payload.excess_blob_gas)
-    draft.set(
-        "latest_execution_payload_header",
-        types_ns.ExecutionPayloadHeader(**header_fields),
+        fields["blob_gas_used"] = int(payload.blob_gas_used)
+        fields["excess_blob_gas"] = int(payload.excess_blob_gas)
+    return fields
+
+
+# ============================================================ blinded block
+# reference: transition_functions/src/*/blinded_block_processing.rs — the
+# builder flow's transition: the block carries an ExecutionPayloadHeader
+# instead of the payload; consistency checks run against the header and
+# it is stored as-is (the EL sees the payload after unblinding).
+
+
+def process_blinded_execution_payload(
+    draft: StateDraft, body, cfg, phase: Phase, types_ns
+) -> None:
+    state = object.__getattribute__(draft, "base")
+    p = draft.p
+    header = body.execution_payload_header
+    if phase >= Phase.CAPELLA or _is_merge_transition_complete(state):
+        _require(
+            bytes(header.parent_hash)
+            == bytes(state.latest_execution_payload_header.block_hash),
+            "blinded payload: parent hash mismatch",
+        )
+    _require(
+        bytes(header.prev_randao)
+        == misc.get_randao_mix(state, accessors.get_current_epoch(state, p), p),
+        "blinded payload: prev_randao mismatch",
     )
+    expected_ts = int(state.genesis_time) + int(state.slot) * cfg.seconds_per_slot
+    _require(int(header.timestamp) == expected_ts, "blinded payload: bad timestamp")
+    if phase >= Phase.DENEB:
+        _require(
+            len(body.blob_kzg_commitments) <= p.MAX_BLOBS_PER_BLOCK,
+            "too many blob commitments",
+        )
+    draft.set("latest_execution_payload_header", header)
+
+
+def process_blinded_withdrawals(draft: StateDraft, header, types_ns) -> None:
+    """Capella blinded withdrawals: the block carries only the
+    withdrawals_root; verify it equals the expected sweep's root, then
+    apply the sweep's debits."""
+    state = object.__getattribute__(draft, "base")
+    expected = get_expected_withdrawals(state, draft, types_ns)
+    withdrawals_type = None
+    for name, typ in types_ns.ExecutionPayload.FIELDS:
+        if name == "withdrawals":
+            withdrawals_type = typ
+            break
+    _require(withdrawals_type is not None, "no withdrawals field in payload")
+    expected_root = withdrawals_type.hash_tree_root(
+        withdrawals_type.coerce(expected)
+    )
+    _require(
+        bytes(header.withdrawals_root) == expected_root,
+        "blinded withdrawals: root does not match expected sweep",
+    )
+    _apply_withdrawals_sweep(draft, state, expected)
+
+
+def process_blinded_block(
+    draft: StateDraft, block, cfg, phase: Phase, types_ns
+) -> None:
+    """process_block for a BlindedBeaconBlock (blinded_block_processing.rs):
+    identical except the payload half runs against the header."""
+    _require(phase >= Phase.BELLATRIX, "blinded blocks require bellatrix")
+    process_block_header(draft, block)
+    body = block.body
+    if phase >= Phase.CAPELLA:
+        process_blinded_withdrawals(
+            draft, body.execution_payload_header, types_ns
+        )
+    process_blinded_execution_payload(draft, body, cfg, phase, types_ns)
+    process_randao(draft, body)
+    process_eth1_data(draft, body)
+    process_operations(draft, body, cfg, phase, types_ns)
+    process_sync_aggregate(draft, body.sync_aggregate)
 
 
 # ================================================================ full block

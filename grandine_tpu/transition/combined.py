@@ -99,6 +99,50 @@ def custom_state_transition(
     return post
 
 
+def blinded_state_transition(
+    state,
+    signed_blinded_block,
+    cfg,
+    verifier: "Optional[Verifier]" = None,
+    state_root_policy: str = "verify",
+):
+    """State transition over a SignedBlindedBeaconBlock (reference
+    transition_functions blinded_block_processing): signature collection
+    is identical (a blinded body carries the same signed operations); the
+    payload half runs against the ExecutionPayloadHeader."""
+    if verifier is None:
+        verifier = MultiVerifier()
+    block = signed_blinded_block.message
+    slot = int(block.slot)
+    if int(state.slot) < slot:
+        state = process_slots(state, slot, cfg)
+    phase = state_phase(state, cfg)
+    ns = getattr(spec_types(cfg.preset), phase.key)
+
+    block_mod.collect_signatures(
+        state, signed_blinded_block, verifier, cfg, phase
+    )
+    settle = verifier.finish_async()
+    draft = StateDraft(state, cfg)
+    process_error: "Optional[Exception]" = None
+    try:
+        block_mod.process_blinded_block(draft, block, cfg, phase, ns)
+    except Exception as e:
+        process_error = e
+    settle()
+    if process_error is not None:
+        raise process_error
+    post = draft.commit()
+    if state_root_policy == "verify":
+        expected = bytes(block.state_root)
+        actual = post.hash_tree_root()
+        if actual != expected:
+            raise StateRootMismatch(
+                f"state root {actual.hex()} != block.state_root {expected.hex()}"
+            )
+    return post
+
+
 def state_transition(state, signed_block, cfg, verifier=None, **kw):
     """Alias of custom_state_transition (per-fork dispatch is internal)."""
     return custom_state_transition(state, signed_block, cfg, verifier, **kw)
@@ -124,6 +168,7 @@ __all__ = [
     "StateRootMismatch",
     "verify_signatures",
     "custom_state_transition",
+    "blinded_state_transition",
     "state_transition",
     "untrusted_state_transition",
     "trusted_state_transition",

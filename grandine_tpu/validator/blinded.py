@@ -1,0 +1,178 @@
+"""Blinded block production + unblinding — reference:
+validator/src/validator.rs:948,3091-3104 (builder path of propose:
+getHeader → build blinded block → sign → submitBlindedBlock → unblind
+and publish the full block) over the builder_api crate.
+
+`produce_blinded_block` mirrors duties.produce_block_unsigned with the
+relay's ExecutionPayloadHeader in place of a local payload;
+`unblind_signed_block` grafts the relay-returned payload back into a
+full SignedBeaconBlock, verifying it matches the committed header.
+"""
+
+from __future__ import annotations
+
+from grandine_tpu.consensus import accessors, signing
+from grandine_tpu.transition.block import payload_header_fields
+from grandine_tpu.transition.combined import blinded_state_transition
+from grandine_tpu.transition.fork_upgrade import state_phase
+from grandine_tpu.transition.slots import process_slots
+from grandine_tpu.types.containers import spec_types
+from grandine_tpu.types.primitives import Phase
+
+
+class UnblindError(Exception):
+    pass
+
+
+def header_from_bid(ns, bid_header: dict):
+    """builder-specs bid header JSON → ExecutionPayloadHeader. The
+    conversion is driven by each FIELD's SSZ type, not the JSON value's
+    Python type — builder-specs serializes uint64 fields as DECIMAL
+    strings ("30000000"), which must parse as ints, never as hex."""
+    from grandine_tpu.ssz.base import UInt
+
+    fields = {}
+    for name, typ in ns.ExecutionPayloadHeader.FIELDS:
+        if name not in bid_header:
+            raise KeyError(f"bid header missing {name}")
+        v = bid_header[name]
+        if isinstance(typ, UInt):
+            fields[name] = int(v)
+        else:
+            fields[name] = bytes.fromhex(str(v).removeprefix("0x"))
+    return ns.ExecutionPayloadHeader(**fields)
+
+
+def header_to_bid(header) -> dict:
+    """ExecutionPayloadHeader → builder-specs bid header JSON (hex for
+    byte fields, decimal strings for uints — the wire format a real
+    relay serves)."""
+    from grandine_tpu.ssz.base import UInt
+
+    out = {}
+    for name, typ in type(header).FIELDS:
+        v = getattr(header, name)
+        if isinstance(typ, UInt):
+            out[name] = str(int(v))
+        else:
+            out[name] = "0x" + bytes(v).hex()
+    return out
+
+
+def produce_blinded_block(
+    state,
+    slot: int,
+    cfg,
+    payload_header,
+    randao_reveal: bytes,
+    attestations=(),
+    sync_aggregate=None,
+    graffiti: bytes = b"",
+    proposer_slashings=(),
+    attester_slashings=(),
+    voluntary_exits=(),
+    bls_to_execution_changes=(),
+):
+    """Unsigned BlindedBeaconBlock on `state` with the relay's payload
+    header; returns (blinded_block, pre_state, post_state)."""
+    from grandine_tpu.consensus.verifier import NullVerifier
+    from grandine_tpu.validator.duties import empty_sync_aggregate
+
+    p = cfg.preset
+    if int(state.slot) < slot:
+        state = process_slots(state, slot, cfg)
+    phase = state_phase(state, cfg)
+    if phase < Phase.BELLATRIX:
+        raise ValueError("blinded blocks require bellatrix")
+    ns = getattr(spec_types(p), phase.key)
+    proposer_index = accessors.get_beacon_proposer_index(state, p)
+
+    body_fields = dict(
+        randao_reveal=bytes(randao_reveal),
+        eth1_data=state.eth1_data,
+        graffiti=graffiti.ljust(32, b"\x00")[:32],
+        proposer_slashings=proposer_slashings,
+        attester_slashings=attester_slashings,
+        attestations=attestations,
+        deposits=[],
+        voluntary_exits=voluntary_exits,
+        sync_aggregate=sync_aggregate
+        if sync_aggregate is not None
+        else empty_sync_aggregate(state, cfg),
+        execution_payload_header=payload_header,
+    )
+    if phase >= Phase.CAPELLA:
+        body_fields["bls_to_execution_changes"] = bls_to_execution_changes
+
+    body = ns.BlindedBeaconBlockBody(**body_fields)
+    block = ns.BlindedBeaconBlock(
+        slot=slot,
+        proposer_index=proposer_index,
+        parent_root=state.latest_block_header.replace(
+            state_root=(
+                state.hash_tree_root()
+                if bytes(state.latest_block_header.state_root) == b"\x00" * 32
+                else bytes(state.latest_block_header.state_root)
+            )
+        ).hash_tree_root(),
+        state_root=b"\x00" * 32,
+        body=body,
+    )
+    post = blinded_state_transition(
+        state,
+        ns.SignedBlindedBeaconBlock(message=block),
+        cfg,
+        NullVerifier(),
+        state_root_policy="trust",
+    )
+    block = block.replace(state_root=post.hash_tree_root())
+    return block, state, post
+
+
+def unblind_signed_block(signed_blinded_block, execution_payload, cfg):
+    """SignedBlindedBeaconBlock + relay payload → full SignedBeaconBlock
+    (validator.rs:3091-3104). The payload must hash to the header the
+    proposer committed to — a mismatching relay response is rejected."""
+    block = signed_blinded_block.message
+    phase = cfg.phase_at_slot(int(block.slot))
+    ns = getattr(spec_types(cfg.preset), phase.key)
+    committed = block.body.execution_payload_header
+    derived = ns.ExecutionPayloadHeader(
+        **payload_header_fields(execution_payload, phase)
+    )
+    if derived.hash_tree_root() != committed.hash_tree_root():
+        raise UnblindError(
+            "relay payload does not match the committed header"
+        )
+    body_fields = {
+        name: getattr(block.body, name)
+        for name, _ in ns.BlindedBeaconBlockBody.FIELDS
+        if name != "execution_payload_header"
+    }
+    body_fields["execution_payload"] = execution_payload
+    full_block = ns.BeaconBlock(
+        slot=int(block.slot),
+        proposer_index=int(block.proposer_index),
+        parent_root=bytes(block.parent_root),
+        state_root=bytes(block.state_root),
+        body=ns.BeaconBlockBody(**body_fields),
+    )
+    return ns.SignedBeaconBlock(
+        message=full_block, signature=bytes(signed_blinded_block.signature)
+    )
+
+
+def blinded_block_signing_root(state, blinded_block, cfg) -> bytes:
+    """Same domain as a full block (DOMAIN_BEACON_PROPOSER over the
+    blinded block's root)."""
+    return signing.block_signing_root(state, blinded_block, cfg)
+
+
+__all__ = [
+    "UnblindError",
+    "header_from_bid",
+    "header_to_bid",
+    "produce_blinded_block",
+    "unblind_signed_block",
+    "blinded_block_signing_root",
+]

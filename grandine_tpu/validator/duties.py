@@ -183,6 +183,7 @@ def produce_block_unsigned(
     attester_slashings: "Sequence" = (),
     bls_to_execution_changes: "Sequence" = (),
     graffiti: bytes = b"",
+    sync_aggregate=None,
 ):
     """Build an UNSIGNED BeaconBlock for `slot` with a caller-provided
     `randao_reveal` — the Beacon API produce-block path
@@ -210,11 +211,14 @@ def produce_block_unsigned(
         voluntary_exits=voluntary_exits,
     )
     if phase >= Phase.ALTAIR:
-        body_fields["sync_aggregate"] = (
-            produce_sync_aggregate(state, cfg, keys)
-            if full_sync_participation
-            else empty_sync_aggregate(state, cfg)
-        )
+        if sync_aggregate is not None:
+            body_fields["sync_aggregate"] = sync_aggregate
+        else:
+            body_fields["sync_aggregate"] = (
+                produce_sync_aggregate(state, cfg, keys)
+                if full_sync_participation
+                else empty_sync_aggregate(state, cfg)
+            )
     if phase >= Phase.BELLATRIX:
         body_fields["execution_payload"] = build_matching_payload(
             state, cfg, ns, phase

@@ -23,6 +23,12 @@ from grandine_tpu.validator.slashing_protection import (
 )
 
 
+class _PostSignFailure(Exception):
+    """Builder flow failed AFTER the blinded block was signed — the relay
+    may hold a valid signature, so no other block may be signed for this
+    slot."""
+
+
 class ValidatorService:
     """Drives duties for every key in the signer registry."""
 
@@ -38,6 +44,7 @@ class ValidatorService:
         eth1_cache=None,
         network=None,
         subnet_service=None,
+        builder_api=None,
     ) -> None:
         self.controller = controller
         self.signer = signer
@@ -50,6 +57,7 @@ class ValidatorService:
         self.eth1_cache = eth1_cache
         self.network = network
         self.subnet_service = subnet_service
+        self.builder_api = builder_api
         self.stats = {"proposed": 0, "attested": 0, "aggregated": 0,
                       "slashing_refusals": 0}
 
@@ -94,6 +102,40 @@ class ValidatorService:
             return None
 
         from grandine_tpu.eth1 import DepositCacheError
+
+        # builder (MEV) path first when configured and the circuit
+        # breaker allows (validator.rs:948 builder-vs-local selection).
+        # Fallback to local building is ONLY safe before the blinded
+        # block is signed: once a signature exists the relay may hold
+        # (and publish) it, and signing a second, different block for
+        # the same slot is a slashable equivocation — post-sign failures
+        # abort the proposal instead.
+        if self.builder_api is not None and self.builder_api.can_use_builder(
+            self.controller, slot, self.p.SLOTS_PER_EPOCH
+        ):
+            try:
+                signed_block = self._build_blinded_block(
+                    pre, slot, proposer_index, pubkey
+                )
+            except _PostSignFailure:
+                self.stats["builder_aborts"] = (
+                    self.stats.get("builder_aborts", 0) + 1
+                )
+                return None
+            except Exception:
+                signed_block = None
+                self.stats["builder_fallbacks"] = (
+                    self.stats.get("builder_fallbacks", 0) + 1
+                )
+            if signed_block is not None:
+                self.controller.on_own_block(signed_block)
+                if self.network is not None:
+                    self.network.publish_block(signed_block)
+                self.stats["proposed"] += 1
+                self.stats["builder_blocks"] = (
+                    self.stats.get("builder_blocks", 0) + 1
+                )
+                return signed_block
 
         try:
             signed_block = self._build_block(pre, slot, proposer_index, pubkey)
@@ -206,6 +248,73 @@ class ValidatorService:
             pubkey, signing.block_signing_root(pre, block, self.cfg)
         )
         return ns.SignedBeaconBlock(message=block, signature=sig)
+
+    def _build_blinded_block(
+        self, pre, slot: int, proposer_index: int, pubkey: bytes
+    ):
+        """Builder flow (validator.rs:3091-3104): getHeader → blinded
+        block → sign → submitBlindedBlock → unblind. Returns the FULL
+        SignedBeaconBlock (the blinded and full block share one signing
+        root, so the signature carries over)."""
+        from grandine_tpu.validator import blinded as blinded_mod
+
+        phase = state_phase(pre, self.cfg)
+        ns = getattr(spec_types(self.p), phase.key)
+        if int(pre.slot) < slot:
+            pre = process_slots(pre, slot, self.cfg)
+        parent_hash = bytes(pre.latest_execution_payload_header.block_hash)
+        bid = self.builder_api.get_execution_payload_header(
+            slot, parent_hash, pubkey
+        )
+        header = blinded_mod.header_from_bid(ns, bid["header"])
+        epoch = accessors.get_current_epoch(pre, self.p)
+        reveal = self.signer.sign(
+            pubkey, signing.randao_signing_root(pre, epoch, self.cfg)
+        )
+        attestations = (
+            self.attestation_pool.pack_attestations(pre, self.cfg, slot=slot)
+            if self.attestation_pool is not None
+            else []
+        )
+        ops = (
+            self.operation_pool.pack(pre)
+            if self.operation_pool is not None
+            else {}
+        )
+        block, pre2, _post = blinded_mod.produce_blinded_block(
+            pre,
+            slot,
+            self.cfg,
+            header,
+            reveal,
+            attestations=attestations,
+            proposer_slashings=ops.get("proposer_slashings", ()),
+            attester_slashings=ops.get("attester_slashings", ()),
+            voluntary_exits=ops.get("voluntary_exits", ()),
+            bls_to_execution_changes=ops.get("bls_to_execution_changes", ()),
+        )
+        # ---- point of no return: from the signature on, a failure must
+        # NOT fall back to local building (equivocation risk)
+        try:
+            sig = self.signer.sign(
+                pubkey, signing.block_signing_root(pre2, block, self.cfg)
+            )
+            signed_blinded = ns.SignedBlindedBeaconBlock(
+                message=block, signature=sig
+            )
+            response = self.builder_api.submit_blinded_block(signed_blinded)
+            payload = ns.ExecutionPayload.deserialize(
+                bytes.fromhex(
+                    response["execution_payload"].removeprefix("0x")
+                )
+                if isinstance(response["execution_payload"], str)
+                else bytes(response["execution_payload"])
+            )
+            return blinded_mod.unblind_signed_block(
+                signed_blinded, payload, self.cfg
+            )
+        except Exception as e:
+            raise _PostSignFailure(repr(e)) from e
 
     # -- attest -------------------------------------------------------------
 

@@ -1,0 +1,301 @@
+"""Blinded-block flow tests — reference: transition_functions/src/*/
+blinded_block_processing.rs and validator.rs:948,3091-3104 (builder path).
+"""
+
+import pytest
+
+from grandine_tpu.consensus.verifier import NullVerifier
+from grandine_tpu.transition.block import payload_header_fields
+from grandine_tpu.transition.combined import (
+    blinded_state_transition,
+    custom_state_transition,
+)
+from grandine_tpu.transition.fork_upgrade import state_phase
+from grandine_tpu.transition.genesis import interop_genesis_state
+from grandine_tpu.transition.slots import process_slots
+from grandine_tpu.types.config import Config
+from grandine_tpu.types.containers import spec_types
+from grandine_tpu.validator.blinded import (
+    UnblindError,
+    header_from_bid,
+    header_to_bid,
+    produce_blinded_block,
+    unblind_signed_block,
+)
+from grandine_tpu.validator.duties import (
+    _interop_keys,
+    build_matching_payload,
+    produce_block,
+)
+
+CFG = Config.minimal()
+P = CFG.preset
+NS = spec_types(P).deneb
+
+
+def matching_header(state, slot):
+    """ExecutionPayloadHeader consistent with the slot-advanced state
+    (what an honest relay would bid)."""
+    advanced = (
+        process_slots(state, slot, CFG) if int(state.slot) < slot else state
+    )
+    phase = state_phase(advanced, CFG)
+    payload = build_matching_payload(advanced, CFG, NS, phase)
+    return (
+        NS.ExecutionPayloadHeader(**payload_header_fields(payload, phase)),
+        payload,
+        advanced,
+    )
+
+
+def signed_blinded(state, slot, **kw):
+    from grandine_tpu.consensus import accessors, signing
+
+    header, payload, advanced = matching_header(state, slot)
+    proposer = accessors.get_beacon_proposer_index(advanced, P)
+    key = _interop_keys(proposer)
+    reveal = key.sign(
+        signing.randao_signing_root(
+            advanced, accessors.get_current_epoch(advanced, P), CFG
+        )
+    ).to_bytes()
+    block, pre, post = produce_blinded_block(
+        advanced, slot, CFG, header, reveal, **kw
+    )
+    sig = key.sign(signing.block_signing_root(pre, block, CFG)).to_bytes()
+    return (
+        NS.SignedBlindedBeaconBlock(message=block, signature=sig),
+        payload,
+        post,
+    )
+
+
+def test_blinded_transition_roundtrip():
+    genesis = interop_genesis_state(16, CFG)
+    sb, payload, post = signed_blinded(genesis, 1)
+    # the blinded transition verifies the state root end-to-end
+    post2 = blinded_state_transition(genesis, sb, CFG, NullVerifier())
+    assert post2.hash_tree_root() == post.hash_tree_root()
+    # header was stored as-is
+    assert bytes(post2.latest_execution_payload_header.block_hash) == bytes(
+        payload.block_hash
+    )
+
+
+def test_blinded_and_full_block_share_signing_root():
+    """HTR(ExecutionPayload) == HTR(ExecutionPayloadHeader) by design, so
+    the blinded and unblinded blocks have one root — the signature made
+    over the blinded block covers the published full block."""
+    genesis = interop_genesis_state(16, CFG)
+    sb, payload, _post = signed_blinded(genesis, 1)
+    full = unblind_signed_block(sb, payload, CFG)
+    assert full.message.hash_tree_root() == sb.message.hash_tree_root()
+    # and the full block passes the normal transition with sig checks off
+    post = custom_state_transition(
+        genesis, full, CFG, NullVerifier(), state_root_policy="verify"
+    )
+    assert int(post.slot) == 1
+
+
+def test_unblind_rejects_mismatched_payload():
+    genesis = interop_genesis_state(16, CFG)
+    sb, payload, _post = signed_blinded(genesis, 1)
+    tampered = payload.replace(block_hash=b"\x66" * 32)
+    with pytest.raises(UnblindError):
+        unblind_signed_block(sb, tampered, CFG)
+
+
+def test_bid_header_json_roundtrip():
+    genesis = interop_genesis_state(16, CFG)
+    header, _payload, _adv = matching_header(genesis, 1)
+    assert header_from_bid(
+        NS, header_to_bid(header)
+    ).hash_tree_root() == header.hash_tree_root()
+
+
+def test_blinded_transition_rejects_wrong_parent_hash():
+    genesis = interop_genesis_state(16, CFG)
+    header, _payload, advanced = matching_header(genesis, 1)
+    bad = header.replace(parent_hash=b"\x13" * 32)
+    from grandine_tpu.transition.block import TransitionError
+
+    with pytest.raises((TransitionError, Exception)) as exc:
+        produce_blinded_block(
+            advanced, 1, CFG, bad, b"\x00" * 96
+        )
+    assert "parent hash" in str(exc.value)
+
+
+def test_validator_service_builder_path():
+    """End-to-end: the service proposes through a mock relay, the relay
+    unblinds, the full block lands in fork choice."""
+    from grandine_tpu.builder_api import BuilderApi
+    from grandine_tpu.fork_choice.store import Tick, TickKind
+    from grandine_tpu.runtime import Controller
+    from grandine_tpu.types.combined import fork_namespace, state_phase_of
+    from grandine_tpu.validator.service import ValidatorService
+    from grandine_tpu.validator.signer import Signer
+
+    genesis = interop_genesis_state(16, CFG)
+    ctrl = Controller(genesis, CFG, verifier_factory=NullVerifier)
+    payload_by_hash = {}
+
+    def relay(method, params):
+        if method == "get_header":
+            slot = params["slot"]
+            state = ctrl.state_at_slot(slot)
+            phase = state_phase_of(state, CFG)
+            ns = fork_namespace(CFG, phase)
+            payload = build_matching_payload(state, CFG, ns, phase)
+            header = ns.ExecutionPayloadHeader(
+                **payload_header_fields(payload, phase)
+            )
+            payload_by_hash[bytes(payload.block_hash)] = payload
+            return {"header": header_to_bid(header), "value": "1000"}
+        if method == "submit_blinded_block":
+            from grandine_tpu.types.combined import decode_signed_block
+
+            # recover the committed block hash from the blinded SSZ: the
+            # mock keys payloads by hash instead of re-parsing the block
+            for payload in payload_by_hash.values():
+                return {
+                    "execution_payload": "0x" + payload.serialize().hex()
+                }
+        raise AssertionError(method)
+
+    signer = Signer()
+    for i in range(16):
+        signer.add_key(_interop_keys(i))
+    service = ValidatorService(
+        ctrl, signer, CFG, builder_api=BuilderApi(relay)
+    )
+    try:
+        ctrl.on_tick(Tick(1, TickKind.PROPOSE))
+        ctrl.wait()
+        block = service.maybe_propose(1)
+        assert block is not None
+        ctrl.wait()
+        assert service.stats.get("builder_blocks") == 1
+        assert ctrl.snapshot().head_root == block.message.hash_tree_root()
+        # full (unblinded) body on the wire
+        assert hasattr(block.message.body, "execution_payload")
+    finally:
+        ctrl.stop()
+
+
+def test_builder_falls_back_to_local_on_relay_error():
+    from grandine_tpu.builder_api import BuilderApi
+    from grandine_tpu.fork_choice.store import Tick, TickKind
+    from grandine_tpu.runtime import Controller
+    from grandine_tpu.validator.service import ValidatorService
+    from grandine_tpu.validator.signer import Signer
+
+    genesis = interop_genesis_state(16, CFG)
+    ctrl = Controller(genesis, CFG, verifier_factory=NullVerifier)
+
+    def broken_relay(method, params):
+        raise ConnectionError("relay down")
+
+    signer = Signer()
+    for i in range(16):
+        signer.add_key(_interop_keys(i))
+    service = ValidatorService(
+        ctrl, signer, CFG, builder_api=BuilderApi(broken_relay)
+    )
+    try:
+        ctrl.on_tick(Tick(1, TickKind.PROPOSE))
+        ctrl.wait()
+        block = service.maybe_propose(1)
+        assert block is not None  # local path produced
+        assert service.stats.get("builder_fallbacks") == 1
+        assert service.stats.get("builder_blocks") is None
+    finally:
+        ctrl.stop()
+
+
+def test_builder_aborts_after_sign_no_equivocation():
+    """A failure AFTER the blinded block is signed (relay may hold the
+    signature) must abort the proposal — falling back to local building
+    would sign a second block for the slot (slashable)."""
+    from grandine_tpu.builder_api import BuilderApi
+    from grandine_tpu.fork_choice.store import Tick, TickKind
+    from grandine_tpu.runtime import Controller
+    from grandine_tpu.types.combined import fork_namespace, state_phase_of
+    from grandine_tpu.validator.service import ValidatorService
+    from grandine_tpu.validator.signer import Signer
+
+    genesis = interop_genesis_state(16, CFG)
+    ctrl = Controller(genesis, CFG, verifier_factory=NullVerifier)
+
+    def relay(method, params):
+        if method == "get_header":
+            slot = params["slot"]
+            state = ctrl.state_at_slot(slot)
+            phase = state_phase_of(state, CFG)
+            ns = fork_namespace(CFG, phase)
+            payload = build_matching_payload(state, CFG, ns, phase)
+            header = ns.ExecutionPayloadHeader(
+                **payload_header_fields(payload, phase)
+            )
+            return {"header": header_to_bid(header), "value": "1"}
+        raise ConnectionError("relay died at submit")  # post-sign failure
+
+    signer = Signer()
+    for i in range(16):
+        signer.add_key(_interop_keys(i))
+    service = ValidatorService(
+        ctrl, signer, CFG, builder_api=BuilderApi(relay)
+    )
+    try:
+        ctrl.on_tick(Tick(1, TickKind.PROPOSE))
+        ctrl.wait()
+        block = service.maybe_propose(1)
+        assert block is None  # aborted, NOT locally rebuilt
+        assert service.stats.get("builder_aborts") == 1
+        assert service.stats.get("builder_fallbacks") is None
+        assert service.stats["proposed"] == 0
+    finally:
+        ctrl.stop()
+
+
+def test_in_process_node_proposes_via_builder():
+    """The devnet node (cli --builder-url wiring) proposes through the
+    relay: produced blocks carry the relay's payload."""
+    from grandine_tpu.builder_api import BuilderApi
+    from grandine_tpu.runtime.node import InProcessNode
+    from grandine_tpu.types.combined import fork_namespace, state_phase_of
+
+    genesis = interop_genesis_state(16, CFG)
+    payloads = {}
+
+    with InProcessNode(genesis, CFG) as node:
+        def relay(method, params):
+            if method == "get_header":
+                slot = params["slot"]
+                state = node.controller.state_at_slot(slot)
+                phase = state_phase_of(state, CFG)
+                ns = fork_namespace(CFG, phase)
+                payload = build_matching_payload(state, CFG, ns, phase)
+                header = ns.ExecutionPayloadHeader(
+                    **payload_header_fields(payload, phase)
+                )
+                payloads[slot] = payload
+                return {"header": header_to_bid(header), "value": "9"}
+            if method == "submit_blinded_block":
+                payload = payloads[max(payloads)]
+                return {"execution_payload": "0x" + payload.serialize().hex()}
+            raise AssertionError(method)
+
+        node.builder_api = BuilderApi(relay)
+        node.run_slot(1, attest=False)
+        assert node.builder_api.stats["headers"] == 1
+        assert node.builder_api.stats["submissions"] == 1
+        assert len(node.produced_blocks) == 1
+        head = node.head()
+        assert head.head_root == (
+            node.produced_blocks[0].message.hash_tree_root()
+        )
+        # the applied block carries the relay's payload block hash
+        assert bytes(
+            head.head_state.latest_execution_payload_header.block_hash
+        ) == bytes(payloads[1].block_hash)

@@ -86,3 +86,45 @@ def test_clear_cofactor_g2_lands_in_subgroup():
     cleared = clear_cofactor_g2(pt)
     assert cleared.is_on_curve()
     assert cleared.mul(constants.R).is_infinity()
+
+
+# ------------------------------------------- fast subgroup-check criteria
+
+
+def test_fast_subgroup_checks_match_scalar_anchor():
+    """The φ/ψ endomorphism subgroup criteria (Bowe; what blst ships)
+    must agree with the full [r]·P anchor — positives and negatives."""
+    from grandine_tpu.crypto import constants
+    from grandine_tpu.crypto.curves import G1, G2
+    from grandine_tpu.crypto.hash_to_curve import (
+        hash_to_field_fq2,
+        map_to_curve_g2,
+    )
+
+    for k in (1, 2, 7, 0xDEADBEEF, constants.R - 1):
+        for point in (G1.mul(k), G2.mul(k)):
+            assert point.in_subgroup()
+            assert point.in_subgroup_slow()
+    # pre-cofactor SSWU outputs are on-curve but NOT in the subgroup
+    for i in range(3):
+        u = hash_to_field_fq2(b"neg-%d" % i, b"SUBGROUP-TEST", 1)[0]
+        raw = map_to_curve_g2(u)
+        assert raw.is_on_curve()
+        assert raw.in_subgroup() == raw.in_subgroup_slow() == False  # noqa: E712
+
+
+def test_fast_cofactor_clearing_matches_h_eff():
+    from grandine_tpu.crypto import constants
+    from grandine_tpu.crypto.curves import G2, clear_cofactor_g2
+    from grandine_tpu.crypto.hash_to_curve import (
+        hash_to_field_fq2,
+        map_to_curve_g2,
+    )
+
+    for i in range(3):
+        u = hash_to_field_fq2(b"clear-%d" % i, b"CLEAR-TEST", 1)[0]
+        raw = map_to_curve_g2(u)
+        fast = clear_cofactor_g2(raw)
+        slow = raw.mul(constants.H_EFF_G2)
+        assert fast.to_affine() == slow.to_affine()
+        assert fast.in_subgroup()

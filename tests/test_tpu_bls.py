@@ -104,3 +104,26 @@ def test_batch_sign_matches_anchor(backend, keys):
     for sig, sk, m in zip(out, sks, msgs):
         assert sig == sk.sign(m)
         assert sig.verify(m, sk.public_key())
+
+
+def test_g2_subgroup_check_batch_matches_anchor():
+    """Device ψ-criterion subgroup check vs the anchor's scalar-mul
+    check, positives and negatives in one batch."""
+    from grandine_tpu.crypto.curves import G2, g2_infinity
+    from grandine_tpu.crypto.hash_to_curve import (
+        hash_to_field_fq2,
+        map_to_curve_g2,
+    )
+    from grandine_tpu.tpu.bls import TpuBlsBackend
+
+    backend = TpuBlsBackend()
+    good = [G2.mul(k) for k in (1, 7, 0xFEED, 31337)]
+    bad = [
+        map_to_curve_g2(hash_to_field_fq2(b"ng-%d" % i, b"SGT", 1)[0])
+        for i in range(3)
+    ]
+    pts = good + bad + [g2_infinity()]
+    out = backend.g2_subgroup_check_batch(pts)
+    expected = [p.in_subgroup_slow() or p.is_infinity() for p in pts]
+    assert out.tolist() == expected
+    assert out.tolist() == [True] * 4 + [False] * 3 + [True]
