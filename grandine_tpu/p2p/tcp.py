@@ -157,12 +157,27 @@ class TcpTransport(Transport):
         conn.thread.start()
         return conn
 
-    def connect(self, host: str, port: int, wait: float = 5.0) -> str:
-        """Dial a peer; returns its peer_id after the HELLO handshake."""
-        sock = socket.create_connection((host, port), timeout=wait)
+    def connect(self, host: str, port: int, wait: float = 30.0) -> str:
+        """Dial a peer; returns its peer_id after the HELLO handshake.
+        Connection-refused/timeout are retried until `wait` expires — the
+        peer process may still be starting up (imports alone take
+        seconds), and a follower races the proposer's bind in the
+        two-process devnet. Permanent errors (DNS failure, unroutable
+        address) raise immediately, and `wait` bounds dial + handshake
+        TOGETHER."""
+        deadline = time.time() + wait
+        while True:
+            try:
+                sock = socket.create_connection(
+                    (host, port), timeout=max(1.0, deadline - time.time())
+                )
+                break
+            except (ConnectionRefusedError, socket.timeout, TimeoutError):
+                if time.time() >= deadline:
+                    raise
+                time.sleep(0.25)
         sock.settimeout(None)
         conn = self._start_conn(sock)
-        deadline = time.time() + wait
         while conn.peer_id is None and conn.alive and time.time() < deadline:
             time.sleep(0.01)
         if conn.peer_id is None:

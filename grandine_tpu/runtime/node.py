@@ -52,6 +52,7 @@ class InProcessNode:
         #: optional BuilderApi (cli --builder-url): when set, _propose
         #: tries the blinded/builder flow before local building
         self.builder_api = None
+        self.builder_stats = {"blocks": 0, "fallbacks": 0, "aborts": 0}
 
     # ------------------------------------------------------------- driving
 
@@ -82,7 +83,12 @@ class InProcessNode:
         ):
             aborted, signed_block = self._propose_via_builder(snapshot, slot)
             if aborted:
+                self.builder_stats["aborts"] += 1
                 return  # post-sign failure: never sign a second block
+            if signed_block is not None:
+                self.builder_stats["blocks"] += 1
+            else:
+                self.builder_stats["fallbacks"] += 1
         if signed_block is None:
             signed_block, _post = produce_block(
                 snapshot.head_state,
@@ -130,7 +136,8 @@ class InProcessNode:
                 state, slot, self.cfg, header, reveal,
                 attestations=self._pool_attestations(snapshot, slot),
             )
-        except Exception:
+        except Exception as e:
+            self.builder_stats["last_error"] = repr(e)
             return False, None  # pre-sign: local fallback is safe
         try:
             sig = key.sign(
@@ -149,7 +156,8 @@ class InProcessNode:
             return False, blinded_mod.unblind_signed_block(
                 signed_blinded, payload, self.cfg
             )
-        except Exception:
+        except Exception as e:
+            self.builder_stats["last_error"] = repr(e)
             return True, None  # post-sign: abort the slot
 
     def _pool_attestations(self, snapshot, slot: int):

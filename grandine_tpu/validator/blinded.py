@@ -72,6 +72,7 @@ def produce_blinded_block(
     attester_slashings=(),
     voluntary_exits=(),
     bls_to_execution_changes=(),
+    deposits=(),
 ):
     """Unsigned BlindedBeaconBlock on `state` with the relay's payload
     header; returns (blinded_block, pre_state, post_state)."""
@@ -94,7 +95,7 @@ def produce_blinded_block(
         proposer_slashings=proposer_slashings,
         attester_slashings=attester_slashings,
         attestations=attestations,
-        deposits=[],
+        deposits=deposits,
         voluntary_exits=voluntary_exits,
         sync_aggregate=sync_aggregate
         if sync_aggregate is not None
@@ -104,17 +105,13 @@ def produce_blinded_block(
     if phase >= Phase.CAPELLA:
         body_fields["bls_to_execution_changes"] = bls_to_execution_changes
 
+    from grandine_tpu.validator.duties import parent_root_of
+
     body = ns.BlindedBeaconBlockBody(**body_fields)
     block = ns.BlindedBeaconBlock(
         slot=slot,
         proposer_index=proposer_index,
-        parent_root=state.latest_block_header.replace(
-            state_root=(
-                state.hash_tree_root()
-                if bytes(state.latest_block_header.state_root) == b"\x00" * 32
-                else bytes(state.latest_block_header.state_root)
-            )
-        ).hash_tree_root(),
+        parent_root=parent_root_of(state),
         state_root=b"\x00" * 32,
         body=body,
     )

@@ -169,6 +169,16 @@ def build_matching_payload(state, cfg, ns, phase: Phase):
 # -------------------------------------------------------------------- block
 
 
+def parent_root_of(state) -> bytes:
+    """Root of the chain's latest block as seen from `state`: the latest
+    header with its state_root backfilled if still zeroed (it is zeroed
+    until the next block's slot processing fills it)."""
+    header = state.latest_block_header
+    if bytes(header.state_root) == b"\x00" * 32:
+        header = header.replace(state_root=state.hash_tree_root())
+    return header.hash_tree_root()
+
+
 def produce_block_unsigned(
     state,
     slot: int,
@@ -230,13 +240,7 @@ def produce_block_unsigned(
     block = ns.BeaconBlock(
         slot=slot,
         proposer_index=proposer_index,
-        parent_root=state.latest_block_header.replace(
-            state_root=(
-                state.hash_tree_root()
-                if bytes(state.latest_block_header.state_root) == b"\x00" * 32
-                else bytes(state.latest_block_header.state_root)
-            )
-        ).hash_tree_root(),
+        parent_root=parent_root_of(state),
         state_root=b"\x00" * 32,
         body=body,
     )

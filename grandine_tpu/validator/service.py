@@ -281,6 +281,11 @@ class ValidatorService:
             if self.operation_pool is not None
             else {}
         )
+        deposits = (
+            self.eth1_cache.deposits_for_block(pre, ns)
+            if self.eth1_cache is not None
+            else []
+        )
         block, pre2, _post = blinded_mod.produce_blinded_block(
             pre,
             slot,
@@ -288,6 +293,7 @@ class ValidatorService:
             header,
             reveal,
             attestations=attestations,
+            deposits=deposits,
             proposer_slashings=ops.get("proposer_slashings", ()),
             attester_slashings=ops.get("attester_slashings", ()),
             voluntary_exits=ops.get("voluntary_exits", ()),
