@@ -58,6 +58,9 @@ def build_parser() -> argparse.ArgumentParser:
                      help="Beacon API to checkpoint-sync the anchor state from")
     run.add_argument("--builder-url", default=None,
                      help="MEV builder relay endpoint")
+    run.add_argument("--key-cache-password-file", default=None,
+                     help="enable the encrypted validator key cache "
+                          "(skips per-keystore KDF on restart)")
     run.add_argument("--listen-port", type=int, default=None,
                      help="serve p2p (TCP gossip + req/resp) on this port "
                           "(0 = pick a free port)")
@@ -162,9 +165,14 @@ def _node_once(args, cfg) -> int:
         genesis = interop_genesis_state(args.validators, cfg)
         stored, unfinalized = storage.load(anchor_state=genesis)
 
+    from grandine_tpu.slasher import Slasher
+
+    operation_pool = OperationPool(cfg)
+    slasher = Slasher(db)
     node = InProcessNode(
         stored, cfg, use_device_firehose=args.use_device,
         execution_engine=engine,
+        slasher=slasher, operation_pool=operation_pool,
     )
     if getattr(args, "web3signer_url", None):
         # remote-signer registry for a ValidatorService embedding; the
@@ -246,16 +254,35 @@ def _node_once(args, cfg) -> int:
         # same split as the reference's validator-vs-node processes.
         km_signer = getattr(node, "remote_signer", None) or Signer()
         node.api_signer = km_signer
+        key_cache = None
+        if getattr(args, "key_cache_password_file", None):
+            from grandine_tpu.validator.key_cache import (
+                KeyCacheError,
+                ValidatorKeyCache,
+            )
+
+            with open(args.key_cache_password_file) as f:
+                key_cache = ValidatorKeyCache(
+                    os.path.join(args.data_dir, "keys.cache"),
+                    f.read().strip(),
+                )
+            try:
+                n_cached = key_cache.load()  # fail fast on a wrong password
+            except KeyCacheError as e:
+                raise SystemExit(f"validator key cache: {e}")
+            if n_cached:
+                print(f"validator key cache: {n_cached} keys")
         ctx = ApiContext(
             node.controller, cfg,
             attestation_pool=AttestationAggPool(cfg),
-            operation_pool=OperationPool(cfg),
+            operation_pool=operation_pool,
             liveness=LivenessTracker(args.validators),
             metrics=metrics,
             sync_pool=SyncCommitteeAggPool(cfg),
             keymanager=KeyManager(
                 km_signer,
                 slashing_protection=SlashingProtection(db),
+                key_cache=key_cache,
             ),
             event_bus=bus,
             network=network,

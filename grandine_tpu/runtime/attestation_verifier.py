@@ -54,6 +54,8 @@ class AttestationVerifier:
         deadline_s: float = 0.050,
         max_active: "Optional[int]" = None,
         use_device: bool = True,
+        slasher=None,
+        operation_pool=None,
     ) -> None:
         self.controller = controller
         self.cfg = controller.cfg
@@ -62,7 +64,21 @@ class AttestationVerifier:
         self.max_batch = max_batch
         self.deadline_s = deadline_s
         self.max_active = max_active or controller.pool.n_threads
+        #: optional slasher fed with every ACCEPTED attestation; detected
+        #: offenses become AttesterSlashing ops in the operation pool
+        #: (the reference's slasher → validator proposer pipeline)
+        self.slasher = slasher
+        self.operation_pool = operation_pool
 
+        #: target_epoch -> {data_root: (attestation, indices)} for recent
+        #: epochs — the evidence store that turns a slasher hit into a
+        #: full AttesterSlashing op (the reference's indexed-attestation
+        #: DB keyed by target+root); epoch-bucketed so pruning is one
+        #: dict-pop per stale epoch, not a rebuild
+        self._recent_attestations: "dict[int, dict]" = {}
+        #: serializes slasher spans + the evidence store across the
+        #: concurrent batch-verify pool threads
+        self._slasher_lock = threading.Lock()
         self._queue: "deque[GossipAttestation]" = deque()
         self._cond = threading.Condition()
         self._active = 0
@@ -146,12 +162,16 @@ class AttestationVerifier:
                 self.controller.on_valid_attestation_batch(
                     [p[3] for p in prepared]
                 )
+                # AFTER delivery: a slasher problem must never cost fork
+                # choice its verified votes
+                self._feed_slasher([(p[4], p[3]) for p in prepared])
                 return
             # batch failed: isolate bad items singularly
             # (attestation_verifier.rs:231-239,377-386)
             self.stats["fallbacks"] += 1
             good = []
-            for msg, sig, mems, valid in prepared:
+            accepted_pairs = []
+            for msg, sig, mems, valid, att in prepared:
                 try:
                     ok = A.Signature.from_bytes(sig).fast_aggregate_verify(
                         msg, mems
@@ -160,11 +180,13 @@ class AttestationVerifier:
                     ok = False  # malformed signature: drop just this item
                 if ok:
                     good.append(valid)
+                    accepted_pairs.append((att, valid))
                     self.stats["accepted"] += 1
                 else:
                     self.stats["rejected"] += 1
             if good:
                 self.controller.on_valid_attestation_batch(good)
+                self._feed_slasher(accepted_pairs)
         finally:
             with self._cond:
                 self._active -= 1
@@ -195,7 +217,101 @@ class AttestationVerifier:
             keys.decompress_pubkey(cols.pubkeys[int(i)], trusted=True)
             for i in indices
         ]
-        return root, bytes(attestation.signature), members, valid
+        return root, bytes(attestation.signature), members, valid, attestation
+
+    #: evidence retention window (epochs) for building slashing ops
+    SLASHER_EVIDENCE_EPOCHS = 64
+
+    def _feed_slasher(self, accepted_pairs) -> None:
+        """Run every ACCEPTED attestation through the slasher; a hit is
+        turned into a full AttesterSlashing op for the proposer pipeline
+        when the conflicting attestation is still in the evidence window
+        (slasher.rs → validator slashing forwarding). Serialized by
+        _slasher_lock (the slasher's span chunks are not thread-safe) and
+        exception-isolated — detection must never break verification."""
+        if self.slasher is None:
+            return
+        try:
+            with self._slasher_lock:
+                for attestation, valid in accepted_pairs:
+                    data = attestation.data
+                    source = int(data.source.epoch)
+                    target = int(data.target.epoch)
+                    data_root = bytes(data.hash_tree_root())
+                    indices = [int(i) for i in valid.indices]
+                    bucket = self._recent_attestations.get(target)
+                    if bucket is None:
+                        bucket = self._recent_attestations[target] = {}
+                        # a NEW epoch appeared: drop stale epoch buckets
+                        # (one pop per epoch, not a rebuild per item)
+                        floor = target - self.SLASHER_EVIDENCE_EPOCHS
+                        for e in [
+                            e
+                            for e in self._recent_attestations
+                            if e < floor
+                        ]:
+                            del self._recent_attestations[e]
+                    bucket[data_root] = (attestation, indices)
+                    hits = self.slasher.on_attestation(
+                        indices, source, target, data_root
+                    )
+                    for hit in hits:
+                        self._build_slashing_op(hit, attestation, indices)
+        except Exception:
+            self.stats["slasher_errors"] = (
+                self.stats.get("slasher_errors", 0) + 1
+            )
+
+    def _build_slashing_op(self, hit, attestation, indices) -> None:
+        if self.operation_pool is None:
+            return
+        if hit.kind == "double_vote":
+            prior_target = int(hit.evidence["target_epoch"])
+            prior_root = bytes.fromhex(hit.evidence["roots"][0])
+        elif hit.kind in ("surround_vote", "surrounded_vote"):
+            prior_target = int(hit.evidence["existing"][1])
+            rec = self.slasher._record(hit.validator_index, prior_target)
+            if rec is None:
+                return  # evidence pruned
+            prior_root = rec[1]
+        else:
+            return
+        prev = self._recent_attestations.get(prior_target, {}).get(prior_root)
+        if prev is None:
+            return  # conflicting attestation no longer retrievable
+        prev_att, prev_indices = prev
+        from grandine_tpu.types.combined import fork_namespace, state_phase_of
+
+        snap = self.controller.snapshot()
+        tns = fork_namespace(
+            self.cfg, state_phase_of(snap.head_state, self.cfg)
+        )
+        prev_indexed = tns.IndexedAttestation(
+            attesting_indices=sorted(prev_indices),
+            data=prev_att.data,
+            signature=bytes(prev_att.signature),
+        )
+        cur_indexed = tns.IndexedAttestation(
+            attesting_indices=sorted(indices),
+            data=attestation.data,
+            signature=bytes(attestation.signature),
+        )
+        # spec is_slashable_attestation_data(data_1, data_2) surrounds
+        # as data_1.source < data_2.source AND data_2.target <
+        # data_1.target: the SURROUNDING attestation must be
+        # attestation_1. For a "surround_vote" hit the NEW attestation
+        # surrounds the existing one.
+        if hit.kind == "surround_vote":
+            att1, att2 = cur_indexed, prev_indexed
+        else:
+            att1, att2 = prev_indexed, cur_indexed
+        slashing = tns.AttesterSlashing(
+            attestation_1=att1, attestation_2=att2
+        )
+        if self.operation_pool.insert_attester_slashing(slashing):
+            self.stats["slashings_emitted"] = (
+                self.stats.get("slashings_emitted", 0) + 1
+            )
 
     def _batch_check(self, messages, signatures, members) -> bool:
         if self.use_device:

@@ -31,6 +31,8 @@ class InProcessNode:
         verifier_factory=None,
         use_device_firehose: bool = False,
         full_sync_participation: bool = False,
+        slasher=None,
+        operation_pool=None,
     ) -> None:
         from grandine_tpu.consensus.verifier import MultiVerifier
 
@@ -42,7 +44,10 @@ class InProcessNode:
             verifier_factory=verifier_factory or MultiVerifier,
         )
         self.attestation_verifier = AttestationVerifier(
-            self.controller, use_device=use_device_firehose
+            self.controller,
+            use_device=use_device_firehose,
+            slasher=slasher,
+            operation_pool=operation_pool,
         )
         self.clock = SlotClock(
             int(genesis_state.genesis_time), cfg.seconds_per_slot

@@ -1,0 +1,154 @@
+"""Encrypted persistent cache of decrypted validator keys — reference:
+validator_key_cache/src/lib.rs:1-12 (decrypted keystores are cached so a
+restart skips the per-keystore scrypt/pbkdf2 KDF — at thousands of keys
+that is minutes of wall time; the cache itself stays encrypted at rest).
+
+File format (`keys.cache`):
+    MAGIC | salt(16) | iv(16) | hmac(32) | ciphertext
+One scrypt KDF unlocks the whole cache (vs one per keystore); payload is
+AES-128-CTR over a JSON {pubkey_hex: secret_hex} map with an
+encrypt-then-MAC HMAC-SHA256 over salt|iv|ciphertext.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac as hmac_mod
+import json
+import os
+import secrets
+from typing import Optional
+
+from grandine_tpu.crypto import bls as A
+from grandine_tpu.validator.keymanager import _aes128_ctr
+
+_MAGIC = b"GTKC1\n"
+#: one interactive unlock for the whole cache; lighter than the
+#: per-keystore EIP-2335 default (2^18) by design — the cache is an
+#: optimization layer, the keystores remain the root of trust
+_SCRYPT_N = 1 << 14
+
+
+class KeyCacheError(Exception):
+    pass
+
+
+def _derive(password: str, salt: bytes) -> "tuple[bytes, bytes]":
+    dk = hashlib.scrypt(
+        password.encode(), salt=salt, n=_SCRYPT_N, r=8, p=1, dklen=48,
+        maxmem=128 * 1024 * 1024,
+    )
+    return dk[:16], dk[16:48]  # (aes key, mac key)
+
+
+class ValidatorKeyCache:
+    """pubkey(48B) -> SecretKey map with encrypted persistence.
+
+    Entries are bound to a digest of the KEYSTORE password they were
+    decrypted with: a cache hit still requires presenting the right
+    keystore password (`get(pubkey, password)`), so the cache never
+    weakens the keystores' role as the authorization gate — it only
+    skips their expensive KDF."""
+
+    def __init__(self, path: str, password: str) -> None:
+        self.path = path
+        self._password = password
+        #: pubkey -> (keystore_pw_digest, SecretKey)
+        self._keys: "dict[bytes, tuple]" = {}
+        self._loaded = False
+
+    # ------------------------------------------------------------- file IO
+
+    def load(self) -> int:
+        """Decrypt the cache file; returns the number of keys loaded
+        (0 if the file does not exist). Raises KeyCacheError on a wrong
+        password or a tampered file."""
+        self._loaded = True
+        if not os.path.exists(self.path):
+            return 0
+        with open(self.path, "rb") as f:
+            blob = f.read()
+        if not blob.startswith(_MAGIC) or len(blob) < len(_MAGIC) + 64:
+            raise KeyCacheError("malformed key cache file")
+        off = len(_MAGIC)
+        salt = blob[off : off + 16]
+        iv = blob[off + 16 : off + 32]
+        mac = blob[off + 32 : off + 64]
+        ct = blob[off + 64 :]
+        aes_key, mac_key = _derive(self._password, salt)
+        expect = hmac_mod.new(mac_key, salt + iv + ct, hashlib.sha256).digest()
+        if not hmac_mod.compare_digest(mac, expect):
+            raise KeyCacheError("key cache MAC mismatch (wrong password?)")
+        payload = json.loads(_aes128_ctr(aes_key, iv, ct))
+        for pk_hex, (pw_digest_hex, sk_hex) in payload.items():
+            self._keys[bytes.fromhex(pk_hex)] = (
+                bytes.fromhex(pw_digest_hex),
+                A.SecretKey.from_bytes(bytes.fromhex(sk_hex)),
+            )
+        return len(self._keys)
+
+    def save(self) -> None:
+        """Atomically (re)write the encrypted cache (0600 perms, like the
+        reference's mdbx env)."""
+        salt = secrets.token_bytes(16)
+        iv = secrets.token_bytes(16)
+        aes_key, mac_key = _derive(self._password, salt)
+        payload = json.dumps({
+            pk.hex(): (digest.hex(), sk.to_bytes().hex())
+            for pk, (digest, sk) in self._keys.items()
+        }).encode()
+        ct = _aes128_ctr(aes_key, iv, payload)
+        mac = hmac_mod.new(mac_key, salt + iv + ct, hashlib.sha256).digest()
+        tmp = f"{self.path}.{os.getpid()}.tmp"
+        fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(_MAGIC + salt + iv + mac + ct)
+            os.replace(tmp, self.path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    # ------------------------------------------------------------- lookups
+
+    @staticmethod
+    def _pw_digest(keystore_password: str) -> bytes:
+        # stored only INSIDE the encrypted cache payload; anyone able to
+        # read it already holds the cache password and the secret keys
+        return hashlib.sha256(
+            b"gtkc-pw:" + keystore_password.encode()
+        ).digest()
+
+    def get(
+        self, pubkey: bytes, keystore_password: str
+    ) -> "Optional[A.SecretKey]":
+        """The cached key, only if `keystore_password` matches the one
+        the entry was decrypted with."""
+        if not self._loaded:
+            self.load()
+        hit = self._keys.get(bytes(pubkey))
+        if hit is None:
+            return None
+        digest, sk = hit
+        if not hmac_mod.compare_digest(
+            digest, self._pw_digest(keystore_password)
+        ):
+            return None
+        return sk
+
+    def put(
+        self, pubkey: bytes, secret_key: "A.SecretKey",
+        keystore_password: str,
+    ) -> None:
+        self._keys[bytes(pubkey)] = (
+            self._pw_digest(keystore_password), secret_key,
+        )
+
+    def __len__(self) -> int:
+        return len(self._keys)
+
+
+__all__ = ["ValidatorKeyCache", "KeyCacheError"]

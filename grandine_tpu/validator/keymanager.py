@@ -195,25 +195,47 @@ def decrypt_keystore(keystore: dict, password: str) -> "A.SecretKey":
 
 class KeyManager:
     """Local keystore import/export + proposer configs (the keymanager-API
-    backend: keystores.rs, proposer_configs.rs)."""
+    backend: keystores.rs, proposer_configs.rs). An optional
+    `ValidatorKeyCache` (validator/key_cache.py) skips the per-keystore
+    KDF on re-import after a restart."""
 
-    def __init__(self, signer, slashing_protection=None) -> None:
+    def __init__(self, signer, slashing_protection=None, key_cache=None) -> None:
         self.signer = signer
         self.slashing_protection = slashing_protection
+        self.key_cache = key_cache
         self.proposer_configs: "dict[bytes, dict]" = {}
 
     def import_keystores(
         self, keystores: "list[dict]", passwords: "list[str]"
     ) -> "list[dict]":
         out = []
+        cache_dirty = False
         for ks, pw in zip(keystores, passwords):
             try:
-                sk = decrypt_keystore(ks, pw)
+                sk = None
+                ks_pk = ks.get("pubkey")
+                if self.key_cache is not None and ks_pk:
+                    try:
+                        sk = self.key_cache.get(
+                            bytes.fromhex(str(ks_pk).removeprefix("0x")), pw
+                        )
+                    except Exception:
+                        sk = None  # cache trouble must not block the KDF path
+                if sk is None:
+                    sk = decrypt_keystore(ks, pw)
                 pk = self.signer.add_key(sk)
+                if self.key_cache is not None:
+                    self.key_cache.put(pk, sk, pw)
+                    cache_dirty = True
                 out.append({"status": "imported",
                             "message": "0x" + pk.hex()})
             except Exception as e:
                 out.append({"status": "error", "message": repr(e)})
+        if cache_dirty:
+            try:
+                self.key_cache.save()
+            except OSError:
+                pass  # the cache is an optimization, not the key store
         return out
 
     def list_keystores(self) -> "list[dict]":

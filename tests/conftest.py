@@ -28,3 +28,24 @@ _sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import bench  # noqa: E402
 
 bench._enable_compilation_cache()
+
+import pytest  # noqa: E402
+
+_last_kernel_module = [None]
+
+
+@pytest.fixture(autouse=True)
+def _drop_jit_memory_between_kernel_modules(request):
+    """Release compiled-executable memory when the suite crosses from one
+    kernel-tier module to the next. A full single-process run
+    (`pytest tests/ -x -q`, the driver's invocation) accumulates every
+    heavy pairing/MSM executable on the 8-device mesh and can abort in
+    XLA's allocator; dropping caches at module boundaries bounds the
+    high-water mark. Warm recompiles come from the persistent on-disk
+    cache, so the cost is seconds, not minutes."""
+    if request.node.get_closest_marker("kernel") is not None:
+        module = request.node.module.__name__
+        if _last_kernel_module[0] not in (None, module):
+            jax.clear_caches()
+        _last_kernel_module[0] = module
+    yield

@@ -1,0 +1,89 @@
+"""Encrypted validator key cache tests — reference:
+validator_key_cache/src/lib.rs (decrypted-keystore cache for fast
+restarts, encrypted at rest).
+"""
+
+import pytest
+
+from grandine_tpu.crypto import bls as A
+from grandine_tpu.validator.key_cache import KeyCacheError, ValidatorKeyCache
+from grandine_tpu.validator.keymanager import KeyManager, encrypt_keystore
+from grandine_tpu.validator.signer import Signer
+
+SK = A.SecretKey.from_bytes((90210).to_bytes(32, "big"))
+PK = SK.public_key().to_bytes()
+
+
+def test_roundtrip_across_instances(tmp_path):
+    path = str(tmp_path / "keys.cache")
+    cache = ValidatorKeyCache(path, "cachepw")
+    cache.put(PK, SK, "kspw")
+    cache.save()
+    fresh = ValidatorKeyCache(path, "cachepw")
+    assert fresh.load() == 1
+    assert fresh.get(PK, "kspw").to_bytes() == SK.to_bytes()
+    # a cache hit still requires the RIGHT keystore password
+    assert fresh.get(PK, "not-the-keystore-pw") is None
+
+
+def test_wrong_password_and_tamper_rejected(tmp_path):
+    path = str(tmp_path / "keys.cache")
+    cache = ValidatorKeyCache(path, "right")
+    cache.put(PK, SK, "kspw")
+    cache.save()
+    with pytest.raises(KeyCacheError):
+        ValidatorKeyCache(path, "wrong").load()
+    blob = bytearray(open(path, "rb").read())
+    blob[-1] ^= 0xFF
+    open(path, "wb").write(bytes(blob))
+    with pytest.raises(KeyCacheError):
+        ValidatorKeyCache(path, "right").load()
+
+
+def test_missing_file_is_empty(tmp_path):
+    cache = ValidatorKeyCache(str(tmp_path / "nope.cache"), "pw")
+    assert cache.load() == 0
+    assert cache.get(PK, "kspw") is None
+
+
+def test_keymanager_skips_kdf_on_reimport(tmp_path, monkeypatch):
+    """Second import of the same keystore comes from the cache — the
+    expensive KDF decrypt must not run again (the restart speedup)."""
+    import grandine_tpu.validator.keymanager as km_mod
+
+    path = str(tmp_path / "keys.cache")
+    keystore = encrypt_keystore(SK, "kspw", kdf="pbkdf2")
+
+    calls = {"n": 0}
+    real = km_mod.decrypt_keystore
+
+    def counting(ks, pw):
+        calls["n"] += 1
+        return real(ks, pw)
+
+    monkeypatch.setattr(km_mod, "decrypt_keystore", counting)
+
+    km1 = KeyManager(Signer(), key_cache=ValidatorKeyCache(path, "cachepw"))
+    out = km1.import_keystores([keystore], ["kspw"])
+    assert out[0]["status"] == "imported"
+    assert calls["n"] == 1
+
+    # "restart": fresh manager + fresh cache instance over the same file
+    km2 = KeyManager(Signer(), key_cache=ValidatorKeyCache(path, "cachepw"))
+    out = km2.import_keystores([keystore], ["kspw"])
+    assert out[0]["status"] == "imported"
+    assert calls["n"] == 1  # KDF skipped
+    assert km2.signer.has_key(PK)
+
+
+def test_keymanager_wrong_password_errors_even_on_cache_hit(tmp_path):
+    """A cached key must NOT make import accept a wrong keystore
+    password — the keystores stay the authorization gate."""
+    path = str(tmp_path / "keys.cache")
+    keystore = encrypt_keystore(SK, "kspw", kdf="pbkdf2")
+    km1 = KeyManager(Signer(), key_cache=ValidatorKeyCache(path, "cachepw"))
+    assert km1.import_keystores([keystore], ["kspw"])[0]["status"] == "imported"
+    km2 = KeyManager(Signer(), key_cache=ValidatorKeyCache(path, "cachepw"))
+    out = km2.import_keystores([keystore], ["WRONG"])
+    assert out[0]["status"] == "error"
+    assert not km2.signer.has_key(PK)
