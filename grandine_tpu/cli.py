@@ -61,6 +61,9 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--key-cache-password-file", default=None,
                      help="enable the encrypted validator key cache "
                           "(skips per-keystore KDF on restart)")
+    run.add_argument("--keymanager-token-file", default=None,
+                     help="bearer token required by the keymanager API "
+                          "routes (unset = open)")
     run.add_argument("--listen-port", type=int, default=None,
                      help="serve p2p (TCP gossip + req/resp) on this port "
                           "(0 = pick a free port)")
@@ -272,6 +275,10 @@ def _node_once(args, cfg) -> int:
                 raise SystemExit(f"validator key cache: {e}")
             if n_cached:
                 print(f"validator key cache: {n_cached} keys")
+        km_token = None
+        if getattr(args, "keymanager_token_file", None):
+            with open(args.keymanager_token_file) as f:
+                km_token = f.read().strip()
         ctx = ApiContext(
             node.controller, cfg,
             attestation_pool=AttestationAggPool(cfg),
@@ -287,7 +294,9 @@ def _node_once(args, cfg) -> int:
             event_bus=bus,
             network=network,
             subnet_service=SubnetService(cfg, network=network),
+            keymanager_token=km_token,
         )
+        ctx.data_dir = args.data_dir
         server, _thread = serve(ctx, port=args.http_port)
         print(f"Beacon API on http://127.0.0.1:{args.http_port}")
 

@@ -45,6 +45,7 @@ class ApiContext:
         sync_pool=None,
         network=None,
         subnet_service=None,
+        keymanager_token: "Optional[str]" = None,
     ) -> None:
         self.controller = controller
         self.cfg = cfg
@@ -59,6 +60,9 @@ class ApiContext:
         self.sync_pool = sync_pool
         self.network = network
         self.subnet_service = subnet_service
+        #: bearer token gating the keymanager routes at the server layer
+        #: (server.py _authorized); None = open (in-process tests)
+        self.keymanager_token = keymanager_token
         #: pubkey-hex -> SignedValidatorRegistrationV1 JSON (builder flow)
         self.validator_registrations: "dict[str, dict]" = {}
         #: validator index -> fee recipient (prepare_beacon_proposer)
@@ -495,6 +499,7 @@ def post_validator_liveness(ctx, params, query, body):
 def get_metrics(ctx, params, query, body):
     if ctx.metrics is None:
         raise ApiError(503, "metrics not wired")
+    ctx.metrics.collect_system_stats(getattr(ctx, "data_dir", None))
     return ctx.metrics.expose()  # text payload
 
 

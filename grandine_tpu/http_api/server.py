@@ -18,6 +18,23 @@ from grandine_tpu.http_api.routing import ApiContext, build_router
 #: comment forces a write, surfacing BrokenPipe on closed sockets)
 SSE_KEEPALIVE_SECONDS = 5.0
 
+_KEYMANAGER_PREFIXES = ("/eth/v1/keystores", "/eth/v1/remotekeys")
+_KEYMANAGER_SUFFIXES = {"feerecipient", "gas_limit", "graffiti"}
+
+
+def _is_keymanager_path(path: str) -> bool:
+    if path.startswith(_KEYMANAGER_PREFIXES):
+        return True
+    # /eth/v1/validator/{pubkey}/{feerecipient|gas_limit|graffiti} —
+    # matched STRUCTURALLY (the router accepts pubkeys with or without
+    # the 0x prefix, so a prefix test would be bypassable)
+    parts = path.strip("/").split("/")
+    return (
+        len(parts) == 5
+        and parts[:3] == ["eth", "v1", "validator"]
+        and parts[4] in _KEYMANAGER_SUFFIXES
+    )
+
 
 def serve(ctx: ApiContext, host: str = "127.0.0.1", port: int = 5052):
     """Start the API server on a daemon thread; returns (server, thread).
@@ -30,6 +47,16 @@ def serve(ctx: ApiContext, host: str = "127.0.0.1", port: int = 5052):
         def _dispatch(self, body=None):
             split = urlsplit(self.path)
             query = dict(parse_qsl(split.query))
+            if not self._authorized(split.path):
+                raw = json.dumps(
+                    {"code": 403, "message": "keymanager token required"}
+                ).encode()
+                self.send_response(403)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(raw)))
+                self.end_headers()
+                self.wfile.write(raw)
+                return
             status, payload = router.dispatch(
                 ctx, self.command, split.path, query, body
             )
@@ -81,6 +108,18 @@ def serve(ctx: ApiContext, host: str = "127.0.0.1", port: int = 5052):
                 pass  # client went away
             finally:
                 ctx.event_bus.unsubscribe(sub)
+
+        def _authorized(self, path: str) -> bool:
+            """Keymanager routes require the bearer token when one is
+            configured (the reference's keymanager API runs behind token
+            auth; Beacon API routes stay open)."""
+            token = getattr(ctx, "keymanager_token", None)
+            if not token or not _is_keymanager_path(path):
+                return True
+            import hmac
+
+            header = self.headers.get("Authorization", "")
+            return hmac.compare_digest(header, f"Bearer {token}")
 
         def do_GET(self):  # noqa: N802
             split = urlsplit(self.path)

@@ -144,6 +144,72 @@ class Metrics:
             "block_processing_seconds", "state-transition duration")
         self.head_slot = Gauge("head_slot", "current head slot")
         self.finalized_epoch = Gauge("finalized_epoch", "finalized epoch")
+        # system stats (the reference's metrics SERVICE collects these
+        # via sysinfo; here straight from /proc, dependency-free)
+        self.process_resident_memory_bytes = Gauge(
+            "process_resident_memory_bytes", "resident set size")
+        self.process_cpu_seconds_total = Gauge(
+            "process_cpu_seconds_total", "user+system CPU time")
+        self.process_open_fds = Gauge(
+            "process_open_fds", "open file descriptors")
+        self.process_start_time_seconds = Gauge(
+            "process_start_time_seconds", "process start, unix time")
+        self.data_dir_bytes = Gauge(
+            "grandine_data_dir_bytes", "on-disk size of the data dir")
+
+    def collect_system_stats(self, data_dir: "str | None" = None) -> None:
+        """Refresh the /proc-sourced gauges (metrics/src/service.rs
+        system-stats collection). Called from the /metrics handler so
+        every scrape sees fresh values; all reads are best-effort."""
+        import os
+        import time
+
+        try:
+            with open("/proc/self/statm") as f:
+                rss_pages = int(f.read().split()[1])
+            self.process_resident_memory_bytes.set(
+                rss_pages * os.sysconf("SC_PAGE_SIZE")
+            )
+        except (OSError, ValueError, IndexError):
+            pass
+        try:
+            tck = os.sysconf("SC_CLK_TCK")
+            with open("/proc/self/stat") as f:
+                parts = f.read().rsplit(")", 1)[1].split()
+            utime, stime = int(parts[11]), int(parts[12])
+            self.process_cpu_seconds_total.set((utime + stime) / tck)
+            with open("/proc/uptime") as f:
+                uptime = float(f.read().split()[0])
+            starttime = int(parts[19]) / tck
+            self.process_start_time_seconds.set(
+                time.time() - uptime + starttime
+            )
+        except (OSError, ValueError, IndexError):
+            pass
+        try:
+            self.process_open_fds.set(len(os.listdir("/proc/self/fd")))
+        except OSError:
+            pass
+        if data_dir:
+            # the recursive walk is O(files); refresh at most once a
+            # minute so Prometheus scrape latency stays flat as the DB
+            # grows
+            now = time.monotonic()
+            if now - getattr(self, "_data_dir_scanned", 0.0) >= 60.0:
+                self._data_dir_scanned = now
+                try:
+                    total = 0
+                    for root, _dirs, files in os.walk(data_dir):
+                        for name in files:
+                            try:
+                                total += os.path.getsize(
+                                    os.path.join(root, name)
+                                )
+                            except OSError:
+                                pass
+                    self.data_dir_bytes.set(total)
+                except OSError:
+                    pass
 
     def all(self):
         return [

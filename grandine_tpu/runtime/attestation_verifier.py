@@ -251,16 +251,40 @@ class AttestationVerifier:
                             if e < floor
                         ]:
                             del self._recent_attestations[e]
-                    bucket[data_root] = (attestation, indices)
+                    # keep up to a few aggregates per data root: a later
+                    # NARROWER aggregate must not evict the one holding
+                    # the offender (each op's signature must match its
+                    # own indices, so entries cannot be union-merged)
+                    entries = bucket.setdefault(data_root, [])
+                    idx_set = set(indices)
+                    if not any(idx_set <= set(i) for _a, i in entries):
+                        entries.append((attestation, indices))
+                        del entries[:-4]
                     hits = self.slasher.on_attestation(
                         indices, source, target, data_root
                     )
+                    # one op per distinct conflicting pair — a whole
+                    # committee equivocating yields one hit per
+                    # validator but identical evidence
+                    seen_pairs = set()
                     for hit in hits:
+                        pair = self._hit_pair(hit, data_root)
+                        if pair in seen_pairs:
+                            continue
+                        seen_pairs.add(pair)
                         self._build_slashing_op(hit, attestation, indices)
         except Exception:
             self.stats["slasher_errors"] = (
                 self.stats.get("slasher_errors", 0) + 1
             )
+
+    @staticmethod
+    def _hit_pair(hit, data_root: bytes):
+        if hit.kind == "double_vote":
+            return ("d", hit.evidence["roots"][0], data_root)
+        if hit.kind in ("surround_vote", "surrounded_vote"):
+            return (hit.kind, tuple(hit.evidence["existing"]), data_root)
+        return (hit.kind, hit.validator_index, data_root)
 
     def _build_slashing_op(self, hit, attestation, indices) -> None:
         if self.operation_pool is None:
@@ -276,10 +300,18 @@ class AttestationVerifier:
             prior_root = rec[1]
         else:
             return
-        prev = self._recent_attestations.get(prior_target, {}).get(prior_root)
-        if prev is None:
+        entries = self._recent_attestations.get(prior_target, {}).get(
+            prior_root, []
+        )
+        if not entries:
             return  # conflicting attestation no longer retrievable
-        prev_att, prev_indices = prev
+        # prefer evidence that contains the offending validator (the op
+        # slashes the INTERSECTION of the two index sets)
+        prev_att, prev_indices = entries[0]
+        for att_i, idx_i in entries:
+            if hit.validator_index in idx_i:
+                prev_att, prev_indices = att_i, idx_i
+                break
         from grandine_tpu.types.combined import fork_namespace, state_phase_of
 
         snap = self.controller.snapshot()

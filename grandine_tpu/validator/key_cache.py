@@ -142,10 +142,20 @@ class ValidatorKeyCache:
     def put(
         self, pubkey: bytes, secret_key: "A.SecretKey",
         keystore_password: str,
-    ) -> None:
-        self._keys[bytes(pubkey)] = (
-            self._pw_digest(keystore_password), secret_key,
-        )
+    ) -> bool:
+        """Returns True when the entry is new or changed (callers skip
+        the save() rewrite for pure cache-hit re-imports)."""
+        entry = (self._pw_digest(keystore_password), secret_key)
+        pk = bytes(pubkey)
+        old = self._keys.get(pk)
+        if (
+            old is not None
+            and old[0] == entry[0]
+            and old[1].to_bytes() == secret_key.to_bytes()
+        ):
+            return False
+        self._keys[pk] = entry
+        return True
 
     def __len__(self) -> int:
         return len(self._keys)

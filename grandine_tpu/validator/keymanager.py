@@ -225,8 +225,8 @@ class KeyManager:
                     sk = decrypt_keystore(ks, pw)
                 pk = self.signer.add_key(sk)
                 if self.key_cache is not None:
-                    self.key_cache.put(pk, sk, pw)
-                    cache_dirty = True
+                    if self.key_cache.put(pk, sk, pw):
+                        cache_dirty = True
                 out.append({"status": "imported",
                             "message": "0x" + pk.hex()})
             except Exception as e:

@@ -174,3 +174,85 @@ def test_keymanager_unwired_is_503(router):
         assert router.dispatch(ctx, "GET", "/eth/v1/keystores")[0] == 503
     finally:
         ctrl.stop()
+
+
+def test_keymanager_token_gates_routes_over_socket():
+    """With a token configured, keymanager routes 403 without the bearer
+    header and work with it; Beacon API routes stay open."""
+    import http.client as hc
+
+    from grandine_tpu.http_api import serve
+
+    genesis = interop_genesis_state(16, CFG)
+    ctrl = Controller(genesis, CFG, verifier_factory=NullVerifier)
+    km = KeyManager(Signer(), slashing_protection=SlashingProtection(
+        Database.in_memory()
+    ))
+    ctx = ApiContext(ctrl, CFG, keymanager=km, keymanager_token="sekrit")
+    server, _ = serve(ctx, port=0)
+    host, port = server.server_address
+    try:
+        conn = hc.HTTPConnection(host, port, timeout=5)
+        conn.request("GET", "/eth/v1/keystores")
+        assert conn.getresponse().status == 403
+        conn.request(
+            "GET", "/eth/v1/keystores",
+            headers={"Authorization": "Bearer wrong"},
+        )
+        assert conn.getresponse().status == 403
+        conn.request(
+            "GET", "/eth/v1/keystores",
+            headers={"Authorization": "Bearer sekrit"},
+        )
+        assert conn.getresponse().status == 200
+        conn.request("GET", "/eth/v1/node/version")  # Beacon API: open
+        assert conn.getresponse().status == 200
+        conn.close()
+    finally:
+        server.shutdown()
+        ctrl.stop()
+
+
+def test_metrics_exposes_system_stats():
+    from grandine_tpu.metrics import Metrics
+
+    m = Metrics()
+    m.collect_system_stats()
+    text = m.expose()
+    assert "process_resident_memory_bytes" in text
+    # a real RSS value, not the default 0
+    for line in text.splitlines():
+        if line.startswith("process_resident_memory_bytes "):
+            assert float(line.split()[1]) > 1e6
+        if line.startswith("process_open_fds "):
+            assert float(line.split()[1]) > 0
+
+
+def test_keymanager_token_covers_unprefixed_pubkey_paths():
+    """The per-pubkey routes accept pubkeys without 0x; the auth gate
+    must match them structurally, not by prefix."""
+    import http.client as hc
+
+    from grandine_tpu.http_api import serve
+
+    genesis = interop_genesis_state(16, CFG)
+    ctrl = Controller(genesis, CFG, verifier_factory=NullVerifier)
+    km = KeyManager(Signer())
+    ctx = ApiContext(ctrl, CFG, keymanager=km, keymanager_token="sekrit")
+    server, _ = serve(ctx, port=0)
+    host, port = server.server_address
+    try:
+        conn = hc.HTTPConnection(host, port, timeout=5)
+        bare = PK_HEX[2:]  # no 0x prefix
+        conn.request(
+            "POST", f"/eth/v1/validator/{bare}/feerecipient",
+            body=json.dumps({"ethaddress": "0x" + "aa" * 20}),
+            headers={"Content-Type": "application/json"},
+        )
+        assert conn.getresponse().status == 403
+        conn.request("GET", f"/eth/v1/validator/{bare}/graffiti")
+        assert conn.getresponse().status == 403
+        conn.close()
+    finally:
+        server.shutdown()
+        ctrl.stop()
