@@ -279,6 +279,12 @@ def _node_once(args, cfg) -> int:
         if getattr(args, "keymanager_token_file", None):
             with open(args.keymanager_token_file) as f:
                 km_token = f.read().strip()
+            if not km_token:
+                # an empty token would silently DISABLE auth
+                raise SystemExit(
+                    f"--keymanager-token-file {args.keymanager_token_file} "
+                    "is empty"
+                )
         ctx = ApiContext(
             node.controller, cfg,
             attestation_pool=AttestationAggPool(cfg),
@@ -295,8 +301,8 @@ def _node_once(args, cfg) -> int:
             network=network,
             subnet_service=SubnetService(cfg, network=network),
             keymanager_token=km_token,
+            data_dir=args.data_dir,
         )
-        ctx.data_dir = args.data_dir
         server, _thread = serve(ctx, port=args.http_port)
         print(f"Beacon API on http://127.0.0.1:{args.http_port}")
 

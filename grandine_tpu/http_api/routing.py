@@ -46,6 +46,7 @@ class ApiContext:
         network=None,
         subnet_service=None,
         keymanager_token: "Optional[str]" = None,
+        data_dir: "Optional[str]" = None,
     ) -> None:
         self.controller = controller
         self.cfg = cfg
@@ -63,6 +64,8 @@ class ApiContext:
         #: bearer token gating the keymanager routes at the server layer
         #: (server.py _authorized); None = open (in-process tests)
         self.keymanager_token = keymanager_token
+        #: data directory whose on-disk size /metrics reports
+        self.data_dir = data_dir
         #: pubkey-hex -> SignedValidatorRegistrationV1 JSON (builder flow)
         self.validator_registrations: "dict[str, dict]" = {}
         #: validator index -> fee recipient (prepare_beacon_proposer)
@@ -499,7 +502,7 @@ def post_validator_liveness(ctx, params, query, body):
 def get_metrics(ctx, params, query, body):
     if ctx.metrics is None:
         raise ApiError(503, "metrics not wired")
-    ctx.metrics.collect_system_stats(getattr(ctx, "data_dir", None))
+    ctx.metrics.collect_system_stats(ctx.data_dir)
     return ctx.metrics.expose()  # text payload
 
 

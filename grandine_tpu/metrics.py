@@ -162,7 +162,6 @@ class Metrics:
         system-stats collection). Called from the /metrics handler so
         every scrape sees fresh values; all reads are best-effort."""
         import os
-        import time
 
         try:
             with open("/proc/self/statm") as f:

@@ -263,32 +263,32 @@ class AttestationVerifier:
                     hits = self.slasher.on_attestation(
                         indices, source, target, data_root
                     )
-                    # one op per distinct conflicting pair — a whole
-                    # committee equivocating yields one hit per
-                    # validator but identical evidence
-                    seen_pairs = set()
+                    # a committee-wide equivocation yields one hit per
+                    # validator with (usually) shared evidence: skip a
+                    # hit only when an ALREADY-BUILT op's index
+                    # intersection covers that validator — never on the
+                    # evidence key alone (validators may live in
+                    # disjoint stored aggregates)
+                    covered: "set[int]" = set()
                     for hit in hits:
-                        pair = self._hit_pair(hit, data_root)
-                        if pair in seen_pairs:
+                        if hit.validator_index in covered:
                             continue
-                        seen_pairs.add(pair)
-                        self._build_slashing_op(hit, attestation, indices)
+                        newly = self._build_slashing_op(
+                            hit, attestation, indices
+                        )
+                        if newly:
+                            covered |= newly
         except Exception:
             self.stats["slasher_errors"] = (
                 self.stats.get("slasher_errors", 0) + 1
             )
 
-    @staticmethod
-    def _hit_pair(hit, data_root: bytes):
-        if hit.kind == "double_vote":
-            return ("d", hit.evidence["roots"][0], data_root)
-        if hit.kind in ("surround_vote", "surrounded_vote"):
-            return (hit.kind, tuple(hit.evidence["existing"]), data_root)
-        return (hit.kind, hit.validator_index, data_root)
-
-    def _build_slashing_op(self, hit, attestation, indices) -> None:
+    def _build_slashing_op(self, hit, attestation, indices):
+        """Build + pool one AttesterSlashing for `hit`; returns the set
+        of validator indices the op's intersection covers (None if no op
+        could be built)."""
         if self.operation_pool is None:
-            return
+            return None
         if hit.kind == "double_vote":
             prior_target = int(hit.evidence["target_epoch"])
             prior_root = bytes.fromhex(hit.evidence["roots"][0])
@@ -296,15 +296,15 @@ class AttestationVerifier:
             prior_target = int(hit.evidence["existing"][1])
             rec = self.slasher._record(hit.validator_index, prior_target)
             if rec is None:
-                return  # evidence pruned
+                return None  # evidence pruned
             prior_root = rec[1]
         else:
-            return
+            return None
         entries = self._recent_attestations.get(prior_target, {}).get(
             prior_root, []
         )
         if not entries:
-            return  # conflicting attestation no longer retrievable
+            return None  # conflicting attestation no longer retrievable
         # prefer evidence that contains the offending validator (the op
         # slashes the INTERSECTION of the two index sets)
         prev_att, prev_indices = entries[0]
@@ -344,6 +344,7 @@ class AttestationVerifier:
             self.stats["slashings_emitted"] = (
                 self.stats.get("slashings_emitted", 0) + 1
             )
+        return set(prev_indices) & set(indices)
 
     def _batch_check(self, messages, signatures, members) -> bool:
         if self.use_device:
