@@ -37,9 +37,19 @@ class BuilderApi:
     skipped slots, stop asking the relay and fall back to local building."""
 
     def __init__(self, relay: "Callable[[str, dict], dict]",
-                 cfg: "Optional[BuilderConfig]" = None) -> None:
+                 cfg: "Optional[BuilderConfig]" = None,
+                 chain_config=None,
+                 relay_pubkey: "Optional[bytes]" = None) -> None:
         self.relay = relay
         self.cfg = cfg or BuilderConfig()
+        # chain config enables bid signature verification; without it the
+        # relay is trusted (test seams only — the node always passes one).
+        # relay_pubkey additionally PINS the builder identity (mev-boost
+        # style): bids from any other key are rejected — without a pin the
+        # signature check provides integrity against corruption but a
+        # malicious relay can sign with its own throwaway key.
+        self.chain_config = chain_config
+        self.relay_pubkey = bytes(relay_pubkey) if relay_pubkey else None
         self.stats = {"headers": 0, "submissions": 0, "circuit_breaks": 0}
 
     # -- circuit breaker ----------------------------------------------------
@@ -67,15 +77,22 @@ class BuilderApi:
     # -- relay calls --------------------------------------------------------
 
     def get_execution_payload_header(
-        self, slot: int, parent_hash: bytes, pubkey: bytes
+        self, slot: int, parent_hash: bytes, pubkey: bytes, ns=None
     ) -> dict:
         """builder-specs getHeader: returns the relay's bid
-        {header: {...}, value: int}."""
+        {header: {...}, value: int, pubkey: hex, signature: hex}.
+
+        When a chain config was provided, the relay's SignedBuilderBid is
+        verified against its embedded builder pubkey before the header is
+        trusted (reference builder_api/src/api.rs:168-185); `ns` is the
+        per-phase spec-types namespace used to reconstruct the header's
+        hash tree root."""
         bid = self.relay("get_header", {
             "slot": slot,
             "parent_hash": bytes(parent_hash).hex(),
             "pubkey": bytes(pubkey).hex(),
         })
+        bid = self._flatten_bid(bid)
         if not isinstance(bid, dict) or "header" not in bid:
             raise BuilderApiError("malformed bid")
         bid_parent = str(bid["header"].get("parent_hash", "")).removeprefix(
@@ -83,8 +100,78 @@ class BuilderApi:
         )
         if bid_parent != bytes(parent_hash).hex():
             raise BuilderApiError("bid parent hash mismatch")
+        if self.chain_config is not None:
+            if ns is None:
+                raise BuilderApiError(
+                    "bid verification requires the spec-types namespace"
+                )
+            self._verify_bid(bid, ns)
         self.stats["headers"] += 1
         return bid
+
+    @staticmethod
+    def _flatten_bid(bid):
+        """Normalize a builder-specs GetHeaderResponse — possibly nested as
+        {version, data: {message: {header, value, pubkey, …},
+        signature}} — into the flat {header, value, pubkey, signature}
+        shape the rest of this class speaks."""
+        if not isinstance(bid, dict):
+            return bid
+        inner = bid.get("data", bid)
+        if isinstance(inner, dict) and "message" in inner:
+            flat = dict(inner["message"])
+            if "signature" in inner:
+                flat["signature"] = inner["signature"]
+            return flat
+        return inner
+
+    def _verify_bid(self, bid: dict, ns) -> None:
+        """Reject a bid whose BuilderBid signature does not verify against
+        the builder pubkey it carries (builder_api/src/api.rs:168-185)."""
+        from grandine_tpu.crypto.bls import PublicKey, Signature
+        from grandine_tpu.validator.blinded import (
+            builder_bid_signing_root,
+            header_from_bid,
+        )
+
+        try:
+            builder_pk = bytes.fromhex(
+                str(bid["pubkey"]).removeprefix("0x")
+            )
+            sig_bytes = bytes.fromhex(
+                str(bid["signature"]).removeprefix("0x")
+            )
+        except (KeyError, ValueError) as e:
+            raise BuilderApiError(f"bid missing pubkey/signature: {e!r}")
+        if self.relay_pubkey is not None and builder_pk != self.relay_pubkey:
+            raise BuilderApiError("bid signed by unpinned builder pubkey")
+        # the bid container's shape is a property of the FORK, not of the
+        # relay's JSON: deneb+ bids sign over blob_kzg_commitments
+        # (builder_api/src/deneb/containers.rs), earlier forks do not
+        deneb_shape = any(
+            name == "blob_kzg_commitments"
+            for name, _ in ns.BeaconBlockBody.FIELDS
+        )
+        try:
+            if deneb_shape:
+                commitments = [
+                    bytes.fromhex(str(c).removeprefix("0x"))
+                    for c in bid.get("blob_kzg_commitments", [])
+                ]
+            else:
+                commitments = None
+            header = header_from_bid(ns, bid["header"])
+            value = int(bid["value"])
+            pk = PublicKey.from_bytes(builder_pk)
+            sig = Signature.from_bytes(sig_bytes)
+            root = builder_bid_signing_root(
+                header, value, builder_pk,
+                self.chain_config, blob_kzg_commitments=commitments,
+            )
+        except (KeyError, ValueError, TypeError) as e:
+            raise BuilderApiError(f"undecodable bid: {e!r}")
+        if not sig.verify(root, pk):
+            raise BuilderApiError("bid signature verification failed")
 
     def submit_blinded_block(self, signed_blinded_block) -> dict:
         """builder-specs submitBlindedBlock: relay unblinds and returns the

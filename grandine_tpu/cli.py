@@ -19,6 +19,20 @@ import sys
 import time
 
 
+def _bls_pubkey_arg(value: str) -> bytes:
+    """argparse type: 48-byte hex BLS pubkey (rejects bad input at startup
+    instead of bricking the builder path at proposal time)."""
+    try:
+        raw = bytes.fromhex(value.removeprefix("0x"))
+    except ValueError:
+        raise argparse.ArgumentTypeError(f"not hex: {value!r}")
+    if len(raw) != 48:
+        raise argparse.ArgumentTypeError(
+            f"BLS pubkey must be 48 bytes, got {len(raw)}"
+        )
+    return raw
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="grandine-tpu",
@@ -58,6 +72,9 @@ def build_parser() -> argparse.ArgumentParser:
                      help="Beacon API to checkpoint-sync the anchor state from")
     run.add_argument("--builder-url", default=None,
                      help="MEV builder relay endpoint")
+    run.add_argument("--builder-pubkey", default=None, type=_bls_pubkey_arg,
+                     help="pin the relay's BLS pubkey (96 hex chars); bids "
+                          "signed by any other key are rejected")
     run.add_argument("--key-cache-password-file", default=None,
                      help="enable the encrypted validator key cache "
                           "(skips per-keystore KDF on restart)")
@@ -194,7 +211,10 @@ def _node_once(args, cfg) -> int:
         from grandine_tpu.builder_api import BuilderApi
         from grandine_tpu.http_clients import BuilderRelayClient
 
-        node.builder_api = BuilderApi(BuilderRelayClient(args.builder_url))
+        node.builder_api = BuilderApi(
+            BuilderRelayClient(args.builder_url), chain_config=cfg,
+            relay_pubkey=getattr(args, "builder_pubkey", None),
+        )
         print(f"builder relay: {args.builder_url}")
     node.controller.storage = storage
     node.controller.store.pre_prune_hook = node.controller._persist_finalized

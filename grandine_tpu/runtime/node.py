@@ -130,6 +130,7 @@ class InProcessNode:
                 slot,
                 bytes(state.latest_execution_payload_header.block_hash),
                 pubkey,
+                ns=ns,
             )
             header = blinded_mod.header_from_bid(ns, bid["header"])
             reveal = key.sign(

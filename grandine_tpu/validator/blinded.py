@@ -43,6 +43,58 @@ def header_from_bid(ns, bid_header: dict):
     return ns.ExecutionPayloadHeader(**fields)
 
 
+#: builder-specs DOMAIN_APPLICATION_BUILDER (reference
+#: builder_api/src/consts.rs:15).
+DOMAIN_APPLICATION_BUILDER = bytes.fromhex("00000001")
+
+_BID_CLASSES: dict = {}
+
+
+def _builder_bid_class(header_cls, commitments: bool, max_commitments: int):
+    """Per-fork BuilderBid container (builder-specs; reference
+    builder_api/src/{bellatrix,capella,deneb}/containers.rs — deneb inserts
+    blob_kzg_commitments between header and value)."""
+    from grandine_tpu.consensus.misc import _container
+    from grandine_tpu.ssz import Bytes48, List, uint256
+
+    key = (header_cls, commitments, max_commitments)
+    cls = _BID_CLASSES.get(key)
+    if cls is None:
+        fields: dict = {"header": header_cls}
+        if commitments:
+            fields["blob_kzg_commitments"] = List(Bytes48, max_commitments)
+        fields["value"] = uint256
+        fields["pubkey"] = Bytes48
+        cls = _container("BuilderBid", fields)
+        _BID_CLASSES[key] = cls
+    return cls
+
+
+def builder_bid_signing_root(
+    header, value: int, pubkey: bytes, cfg, blob_kzg_commitments=None
+) -> bytes:
+    """Signing root of a builder bid: compute_domain(
+    DOMAIN_APPLICATION_BUILDER, genesis_fork_version, zero root) — the
+    reference's SignForAllForks impl for BuilderBid
+    (builder_api/src/signing.rs:11-27, helper_functions signing.rs:59-64)."""
+    from grandine_tpu.consensus.misc import compute_domain, compute_signing_root
+
+    has_commitments = blob_kzg_commitments is not None
+    bid_cls = _builder_bid_class(
+        type(header), has_commitments,
+        cfg.preset.MAX_BLOB_COMMITMENTS_PER_BLOCK,
+    )
+    fields = dict(header=header, value=int(value), pubkey=bytes(pubkey))
+    if has_commitments:
+        fields["blob_kzg_commitments"] = [
+            bytes(c) for c in blob_kzg_commitments
+        ]
+    domain = compute_domain(
+        DOMAIN_APPLICATION_BUILDER, cfg.genesis_fork_version
+    )
+    return compute_signing_root(bid_cls(**fields), domain)
+
+
 def header_to_bid(header) -> dict:
     """ExecutionPayloadHeader → builder-specs bid header JSON (hex for
     byte fields, decimal strings for uints — the wire format a real

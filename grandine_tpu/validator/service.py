@@ -264,7 +264,7 @@ class ValidatorService:
             pre = process_slots(pre, slot, self.cfg)
         parent_hash = bytes(pre.latest_execution_payload_header.block_hash)
         bid = self.builder_api.get_execution_payload_header(
-            slot, parent_hash, pubkey
+            slot, parent_hash, pubkey, ns=ns
         )
         header = blinded_mod.header_from_bid(ns, bid["header"])
         epoch = accessors.get_current_epoch(pre, self.p)
