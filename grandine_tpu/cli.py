@@ -50,6 +50,9 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument(
         "--use-device", action="store_true",
         help="route batch verification through the TPU backend")
+    parser.add_argument(
+        "--no-warm", action="store_true",
+        help="skip the startup kernel-bucket precompile warmer")
 
     sub = parser.add_subparsers(dest="command")
 
@@ -81,6 +84,9 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--keymanager-token-file", default=None,
                      help="bearer token required by the keymanager API "
                           "routes (unset = open)")
+    run.add_argument("--metrics-url", default=None,
+                     help="push client stats to this beaconcha.in-style "
+                          "endpoint every 60s")
     run.add_argument("--listen-port", type=int, default=None,
                      help="serve p2p (TCP gossip + req/resp) on this port "
                           "(0 = pick a free port)")
@@ -194,6 +200,13 @@ def _node_once(args, cfg) -> int:
         execution_engine=engine,
         slasher=slasher, operation_pool=operation_pool,
     )
+    if args.use_device and not getattr(args, "no_warm", False):
+        # precompile the kernel bucket manifest in the background while
+        # the node syncs — an uncompiled bucket mid-chain stalls
+        # verification for the whole compile (runtime/warmup.py)
+        from grandine_tpu.runtime.warmup import warm_in_background
+
+        warm_in_background(progress=lambda m: print(f"[warmup] {m}"))
     if getattr(args, "web3signer_url", None):
         # remote-signer registry for a ValidatorService embedding; the
         # list_keys round-trip also fail-fasts on a bad endpoint
@@ -219,6 +232,15 @@ def _node_once(args, cfg) -> int:
     node.controller.storage = storage
     node.controller.store.pre_prune_hook = node.controller._persist_finalized
     node.controller.metrics = metrics
+    if getattr(args, "metrics_url", None):
+        from grandine_tpu.metrics import RemoteMetricsService
+
+        pusher = RemoteMetricsService(
+            args.metrics_url, metrics, controller=node.controller,
+            data_dir=args.data_dir,
+        )
+        pusher.start()
+        print(f"metrics push: {args.metrics_url} every 60s")
     if unfinalized:
         # crash-restart: replay the persisted unfinalized head so we don't
         # regress to finality and double-propose already-signed slots
@@ -246,6 +268,7 @@ def _node_once(args, cfg) -> int:
             transport, node.controller, cfg,
             attestation_verifier=node.attestation_verifier,
             storage=storage,
+            operation_pool=operation_pool,
         )
         print(f"p2p listening on 127.0.0.1:{transport.port}", flush=True)
         for addr in args.peer:
@@ -305,13 +328,16 @@ def _node_once(args, cfg) -> int:
                     f"--keymanager-token-file {args.keymanager_token_file} "
                     "is empty"
                 )
+        sync_pool = SyncCommitteeAggPool(cfg)
+        if network is not None:
+            network.sync_pool = sync_pool  # gossip sync topics feed it
         ctx = ApiContext(
             node.controller, cfg,
             attestation_pool=AttestationAggPool(cfg),
             operation_pool=operation_pool,
             liveness=LivenessTracker(args.validators),
             metrics=metrics,
-            sync_pool=SyncCommitteeAggPool(cfg),
+            sync_pool=sync_pool,
             keymanager=KeyManager(
                 km_signer,
                 slashing_protection=SlashingProtection(db),

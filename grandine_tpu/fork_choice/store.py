@@ -39,6 +39,7 @@ from grandine_tpu.consensus.verifier import (
     SignatureInvalid,
     Verifier,
 )
+from grandine_tpu.execution import PayloadStatus
 from grandine_tpu.transition.combined import custom_state_transition
 from grandine_tpu.transition.fork_upgrade import state_phase
 from grandine_tpu.transition.slots import process_slots
@@ -82,10 +83,13 @@ class BlockNode:
         "slot",
         "unrealized_justified",
         "unrealized_finalized",
+        "optimistic",
+        "execution_block_hash",
     )
 
     def __init__(self, root, signed_block, state,
-                 unrealized_justified, unrealized_finalized) -> None:
+                 unrealized_justified, unrealized_finalized,
+                 optimistic: bool = False) -> None:
         self.root = root
         self.signed_block = signed_block
         self.state = state
@@ -93,18 +97,50 @@ class BlockNode:
         self.slot = int(signed_block.message.slot)
         self.unrealized_justified = unrealized_justified
         self.unrealized_finalized = unrealized_finalized
+        # optimistic-sync bookkeeping (fork_choice_control/src/controller.rs
+        # :236-247): True while the EL has not yet judged this payload
+        self.optimistic = optimistic
+        body = getattr(signed_block.message, "body", None)
+        payload = getattr(body, "execution_payload", None) if body else None
+        self.execution_block_hash = (
+            bytes(payload.block_hash) if payload is not None else None
+        )
 
 
 class ValidBlock:
     """Result of validate_block, ready for apply_block."""
 
-    __slots__ = ("signed_block", "root", "state", "is_timely")
+    __slots__ = ("signed_block", "root", "state", "is_timely", "optimistic")
 
-    def __init__(self, signed_block, root, state, is_timely) -> None:
+    def __init__(self, signed_block, root, state, is_timely,
+                 optimistic: bool = False) -> None:
         self.signed_block = signed_block
         self.root = root
         self.state = state
         self.is_timely = is_timely
+        # imported before the EL judged the payload (SYNCING/ACCEPTED)
+        self.optimistic = optimistic
+
+
+class _RecordingEngine:
+    """Engine proxy capturing the last notify_new_payload verdict during a
+    single validate_block (the verdict decides optimistic marking)."""
+
+    __slots__ = ("inner", "last_status")
+
+    def __init__(self, inner) -> None:
+        self.inner = inner
+        self.last_status = None
+
+    def notify_new_payload(self, payload):
+        self.last_status = self.inner.notify_new_payload(payload)
+        return self.last_status
+
+    def notify_forkchoice_updated(self, *args, **kwargs):
+        return self.inner.notify_forkchoice_updated(*args, **kwargs)
+
+    def allow_optimistic_import(self) -> bool:
+        return self.inner.allow_optimistic_import()
 
 
 class ValidAttestation:
@@ -198,6 +234,10 @@ class Store:
         self._id_roots: "list[bytes]" = []
         self.equivocating: "set[int]" = set()
 
+        #: execution payload block_hash → block root (optimistic-sync
+        #: status updates arrive keyed by execution hash)
+        self._exec_index: "dict[bytes, bytes]" = {}
+
         self.proposer_boost_root: "Optional[bytes]" = None
         self.slot = int(anchor_state.slot)
         self.interval = 0
@@ -270,16 +310,28 @@ class Store:
 
         if verifier is None:
             verifier = MultiVerifier()
+        # record the EL's verdict so SYNCING/ACCEPTED imports are marked
+        # optimistic on the node (spec optimistic sync; the async status
+        # updates arrive later via apply_payload_status)
+        recording = _RecordingEngine(self.execution_engine)
         post = custom_state_transition(
             parent.state,
             signed_block,
             self.cfg,
             verifier,
-            execution_engine=self.execution_engine,
+            execution_engine=recording,
             state_root_policy=state_root_policy,
         )
+        optimistic = recording.last_status in (
+            PayloadStatus.SYNCING, PayloadStatus.ACCEPTED,
+        ) or (parent.optimistic and recording.last_status is None)
+        if optimistic and not self.execution_engine.allow_optimistic_import():
+            raise ForkChoiceError(
+                "optimistic import disallowed by execution engine"
+            )
         is_timely = self.slot == slot and self.interval == 0
-        return ValidBlock(signed_block, root, post, is_timely)
+        return ValidBlock(signed_block, root, post, is_timely,
+                          optimistic=optimistic)
 
     def validate_attestation(
         self, data_slot: int, committee_index: int, target_epoch: int,
@@ -350,11 +402,18 @@ class Store:
         post = valid.state
         uj, uf = unrealized_checkpoints(post, self.cfg)
         node = BlockNode(
-            root, valid.signed_block, post, uj, uf
+            root, valid.signed_block, post, uj, uf,
+            optimistic=valid.optimistic,
         )
         self.blocks[root] = node
         self.children.setdefault(node.parent_root, []).append(root)
         self.children.setdefault(root, [])
+        if node.execution_block_hash:
+            self._exec_index[node.execution_block_hash] = root
+        if not node.optimistic:
+            # a VALID payload validates its whole ancestor chain (engine
+            # API semantics) — promote any optimistic ancestors
+            self._promote_valid(node.parent_root)
 
         # spec on_block (v1.3+) gates the boost with
         # is_first_block = (proposer_boost_root == Root()): only the FIRST
@@ -472,6 +531,87 @@ class Store:
             for r, cs in self.children.items()
             if r in keep
         }
+        self._exec_index = {
+            h: r for h, r in self._exec_index.items() if r in keep
+        }
+
+    # -------------------------------------------------- optimistic sync
+
+    def is_optimistic(self, root: "Optional[bytes]" = None) -> bool:
+        """Is `root` (default: the current head) optimistically imported —
+        i.e. does its chain contain a payload the EL has not yet judged?
+        Nodes record their own status and VALID promotion clears ancestors,
+        so one node read suffices."""
+        root = bytes(root) if root is not None else self.get_head()
+        node = self.blocks.get(root)
+        return bool(node is not None and node.optimistic)
+
+    def _promote_valid(self, root: bytes) -> None:
+        """Mark `root` and all its optimistic ancestors valid (engine-API
+        semantics: VALID for a payload validates its ancestor chain)."""
+        node = self.blocks.get(bytes(root))
+        while node is not None and node.optimistic:
+            node.optimistic = False
+            node = self.blocks.get(node.parent_root)
+
+    def apply_payload_status(
+        self,
+        execution_block_hash: bytes,
+        status: "PayloadStatus",
+        latest_valid_hash: "Optional[bytes]" = None,
+    ) -> "list[bytes]":
+        """Mutator-only: apply an asynchronous EL verdict
+        (on_notified_new_payload / on_notified_fork_choice_update —
+        fork_choice_control/src/controller.rs:236-247).
+
+        VALID promotes the block and its ancestors out of optimistic
+        status. INVALID removes the block AND all its descendants from the
+        DAG (they can never become canonical); with latest_valid_hash the
+        invalidation extends up the chain to just above that payload.
+        Returns the list of removed roots (empty for VALID/SYNCING)."""
+        root = self._exec_index.get(bytes(execution_block_hash))
+        if root is None or root not in self.blocks:
+            return []
+        if status == PayloadStatus.VALID:
+            self._promote_valid(root)
+            return []
+        if status != PayloadStatus.INVALID:
+            return []  # SYNCING/ACCEPTED carry no new information
+        # find the oldest invalid ancestor: everything above
+        # latest_valid_hash (when given and on this chain) is invalid too
+        oldest_invalid = root
+        if latest_valid_hash is not None:
+            lv = bytes(latest_valid_hash)
+            node = self.blocks[root]
+            while True:
+                parent = self.blocks.get(node.parent_root)
+                if parent is None or parent.execution_block_hash == lv:
+                    break
+                oldest_invalid = parent.root
+                node = parent
+        fin_root = bytes(self.finalized_checkpoint.root)
+        if oldest_invalid == fin_root or self.is_descendant(
+            oldest_invalid, fin_root
+        ):
+            raise ForkChoiceError(
+                "execution engine invalidated the finalized chain"
+            )
+        removed = [
+            r for r in self.blocks if self.is_descendant(oldest_invalid, r)
+        ]
+        removed_set = set(removed)
+        for r in removed:
+            node = self.blocks.pop(r)
+            self.children.pop(r, None)
+            if node.execution_block_hash:
+                self._exec_index.pop(node.execution_block_hash, None)
+        self.children = {
+            r: [c for c in cs if c not in removed_set]
+            for r, cs in self.children.items()
+        }
+        if self.proposer_boost_root in removed_set:
+            self.proposer_boost_root = None
+        return removed
 
     # ------------------------------------------------------------------ head
 

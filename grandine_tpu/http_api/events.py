@@ -14,6 +14,7 @@ construction only; the wire encode happens on the subscriber's thread).
 
 from __future__ import annotations
 
+import hashlib
 import json
 import queue
 import threading
@@ -235,8 +236,25 @@ def wire_controller_events(controller, bus: EventBus) -> None:
         )
         check_finality(snap)
 
+    def on_blob_sidecar(block_root, sidecar) -> None:
+        bus.publish(
+            "blob_sidecar",
+            {
+                "block_root": _hex(block_root),
+                "index": str(int(sidecar.index)),
+                "slot": str(int(sidecar.signed_block_header.message.slot)),
+                "kzg_commitment": _hex(bytes(sidecar.kzg_commitment)),
+                "versioned_hash": _hex(
+                    b"\x01"
+                    + hashlib.sha256(bytes(sidecar.kzg_commitment)).digest()[1:]
+                ),
+            },
+        )
+
     controller.on_head_change.append(on_head_change)
     controller.on_block_applied.append(on_block_applied)
+    if hasattr(controller, "on_blob_sidecar"):
+        controller.on_blob_sidecar.append(on_blob_sidecar)
 
 
 __all__ = [

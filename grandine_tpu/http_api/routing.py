@@ -196,7 +196,7 @@ def get_node_syncing(ctx, params, query, body):
             "head_slot": str(head_slot),
             "sync_distance": str(max(0, snap.slot - head_slot)),
             "is_syncing": snap.slot - head_slot > 1,
-            "is_optimistic": False,
+            "is_optimistic": bool(getattr(snap, "is_optimistic", False)),
             "el_offline": True,
         }
     }
@@ -217,6 +217,94 @@ def get_genesis(ctx, params, query, body):
 def get_state_root(ctx, params, query, body):
     state = ctx.resolve_state(params["state_id"])
     return {"data": {"root": hex_(state.hash_tree_root())}}
+
+
+def get_debug_fork_choice(ctx, params, query, body):
+    """Beacon API /eth/v1/debug/fork_choice (http_api/src/routing.rs:461):
+    the store's block DAG with per-node weight/viability detail.
+
+    The DAG is mutator-owned; this handler reads it racily (blocks is
+    insert-only except at finality/invalidation pruning) and retries the
+    whole computation on a concurrent-mutation error instead of taking a
+    lock on the hot path — a debug endpoint must never slow the mutator."""
+    store = ctx.controller.store
+    snap = ctx.snapshot()
+    last_err = None
+    for _attempt in range(3):
+        try:
+            return _debug_fork_choice_once(store, snap)
+        except RuntimeError as e:  # dict mutated during iteration
+            last_err = e
+    raise last_err
+
+
+def _debug_fork_choice_once(store, snap):
+    weights = store._subtree_weights(bytes(store.justified_checkpoint.root))
+    nodes = []
+    for root, node in list(store.blocks.items()):
+        nodes.append({
+            "slot": str(node.slot),
+            "block_root": hex_(root),
+            "parent_root": hex_(node.parent_root),
+            "justified_epoch": str(
+                int(node.state.current_justified_checkpoint.epoch)
+            ),
+            "finalized_epoch": str(int(node.state.finalized_checkpoint.epoch)),
+            "weight": str(weights.get(root, 0)),
+            "validity": "optimistic" if node.optimistic else "valid",
+            "execution_block_hash": hex_(
+                node.execution_block_hash or b"\x00" * 32
+            ),
+        })
+    return {
+        "justified_checkpoint": {
+            "epoch": str(int(snap.justified_checkpoint.epoch)),
+            "root": hex_(snap.justified_checkpoint.root),
+        },
+        "finalized_checkpoint": {
+            "epoch": str(int(snap.finalized_checkpoint.epoch)),
+            "root": hex_(snap.finalized_checkpoint.root),
+        },
+        "fork_choice_nodes": nodes,
+    }
+
+
+def get_debug_heads(ctx, params, query, body):
+    """Chain tips (blocks without children) — /eth/v2/debug/beacon/heads.
+    Same racy-read + snapshot-copy discipline as debug_fork_choice."""
+    store = ctx.controller.store
+    snap = ctx.snapshot()
+    blocks = dict(store.blocks)
+    children = dict(store.children)
+    heads = [
+        {
+            "root": hex_(root),
+            "slot": str(node.slot),
+            "execution_optimistic": bool(node.optimistic),
+        }
+        for root, node in blocks.items()
+        if not children.get(root)
+    ]
+    return {"data": heads or [{
+        "root": hex_(snap.head_root),
+        "slot": str(int(snap.head_state.slot)),
+        "execution_optimistic": bool(snap.is_optimistic),
+    }]}
+
+
+def get_debug_state(ctx, params, query, body):
+    """Full SSZ state dump — /eth/v2/debug/beacon/states/{state_id}
+    (returns the raw container; the server layer SSZ/JSON-encodes)."""
+    from grandine_tpu.types.combined import state_phase_of
+
+    state = ctx.resolve_state(params["state_id"])
+    return {
+        "version": state_phase_of(state, ctx.cfg).key,
+        "execution_optimistic": bool(
+            getattr(ctx.snapshot(), "is_optimistic", False)
+        ),
+        "data": {"ssz": "0x" + state.serialize().hex()},
+    }
 
 
 def get_state_fork(ctx, params, query, body):
@@ -1426,6 +1514,9 @@ def build_router() -> Router:
     r.add("GET", "/eth/v1/node/version", get_node_version)
     r.add("GET", "/eth/v1/node/health", get_node_health)
     r.add("GET", "/eth/v1/node/syncing", get_node_syncing)
+    r.add("GET", "/eth/v1/debug/fork_choice", get_debug_fork_choice)
+    r.add("GET", "/eth/v2/debug/beacon/heads", get_debug_heads)
+    r.add("GET", "/eth/v2/debug/beacon/states/{state_id}", get_debug_state)
     r.add("GET", "/eth/v1/beacon/genesis", get_genesis)
     r.add("GET", "/eth/v1/beacon/states/{state_id}/root", get_state_root)
     r.add("GET", "/eth/v1/beacon/states/{state_id}/fork", get_state_fork)

@@ -100,9 +100,56 @@ def validate_blob_sidecar(
         raise eip4844.KzgError("blob KZG proof invalid")
 
 
+def make_blob_sidecars(
+    ns, p, signed_block, blobs, setup: "Optional[object]" = None,
+    proofs: "Optional[list]" = None,
+):
+    """Proposer side: BlobSidecar containers for a signed deneb block
+    (spec get_blob_sidecars; validator/src/validator.rs blob bundle
+    handling). `blobs[i]` must match body.blob_kzg_commitments[i]; proofs
+    are computed when not supplied (the builder/EL normally supplies
+    them)."""
+    block = signed_block.message
+    body = block.body
+    commitments = [bytes(c) for c in body.blob_kzg_commitments]
+    assert len(blobs) == len(commitments), "one blob per commitment"
+    header = ns.BeaconBlockHeader(
+        slot=int(block.slot),
+        proposer_index=int(block.proposer_index),
+        parent_root=bytes(block.parent_root),
+        state_root=bytes(block.state_root),
+        body_root=body.hash_tree_root(),
+    )
+    signed_header = ns.SignedBeaconBlockHeader(
+        message=header, signature=bytes(signed_block.signature)
+    )
+    out = []
+    for i, blob in enumerate(blobs):
+        proof = (
+            proofs[i]
+            if proofs is not None
+            else eip4844.compute_blob_kzg_proof(
+                bytes(blob), commitments[i], setup
+            )
+        )
+        out.append(
+            ns.BlobSidecar(
+                index=i,
+                blob=bytes(blob),
+                kzg_commitment=commitments[i],
+                kzg_proof=bytes(proof),
+                signed_block_header=signed_header,
+                kzg_commitment_inclusion_proof=
+                    build_commitment_inclusion_proof(body, i, p),
+            )
+        )
+    return out
+
+
 __all__ = [
     "build_commitment_inclusion_proof",
     "verify_commitment_inclusion",
     "validate_blob_sidecar",
+    "make_blob_sidecars",
     "inclusion_proof_depth",
 ]

@@ -221,4 +221,107 @@ class Metrics:
         return "".join(m.expose() for m in self.all())
 
 
-__all__ = ["Counter", "Gauge", "Histogram", "Metrics"]
+class RemoteMetricsService:
+    """Periodic push of client stats to a beaconcha.in-style endpoint —
+    reference metrics/src/service.rs (METRICS_UPDATE_INTERVAL = 60 s) +
+    beaconchain.rs (the MetricsContent JSON shape: a list of
+    {version, timestamp, process, ...} entries).
+
+    `post` is an injected callable (url, json_body) → status for tests;
+    the default uses urllib. Runs on a daemon thread; failures are
+    counted, never raised (losing a stats push must not hurt the node)."""
+
+    INTERVAL_S = 60.0
+
+    def __init__(self, url: str, metrics: "Metrics", controller=None,
+                 data_dir: "str | None" = None, post=None) -> None:
+        self.url = url
+        self.metrics = metrics
+        self.controller = controller
+        self.data_dir = data_dir
+        self.post = post or self._default_post
+        self.stats = {"pushes": 0, "failures": 0}
+        self._stop = False
+        self._thread = None
+
+    @staticmethod
+    def _default_post(url: str, body: dict) -> int:
+        import json as _json
+        import urllib.request
+
+        req = urllib.request.Request(
+            url,
+            data=_json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status
+
+    def snapshot_body(self) -> list:
+        """The beaconcha.in client-stats payload (beaconchain.rs
+        MetricsContent: one 'beaconnode' entry + one 'system' entry)."""
+        self.metrics.collect_system_stats(self.data_dir)
+
+        def g(m):
+            v = m.value
+            return v() if callable(v) else v
+        beaconnode: dict = {
+            "version": 1,
+            "timestamp": int(time.time() * 1000),
+            "process": "beaconnode",
+            "cpu_process_seconds_total": g(
+                self.metrics.process_cpu_seconds_total
+            ),
+            "memory_process_bytes": g(
+                self.metrics.process_resident_memory_bytes
+            ),
+        }
+        if self.controller is not None:
+            snap = self.controller.snapshot()
+            beaconnode["sync_beacon_head_slot"] = int(snap.head_state.slot)
+            beaconnode["sync_eth2_synced"] = bool(
+                snap.slot - int(snap.head_state.slot) <= 1
+            )
+        system = {
+            "version": 1,
+            "timestamp": beaconnode["timestamp"],
+            "process": "system",
+            "disk_beaconchain_bytes_total": g(self.metrics.data_dir_bytes),
+            "memory_node_bytes_total": g(
+                self.metrics.process_resident_memory_bytes
+            ),
+        }
+        return [beaconnode, system]
+
+    def push_once(self) -> bool:
+        try:
+            status = self.post(self.url, self.snapshot_body())
+            ok = 200 <= int(status) < 300
+        except Exception:
+            ok = False
+        self.stats["pushes" if ok else "failures"] += 1
+        return ok
+
+    def start(self) -> None:
+        import threading
+
+        def loop() -> None:
+            while not self._stop:
+                self.push_once()
+                deadline = time.monotonic() + self.INTERVAL_S
+                while not self._stop and time.monotonic() < deadline:
+                    time.sleep(0.25)
+
+        self._thread = threading.Thread(
+            target=loop, name="metrics-push", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop = True
+
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "Metrics", "RemoteMetricsService",
+]

@@ -43,9 +43,38 @@ class GossipTopics:
     def voluntary_exit(digest: bytes) -> str:
         return f"/eth2/{digest.hex()}/voluntary_exit/ssz_snappy"
 
+    @staticmethod
+    def blob_sidecar(digest: bytes, subnet: int) -> str:
+        return f"/eth2/{digest.hex()}/blob_sidecar_{subnet}/ssz_snappy"
+
+    @staticmethod
+    def sync_committee(digest: bytes, subnet: int) -> str:
+        return f"/eth2/{digest.hex()}/sync_committee_{subnet}/ssz_snappy"
+
+    @staticmethod
+    def sync_committee_contribution(digest: bytes) -> str:
+        return (
+            f"/eth2/{digest.hex()}"
+            "/sync_committee_contribution_and_proof/ssz_snappy"
+        )
+
+    @staticmethod
+    def proposer_slashing(digest: bytes) -> str:
+        return f"/eth2/{digest.hex()}/proposer_slashing/ssz_snappy"
+
+    @staticmethod
+    def attester_slashing(digest: bytes) -> str:
+        return f"/eth2/{digest.hex()}/attester_slashing/ssz_snappy"
+
+    @staticmethod
+    def bls_to_execution_change(digest: bytes) -> str:
+        return f"/eth2/{digest.hex()}/bls_to_execution_change/ssz_snappy"
+
 
 class Transport:
-    """What a WAN backend provides: pubsub + the BlocksByRange req/resp."""
+    """What a WAN backend provides: pubsub + the req/resp protocols
+    (Status, BlocksByRange/Root, BlobsByRange/Root — p2p/src/network.rs
+    :13-24,911-912)."""
 
     def publish(self, topic: str, payload: bytes) -> None:
         raise NotImplementedError
@@ -61,10 +90,28 @@ class Transport:
     ) -> "list[bytes]":
         raise NotImplementedError
 
+    def request_blocks_by_root(
+        self, peer: str, roots: "list[bytes]"
+    ) -> "list[bytes]":
+        raise NotImplementedError
+
+    def request_blobs_by_range(
+        self, peer: str, start_slot: int, count: int
+    ) -> "list[bytes]":
+        raise NotImplementedError
+
+    def request_blobs_by_root(
+        self, peer: str, ids: "list[tuple[bytes, int]]"
+    ) -> "list[bytes]":
+        raise NotImplementedError
+
     def request_status(self, peer: str) -> dict:
         raise NotImplementedError
 
-    def register_provider(self, blocks_by_range, status) -> None:
+    def register_provider(
+        self, blocks_by_range, status,
+        blocks_by_root=None, blobs_by_range=None, blobs_by_root=None,
+    ) -> None:
         """Install the local node's req/resp serving callbacks."""
         raise NotImplementedError
 
@@ -86,11 +133,15 @@ class InMemoryHub:
         self, peer_id: str,
         blocks_by_range: "Callable[[int, int], list[bytes]]",
         status: "Callable[[], dict]",
+        blocks_by_root=None, blobs_by_range=None, blobs_by_root=None,
     ) -> None:
         with self._lock:
             self._providers[peer_id] = {
                 "blocks_by_range": blocks_by_range,
                 "status": status,
+                "blocks_by_root": blocks_by_root,
+                "blobs_by_range": blobs_by_range,
+                "blobs_by_root": blobs_by_root,
             }
 
     # -- hub internals ------------------------------------------------------
@@ -115,7 +166,10 @@ class InMemoryHub:
             provider = self._providers.get(peer)
         if provider is None:
             raise ConnectionError(f"unknown peer {peer}")
-        return provider[what](*args)
+        fn = provider.get(what)
+        if fn is None:
+            raise ConnectionError(f"peer {peer} does not serve {what}")
+        return fn(*args)
 
 
 class _HubTransport(Transport):
@@ -135,11 +189,22 @@ class _HubTransport(Transport):
     def request_blocks_by_range(self, peer, start_slot, count):
         return self.hub._request(peer, "blocks_by_range", start_slot, count)
 
+    def request_blocks_by_root(self, peer, roots):
+        return self.hub._request(peer, "blocks_by_root", roots)
+
+    def request_blobs_by_range(self, peer, start_slot, count):
+        return self.hub._request(peer, "blobs_by_range", start_slot, count)
+
+    def request_blobs_by_root(self, peer, ids):
+        return self.hub._request(peer, "blobs_by_root", ids)
+
     def request_status(self, peer):
         return self.hub._request(peer, "status")
 
-    def register_provider(self, blocks_by_range, status):
-        self.hub.register_provider(self.peer_id, blocks_by_range, status)
+    def register_provider(self, blocks_by_range, status, **extra):
+        self.hub.register_provider(
+            self.peer_id, blocks_by_range, status, **extra
+        )
 
 
 class Network:
@@ -154,12 +219,16 @@ class Network:
         cfg,
         attestation_verifier=None,
         storage=None,
+        sync_pool=None,
+        operation_pool=None,
     ) -> None:
         self.transport = transport
         self.controller = controller
         self.cfg = cfg
         self.attestation_verifier = attestation_verifier
         self.storage = storage
+        self.sync_pool = sync_pool
+        self.operation_pool = operation_pool
         snap = controller.snapshot()
         self.digest = GossipTopics.fork_digest(cfg, snap.head_state)
         self.stats = defaultdict(int)
@@ -185,9 +254,41 @@ class Network:
                 GossipTopics.beacon_attestation(self.digest, subnet),
                 self._on_gossip_attestation,
             )
+        # deneb blob-sidecar subnets (p2p/src/network.rs:104,221-222)
+        for subnet in range(cfg.blob_sidecar_subnet_count):
+            transport.subscribe(
+                GossipTopics.blob_sidecar(self.digest, subnet),
+                self._on_gossip_blob_sidecar,
+            )
+        # sync-committee message/contribution + operation topics
+        # (p2p/src/network.rs:42-50,233,273)
+        for subnet in range(cfg.sync_committee_subnet_count):
+            transport.subscribe(
+                GossipTopics.sync_committee(self.digest, subnet),
+                self._on_gossip_sync_committee_message,
+            )
+        transport.subscribe(
+            GossipTopics.sync_committee_contribution(self.digest),
+            self._on_gossip_sync_contribution,
+        )
+        transport.subscribe(
+            GossipTopics.proposer_slashing(self.digest),
+            self._on_gossip_proposer_slashing,
+        )
+        transport.subscribe(
+            GossipTopics.attester_slashing(self.digest),
+            self._on_gossip_attester_slashing,
+        )
+        transport.subscribe(
+            GossipTopics.bls_to_execution_change(self.digest),
+            self._on_gossip_bls_change,
+        )
         try:
             transport.register_provider(
-                self._serve_blocks_by_range, self._serve_status
+                self._serve_blocks_by_range, self._serve_status,
+                blocks_by_root=self._serve_blocks_by_root,
+                blobs_by_range=self._serve_blobs_by_range,
+                blobs_by_root=self._serve_blobs_by_root,
             )
         except NotImplementedError:
             pass
@@ -259,6 +360,183 @@ class Network:
             return
         self.attestation_verifier.submit(signed.message.aggregate)
 
+    def _deneb_ns(self):
+        from grandine_tpu.types.containers import spec_types
+
+        return spec_types(self.cfg.preset).deneb
+
+    def _on_gossip_blob_sidecar(self, topic: str, payload: bytes) -> None:
+        self.stats["blob_sidecars_in"] += 1
+        try:
+            sidecar = self._deneb_ns().BlobSidecar.deserialize(
+                frame_decompress(payload)
+            )
+        except Exception:
+            self.stats["decode_failures"] += 1
+            return
+        self.controller.on_gossip_blob_sidecar(sidecar)
+
+    def _on_gossip_sync_committee_message(
+        self, topic: str, payload: bytes
+    ) -> None:
+        self.stats["sync_messages_in"] += 1
+        if self.sync_pool is None:
+            return
+        try:
+            msg = self._deneb_ns().SyncCommitteeMessage.deserialize(
+                frame_decompress(payload)
+            )
+        except Exception:
+            self.stats["decode_failures"] += 1
+            return
+        # validator_index → committee position(s) via the head state's
+        # current sync committee (a validator can hold several positions)
+        state = self.controller.snapshot().head_state
+        vidx = int(msg.validator_index)
+        if vidx >= len(state.validators):
+            self.stats["decode_failures"] += 1
+            return
+        pubkey = bytes(state.validators[vidx].pubkey)
+        # gossip validation: the signature must verify against the
+        # claimed validator's key for the message's slot/root — a forged
+        # signature inserted into the pool would poison the produced
+        # sync aggregate and invalidate this node's own proposals
+        # (p2p gossip rules; sync_committee_agg_pool tasks.rs)
+        from grandine_tpu.consensus import misc, signing
+        from grandine_tpu.crypto import bls as A
+
+        try:
+            root = signing.sync_committee_message_signing_root(
+                state, bytes(msg.beacon_block_root),
+                misc.compute_epoch_at_slot(int(msg.slot), self.cfg.preset),
+                self.cfg,
+            )
+            sig = A.Signature.from_bytes(bytes(msg.signature))
+            pk = A.PublicKey.from_bytes(pubkey)
+            if not sig.verify(root, pk):
+                raise ValueError("bad signature")
+        except Exception:
+            self.stats["sync_messages_rejected"] += 1
+            return
+        for pos, pk_bytes in enumerate(state.current_sync_committee.pubkeys):
+            if bytes(pk_bytes) == pubkey:
+                self.sync_pool.insert_message(
+                    int(msg.slot), bytes(msg.beacon_block_root),
+                    pos, bytes(msg.signature),
+                )
+
+    def _on_gossip_sync_contribution(self, topic: str, payload: bytes) -> None:
+        self.stats["sync_contributions_in"] += 1
+        if self.sync_pool is None:
+            return
+        try:
+            signed = self._deneb_ns().SignedContributionAndProof.deserialize(
+                frame_decompress(payload)
+            )
+        except Exception:
+            self.stats["decode_failures"] += 1
+            return
+        contribution = signed.message.contribution
+        # verify the contribution's aggregate signature against the set
+        # subcommittee members before it can poison the pool's aggregates
+        from grandine_tpu.consensus import misc, signing
+        from grandine_tpu.crypto import bls as A
+
+        state = self.controller.snapshot().head_state
+        p = self.cfg.preset
+        try:
+            sub = int(contribution.subcommittee_index)
+            sub_size = p.SYNC_COMMITTEE_SIZE // self.cfg.sync_committee_subnet_count
+            members = state.current_sync_committee.pubkeys[
+                sub * sub_size : (sub + 1) * sub_size
+            ]
+            bits = list(contribution.aggregation_bits)
+            pks = [
+                A.PublicKey.from_bytes(bytes(pk))
+                for bit, pk in zip(bits, members)
+                if bit
+            ]
+            if not pks:
+                raise ValueError("empty contribution")
+            root = signing.sync_committee_message_signing_root(
+                state, bytes(contribution.beacon_block_root),
+                misc.compute_epoch_at_slot(int(contribution.slot), p),
+                self.cfg,
+            )
+            sig = A.Signature.from_bytes(bytes(contribution.signature))
+            if not sig.fast_aggregate_verify(root, pks):
+                raise ValueError("bad aggregate signature")
+        except Exception:
+            self.stats["sync_contributions_rejected"] += 1
+            return
+        self.sync_pool.insert_contribution(contribution)
+
+    def _on_gossip_proposer_slashing(self, topic: str, payload: bytes) -> None:
+        self.stats["proposer_slashings_in"] += 1
+        if self.operation_pool is None:
+            return
+        try:
+            slashing = self._deneb_ns().ProposerSlashing.deserialize(
+                frame_decompress(payload)
+            )
+        except Exception:
+            self.stats["decode_failures"] += 1
+            return
+        self.operation_pool.insert_proposer_slashing(slashing)
+
+    def _on_gossip_attester_slashing(self, topic: str, payload: bytes) -> None:
+        self.stats["attester_slashings_in"] += 1
+        try:
+            slashing = self._deneb_ns().AttesterSlashing.deserialize(
+                frame_decompress(payload)
+            )
+        except Exception:
+            self.stats["decode_failures"] += 1
+            return
+        # full validation BEFORE any effect: slashable data + BOTH indexed
+        # attestation signatures. An unvalidated slashing would let any
+        # peer zero arbitrary validators' fork-choice weight and poison
+        # this node's own block proposals (spec p2p gossip validation;
+        # process_attester_slashing preconditions).
+        from grandine_tpu.consensus import predicates
+        from grandine_tpu.consensus.verifier import SingleVerifier
+
+        att1, att2 = slashing.attestation_1, slashing.attestation_2
+        state = self.controller.snapshot().head_state
+        try:
+            if not predicates.is_slashable_attestation_data(
+                att1.data, att2.data
+            ):
+                raise ValueError("attestations are not slashable")
+            for indexed in (att1, att2):
+                predicates.validate_indexed_attestation(
+                    indexed, state, SingleVerifier(), self.cfg
+                )
+        except Exception:
+            self.stats["attester_slashings_rejected"] += 1
+            return
+        if self.operation_pool is not None:
+            self.operation_pool.insert_attester_slashing(slashing)
+        # fork choice marks the intersection equivocating
+        a = set(int(i) for i in att1.attesting_indices)
+        b = set(int(i) for i in att2.attesting_indices)
+        both = sorted(a & b)
+        if both:
+            self.controller.on_attester_slashing(both)
+
+    def _on_gossip_bls_change(self, topic: str, payload: bytes) -> None:
+        self.stats["bls_changes_in"] += 1
+        if self.operation_pool is None:
+            return
+        try:
+            signed = self._deneb_ns().SignedBLSToExecutionChange.deserialize(
+                frame_decompress(payload)
+            )
+        except Exception:
+            self.stats["decode_failures"] += 1
+            return
+        self.operation_pool.insert_bls_to_execution_change(signed)
+
     # ----------------------------------------------------------- outbound
 
     def publish_aggregate(self, signed_aggregate_and_proof) -> None:
@@ -282,6 +560,51 @@ class Network:
             frame_compress(attestation.serialize()),
         )
 
+    def publish_blob_sidecar(self, sidecar) -> None:
+        """Subnet = index % BLOB_SIDECAR_SUBNET_COUNT (spec
+        compute_subnet_for_blob_sidecar)."""
+        self.stats["blob_sidecars_out"] += 1
+        subnet = int(sidecar.index) % self.cfg.blob_sidecar_subnet_count
+        self.transport.publish(
+            GossipTopics.blob_sidecar(self.digest, subnet),
+            frame_compress(sidecar.serialize()),
+        )
+
+    def publish_sync_committee_message(self, msg, subnet: int = 0) -> None:
+        self.stats["sync_messages_out"] += 1
+        self.transport.publish(
+            GossipTopics.sync_committee(self.digest, subnet),
+            frame_compress(msg.serialize()),
+        )
+
+    def publish_sync_contribution(self, signed_contribution) -> None:
+        self.stats["sync_contributions_out"] += 1
+        self.transport.publish(
+            GossipTopics.sync_committee_contribution(self.digest),
+            frame_compress(signed_contribution.serialize()),
+        )
+
+    def publish_proposer_slashing(self, slashing) -> None:
+        self.stats["proposer_slashings_out"] += 1
+        self.transport.publish(
+            GossipTopics.proposer_slashing(self.digest),
+            frame_compress(slashing.serialize()),
+        )
+
+    def publish_attester_slashing(self, slashing) -> None:
+        self.stats["attester_slashings_out"] += 1
+        self.transport.publish(
+            GossipTopics.attester_slashing(self.digest),
+            frame_compress(slashing.serialize()),
+        )
+
+    def publish_bls_change(self, signed_change) -> None:
+        self.stats["bls_changes_out"] += 1
+        self.transport.publish(
+            GossipTopics.bls_to_execution_change(self.digest),
+            frame_compress(signed_change.serialize()),
+        )
+
     # ------------------------------------------------------------ serving
 
     def _serve_blocks_by_range(self, start_slot: int, count: int) -> "list[bytes]":
@@ -299,6 +622,41 @@ class Network:
                     block = self.storage.finalized_block_by_root(root)
             if block is not None:
                 out.append(block.serialize())
+        return out
+
+    def _serve_blocks_by_root(self, roots: "list[bytes]") -> "list[bytes]":
+        """BeaconBlocksByRoot (p2p/src/network.rs:911-912): resolve a
+        delayed block's unknown parent without waiting for range sync."""
+        out = []
+        store = self.controller.store
+        for root in roots:
+            root = bytes(root)
+            node = store.blocks.get(root)
+            block = node.signed_block if node is not None else None
+            if (
+                block is None or not hasattr(block, "serialize")
+            ) and self.storage is not None:
+                block = self.storage.finalized_block_by_root(root)
+            if block is not None and hasattr(block, "serialize"):
+                out.append(block.serialize())
+        return out
+
+    def _serve_blobs_by_range(self, start_slot: int, count: int) -> "list[bytes]":
+        out = []
+        store = self.controller.store
+        for node in sorted(store.blocks.values(), key=lambda n: n.slot):
+            if start_slot <= node.slot < start_slot + count:
+                for sc in self.controller.blob_sidecars_for(node.root):
+                    out.append(sc.serialize())
+        return out
+
+    def _serve_blobs_by_root(self, ids: "list") -> "list[bytes]":
+        """ids: [(block_root, index), ...] (spec BlobIdentifier)."""
+        out = []
+        for root, index in ids:
+            for sc in self.controller.blob_sidecars_for(bytes(root)):
+                if int(sc.index) == int(index):
+                    out.append(sc.serialize())
         return out
 
     def _serve_status(self) -> dict:

@@ -54,7 +54,63 @@ class BlockSyncService:
         self.sync_manager = SyncManager(transport)
         # two epochs per round, like the reference's verification pool
         self.batch_size = batch_size or 2 * cfg.preset.SLOTS_PER_EPOCH
-        self.stats = {"requested": 0, "applied_batches": 0}
+        self.stats = {"requested": 0, "applied_batches": 0,
+                      "root_requests": 0, "blob_requests": 0}
+        # resolve delayed-by-parent blocks via BlocksByRoot instead of
+        # waiting for the next range round (p2p/src/network.rs:911-912)
+        if hasattr(controller, "on_unknown_parent"):
+            controller.on_unknown_parent.append(self._on_unknown_parent)
+
+    def _on_unknown_parent(self, parent_root: bytes) -> None:
+        """Mutator-thread hook: fetch the missing parent off-thread."""
+        def task() -> None:
+            self.sync_manager.refresh()
+            peer = self.sync_manager.best_peer()
+            if peer is None:
+                return
+            try:
+                raw = self.transport.request_blocks_by_root(
+                    peer, [parent_root]
+                )
+            except Exception:
+                return  # range sync remains the fallback
+            self.stats["root_requests"] += 1
+            for data in raw:
+                try:
+                    block = decode_signed_block(data, self.cfg)
+                except Exception:
+                    continue
+                self.controller.on_requested_block(block)
+
+        from grandine_tpu.runtime.thread_pool import Priority
+
+        self.controller.pool.spawn(task, Priority.LOW)
+
+    def _fetch_blobs(self, peer: str, blocks) -> None:
+        """Range-synced deneb blocks need their sidecars before the blob
+        gate lets them import (BlobsByRange; p2p/src/network.rs:15)."""
+        need = [
+            b for b in blocks
+            if getattr(b.message.body, "blob_kzg_commitments", None)
+        ]
+        if not need:
+            return
+        lo = min(int(b.message.slot) for b in need)
+        hi = max(int(b.message.slot) for b in need)
+        try:
+            raw = self.transport.request_blobs_by_range(peer, lo, hi - lo + 1)
+        except Exception:
+            return
+        self.stats["blob_requests"] += len(raw)
+        from grandine_tpu.types.containers import spec_types
+
+        ns = spec_types(self.cfg.preset).deneb
+        for data in raw:
+            try:
+                sidecar = ns.BlobSidecar.deserialize(data)
+            except Exception:
+                continue
+            self.controller.on_gossip_blob_sidecar(sidecar)
 
     def sync_once(self) -> bool:
         """One round: returns True when more work remains."""
@@ -88,6 +144,7 @@ class BlockSyncService:
 
             max_received = max(int(b.message.slot) for b in blocks)
             self.controller.on_tick(Tick(max_received, TickKind.AGGREGATE))
+            self._fetch_blobs(peer, blocks)
         for block in blocks:
             self.controller.on_requested_block(block)
         self.controller.wait()

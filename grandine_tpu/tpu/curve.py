@@ -67,6 +67,7 @@ class FieldOps:
     neg: Callable
     select: Callable  # (cond_bool_batch, a, b) -> a where cond else b
     is_zero: Callable  # elem -> bool batch
+    is_zero_many: Callable  # [elem] -> [bool batch] (one canonical pass)
     zeros_like: Callable
     one_like: Callable
     index: Callable  # (elem, idx) -> elem (numpy-style batch index)
@@ -102,6 +103,7 @@ FP_OPS = FieldOps(
     neg=L.neg_mod,
     select=L.select,
     is_zero=L.is_zero_val,
+    is_zero_many=L.is_zero_val_many,
     zeros_like=_fp_zeros_like,
     one_like=_fp_one_like,
     index=_fp_index,
@@ -118,6 +120,7 @@ FP2_OPS = FieldOps(
     neg=F.fp2_neg,
     select=F.fp2_select,
     is_zero=F.fp2_is_zero,
+    is_zero_many=F.fp2_is_zero_many,
     zeros_like=_fp2_zeros_like,
     one_like=_fp2_one_like,
     index=_fp2_index,
@@ -134,15 +137,17 @@ def point_infinity_like(x, ops: FieldOps):
 
 
 def point_double(p, ops: FieldOps):
-    """dbl-2009-l (a=0): complete on our curves (see module docstring)."""
+    """dbl-2009-l (a=0): complete on our curves (see module docstring).
+    Scheduled in THREE fused montmul levels (E = 3A is known after level 1,
+    so F = E² joins C/T1 in level 2) — sequential montmul calls are the
+    latency unit of every kernel built on these formulas."""
     X, Y, Z = p
     A, Bq, YZ = ops.mul_many([X, Y, Y], [X, Y, Z])
     XB = ops.add(X, Bq)
-    C, T1 = ops.mul_many([Bq, XB], [Bq, XB])
+    E = ops.add(ops.add(A, A), A)
+    C, T1, Fv = ops.mul_many([Bq, XB, E], [Bq, XB, E])
     D = ops.sub(T1, ops.add(A, C))
     D = ops.add(D, D)  # 2((X+B)² - A - C)
-    E = ops.add(ops.add(A, A), A)
-    (Fv,) = ops.mul_many([E], [E])
     X3 = ops.sub(Fv, ops.add(D, D))
     (t,) = ops.mul_many([E], [ops.sub(D, X3)])
     C2 = ops.add(C, C)
@@ -178,35 +183,55 @@ def point_madd_unsafe(p, qx, qy, ops: FieldOps):
 
 def point_add_complete(p, q, ops: FieldOps):
     """Full Jacobian addition handling ∞, P=Q (→ double) and P=-Q (→ ∞),
-    branchlessly (add-2007-bl + selects). For reduction trees over
-    adversary-influenced points."""
+    branchlessly (add-2007-bl + selects).
+
+    Scheduled in FIVE fused montmul levels with the 2P fallback's products
+    (dbl-2009-l on p) STACKED INTO the same calls — sequential montmul
+    calls, not field products, are the latency unit of the MSM scan and
+    every reduction tree, and the naive schedule (separate add + double,
+    four separate zero tests) pays 11 calls plus 4 canonicalization scans
+    where this pays 5 plus 1:
+      L1  Z1², Z2², + double's A=X1², B=Y1², YZ=Y1·Z1
+      L2  U1, U2, t1, t2, Z1·Z2 (Z3 = 2·Z1Z2·H replaces the
+          (Z1+Z2)²-Z1Z1-Z2Z2 form, saving the level-6 square),
+          + double's C=B², T1=(X1+B)², F=E²  (E = 3A)
+      L3  S1, S2, I=(2H)², Z3=(2·Z1Z2)·H, + double's t=E·(D−X3d)
+      L4  J=H·I, V=U1·I, r²
+      L5  t=r·(V−X3), S1·J
+    All four degeneracy tests (Z1, Z2, H, r zero) share one stacked
+    canonicalization pass."""
     X1, Y1, Z1 = p
     X2, Y2, Z2 = q
-    Z1Z1, Z2Z2 = ops.mul_many([Z1, Z2], [Z1, Z2])
-    U1, U2, t1, t2 = ops.mul_many(
-        [X1, X2, Z2, Z1], [Z2Z2, Z1Z1, Z2Z2, Z1Z1]
+    Z1Z1, Z2Z2, dA, dB, dYZ = ops.mul_many(
+        [Z1, Z2, X1, Y1, Y1], [Z1, Z2, X1, Y1, Z1]
     )
-    S1, S2 = ops.mul_many([Y1, Y2], [t1, t2])
+    dE = ops.add(ops.add(dA, dA), dA)
+    dXB = ops.add(X1, dB)
+    U1, U2, t1, t2, Z1Z2, dC, dT1, dF = ops.mul_many(
+        [X1, X2, Z2, Z1, Z1, dB, dXB, dE],
+        [Z2Z2, Z1Z1, Z2Z2, Z1Z1, Z2, dB, dXB, dE],
+    )
     H = ops.sub(U2, U1)
     H2 = ops.add(H, H)
-    (I,) = ops.mul_many([H2], [H2])
+    ZZ2 = ops.add(Z1Z2, Z1Z2)
+    dD = ops.sub(dT1, ops.add(dA, dC))
+    dD = ops.add(dD, dD)
+    dX3 = ops.sub(dF, ops.add(dD, dD))
+    S1, S2, I, Z3, dt = ops.mul_many(
+        [Y1, Y2, H2, ZZ2, dE],
+        [t1, t2, H2, H, ops.sub(dD, dX3)],
+    )
     r = ops.sub(S2, S1)
     r = ops.add(r, r)
+    p_inf, q_inf, eq_x, eq_y = ops.is_zero_many([Z1, Z2, H, r])
     J, V, R2 = ops.mul_many([H, U1, r], [I, I, r])
     X3 = ops.sub(R2, ops.add(J, ops.add(V, V)))
-    Z12 = ops.add(Z1, Z2)
-    t, S1J, Z12sq = ops.mul_many(
-        [r, S1, Z12], [ops.sub(V, X3), J, Z12]
-    )
+    t, S1J = ops.mul_many([r, S1], [ops.sub(V, X3), J])
     Y3 = ops.sub(t, ops.add(S1J, S1J))
-    Zpre = ops.sub(Z12sq, ops.add(Z1Z1, Z2Z2))
-    (Z3,) = ops.mul_many([Zpre], [H])
-
-    dbl = point_double(p, ops)
-    p_inf = ops.is_zero(Z1)
-    q_inf = ops.is_zero(Z2)
-    eq_x = ops.is_zero(H)
-    eq_y = ops.is_zero(r)
+    dC2 = ops.add(dC, dC)
+    dC4 = ops.add(dC2, dC2)
+    dY3 = ops.sub(dt, ops.add(dC4, dC4))
+    dbl = (dX3, dY3, ops.add(dYZ, dYZ))
     inf = point_infinity_like(X1, ops)
 
     def sel3(cond, a, b):
@@ -501,6 +526,46 @@ def g2_points_to_dev(points):
     flat = [c for quad in coords for c in quad]
     limbs = ints_to_mont_limbs(flat).reshape(n, 2, 2, L.NLIMBS)
     return limbs[:, 0], limbs[:, 1], inf
+
+
+def g2_points_to_packed(points):
+    """Anchor G2 points → ((N, 4, 13) uint32 packed canonical affine
+    coords [x.c0, x.c1, y.c0, y.c1], (N,) inf). Half the bytes of the
+    Montgomery limb REST format — for transfer-bound upload paths; the
+    device unpacks (limbs.unpack_words + one montmul by R²)."""
+    n = len(points)
+    inf = np.zeros(n, dtype=bool)
+    norms = []
+    for i, pt in enumerate(points):
+        if pt.is_infinity():
+            inf[i] = True
+            norms.append(0)
+        else:
+            z = pt.z
+            norms.append((z.c0.n * z.c0.n + z.c1.n * z.c1.n) % _P)
+    ninv = _batch_inv_mod_p(norms)
+    coords = []
+    for pt, nv in zip(points, ninv):
+        if nv == 0:
+            coords.extend((0, 0, 0, 0))
+            continue
+        z = pt.z
+        zi0 = z.c0.n * nv % _P
+        zi1 = (-z.c1.n) % _P * nv % _P
+        zi2_0 = (zi0 * zi0 - zi1 * zi1) % _P
+        zi2_1 = 2 * zi0 * zi1 % _P
+        zi3_0 = (zi2_0 * zi0 - zi2_1 * zi1) % _P
+        zi3_1 = (zi2_0 * zi1 + zi2_1 * zi0) % _P
+        x0, x1 = pt.x.c0.n, pt.x.c1.n
+        y0, y1 = pt.y.c0.n, pt.y.c1.n
+        coords.extend((
+            (x0 * zi2_0 - x1 * zi2_1) % _P,
+            (x0 * zi2_1 + x1 * zi2_0) % _P,
+            (y0 * zi3_0 - y1 * zi3_1) % _P,
+            (y0 * zi3_1 + y1 * zi3_0) % _P,
+        ))
+    packed = L.pack_fp_words_host(coords).reshape(n, 4, L.NWORDS)
+    return packed, inf
 
 
 def scalar_mul_glv(

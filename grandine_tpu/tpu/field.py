@@ -202,6 +202,16 @@ def fp2_is_zero(a):
     return jnp.logical_and(L.is_zero_val(a[0]), L.is_zero_val(a[1]))
 
 
+def fp2_is_zero_many(elems) -> list:
+    """Zero tests for K same-shape Fp2 elements in one canonicalization
+    pass (both components of every element share one stacked scan)."""
+    flat = [c for e in elems for c in (e[0], e[1])]
+    z = L.is_zero_val_many(flat)
+    return [
+        jnp.logical_and(z[2 * i], z[2 * i + 1]) for i in range(len(elems))
+    ]
+
+
 def fp2_select(cond, a, b):
     return (L.select(cond, a[0], b[0]), L.select(cond, a[1], b[1]))
 

@@ -255,6 +255,52 @@ def montsq(a) -> jnp.ndarray:
     return montmul(a, a)
 
 
+# --- packed transfer format -------------------------------------------------
+#
+# Canonical Fp values travel host→device as 13 little-endian uint32 words
+# (the 13th is always zero padding) — 52 bytes instead of the 104-byte
+# int32 limb form. The device unpacks to 15-bit limbs with static
+# shifts/gathers and one montmul by R² lifts the batch into Montgomery
+# form. Halving upload bytes matters because tunnel/PCIe transfers
+# serialize with execution on the per-batch clock (bench.py pipeline).
+
+NWORDS = 13
+_UNPACK_J = np.array([(15 * i) >> 5 for i in range(NLIMBS)], np.int32)
+_UNPACK_OFF = np.array([(15 * i) & 31 for i in range(NLIMBS)], np.int32)
+R2_DIGITS = [int(x) for x in int_to_limbs(R2)]
+
+
+def pack_fp_words_host(values) -> np.ndarray:
+    """Canonical ints → (N, 13) uint32 little-endian words."""
+    n = len(values)
+    out = np.zeros((n, NWORDS), np.uint32)
+    for i, v in enumerate(values):
+        v = int(v)
+        assert 0 <= v < (1 << 384)
+        for j in range(12):
+            out[i, j] = (v >> (32 * j)) & 0xFFFFFFFF
+    return out
+
+
+def unpack_words(w) -> jnp.ndarray:
+    """(…, 13) uint32 REST words → canonical device limbs (26, …) int32
+    (NON-Montgomery; multiply by R² via montmul to enter the field)."""
+    w = jnp.asarray(w, jnp.uint32)
+    j = jnp.asarray(_UNPACK_J)
+    off = jnp.asarray(_UNPACK_OFF.astype(np.uint32))
+    lo = jnp.take(w, j, axis=-1) >> off  # (…, 26)
+    hi_src = jnp.take(w, j + 1, axis=-1)
+    hi = jnp.where(off == 0, jnp.uint32(0), hi_src << (32 - off))
+    limbs = ((lo | hi) & jnp.uint32(MASK)).astype(_DT)
+    return jnp.moveaxis(limbs, -1, 0)
+
+
+def to_mont_dev(x_canonical) -> jnp.ndarray:
+    """Canonical device limbs → Montgomery form (one fused montmul)."""
+    r2 = const_fp(R2_DIGITS, x_canonical.shape[1:])
+    return montmul(x_canonical, r2)
+
+
 def pow_fixed(a, exponent: int) -> jnp.ndarray:
     """a^e for a host-known exponent (LSB-first square-and-multiply scan)."""
     nbits = max(exponent.bit_length(), 1)
@@ -305,6 +351,15 @@ def is_zero_val(a) -> jnp.ndarray:
     pats = pats.reshape((NLIMBS, 16) + (1,) * (canon.ndim - 1))
     eq = canon[:, None] == pats  # (26, 16, *batch)
     return jnp.any(jnp.all(eq, axis=0), axis=0)
+
+
+def is_zero_val_many(elems) -> list:
+    """Zero tests for K same-shape elements in ONE canonicalization pass
+    (canonical_digits is a 25-step sequential scan — the dominant latency of
+    a zero test at narrow widths; stacking amortizes it)."""
+    stacked = stack_fp(list(elems))  # (26, K, *batch)
+    z = is_zero_val(stacked)  # (K, *batch)
+    return [z[i] for i in range(len(elems))]
 
 
 def is_one_mont(a) -> jnp.ndarray:

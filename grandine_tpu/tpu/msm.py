@@ -37,6 +37,7 @@ slots are algebraically neutral — no masks in the hot loop.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 
 import numpy as np
@@ -45,6 +46,15 @@ import jax.numpy as jnp
 from jax import lax
 
 from grandine_tpu.tpu import curve as C
+
+#: Scan lane count T (bucket-accumulation width). More lanes = fewer
+#: sequential scan steps (S = ceil(2NW / T)), BUT the montmul inner scan
+#: carries 27 column accumulators of width (products × T) that must live
+#: in VMEM: at T=32768 with ~8 stacked products that carry is ~28 MB and
+#: SPILLS (measured 5× slower end-to-end on v5e via
+#: device_residency_probe variant C: 391 ms at 8192 vs 2100 ms at 32768).
+#: 8192 keeps the carry ~5 MB — comfortably resident.
+MSM_LANES = int(os.environ.get("GT_MSM_LANES", "8192"))
 
 
 def _next_pow2(n: int) -> int:
@@ -87,7 +97,7 @@ def plan_msm(
     group_of_point=None,
     n_groups: int = 1,
     window_bits: int = 8,
-    lanes: int = 8192,
+    lanes: "int | None" = None,
     j_min: int = 2,
 ) -> MsmPlan:
     """Build the device plan for Σᵢ (r0ᵢ + r1ᵢ·λ)·Pᵢ (per group).
@@ -130,7 +140,7 @@ def plan_msm(
     # T lanes × S slots; lane t owns sorted ranks [t·S, (t+1)·S). S is a
     # static function of the UNPRUNED total so jit shapes don't depend on
     # the random scalars.
-    T = int(lanes)
+    T = int(lanes if lanes is not None else MSM_LANES)
     total = 2 * n * W
     while T > 256 and total < 8 * T:
         T //= 2

@@ -110,8 +110,19 @@ MINIMAL = Preset(
 )
 
 
+# Medalla testnet: mainnet with the early-2020 penalty parameters and a
+# 32-epoch eth1 voting period (reference types/src/preset.rs:350-409).
+MEDALLA = Preset(
+    name="medalla",
+    EPOCHS_PER_ETH1_VOTING_PERIOD=32,
+    INACTIVITY_PENALTY_QUOTIENT=1 << 24,
+    MIN_SLASHING_PENALTY_QUOTIENT=32,
+    PROPORTIONAL_SLASHING_MULTIPLIER=3,
+)
+
+
 def by_name(name: str) -> Preset:
-    presets = {"mainnet": MAINNET, "minimal": MINIMAL}
+    presets = {"mainnet": MAINNET, "minimal": MINIMAL, "medalla": MEDALLA}
     try:
         return presets[name]
     except KeyError:

@@ -194,6 +194,7 @@ def produce_block_unsigned(
     bls_to_execution_changes: "Sequence" = (),
     graffiti: bytes = b"",
     sync_aggregate=None,
+    blob_kzg_commitments: "Sequence" = (),
 ):
     """Build an UNSIGNED BeaconBlock for `slot` with a caller-provided
     `randao_reveal` — the Beacon API produce-block path
@@ -235,6 +236,10 @@ def produce_block_unsigned(
         )
     if phase >= Phase.CAPELLA:
         body_fields["bls_to_execution_changes"] = bls_to_execution_changes
+    if phase >= Phase.DENEB:
+        body_fields["blob_kzg_commitments"] = [
+            bytes(c) for c in blob_kzg_commitments
+        ]
 
     body = ns.BeaconBlockBody(**body_fields)
     block = ns.BeaconBlock(

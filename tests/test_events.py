@@ -242,3 +242,32 @@ def test_sse_stream_rejects_unknown_topic():
     finally:
         server.shutdown()
         ctrl.stop()
+
+
+def test_blob_sidecar_event_published():
+    """A validated sidecar fires the SSE blob_sidecar event with its
+    versioned hash (events.rs BlobSidecarEvent)."""
+    from grandine_tpu.consensus.verifier import NullVerifier
+    from grandine_tpu.http_api.events import EventBus, wire_controller_events
+    from grandine_tpu.runtime.controller import Controller
+    from tests.test_blob_plane import CFG as BCFG, blob_block
+
+    from grandine_tpu.transition.genesis import interop_genesis_state
+
+    genesis = interop_genesis_state(16, BCFG)
+    ctrl = Controller(genesis, BCFG, verifier_factory=NullVerifier)
+    bus = EventBus()
+    wire_controller_events(ctrl, bus)
+    sub = bus.subscribe(["blob_sidecar"])
+    try:
+        signed, _post, sidecars = blob_block(genesis, 1)
+        ctrl.on_gossip_blob_sidecar(sidecars[0])
+        ctrl.wait()
+        got = sub.next(timeout=5)
+        assert got is not None
+        topic, data = got
+        assert topic == "blob_sidecar"
+        assert data["index"] == "0"
+        assert data["versioned_hash"].startswith("0x01")
+    finally:
+        ctrl.stop()

@@ -438,3 +438,23 @@ def test_node_identity_and_peers(router, ctx):
     assert status == 200 and payload["meta"]["count"] == 0
     status, payload = router.dispatch(ctx, "GET", "/eth/v1/node/peer_count")
     assert status == 200 and payload["data"]["connected"] == "0"
+
+
+def test_debug_routes(router, ctx):
+    """/eth/v1/debug/fork_choice, /eth/v2/debug/beacon/heads, and the
+    debug state dump (http_api/src/routing.rs:460-467)."""
+    status, body = router.dispatch(ctx, "GET", "/eth/v1/debug/fork_choice")
+    assert status == 200
+    assert body["fork_choice_nodes"]
+    n0 = body["fork_choice_nodes"][0]
+    assert {"slot", "block_root", "weight", "validity"} <= set(n0)
+
+    status, body = router.dispatch(ctx, "GET", "/eth/v2/debug/beacon/heads")
+    assert status == 200
+    assert body["data"][0]["root"].startswith("0x")
+
+    status, body = router.dispatch(
+        ctx, "GET", "/eth/v2/debug/beacon/states/head"
+    )
+    assert status == 200
+    assert body["data"]["ssz"].startswith("0x")

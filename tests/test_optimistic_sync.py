@@ -1,0 +1,244 @@
+"""Optimistic-sync tests: SYNCING imports are marked optimistic, async
+engine verdicts promote (VALID) or prune (INVALID, with head retreat) —
+reference fork_choice_control/src/controller.rs:236-247
+(on_notified_new_payload / on_notified_fork_choice_update) and
+execution_engine/src/execution_engine.rs:21-54.
+"""
+
+import pytest
+
+from grandine_tpu.consensus.verifier import NullVerifier
+from grandine_tpu.execution import (
+    MockExecutionEngine,
+    PayloadStatus,
+)
+from grandine_tpu.fork_choice import ForkChoiceError, Store, Tick, TickKind
+from grandine_tpu.transition.genesis import interop_genesis_state
+from grandine_tpu.types.config import Config
+from grandine_tpu.validator.duties import produce_block
+
+CFG = Config.minimal()
+P = CFG.preset
+
+
+@pytest.fixture()
+def genesis():
+    return interop_genesis_state(32, CFG)
+
+
+def _exec_hash(signed_block) -> bytes:
+    return bytes(signed_block.message.body.execution_payload.block_hash)
+
+
+def add_block(store, state, slot, timely=True):
+    blk, post = produce_block(state, slot, CFG, full_sync_participation=False)
+    store.apply_tick(Tick(slot, TickKind.PROPOSE if timely else TickKind.ATTEST))
+    valid = store.validate_block(blk, NullVerifier())
+    store.apply_block(valid)
+    return blk, valid, post
+
+
+def test_syncing_import_marks_optimistic_and_valid_promotes(genesis):
+    engine = MockExecutionEngine(default=PayloadStatus.SYNCING)
+    store = Store(genesis, CFG, execution_engine=engine)
+    b1, valid1, post1 = add_block(store, genesis, 1)
+    assert valid1.optimistic
+    assert store.is_optimistic(valid1.root)
+    assert store.is_optimistic()  # head is the optimistic block
+
+    b2, valid2, _post2 = add_block(store, post1, 2)
+    assert valid2.optimistic  # whole chain unjudged
+    assert store.is_optimistic(valid2.root)
+
+    # async VALID for the TIP validates the whole ancestor chain
+    removed = store.apply_payload_status(_exec_hash(b2), PayloadStatus.VALID)
+    assert removed == []
+    assert not store.is_optimistic(valid2.root)
+    assert not store.is_optimistic(valid1.root)
+    assert not store.is_optimistic()
+
+
+def test_valid_child_import_promotes_optimistic_ancestors(genesis):
+    engine = MockExecutionEngine(default=PayloadStatus.SYNCING)
+    store = Store(genesis, CFG, execution_engine=engine)
+    b1, valid1, post1 = add_block(store, genesis, 1)
+    assert store.is_optimistic(valid1.root)
+
+    # the EL catches up: the NEXT block's payload is judged VALID inline,
+    # which (engine-API semantics) validates the ancestors too
+    b2, post2 = produce_block(post1, 2, CFG, full_sync_participation=False)
+    engine.status_for[_exec_hash(b2)] = PayloadStatus.VALID
+    store.apply_tick(Tick(2, TickKind.PROPOSE))
+    valid2 = store.validate_block(b2, NullVerifier())
+    assert not valid2.optimistic
+    store.apply_block(valid2)
+    assert not store.is_optimistic(valid1.root)
+
+
+def test_invalid_prunes_branch_and_head_retreats(genesis):
+    engine = MockExecutionEngine(default=PayloadStatus.SYNCING)
+    store = Store(genesis, CFG, execution_engine=engine)
+    # two branches off genesis: a1 (slot 1, judged VALID) and
+    # b1 <- b2 (slots 2, 3, optimistic)
+    a1_blk, a1_post = produce_block(genesis, 1, CFG, full_sync_participation=False)
+    engine.status_for[_exec_hash(a1_blk)] = PayloadStatus.VALID
+    store.apply_tick(Tick(1, TickKind.PROPOSE))
+    a1 = store.validate_block(a1_blk, NullVerifier())
+    store.apply_block(a1)
+
+    b1_blk, b1, b1_post = add_block(store, genesis, 2)
+    b2_blk, b2, _ = add_block(store, b1_post, 3)
+    assert b1.optimistic and b2.optimistic
+    # last timely block gets the proposer boost: head = b2
+    assert store.get_head() == b2.root
+
+    removed = store.apply_payload_status(
+        _exec_hash(b1_blk), PayloadStatus.INVALID
+    )
+    assert set(removed) == {b1.root, b2.root}
+    assert b1.root not in store.blocks and b2.root not in store.blocks
+    assert store.get_head() == a1.root  # head retreated to the valid branch
+    assert not store.is_optimistic()
+
+
+def test_invalid_with_latest_valid_hash_extends_invalidation(genesis):
+    engine = MockExecutionEngine(default=PayloadStatus.SYNCING)
+    store = Store(genesis, CFG, execution_engine=engine)
+    b1_blk, b1, post1 = add_block(store, genesis, 1)
+    b2_blk, b2, post2 = add_block(store, post1, 2)
+    b3_blk, b3, _ = add_block(store, post2, 3)
+
+    # INVALID for the tip with latest_valid_hash = b1's payload: b2 and b3
+    # are invalid, b1 survives
+    removed = store.apply_payload_status(
+        _exec_hash(b3_blk), PayloadStatus.INVALID,
+        latest_valid_hash=_exec_hash(b1_blk),
+    )
+    assert set(removed) == {b2.root, b3.root}
+    assert b1.root in store.blocks
+    assert store.get_head() == b1.root
+
+
+def test_invalidating_finalized_chain_is_fatal(genesis):
+    engine = MockExecutionEngine(default=PayloadStatus.SYNCING)
+    store = Store(genesis, CFG, execution_engine=engine)
+    b1_blk, b1, post1 = add_block(store, genesis, 1)
+    # pretend b1 is finalized (simulate: point the finalized checkpoint at it)
+    Checkpoint = type(genesis.finalized_checkpoint)
+    store.finalized_checkpoint = Checkpoint(epoch=1, root=b1.root)
+    with pytest.raises(ForkChoiceError, match="finalized"):
+        store.apply_payload_status(_exec_hash(b1_blk), PayloadStatus.INVALID)
+
+
+def test_optimistic_import_gate(genesis):
+    class NoOptimistic(MockExecutionEngine):
+        def allow_optimistic_import(self):
+            return False
+
+    engine = NoOptimistic(default=PayloadStatus.SYNCING)
+    store = Store(genesis, CFG, execution_engine=engine)
+    blk, _post = produce_block(genesis, 1, CFG, full_sync_participation=False)
+    store.apply_tick(Tick(1, TickKind.PROPOSE))
+    with pytest.raises(ForkChoiceError, match="optimistic"):
+        store.validate_block(blk, NullVerifier())
+
+
+def test_controller_async_verdicts_and_syncing_endpoint(genesis):
+    from grandine_tpu.runtime.controller import Controller
+
+    engine = MockExecutionEngine(default=PayloadStatus.SYNCING)
+    ctrl = Controller(genesis, CFG, execution_engine=engine,
+                      verifier_factory=NullVerifier)
+    try:
+        blk, post1 = produce_block(genesis, 1, CFG,
+                                   full_sync_participation=False)
+        ctrl.on_tick(Tick(1, TickKind.PROPOSE))
+        ctrl.on_gossip_block(blk)
+        ctrl.wait()
+        snap = ctrl.snapshot()
+        assert snap.head_root == blk.message.hash_tree_root()
+        assert snap.is_optimistic
+
+        # the Beacon API surfaces the optimistic flag honestly
+        from grandine_tpu.http_api.routing import get_node_syncing
+
+        class Ctx:
+            snapshot = staticmethod(ctrl.snapshot)
+
+        body = get_node_syncing(Ctx, {}, {}, None)
+        assert body["data"]["is_optimistic"] is True
+
+        # SYNCING -> VALID promotion
+        ctrl.on_notified_new_payload(_exec_hash(blk), PayloadStatus.VALID)
+        ctrl.wait()
+        assert not ctrl.snapshot().is_optimistic
+        assert get_node_syncing(Ctx, {}, {}, None)["data"]["is_optimistic"] is False
+    finally:
+        ctrl.stop()
+
+
+def test_controller_invalid_retreats_head_and_fires_head_change(genesis):
+    from grandine_tpu.runtime.controller import Controller
+
+    engine = MockExecutionEngine(default=PayloadStatus.SYNCING)
+    ctrl = Controller(genesis, CFG, execution_engine=engine,
+                      verifier_factory=NullVerifier)
+    try:
+        a1_blk, _ = produce_block(genesis, 1, CFG,
+                                  full_sync_participation=False)
+        engine.status_for[_exec_hash(a1_blk)] = PayloadStatus.VALID
+        ctrl.on_tick(Tick(1, TickKind.PROPOSE))
+        ctrl.on_gossip_block(a1_blk)
+        ctrl.wait()
+
+        b1_blk, _ = produce_block(genesis, 2, CFG,
+                                  full_sync_participation=False)
+        ctrl.on_tick(Tick(2, TickKind.PROPOSE))
+        ctrl.on_gossip_block(b1_blk)
+        ctrl.wait()
+        assert ctrl.snapshot().head_root == b1_blk.message.hash_tree_root()
+
+        heads = []
+        ctrl.on_head_change.append(lambda old, snap: heads.append(snap.head_root))
+        ctrl.on_notified_forkchoice_updated(
+            _exec_hash(b1_blk), PayloadStatus.INVALID
+        )
+        ctrl.wait()
+        snap = ctrl.snapshot()
+        assert snap.head_root == a1_blk.message.hash_tree_root()
+        assert not snap.is_optimistic
+        assert heads == [a1_blk.message.hash_tree_root()]
+    finally:
+        ctrl.stop()
+
+
+def test_head_change_sends_forkchoice_updated_and_applies_verdict(genesis):
+    """Every head move notifies the EL (engine_forkchoiceUpdated) off the
+    mutator thread; the returned VALID verdict promotes the optimistic
+    head without an explicit on_notified_* call."""
+    from grandine_tpu.runtime.controller import Controller
+
+    engine = MockExecutionEngine(default=PayloadStatus.SYNCING)
+    ctrl = Controller(genesis, CFG, execution_engine=engine,
+                      verifier_factory=NullVerifier)
+    try:
+        blk, _ = produce_block(genesis, 1, CFG, full_sync_participation=False)
+        # the EL answers VALID to the fcU for this head
+        engine.status_for[_exec_hash(blk)] = PayloadStatus.SYNCING
+        ctrl.on_tick(Tick(1, TickKind.PROPOSE))
+        ctrl.on_gossip_block(blk)
+        ctrl.wait()
+        assert engine.forkchoice_calls  # fcU was sent for the new head
+        head_hash, safe_hash, fin_hash = engine.forkchoice_calls[-1]
+        assert head_hash == _exec_hash(blk)
+        assert ctrl.snapshot().is_optimistic  # fcU answered SYNCING
+
+        # the EL catches up: next fcU (triggered by the next head) VALID
+        engine.status_for[_exec_hash(blk)] = PayloadStatus.VALID
+        ctrl.on_notified_forkchoice_updated(
+            _exec_hash(blk), PayloadStatus.VALID
+        )
+        ctrl.wait()
+        assert not ctrl.snapshot().is_optimistic
+    finally:
+        ctrl.stop()

@@ -174,3 +174,128 @@ def test_two_process_devnet_reaches_finality(tmp_path):
     finally:
         proposer.kill()
         proposer.wait()
+
+
+# --- wire robustness (VERDICT r4 weak #8) ----------------------------------
+
+
+def _raw_hello(port, digest=DIGEST, peer_id="raw"):
+    """Open a raw socket and speak just enough protocol to be a peer."""
+    import json as _json
+    import struct as _struct
+
+    s = socket.create_connection(("127.0.0.1", port))
+    body = _json.dumps(
+        {"peer_id": peer_id, "fork_digest": digest.hex()}
+    ).encode()
+    s.sendall(_struct.pack(">BI", 1, len(body)) + body)
+    return s
+
+
+def _wait_peer(t, peer_id, timeout=3.0):
+    deadline = time.time() + timeout
+    while peer_id not in t.peers() and time.time() < deadline:
+        time.sleep(0.01)
+    return peer_id in t.peers()
+
+
+def test_garbage_and_unknown_frames_do_not_kill_the_node():
+    import struct as _struct
+
+    t = _mk()
+    try:
+        s = _raw_hello(t.port)
+        assert _wait_peer(t, "raw")
+        # unknown frame kind: counted, connection survives
+        s.sendall(_struct.pack(">BI", 99, 3) + b"abc")
+        # garbage gossip body (bad topic length prefix)
+        s.sendall(_struct.pack(">BI", 2, 2) + b"\xff\xff")
+        time.sleep(0.2)
+        assert t.stats["unknown_frames"] >= 1
+        # node is still serving: a real peer can connect and gossip
+        b = _mk()
+        try:
+            b.connect("127.0.0.1", t.port)
+            got = []
+            t.subscribe("topic/ok", lambda _t, p: got.append(p))
+            b.publish("topic/ok", b"alive")
+            deadline = time.time() + 3
+            while not got and time.time() < deadline:
+                time.sleep(0.01)
+            assert got == [b"alive"]
+        finally:
+            b.close()
+    finally:
+        t.close()
+
+
+def test_oversized_frame_drops_the_peer():
+    import struct as _struct
+
+    t = _mk()
+    try:
+        s = _raw_hello(t.port)
+        assert _wait_peer(t, "raw")
+        # header claims 128 MiB (> the 64 MiB cap): peer must be dropped
+        s.sendall(_struct.pack(">BI", 2, 1 << 27))
+        deadline = time.time() + 3
+        while "raw" in t.peers() and time.time() < deadline:
+            time.sleep(0.01)
+        assert "raw" not in t.peers()
+    finally:
+        t.close()
+
+
+def test_mid_frame_disconnect_is_clean():
+    import struct as _struct
+
+    t = _mk()
+    try:
+        s = _raw_hello(t.port)
+        assert _wait_peer(t, "raw")
+        # announce a 1000-byte frame, send half, vanish
+        s.sendall(_struct.pack(">BI", 2, 1000) + b"x" * 500)
+        s.close()
+        deadline = time.time() + 3
+        while "raw" in t.peers() and time.time() < deadline:
+            time.sleep(0.01)
+        assert "raw" not in t.peers()
+        assert t.stats["handler_errors"] == 0
+    finally:
+        t.close()
+
+
+def test_slow_reader_is_dropped_not_blocking_the_relay():
+    """A peer that handshakes and then never reads: once its per-peer
+    write buffer passes the bound, the node DROPS it; publishes keep
+    flowing to healthy peers throughout."""
+    t, healthy = _mk(), _mk()
+    got = []
+    healthy.subscribe("topic/flood", lambda _t, p: got.append(p))
+    try:
+        s = _raw_hello(t.port, peer_id="sloth")
+        assert _wait_peer(t, "sloth")
+        # make the slow peer's kernel buffers tiny so back-pressure hits
+        # the sender's queue instead of the OS absorbing the flood
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4096)
+        healthy.connect("127.0.0.1", t.port)
+        chunk = b"y" * (1 << 20)  # 1 MiB per publish
+        deadline = time.time() + 20
+        dropped = False
+        i = 0
+        while time.time() < deadline:
+            t.publish("topic/flood", chunk + i.to_bytes(4, "big"))
+            i += 1
+            if "sloth" not in t.peers():
+                dropped = True
+                break
+        assert dropped, "slow peer was never dropped"
+        assert t.stats["slow_peer_drops"] >= 1
+        # healthy peer kept receiving the whole time
+        deadline = time.time() + 5
+        while len(got) < i and time.time() < deadline:
+            time.sleep(0.05)
+        assert len(got) == i
+    finally:
+        t.close()
+        healthy.close()

@@ -236,3 +236,44 @@ def test_in_process_node_runs_epochs(genesis):
         assert node.attestation_verifier.stats["rejected"] == 0
     finally:
         node.stop()
+
+
+def test_kernel_warmup_manifest():
+    """The startup warmer compiles every manifest entry without error
+    (tiny buckets here — same code path, CPU-sized shapes)."""
+    from grandine_tpu.runtime import warmup
+
+    entries = [("aggregate", 4), ("multi_verify", 16), ("subgroup", 4)]
+    msgs = []
+    done = warmup.warm_all(entries, progress=msgs.append)
+    assert done == len(entries)
+    assert all("FAILED" not in m for m in msgs)
+    assert len(warmup.manifest()) >= 10
+
+
+def test_remote_metrics_push():
+    """RemoteMetricsService pushes the beaconcha.in client-stats shape
+    (one beaconnode + one system entry) and counts failures without
+    raising (metrics/src/service.rs + beaconchain.rs)."""
+    from grandine_tpu.metrics import Metrics, RemoteMetricsService
+
+    got = []
+
+    def fake_post(url, body):
+        got.append((url, body))
+        return 200
+
+    svc = RemoteMetricsService(
+        "http://push.example/stats", Metrics(), post=fake_post
+    )
+    assert svc.push_once()
+    url, body = got[0]
+    assert url == "http://push.example/stats"
+    procs = {e["process"] for e in body}
+    assert procs == {"beaconnode", "system"}
+    assert all("timestamp" in e and e["version"] == 1 for e in body)
+    assert svc.stats == {"pushes": 1, "failures": 0}
+
+    svc.post = lambda u, b: (_ for _ in ()).throw(OSError("down"))
+    assert not svc.push_once()
+    assert svc.stats["failures"] == 1

@@ -1,0 +1,617 @@
+"""Hand-encoded end-to-end conformance cases (VERDICT r4 #3).
+
+Unlike the rest of the suite, the EXPECTED post-states here are not
+produced by the transition code under test: each case reconstructs the
+post-state by hand — applying the spec text's prescribed mutations
+(formulas transcribed inline with literal spec constants, hashes via
+hashlib, roots via the SSZ layer, which has its own independent suites) —
+and requires the implementation's full post-state ROOT to match. Any
+unexpected field change, wrong reward amount, or missed update moves the
+root and fails the case.
+
+Reference counterpart: the consensus-spec-tests operations/sanity replays
+(transition_functions/src/*/block_processing.rs:550-605); the official
+vectors are not vendorable offline, so these cases are derived from the
+spec text (phase0/altair/capella/deneb beacon-chain.md) instead.
+
+Spec constants are written as literals on purpose — reading them from the
+implementation's Preset would let a mistyped constant cancel out.
+"""
+
+import hashlib
+
+import pytest
+
+from grandine_tpu.consensus import accessors
+from grandine_tpu.consensus.verifier import NullVerifier
+from grandine_tpu.transition.combined import custom_state_transition
+from grandine_tpu.transition.genesis import interop_genesis_state
+from grandine_tpu.transition.slots import process_slots
+from grandine_tpu.types.config import Config
+from grandine_tpu.types.containers import spec_types
+from grandine_tpu.validator.duties import _interop_keys
+
+CFG = Config.minimal()
+P = CFG.preset
+NS = spec_types(P).deneb
+
+# --- spec constants, transcribed as literals (minimal preset / deneb) ------
+SLOTS_PER_EPOCH = 8
+SLOTS_PER_HISTORICAL_ROOT = 64
+EPOCHS_PER_HISTORICAL_VECTOR = 64
+EPOCHS_PER_ETH1_VOTING_PERIOD = 4
+SECONDS_PER_SLOT = 6
+MAX_SEED_LOOKAHEAD = 4
+MIN_VALIDATOR_WITHDRAWABILITY_DELAY = 256
+EFFECTIVE_BALANCE_INCREMENT = 10**9
+MAX_EFFECTIVE_BALANCE = 32 * 10**9
+BASE_REWARD_FACTOR = 64
+MIN_SLASHING_PENALTY_QUOTIENT_BELLATRIX = 32
+WHISTLEBLOWER_REWARD_QUOTIENT = 512
+PROPOSER_REWARD_QUOTIENT = 8
+MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP = 16
+TIMELY_SOURCE_WEIGHT = 14
+TIMELY_TARGET_WEIGHT = 26
+TIMELY_HEAD_WEIGHT = 14
+SYNC_REWARD_WEIGHT = 2
+PROPOSER_WEIGHT = 8
+WEIGHT_DENOMINATOR = 64
+TIMELY_SOURCE_FLAG = 1 << 0
+TIMELY_TARGET_FLAG = 1 << 1
+TIMELY_HEAD_FLAG = 1 << 2
+
+N_VALIDATORS = 16
+ZERO32 = b"\x00" * 32
+
+
+def sha256(b: bytes) -> bytes:
+    return hashlib.sha256(b).digest()
+
+
+@pytest.fixture(scope="module")
+def genesis():
+    return interop_genesis_state(N_VALIDATORS, CFG)
+
+
+# --- hand-transcribed spec helpers -----------------------------------------
+
+
+def hand_process_slot(state):
+    """Spec `process_slot` transcribed: cache the state root, backfill the
+    header's state root, cache the block root, bump the slot."""
+    slot = int(state.slot)
+    prev_state_root = state.hash_tree_root()
+    state_roots = list(state.state_roots)
+    state_roots[slot % SLOTS_PER_HISTORICAL_ROOT] = prev_state_root
+    header = state.latest_block_header
+    if bytes(header.state_root) == ZERO32:
+        header = header.replace(state_root=prev_state_root)
+    block_roots = list(state.block_roots)
+    block_roots[slot % SLOTS_PER_HISTORICAL_ROOT] = header.hash_tree_root()
+    return state.replace(
+        state_roots=state_roots,
+        block_roots=block_roots,
+        latest_block_header=header,
+        slot=slot + 1,
+    )
+
+
+def hand_process_slots(state, target: int):
+    """Spec `process_slots` for targets INSIDE the current epoch (no
+    epoch-boundary processing transcribed here)."""
+    while int(state.slot) < target:
+        assert (int(state.slot) + 1) % SLOTS_PER_EPOCH != 0, (
+            "hand helper only covers intra-epoch advances"
+        )
+        state = hand_process_slot(state)
+    return state
+
+
+def hand_payload(state_after_slots, block_hash=b"\x42" * 32):
+    """A minimal ExecutionPayload consistent with the advanced pre-state
+    (the consistency rules of spec `process_execution_payload`)."""
+    slot = int(state_after_slots.slot)
+    epoch = slot // SLOTS_PER_EPOCH
+    prev_randao = bytes(
+        state_after_slots.randao_mixes[epoch % EPOCHS_PER_HISTORICAL_VECTOR]
+    )
+    return NS.ExecutionPayload(
+        parent_hash=bytes(
+            state_after_slots.latest_execution_payload_header.block_hash
+        ),
+        prev_randao=prev_randao,
+        timestamp=int(state_after_slots.genesis_time) + slot * SECONDS_PER_SLOT,
+        block_hash=block_hash,
+    )
+
+
+def hand_block(state_advanced, proposer_index: int, body):
+    """The unsigned block shell for the advanced state (spec
+    `process_block_header` inputs)."""
+    parent_header = state_advanced.latest_block_header
+    if bytes(parent_header.state_root) == ZERO32:
+        # process_slots has always backfilled it on the advanced state
+        raise AssertionError("advance the state first")
+    return NS.BeaconBlock(
+        slot=int(state_advanced.slot),
+        proposer_index=proposer_index,
+        parent_root=parent_header.hash_tree_root(),
+        state_root=ZERO32,  # policy "trust": not checked
+        body=body,
+    )
+
+
+def hand_block_shell_post(state_advanced, block):
+    """Expected state after the NON-operation parts of spec process_block
+    on an otherwise-empty deneb block: process_block_header,
+    process_withdrawals (none due — genesis credentials are 0x00),
+    process_execution_payload, process_randao, process_eth1_data,
+    process_sync_aggregate (deltas from the block's own bits).
+    Operation cases apply their deltas on top of this."""
+    body = block.body
+    block_proposer_index = int(block.proposer_index)
+    # process_block_header: store the header with a ZERO state root
+    new_header = NS.BeaconBlockHeader(
+        slot=int(block.slot),
+        proposer_index=int(block.proposer_index),
+        parent_root=bytes(block.parent_root),
+        state_root=ZERO32,
+        body_root=body.hash_tree_root(),
+    )
+    # process_withdrawals: expected list is empty (no 0x01 credentials),
+    # sweep pointer advances by min(sweep, n) ... (i + sweep) % n
+    next_wv = (
+        int(state_advanced.next_withdrawal_validator_index)
+        + MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP
+    ) % N_VALIDATORS
+    # process_execution_payload: header copy of the payload
+    payload = body.execution_payload
+    payload_header = NS.ExecutionPayloadHeader(
+        parent_hash=bytes(payload.parent_hash),
+        fee_recipient=bytes(payload.fee_recipient),
+        state_root=bytes(payload.state_root),
+        receipts_root=bytes(payload.receipts_root),
+        logs_bloom=bytes(payload.logs_bloom),
+        prev_randao=bytes(payload.prev_randao),
+        block_number=int(payload.block_number),
+        gas_limit=int(payload.gas_limit),
+        gas_used=int(payload.gas_used),
+        timestamp=int(payload.timestamp),
+        extra_data=bytes(payload.extra_data),
+        base_fee_per_gas=int(payload.base_fee_per_gas),
+        block_hash=bytes(payload.block_hash),
+        transactions_root=payload.transactions.hash_tree_root(),
+        withdrawals_root=payload.withdrawals.hash_tree_root(),
+        blob_gas_used=int(payload.blob_gas_used),
+        excess_blob_gas=int(payload.excess_blob_gas),
+    )
+    # process_randao: mix ^= sha256(reveal)
+    epoch = int(state_advanced.slot) // SLOTS_PER_EPOCH
+    mixes = list(state_advanced.randao_mixes)
+    i = epoch % EPOCHS_PER_HISTORICAL_VECTOR
+    mixes[i] = bytes(
+        a ^ b
+        for a, b in zip(bytes(mixes[i]), sha256(bytes(body.randao_reveal)))
+    )
+    # process_eth1_data: append the vote
+    votes = list(state_advanced.eth1_data_votes) + [body.eth1_data]
+    # process_sync_aggregate: participants earn participant_reward (and
+    # the proposer a cut per participant); NON-participants are penalized
+    # participant_reward each — an all-false aggregate still moves
+    # balances (altair beacon-chain.md process_sync_aggregate)
+    import math
+
+    total_active = N_VALIDATORS * MAX_EFFECTIVE_BALANCE
+    total_increments = total_active // EFFECTIVE_BALANCE_INCREMENT
+    per_increment = (
+        EFFECTIVE_BALANCE_INCREMENT * BASE_REWARD_FACTOR
+        // math.isqrt(total_active)
+    )
+    total_base_rewards = per_increment * total_increments
+    max_participant_rewards = (
+        total_base_rewards * SYNC_REWARD_WEIGHT
+        // WEIGHT_DENOMINATOR
+        // SLOTS_PER_EPOCH
+    )
+    participant_reward = max_participant_rewards // 32  # SYNC_COMMITTEE_SIZE
+    proposer_cut = (
+        participant_reward * PROPOSER_WEIGHT
+        // (WEIGHT_DENOMINATOR - PROPOSER_WEIGHT)
+    )
+    pk_to_idx = {
+        bytes(v.pubkey): i
+        for i, v in enumerate(state_advanced.validators)
+    }
+    bals = [int(b) for b in state_advanced.balances]
+    bits = list(body.sync_aggregate.sync_committee_bits)
+    for bit, pk in zip(bits, state_advanced.current_sync_committee.pubkeys):
+        vidx = pk_to_idx[bytes(pk)]
+        if bit:
+            bals[vidx] += participant_reward
+            bals[int(block_proposer_index)] += proposer_cut
+        else:
+            bals[vidx] = max(0, bals[vidx] - participant_reward)
+    return state_advanced.replace(
+        latest_block_header=new_header,
+        next_withdrawal_validator_index=next_wv,
+        latest_execution_payload_header=payload_header,
+        randao_mixes=mixes,
+        eth1_data_votes=votes,
+        balances=bals,
+    )
+
+
+def run_block(genesis, body_kwargs=None, slot=1):
+    """Drive the implementation: advance + apply one block with the given
+    extra body fields; return (implementation post, advanced pre, block)."""
+    pre = process_slots(genesis, slot, CFG)  # implementation advance
+    proposer = accessors.get_beacon_proposer_index(pre, P)
+    reveal = _interop_keys(proposer).sign(b"\x5a" * 32).to_bytes()
+    fields = dict(
+        randao_reveal=reveal,
+        eth1_data=genesis.eth1_data,
+        execution_payload=hand_payload(pre),
+        sync_aggregate=NS.SyncAggregate(
+            sync_committee_signature=b"\xc0" + b"\x00" * 95
+        ),
+    )
+    fields.update(body_kwargs or {})
+    body = NS.BeaconBlockBody(**fields)
+    block = hand_block(pre, proposer, body)
+    signed = NS.SignedBeaconBlock(message=block)
+    post = custom_state_transition(
+        genesis, signed, CFG, NullVerifier(), state_root_policy="trust"
+    )
+    return post, pre, block
+
+
+# ===================================================================== cases
+
+
+def test_case_slot_processing_matches_hand_transcription(genesis):
+    """Sanity case: three intra-epoch empty slots — the implementation's
+    process_slots must equal the spec-text transcription exactly."""
+    impl = process_slots(genesis, 3, CFG)
+    hand = hand_process_slots(genesis, 3)
+    assert impl.hash_tree_root() == hand.hash_tree_root()
+
+
+def test_case_empty_block(genesis):
+    """Header + randao + eth1 vote + payload + (empty) withdrawals sweep:
+    the whole non-operation block shell, root-for-root."""
+    post, pre, block = run_block(genesis)
+    expected = hand_block_shell_post(pre, block)
+    assert post.hash_tree_root() == expected.hash_tree_root()
+
+
+def test_case_voluntary_exit(genesis):
+    """Spec `process_voluntary_exit` / `initiate_validator_exit`:
+    exit_epoch = compute_activation_exit_epoch(current) = current + 1 +
+    MAX_SEED_LOOKAHEAD (no churn queue at one exit), withdrawable_epoch =
+    exit_epoch + MIN_VALIDATOR_WITHDRAWABILITY_DELAY."""
+    # spec: an exit needs current_epoch >= activation_epoch +
+    # SHARD_COMMITTEE_PERIOD (64 on minimal) — advance the chain instead
+    # of faking ages: 64 epochs of empty slots on the implementation
+    # (epoch processing is covered by its own suites), then exit at the
+    # first slot of epoch 64
+    idx = 5
+    aged = process_slots(genesis, 64 * SLOTS_PER_EPOCH, CFG)
+    exit_msg = NS.VoluntaryExit(epoch=64, validator_index=idx)
+    signed_exit = NS.SignedVoluntaryExit(
+        message=exit_msg, signature=b"\x00" * 96
+    )
+    post, pre, block = run_block(
+        aged, {"voluntary_exits": [signed_exit]},
+        slot=64 * SLOTS_PER_EPOCH + 1,
+    )
+    current_epoch = 64
+    exit_epoch = current_epoch + 1 + MAX_SEED_LOOKAHEAD
+    withdrawable = exit_epoch + MIN_VALIDATOR_WITHDRAWABILITY_DELAY
+    vals = list(pre.validators)
+    vals[idx] = vals[idx].replace(
+        exit_epoch=exit_epoch, withdrawable_epoch=withdrawable
+    )
+    expected = hand_block_shell_post(pre, block).replace(validators=vals)
+    assert post.hash_tree_root() == expected.hash_tree_root()
+
+
+def test_case_proposer_slashing(genesis):
+    """Spec `process_proposer_slashing` + `slash_validator` (deneb):
+    offender: slashed, exit via initiate_validator_exit, withdrawable
+    extended to epoch + EPOCHS_PER_SLASHINGS_VECTOR (64), balance -=
+    EB / MIN_SLASHING_PENALTY_QUOTIENT_BELLATRIX; slashings[0] += EB;
+    proposer gets whistleblower EB/512 split: proposer share =
+    whistleblower_reward * PROPOSER_WEIGHT // WEIGHT_DENOMINATOR, and the
+    (same) whistleblower gets the remainder — both are the proposer here."""
+    offender = 6
+    h1 = NS.BeaconBlockHeader(
+        slot=0, proposer_index=offender, body_root=b"\x01" * 32
+    )
+    h2 = NS.BeaconBlockHeader(
+        slot=0, proposer_index=offender, body_root=b"\x02" * 32
+    )
+    slashing = NS.ProposerSlashing(
+        signed_header_1=NS.SignedBeaconBlockHeader(
+            message=h1, signature=b"\x00" * 96
+        ),
+        signed_header_2=NS.SignedBeaconBlockHeader(
+            message=h2, signature=b"\x00" * 96
+        ),
+    )
+    post, pre, block = run_block(
+        genesis, {"proposer_slashings": [slashing]}
+    )
+    proposer = int(block.proposer_index)
+    eb = MAX_EFFECTIVE_BALANCE
+    exit_epoch = 0 + 1 + MAX_SEED_LOOKAHEAD
+    withdrawable = max(
+        exit_epoch + MIN_VALIDATOR_WITHDRAWABILITY_DELAY, 0 + 64
+    )
+    vals = list(pre.validators)
+    vals[offender] = vals[offender].replace(
+        slashed=True, exit_epoch=exit_epoch, withdrawable_epoch=withdrawable
+    )
+    slashings = list(pre.slashings)
+    slashings[0] = int(slashings[0]) + eb
+    shell = hand_block_shell_post(pre, block)
+    bals = [int(b) for b in shell.balances]
+    bals[offender] -= eb // MIN_SLASHING_PENALTY_QUOTIENT_BELLATRIX
+    whistleblower_reward = eb // WHISTLEBLOWER_REWARD_QUOTIENT
+    proposer_cut = (
+        whistleblower_reward * PROPOSER_WEIGHT // WEIGHT_DENOMINATOR
+    )
+    # proposer IS the whistleblower: gets the cut plus the remainder
+    bals[proposer] += proposer_cut + (whistleblower_reward - proposer_cut)
+    expected = shell.replace(
+        validators=vals, slashings=slashings, balances=bals
+    )
+    assert post.hash_tree_root() == expected.hash_tree_root()
+
+
+def test_case_attester_slashing(genesis):
+    """Spec `process_attester_slashing`: every index attesting in both
+    conflicting attestations is slashed (same deltas as above, one
+    whistleblower payment per offender)."""
+    offenders = [2, 9]
+    data1 = NS.AttestationData(
+        slot=0, index=0,
+        beacon_block_root=b"\x01" * 32,
+        source=NS.Checkpoint(epoch=0, root=ZERO32),
+        target=NS.Checkpoint(epoch=0, root=b"\x01" * 32),
+    )
+    data2 = data1.replace(beacon_block_root=b"\x02" * 32,
+                          target=NS.Checkpoint(epoch=0, root=b"\x02" * 32))
+    s = NS.AttesterSlashing(
+        attestation_1=NS.IndexedAttestation(
+            attesting_indices=offenders, data=data1, signature=b"\x00" * 96
+        ),
+        attestation_2=NS.IndexedAttestation(
+            attesting_indices=offenders, data=data2, signature=b"\x00" * 96
+        ),
+    )
+    post, pre, block = run_block(genesis, {"attester_slashings": [s]})
+    proposer = int(block.proposer_index)
+    eb = MAX_EFFECTIVE_BALANCE
+    exit_epoch = 0 + 1 + MAX_SEED_LOOKAHEAD
+    withdrawable = max(exit_epoch + MIN_VALIDATOR_WITHDRAWABILITY_DELAY, 64)
+    vals = list(pre.validators)
+    slashings = list(pre.slashings)
+    shell = hand_block_shell_post(pre, block)
+    bals = [int(b) for b in shell.balances]
+    for off in offenders:
+        vals[off] = vals[off].replace(
+            slashed=True, exit_epoch=exit_epoch,
+            withdrawable_epoch=withdrawable,
+        )
+        slashings[0] = int(slashings[0]) + eb
+        bals[off] -= eb // MIN_SLASHING_PENALTY_QUOTIENT_BELLATRIX
+        wr = eb // WHISTLEBLOWER_REWARD_QUOTIENT
+        bals[proposer] += wr
+    expected = shell.replace(
+        validators=vals, slashings=slashings, balances=bals
+    )
+    assert post.hash_tree_root() == expected.hash_tree_root()
+
+
+def test_case_bls_to_execution_change(genesis):
+    """Spec `process_bls_to_execution_change`: credentials become
+    0x01 || 11 zero bytes || execution address. The from_bls_pubkey must
+    hash to the current 0x00 credentials (sha256(pubkey)[1:] match)."""
+    idx = 4
+    # craft a pre-state whose validator 4 has BLS credentials bound to a
+    # known withdrawal pubkey: creds = 0x00 || sha256(pubkey)[1:]
+    pk = bytes(genesis.validators[idx].pubkey)
+    vals = list(genesis.validators)
+    vals[idx] = vals[idx].replace(
+        withdrawal_credentials=b"\x00" + sha256(pk)[1:]
+    )
+    base = genesis.replace(validators=vals)
+    address = b"\xaa" * 20
+    change = NS.SignedBLSToExecutionChange(
+        message=NS.BLSToExecutionChange(
+            validator_index=idx, from_bls_pubkey=pk,
+            to_execution_address=address,
+        ),
+        signature=b"\x00" * 96,
+    )
+    post, pre, block = run_block(
+        base, {"bls_to_execution_changes": [change]}
+    )
+    vals = list(pre.validators)
+    vals[idx] = vals[idx].replace(
+        withdrawal_credentials=b"\x01" + b"\x00" * 11 + address
+    )
+    expected = hand_block_shell_post(pre, block).replace(validators=vals)
+    assert post.hash_tree_root() == expected.hash_tree_root()
+
+
+def test_case_deposit_top_up(genesis):
+    """Spec `process_deposit` applied to an EXISTING pubkey: no registry
+    change, just balance += amount (signature not re-checked on top-ups);
+    eth1_deposit_index advances."""
+    idx = 7
+    amount = 3 * 10**9
+    pk = bytes(genesis.validators[idx].pubkey)
+    creds = bytes(genesis.validators[idx].withdrawal_credentials)
+    from grandine_tpu.eth1 import Eth1Cache
+
+    # the deposit must carry a valid Merkle branch against state.eth1_data
+    cache = Eth1Cache(CFG)
+    # the 16 genesis deposits occupy indices 0..15 (state.eth1_deposit_
+    # index is 16); their leaf contents are irrelevant to the new proof
+    for i in range(16):
+        cache.add_deposit(NS.DepositData(pubkey=b"%02d" % i + b"\x00" * 46))
+    data = NS.DepositData(
+        pubkey=pk, withdrawal_credentials=creds, amount=amount,
+        signature=b"\x00" * 96,
+    )
+    cache.add_deposit(data)
+    base = genesis.replace(eth1_data=cache.eth1_data(NS))
+    [deposit] = cache.deposits_for_block(base, NS)
+    post, pre, block = run_block(base, {"deposits": [deposit]})
+    shell = hand_block_shell_post(pre, block)
+    bals = [int(b) for b in shell.balances]
+    bals[idx] += amount
+    expected = shell.replace(balances=bals, eth1_deposit_index=17)
+    assert post.hash_tree_root() == expected.hash_tree_root()
+
+
+def _base_reward(total_active_gwei: int) -> int:
+    """Spec get_base_reward for a MAX_EFFECTIVE_BALANCE validator:
+    (EB // INCREMENT) * (INCREMENT * BASE_REWARD_FACTOR // isqrt(total))."""
+    import math
+
+    increments = MAX_EFFECTIVE_BALANCE // EFFECTIVE_BALANCE_INCREMENT
+    per_increment = (
+        EFFECTIVE_BALANCE_INCREMENT * BASE_REWARD_FACTOR
+        // math.isqrt(total_active_gwei)
+    )
+    return increments * per_increment
+
+
+def test_case_attestation_flags_and_proposer_reward(genesis):
+    """Spec `process_attestation` (deneb): a slot-0 attestation included at
+    slot 1 with matching source/target/head sets all three timeliness
+    flags on its committee and pays the proposer
+    numerator // ((64-8) * 64 // 8)."""
+    pre1 = process_slots(genesis, 1, CFG)
+    committee = accessors.get_beacon_committee(pre1, 0, 0, P)
+    block_root_0 = bytes(pre1.block_roots[0])
+    data = NS.AttestationData(
+        slot=0, index=0,
+        beacon_block_root=block_root_0,
+        source=NS.Checkpoint(epoch=0, root=ZERO32),
+        target=NS.Checkpoint(epoch=0, root=block_root_0),
+    )
+    bits = [True] * len(committee)
+    att = NS.Attestation(
+        aggregation_bits=bits, data=data, signature=b"\x00" * 96
+    )
+    post, pre, block = run_block(genesis, {"attestations": [att]})
+    proposer = int(block.proposer_index)
+
+    total_active = N_VALIDATORS * MAX_EFFECTIVE_BALANCE
+    br = _base_reward(total_active)
+    flags = TIMELY_SOURCE_FLAG | TIMELY_TARGET_FLAG | TIMELY_HEAD_FLAG
+    part = list(int(x) for x in pre.current_epoch_participation)
+    numerator = 0
+    for i in committee:
+        assert part[i] == 0
+        part[i] = flags
+        numerator += br * (
+            TIMELY_SOURCE_WEIGHT + TIMELY_TARGET_WEIGHT + TIMELY_HEAD_WEIGHT
+        )
+    denominator = (
+        (WEIGHT_DENOMINATOR - PROPOSER_WEIGHT)
+        * WEIGHT_DENOMINATOR
+        // PROPOSER_WEIGHT
+    )
+    shell = hand_block_shell_post(pre, block)
+    bals = [int(b) for b in shell.balances]
+    bals[proposer] += numerator // denominator
+    expected = shell.replace(
+        current_epoch_participation=part, balances=bals
+    )
+    assert post.hash_tree_root() == expected.hash_tree_root()
+
+
+def test_case_sync_aggregate_rewards(genesis):
+    """Spec `process_sync_aggregate` with ONE participant bit set: that
+    validator earns participant_reward, the proposer earns the
+    PROPOSER_WEIGHT/(WEIGHT_DENOMINATOR-PROPOSER_WEIGHT) cut, and the 31
+    absentees are each penalized participant_reward (the expected deltas
+    are transcribed in hand_block_shell_post from the block's own bits)."""
+    bits = [False] * 32
+    bits[0] = True
+    agg = NS.SyncAggregate(
+        sync_committee_bits=bits,
+        sync_committee_signature=b"\xc0" + b"\x00" * 95,
+    )
+    post, pre, block = run_block(genesis, {"sync_aggregate": agg})
+    expected = hand_block_shell_post(pre, block)
+    # the shell moved real value: participant 0 gained, absentees lost
+    assert expected.hash_tree_root() != pre.hash_tree_root()
+    assert post.hash_tree_root() == expected.hash_tree_root()
+
+
+def test_case_partial_withdrawal(genesis):
+    """Spec `get_expected_withdrawals` + `process_withdrawals`: a validator
+    with 0x01 credentials and balance above MAX_EFFECTIVE_BALANCE yields a
+    partial withdrawal of the excess; balance drops to max;
+    next_withdrawal_index advances by 1; the sweep pointer lands after the
+    last withdrawn validator."""
+    idx = 3
+    address = b"\xbb" * 20
+    excess = 5 * 10**9
+    vals = list(genesis.validators)
+    vals[idx] = vals[idx].replace(
+        withdrawal_credentials=b"\x01" + b"\x00" * 11 + address
+    )
+    bals = [int(b) for b in genesis.balances]
+    bals[idx] = MAX_EFFECTIVE_BALANCE + excess
+    base = genesis.replace(validators=vals, balances=bals)
+
+    pre = process_slots(base, 1, CFG)
+    proposer = accessors.get_beacon_proposer_index(pre, P)
+    reveal = _interop_keys(proposer).sign(b"\x5a" * 32).to_bytes()
+    withdrawal = NS.Withdrawal(
+        index=0, validator_index=idx, address=address, amount=excess
+    )
+    payload = hand_payload(pre).replace(withdrawals=[withdrawal])
+    body = NS.BeaconBlockBody(
+        randao_reveal=reveal,
+        eth1_data=base.eth1_data,
+        execution_payload=payload,
+        sync_aggregate=NS.SyncAggregate(
+            sync_committee_signature=b"\xc0" + b"\x00" * 95
+        ),
+    )
+    block = hand_block(pre, proposer, body)
+    post = custom_state_transition(
+        base, NS.SignedBeaconBlock(message=block), CFG, NullVerifier(),
+        state_root_policy="trust",
+    )
+    shell = hand_block_shell_post(pre, block)
+    ebals = [int(b) for b in shell.balances]
+    ebals[idx] -= excess
+    expected = shell.replace(
+        balances=ebals,
+        next_withdrawal_index=1,
+        # full sweep: (last_withdrawn + 1) % n when the withdrawal list is
+        # below MAX_WITHDRAWALS_PER_PAYLOAD is NOT used — the sweep ran the
+        # whole bounded range, so pointer = (prev + sweep) % n = 0; but the
+        # shell already set that, so override with the spec's actual rule:
+        # len(withdrawals) < MAX_WITHDRAWALS_PER_PAYLOAD -> (prev+sweep)%n
+        next_withdrawal_validator_index=(0 + 16) % N_VALIDATORS,
+    )
+    assert post.hash_tree_root() == expected.hash_tree_root()
+
+
+def test_case_randao_mix_is_xor_of_reveal_hash(genesis):
+    """Spec `process_randao` in isolation, cross-checked with hashlib (no
+    framework hashing involved in the expectation)."""
+    post, pre, block = run_block(genesis)
+    reveal = bytes(block.body.randao_reveal)
+    old_mix = bytes(pre.randao_mixes[0])
+    want = bytes(a ^ b for a, b in zip(old_mix, sha256(reveal)))
+    assert bytes(post.randao_mixes[0]) == want

@@ -312,6 +312,9 @@ def run_config3(n: int, n_slots: int, use_device: bool) -> dict:
         "blocks_per_s": round(n_blocks / wall, 3),
         "p50_block_ms": round(float(np.percentile(lat, 50)) * 1000, 1),
         "p99_block_ms": round(float(np.percentile(lat, 99)) * 1000, 1),
+        # per-block wall times (ms) for tail diagnosis: index 0 is the
+        # chain's slot 3; epoch boundaries fall where slot % 32 == 0
+        "block_ms": [round(x * 1000, 1) for x in lat],
         "prep_s": round(prep_s, 1),
         "warmup_first_block_s": round(warm_s, 1),
         "device": use_device,
@@ -332,6 +335,7 @@ def run_config4(
     use_device: bool,
     arrival_rate: float = 0.0,
     max_batch: int = 64,
+    bad_rate: float = 0.0,
 ) -> dict:
     """Firehose: unaggregated gossip attestations through the
     AttestationVerifier at the dispatch shapes it actually forms.
@@ -381,6 +385,15 @@ def run_config4(
             scalars.append(ap.sk_int(int(vi)))
             metas.append((data, len(committee), pos))
     sigs = signer.sign_batch(msgs, scalars)
+    # adversarial scenario: a fraction of signatures are VALID points for
+    # the WRONG message (passes prevalidation and decompression; only the
+    # pairing check catches it) — the exact attack that forces the
+    # batch-fail → singular-fallback path (attestation_verifier.rs:231-239)
+    n_bad = int(len(sigs) * bad_rate)
+    if n_bad:
+        bad_every = len(sigs) // n_bad
+        for i in range(0, n_bad * bad_every, bad_every):
+            sigs[i] = sigs[(i + 1) % len(sigs)]
     for (data, clen, pos), sig in zip(metas, sigs):
         bits = np.zeros(clen, dtype=bool)
         bits[pos] = True
@@ -479,6 +492,7 @@ def run_config4(
             len(lat_arr) and float(np.percentile(lat_arr, 99)) < 4.0
         ),
         "max_batch": max_batch,
+        "bad_signatures": n_bad,
         "prep_s": round(prep_s, 1),
         "device": use_device,
     }
@@ -548,6 +562,16 @@ def main() -> int:
             use_device,
             arrival_rate=rate,
             max_batch=int(os.environ.get("FIREHOSE_MAX_BATCH", "64")),
+        )
+        # adversarial pass: ~1 bad signature per max_batch-sized batch —
+        # the DoS surface of batch verification; the deadline must still
+        # clear with the fallback cost on the clock
+        r4["adversarial"] = run_config4(
+            n,
+            use_device,
+            arrival_rate=rate,
+            max_batch=int(os.environ.get("FIREHOSE_MAX_BATCH", "64")),
+            bad_rate=float(os.environ.get("FIREHOSE_BAD_RATE", "0.016")),
         )
         r4["crossover"] = crossover_probe(use_device)
         with open(os.path.join(REPO, "BENCH_CONFIG4.json"), "w") as f:
