@@ -170,7 +170,10 @@ def wire_controller_events(controller, bus: EventBus) -> None:
                 if fin_node is not None
                 else _hex(b"\x00" * 32),
                 "epoch": str(fin),
-                "execution_optimistic": False,
+                "execution_optimistic": bool(
+                    fin_node is not None
+                    and getattr(fin_node, "optimistic", False)
+                ),
             },
         )
 
@@ -200,7 +203,9 @@ def wire_controller_events(controller, bus: EventBus) -> None:
                 "epoch_transition": epoch_transition,
                 "previous_duty_dependent_root": prev_dep,
                 "current_duty_dependent_root": cur_dep,
-                "execution_optimistic": False,
+                "execution_optimistic": bool(
+                    getattr(snap, "is_optimistic", False)
+                ),
             },
         )
         # a reorg is a head change whose old head is NOT an ancestor of
@@ -220,7 +225,9 @@ def wire_controller_events(controller, bus: EventBus) -> None:
                             snap.head_state.hash_tree_root()
                         ),
                         "epoch": str(snap.slot // slots_per_epoch),
-                        "execution_optimistic": False,
+                        "execution_optimistic": bool(
+                            getattr(snap, "is_optimistic", False)
+                        ),
                     },
                 )
         check_finality(snap)
@@ -231,7 +238,9 @@ def wire_controller_events(controller, bus: EventBus) -> None:
             {
                 "slot": str(int(valid.signed_block.message.slot)),
                 "block": _hex(valid.root),
-                "execution_optimistic": False,
+                "execution_optimistic": bool(
+                    getattr(valid, "optimistic", False)
+                ),
             },
         )
         check_finality(snap)

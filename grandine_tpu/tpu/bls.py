@@ -637,7 +637,7 @@ def make_sharded_multi_verify(mesh, axis: str = "batch"):
     fn = jax.shard_map(
         local_step, mesh=mesh, in_specs=shardings, out_specs=P(), check_vma=False
     )
-    return jax.jit(fn)
+    return _no_persistent_cache_first_call(jax.jit(fn))
 
 
 def sharded_msm_plans(r_lo, r_hi, pk_inf, sig_inf, n_dev: int):
@@ -816,7 +816,52 @@ def make_sharded_multi_verify_msm(
         local_step, mesh=mesh, in_specs=in_specs, out_specs=P(),
         check_vma=False,
     )
-    return jax.jit(fn)
+    return _no_persistent_cache_first_call(jax.jit(fn))
+
+
+import threading as _threading
+
+_CACHE_BYPASS_LOCK = _threading.RLock()
+_CACHE_BYPASS_DEPTH = [0]
+
+
+def _no_persistent_cache_first_call(jitted):
+    """Wrap a jitted MULTI-DEVICE function so every call runs with the
+    persistent compilation cache bypassed in both directions (jax.jit
+    compiles once per input SHAPE, so any call may compile).
+
+    Multi-device executables and the on-disk cache do not mix here:
+    serializing one ABORTS inside XLA (proto-size CHECK in
+    put_executable_and_time), and deserializing an entry written by an
+    earlier/killed run SEGFAULTS in get_executable_and_time — both
+    observed on the 8-device CPU mesh. The cache-enabled decision is
+    LATCHED per process (compilation_cache.is_cache_used memoizes its
+    first config read), so the flag flip must be paired with a latch
+    reset on both sides. A depth-counted lock makes concurrent sharded
+    calls nest instead of racing the window shut; unrelated kernels that
+    compile inside an open window merely skip their cache entry (benign)."""
+    from jax._src import compilation_cache as _cc
+
+    name = "jax_enable_compilation_cache"
+    saved = [True]
+
+    def call(*args):
+        with _CACHE_BYPASS_LOCK:
+            _CACHE_BYPASS_DEPTH[0] += 1
+            if _CACHE_BYPASS_DEPTH[0] == 1:
+                saved[0] = getattr(jax.config, name)
+                _cc.reset_cache()
+                jax.config.update(name, False)
+        try:
+            return jitted(*args)
+        finally:
+            with _CACHE_BYPASS_LOCK:
+                _CACHE_BYPASS_DEPTH[0] -= 1
+                if _CACHE_BYPASS_DEPTH[0] == 0:
+                    jax.config.update(name, saved[0])
+                    _cc.reset_cache()  # re-latch with the restored setting
+
+    return call
 
 
 # --- host-facing backend ----------------------------------------------------
