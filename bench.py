@@ -288,14 +288,27 @@ def main() -> None:
         t0 = time.time()
         iters = 0
         latencies = []
+        # per-batch stage breakdown, named like the runtime's
+        # verify_stage_seconds histogram labels: host_prep = plan build,
+        # upload_bytes = device_put enqueue, execute = dispatch + force
+        # (the force also absorbs readback of the 1-bit verdict)
+        stages = {"host_prep": [], "upload_bytes": [], "execute": []}
         staged = upload(make_plans(1))
         while True:
             iters += 1
             t1 = time.time()
             pending = dev_call(staged)  # async dispatch, args resident
-            staged = upload(make_plans(iters + 1))  # host+PCIe ∥ device
+            t_disp = time.time()
+            plans = make_plans(iters + 1)  # host plan ∥ device
+            t_plan = time.time()
+            staged = upload(plans)  # PCIe ∥ device
+            t_up = time.time()
             ok = bool(pending)  # force the verdict
-            latencies.append(time.time() - t1)
+            t_force = time.time()
+            latencies.append(t_force - t1)
+            stages["host_prep"].append(t_plan - t_disp)
+            stages["upload_bytes"].append(t_up - t_plan)
+            stages["execute"].append((t_disp - t1) + (t_force - t_up))
             elapsed = time.time() - t0
             if elapsed > 15.0 or iters >= 30:
                 break
@@ -328,6 +341,17 @@ def main() -> None:
             f"p50_batch_latency={p50 * 1000:.0f}ms "
             f"wall_mean={mean_sigs_per_sec:.0f}sigs/s "
             f"platform={jax.devices()[0].platform}",
+            file=sys.stderr,
+        )
+        med = lambda xs: sorted(xs)[len(xs) // 2]  # noqa: E731
+        print(
+            json.dumps({
+                "metric": "bls_verify_stage_breakdown",
+                "unit": "ms/batch (p50)",
+                "value": {s: round(med(v) * 1000, 2)
+                          for s, v in stages.items()},
+                "compile_s": round(compile_s, 2),
+            }),
             file=sys.stderr,
         )
     except Exception as e:  # still emit a parseable line on failure

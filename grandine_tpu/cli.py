@@ -87,6 +87,10 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--metrics-url", default=None,
                      help="push client stats to this beaconcha.in-style "
                           "endpoint every 60s")
+    run.add_argument("--trace-out", default=None,
+                     help="append finished spans to this JSONL file (the "
+                          "live ring buffer also serves "
+                          "/eth/v1/debug/grandine/trace)")
     run.add_argument("--listen-port", type=int, default=None,
                      help="serve p2p (TCP gossip + req/resp) on this port "
                           "(0 = pick a free port)")
@@ -160,6 +164,12 @@ def _node_once(args, cfg) -> int:
     db = Database.persistent(os.path.join(args.data_dir, "chain.sqlite"))
     storage = Storage(db, cfg)
     metrics = Metrics()
+    from grandine_tpu.tracing import Tracer
+
+    tracer = Tracer()
+    if getattr(args, "trace_out", None):
+        tracer.set_jsonl_path(args.trace_out)
+        print(f"trace spans -> {args.trace_out}")
 
     # concrete HTTP clients behind the seams (http_clients.py); absent
     # flags keep the Null/Mock/injected defaults the tests use
@@ -199,6 +209,7 @@ def _node_once(args, cfg) -> int:
         stored, cfg, use_device_firehose=args.use_device,
         execution_engine=engine,
         slasher=slasher, operation_pool=operation_pool,
+        metrics=metrics, tracer=tracer,
     )
     if args.use_device and not getattr(args, "no_warm", False):
         # precompile the kernel bucket manifest in the background while
@@ -348,6 +359,7 @@ def _node_once(args, cfg) -> int:
             subnet_service=SubnetService(cfg, network=network),
             keymanager_token=km_token,
             data_dir=args.data_dir,
+            tracer=tracer,
         )
         server, _thread = serve(ctx, port=args.http_port)
         print(f"Beacon API on http://127.0.0.1:{args.http_port}")

@@ -47,6 +47,7 @@ class ApiContext:
         subnet_service=None,
         keymanager_token: "Optional[str]" = None,
         data_dir: "Optional[str]" = None,
+        tracer=None,
     ) -> None:
         self.controller = controller
         self.cfg = cfg
@@ -66,6 +67,8 @@ class ApiContext:
         self.keymanager_token = keymanager_token
         #: data directory whose on-disk size /metrics reports
         self.data_dir = data_dir
+        #: grandine_tpu.tracing.Tracer backing /eth/v1/debug/grandine/trace
+        self.tracer = tracer
         #: pubkey-hex -> SignedValidatorRegistrationV1 JSON (builder flow)
         self.validator_registrations: "dict[str, dict]" = {}
         #: validator index -> fee recipient (prepare_beacon_proposer)
@@ -592,6 +595,18 @@ def get_metrics(ctx, params, query, body):
         raise ApiError(503, "metrics not wired")
     ctx.metrics.collect_system_stats(ctx.data_dir)
     return ctx.metrics.expose()  # text payload
+
+
+def get_debug_trace(ctx, params, query, body):
+    """Chrome trace-event dump of the tracer's span ring buffer — load
+    the payload in chrome://tracing or Perfetto. `?clear=true` drains
+    the buffer after the dump so successive captures don't overlap."""
+    if ctx.tracer is None:
+        raise ApiError(503, "tracer not wired")
+    payload = ctx.tracer.chrome_trace()
+    if str(query.get("clear", "")).lower() in ("1", "true", "yes"):
+        ctx.tracer.clear()
+    return payload
 
 
 # ------------------------------------------- JSON <-> container codecs
@@ -1539,6 +1554,7 @@ def build_router() -> Router:
     r.add("GET", "/eth/v1/validator/duties/proposer/{epoch}", get_proposer_duties)
     r.add("POST", "/eth/v1/validator/duties/attester/{epoch}", post_attester_duties)
     r.add("GET", "/metrics", get_metrics)
+    r.add("GET", "/eth/v1/debug/grandine/trace", get_debug_trace)
     # state breadth (routing.rs:341-369)
     r.add(
         "GET", "/eth/v1/beacon/states/{state_id}/committees",

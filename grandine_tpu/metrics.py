@@ -103,7 +103,7 @@ class Histogram:
 
 
 class _Timer:
-    def __init__(self, hist: Histogram) -> None:
+    def __init__(self, hist) -> None:
         self._hist = hist
 
     def __enter__(self):
@@ -112,6 +112,206 @@ class _Timer:
 
     def __exit__(self, *_):
         self._hist.observe(time.perf_counter() - self._t0)
+
+
+# --- labeled families -------------------------------------------------------
+#
+# The reference client leans on prometheus's labeled vectors
+# (IntCounterVec / HistogramVec) for anything with a dimension — gossip
+# topic, req/resp protocol, kernel variant. Children are cached per
+# label-value tuple so the hot path is one dict lookup, and exposition
+# emits one HELP/TYPE header per family with `{label="value"}` samples.
+
+
+def _escape_label_value(value: str) -> str:
+    """Prometheus text-format escaping for label values."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _label_str(labelnames, values) -> str:
+    return ",".join(
+        f'{n}="{_escape_label_value(v)}"'
+        for n, v in zip(labelnames, values)
+    )
+
+
+class _LabeledFamily:
+    """Shared child-caching machinery for labeled counters/gauges/
+    histograms. `labels(*values)` returns (creating on first use) the
+    child for that label-value tuple; children are never evicted, so
+    label cardinality must stay bounded by construction (topic names,
+    protocol ids, kernel names — not peer ids)."""
+
+    def __init__(self, name: str, help_: str,
+                 labelnames: "Sequence[str]") -> None:
+        if not labelnames:
+            raise ValueError(f"{name}: labeled family needs >= 1 label")
+        self.name = name
+        self.help = help_
+        self.labelnames = tuple(str(n) for n in labelnames)
+        self._children: dict = {}
+        self._lock = threading.Lock()
+
+    def _make_child(self):
+        raise NotImplementedError
+
+    def labels(self, *values, **kwargs):
+        if kwargs:
+            if values:
+                raise ValueError("pass label values positionally or by name")
+            try:
+                values = tuple(kwargs[n] for n in self.labelnames)
+            except KeyError as e:
+                raise ValueError(f"{self.name}: missing label {e}") from e
+        values = tuple(str(v) for v in values)
+        if len(values) != len(self.labelnames):
+            raise ValueError(
+                f"{self.name}: expected {len(self.labelnames)} label "
+                f"values, got {len(values)}"
+            )
+        child = self._children.get(values)
+        if child is None:
+            with self._lock:
+                child = self._children.get(values)
+                if child is None:
+                    child = self._make_child()
+                    self._children[values] = child
+        return child
+
+    def children(self) -> dict:
+        with self._lock:
+            return dict(self._children)
+
+    def _sorted_children(self):
+        with self._lock:
+            return sorted(self._children.items())
+
+
+class LabeledCounter(_LabeledFamily):
+    _TYPE = "counter"
+
+    class Child:
+        __slots__ = ("_value", "_lock")
+
+        def __init__(self) -> None:
+            self._value = 0.0
+            self._lock = threading.Lock()
+
+        def inc(self, amount: float = 1.0) -> None:
+            with self._lock:
+                self._value += amount
+
+        @property
+        def value(self) -> float:
+            return self._value
+
+    def _make_child(self):
+        return self.Child()
+
+    def inc(self, *values, amount: float = 1.0) -> None:
+        self.labels(*values).inc(amount)
+
+    def value(self, *values) -> float:
+        return self.labels(*values).value
+
+    def expose(self) -> str:
+        out = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} {self._TYPE}",
+        ]
+        for values, child in self._sorted_children():
+            ls = _label_str(self.labelnames, values)
+            out.append(f"{self.name}{{{ls}}} {child._value}")
+        return "\n".join(out) + "\n"
+
+
+class LabeledGauge(LabeledCounter):
+    _TYPE = "gauge"
+
+    class Child(LabeledCounter.Child):
+        __slots__ = ()
+
+        def set(self, value: float) -> None:
+            with self._lock:
+                self._value = float(value)
+
+        def dec(self, amount: float = 1.0) -> None:
+            self.inc(-amount)
+
+    def set(self, *values, value: float) -> None:
+        self.labels(*values).set(value)
+
+
+class LabeledHistogram(_LabeledFamily):
+    def __init__(self, name: str, help_: str,
+                 labelnames: "Sequence[str]",
+                 buckets: "Sequence[float]" = _DEFAULT_BUCKETS) -> None:
+        super().__init__(name, help_, labelnames)
+        self.buckets = tuple(buckets)
+
+    class Child:
+        __slots__ = ("buckets", "_counts", "_sum", "_count", "_lock")
+
+        def __init__(self, buckets) -> None:
+            self.buckets = buckets
+            self._counts = [0] * (len(buckets) + 1)
+            self._sum = 0.0
+            self._count = 0
+            self._lock = threading.Lock()
+
+        def observe(self, value: float) -> None:
+            with self._lock:
+                self._sum += value
+                self._count += 1
+                for i, bound in enumerate(self.buckets):
+                    if value <= bound:
+                        self._counts[i] += 1
+                        return
+                self._counts[-1] += 1
+
+        def time(self) -> "_Timer":
+            return _Timer(self)
+
+        @property
+        def count(self) -> int:
+            return self._count
+
+        @property
+        def sum(self) -> float:
+            return self._sum
+
+    def _make_child(self):
+        return self.Child(self.buckets)
+
+    def observe(self, *values, value: float) -> None:
+        self.labels(*values).observe(value)
+
+    def time(self, *values) -> "_Timer":
+        return self.labels(*values).time()
+
+    def expose(self) -> str:
+        out = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} histogram",
+        ]
+        for values, child in self._sorted_children():
+            base = _label_str(self.labelnames, values)
+            cumulative = 0
+            for bound, count in zip(self.buckets, child._counts):
+                cumulative += count
+                out.append(
+                    f'{self.name}_bucket{{{base},le="{bound}"}} {cumulative}'
+                )
+            cumulative += child._counts[-1]
+            out.append(f'{self.name}_bucket{{{base},le="+Inf"}} {cumulative}')
+            out.append(f"{self.name}_sum{{{base}}} {child._sum}")
+            out.append(f"{self.name}_count{{{base}}} {child._count}")
+        return "\n".join(out) + "\n"
 
 
 class Metrics:
@@ -156,6 +356,42 @@ class Metrics:
             "process_start_time_seconds", "process start, unix time")
         self.data_dir_bytes = Gauge(
             "grandine_data_dir_bytes", "on-disk size of the data dir")
+        # gossip boundary (labeled per topic kind: the reference's
+        # gossipsub acceptance vectors)
+        self.gossip_messages = LabeledCounter(
+            "gossip_messages_total",
+            "gossip messages by topic kind and validation result",
+            ("topic", "result"),
+        )
+        # req/resp boundary: requests served per protocol
+        self.rpc_requests = LabeledCounter(
+            "rpc_requests_total",
+            "req/resp requests served, by protocol",
+            ("protocol",),
+        )
+        # device plane, per kernel variant
+        self.device_kernel_calls = LabeledCounter(
+            "device_kernel_calls_total",
+            "accelerator kernel dispatches, by kernel variant",
+            ("kernel",),
+        )
+        self.device_kernel_sigs = LabeledCounter(
+            "device_kernel_signatures_total",
+            "signatures processed per kernel variant",
+            ("kernel",),
+        )
+        # verify-plane stage attribution: host_prep / upload_bytes /
+        # compile / execute / readback / fallback. Finer low end than
+        # the defaults: host prep for a 64-att batch is ~100 µs.
+        self.verify_stage_seconds = LabeledHistogram(
+            "verify_stage_seconds",
+            "attestation batch-verify latency, by pipeline stage",
+            ("stage",),
+            buckets=(
+                0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+            ),
+        )
 
     def collect_system_stats(self, data_dir: "str | None" = None) -> None:
         """Refresh the /proc-sourced gauges (metrics/src/service.rs
@@ -213,7 +449,7 @@ class Metrics:
     def all(self):
         return [
             v for v in vars(self).values()
-            if isinstance(v, (Counter, Gauge, Histogram))
+            if isinstance(v, (Counter, Gauge, Histogram, _LabeledFamily))
         ]
 
     def expose(self) -> str:
@@ -323,5 +559,7 @@ class RemoteMetricsService:
 
 
 __all__ = [
-    "Counter", "Gauge", "Histogram", "Metrics", "RemoteMetricsService",
+    "Counter", "Gauge", "Histogram",
+    "LabeledCounter", "LabeledGauge", "LabeledHistogram",
+    "Metrics", "RemoteMetricsService",
 ]

@@ -221,6 +221,7 @@ class Network:
         storage=None,
         sync_pool=None,
         operation_pool=None,
+        metrics=None,
     ) -> None:
         self.transport = transport
         self.controller = controller
@@ -229,6 +230,12 @@ class Network:
         self.storage = storage
         self.sync_pool = sync_pool
         self.operation_pool = operation_pool
+        #: shared Metrics struct (labeled per-topic gossip counters +
+        #: per-protocol req/resp counters); defaults to the controller's
+        self.metrics = (
+            metrics if metrics is not None
+            else getattr(controller, "metrics", None)
+        )
         snap = controller.snapshot()
         self.digest = GossipTopics.fork_digest(cfg, snap.head_state)
         self.stats = defaultdict(int)
@@ -295,6 +302,30 @@ class Network:
 
     # ------------------------------------------------------------ inbound
 
+    @staticmethod
+    def _topic_kind(topic: str) -> str:
+        """`/eth2/<digest>/beacon_attestation_5/ssz_snappy` →
+        `beacon_attestation` — the subnet number is stripped so label
+        cardinality stays at the topic-kind count, not 64× it."""
+        parts = topic.split("/")
+        name = parts[3] if len(parts) > 3 else topic
+        base, _, suffix = name.rpartition("_")
+        return base if suffix.isdigit() and base else name
+
+    def _count_gossip(self, topic: str, result: str) -> None:
+        """Per-topic accept/ignore/reject accounting (the gossipsub
+        MessageAcceptance triple): accept = handed to a service, ignore =
+        dropped without prejudice (off-subnet / no service wired), reject
+        = invalid (decode or validation failure)."""
+        if self.metrics is not None:
+            self.metrics.gossip_messages.labels(
+                self._topic_kind(topic), result
+            ).inc()
+
+    def _count_rpc(self, protocol: str) -> None:
+        if self.metrics is not None:
+            self.metrics.rpc_requests.labels(protocol).inc()
+
     def _on_gossip_block(self, topic: str, payload: bytes) -> None:
         from grandine_tpu.types.combined import decode_signed_block
 
@@ -303,7 +334,9 @@ class Network:
             block = decode_signed_block(frame_decompress(payload), self.cfg)
         except Exception:
             self.stats["decode_failures"] += 1
+            self._count_gossip(topic, "reject")
             return
+        self._count_gossip(topic, "accept")
         self.controller.on_gossip_block(block)
 
     def set_attestation_subnets(self, subnets: "set[int]") -> None:
@@ -332,16 +365,20 @@ class Network:
             and subnet not in self.active_attestation_subnets
         ):
             self.stats["attestations_off_subnet"] += 1
+            self._count_gossip(topic, "ignore")
             return
         self.stats["attestations_in"] += 1
         if self.attestation_verifier is None:
+            self._count_gossip(topic, "ignore")
             return
         try:
             slot = self.controller.snapshot().slot
             att = decode_attestation(frame_decompress(payload), self.cfg, slot)
         except Exception:
             self.stats["decode_failures"] += 1
+            self._count_gossip(topic, "reject")
             return
+        self._count_gossip(topic, "accept")
         self.attestation_verifier.submit(att)
 
     def _on_gossip_aggregate(self, topic: str, payload: bytes) -> None:
@@ -349,6 +386,7 @@ class Network:
 
         self.stats["aggregates_in"] += 1
         if self.attestation_verifier is None:
+            self._count_gossip(topic, "ignore")
             return
         try:
             slot = self.controller.snapshot().slot
@@ -357,7 +395,9 @@ class Network:
             )
         except Exception:
             self.stats["decode_failures"] += 1
+            self._count_gossip(topic, "reject")
             return
+        self._count_gossip(topic, "accept")
         self.attestation_verifier.submit(signed.message.aggregate)
 
     def _deneb_ns(self):
@@ -373,7 +413,9 @@ class Network:
             )
         except Exception:
             self.stats["decode_failures"] += 1
+            self._count_gossip(topic, "reject")
             return
+        self._count_gossip(topic, "accept")
         self.controller.on_gossip_blob_sidecar(sidecar)
 
     def _on_gossip_sync_committee_message(
@@ -381,6 +423,7 @@ class Network:
     ) -> None:
         self.stats["sync_messages_in"] += 1
         if self.sync_pool is None:
+            self._count_gossip(topic, "ignore")
             return
         try:
             msg = self._deneb_ns().SyncCommitteeMessage.deserialize(
@@ -388,6 +431,7 @@ class Network:
             )
         except Exception:
             self.stats["decode_failures"] += 1
+            self._count_gossip(topic, "reject")
             return
         # validator_index → committee position(s) via the head state's
         # current sync committee (a validator can hold several positions)
@@ -395,6 +439,7 @@ class Network:
         vidx = int(msg.validator_index)
         if vidx >= len(state.validators):
             self.stats["decode_failures"] += 1
+            self._count_gossip(topic, "reject")
             return
         pubkey = bytes(state.validators[vidx].pubkey)
         # gossip validation: the signature must verify against the
@@ -417,7 +462,9 @@ class Network:
                 raise ValueError("bad signature")
         except Exception:
             self.stats["sync_messages_rejected"] += 1
+            self._count_gossip(topic, "reject")
             return
+        self._count_gossip(topic, "accept")
         for pos, pk_bytes in enumerate(state.current_sync_committee.pubkeys):
             if bytes(pk_bytes) == pubkey:
                 self.sync_pool.insert_message(
@@ -428,6 +475,7 @@ class Network:
     def _on_gossip_sync_contribution(self, topic: str, payload: bytes) -> None:
         self.stats["sync_contributions_in"] += 1
         if self.sync_pool is None:
+            self._count_gossip(topic, "ignore")
             return
         try:
             signed = self._deneb_ns().SignedContributionAndProof.deserialize(
@@ -435,6 +483,7 @@ class Network:
             )
         except Exception:
             self.stats["decode_failures"] += 1
+            self._count_gossip(topic, "reject")
             return
         contribution = signed.message.contribution
         # verify the contribution's aggregate signature against the set
@@ -468,12 +517,15 @@ class Network:
                 raise ValueError("bad aggregate signature")
         except Exception:
             self.stats["sync_contributions_rejected"] += 1
+            self._count_gossip(topic, "reject")
             return
+        self._count_gossip(topic, "accept")
         self.sync_pool.insert_contribution(contribution)
 
     def _on_gossip_proposer_slashing(self, topic: str, payload: bytes) -> None:
         self.stats["proposer_slashings_in"] += 1
         if self.operation_pool is None:
+            self._count_gossip(topic, "ignore")
             return
         try:
             slashing = self._deneb_ns().ProposerSlashing.deserialize(
@@ -481,7 +533,52 @@ class Network:
             )
         except Exception:
             self.stats["decode_failures"] += 1
+            self._count_gossip(topic, "reject")
             return
+        # full validation BEFORE insert, mirroring the attester-slashing
+        # handler: process_proposer_slashing preconditions + BOTH header
+        # signatures. Without this any peer could stuff the pool with
+        # junk that invalidates our own block proposals at pack time.
+        from grandine_tpu.consensus import (
+            accessors, keys, misc, predicates, signing,
+        )
+        from grandine_tpu.crypto import bls as A
+
+        h1 = slashing.signed_header_1.message
+        h2 = slashing.signed_header_2.message
+        state = self.controller.snapshot().head_state
+        try:
+            if int(h1.slot) != int(h2.slot):
+                raise ValueError("headers are for different slots")
+            if int(h1.proposer_index) != int(h2.proposer_index):
+                raise ValueError("headers are for different proposers")
+            if h1.hash_tree_root() == h2.hash_tree_root():
+                raise ValueError("headers are identical")
+            idx = int(h1.proposer_index)
+            if idx >= len(state.validators):
+                raise ValueError("proposer index out of range")
+            epoch = misc.compute_epoch_at_slot(
+                int(state.slot), self.cfg.preset
+            )
+            if not predicates.is_slashable_validator(
+                state.validators[idx], epoch
+            ):
+                raise ValueError("proposer is not slashable")
+            cols = accessors.registry_columns(state)
+            pk = keys.decompress_pubkey(cols.pubkeys[idx], trusted=True)
+            for signed in (slashing.signed_header_1,
+                           slashing.signed_header_2):
+                root = signing.header_signing_root(
+                    state, signed.message, self.cfg
+                )
+                sig = A.Signature.from_bytes(bytes(signed.signature))
+                if not sig.verify(root, pk):
+                    raise ValueError("bad header signature")
+        except Exception:
+            self.stats["proposer_slashings_rejected"] += 1
+            self._count_gossip(topic, "reject")
+            return
+        self._count_gossip(topic, "accept")
         self.operation_pool.insert_proposer_slashing(slashing)
 
     def _on_gossip_attester_slashing(self, topic: str, payload: bytes) -> None:
@@ -492,6 +589,7 @@ class Network:
             )
         except Exception:
             self.stats["decode_failures"] += 1
+            self._count_gossip(topic, "reject")
             return
         # full validation BEFORE any effect: slashable data + BOTH indexed
         # attestation signatures. An unvalidated slashing would let any
@@ -514,7 +612,9 @@ class Network:
                 )
         except Exception:
             self.stats["attester_slashings_rejected"] += 1
+            self._count_gossip(topic, "reject")
             return
+        self._count_gossip(topic, "accept")
         if self.operation_pool is not None:
             self.operation_pool.insert_attester_slashing(slashing)
         # fork choice marks the intersection equivocating
@@ -527,6 +627,7 @@ class Network:
     def _on_gossip_bls_change(self, topic: str, payload: bytes) -> None:
         self.stats["bls_changes_in"] += 1
         if self.operation_pool is None:
+            self._count_gossip(topic, "ignore")
             return
         try:
             signed = self._deneb_ns().SignedBLSToExecutionChange.deserialize(
@@ -534,7 +635,27 @@ class Network:
             )
         except Exception:
             self.stats["decode_failures"] += 1
+            self._count_gossip(topic, "reject")
             return
+        # verify the change signature (under the genesis-fork-version
+        # domain, against the claimed from_bls_pubkey) before it can
+        # reach the pool. The withdrawal-credential hash binding stays in
+        # OperationPool.pack, where the packing state is authoritative.
+        from grandine_tpu.consensus import signing
+        from grandine_tpu.consensus.verifier import SingleVerifier
+
+        state = self.controller.snapshot().head_state
+        try:
+            if int(signed.message.validator_index) >= len(state.validators):
+                raise ValueError("validator index out of range")
+            signing.extend_with_bls_to_execution_change(
+                SingleVerifier(), state, signed, self.cfg
+            )
+        except Exception:
+            self.stats["bls_changes_rejected"] += 1
+            self._count_gossip(topic, "reject")
+            return
+        self._count_gossip(topic, "accept")
         self.operation_pool.insert_bls_to_execution_change(signed)
 
     # ----------------------------------------------------------- outbound
@@ -608,6 +729,7 @@ class Network:
     # ------------------------------------------------------------ serving
 
     def _serve_blocks_by_range(self, start_slot: int, count: int) -> "list[bytes]":
+        self._count_rpc("beacon_blocks_by_range")
         out = []
         store = self.controller.store
         by_slot = {}
@@ -627,6 +749,7 @@ class Network:
     def _serve_blocks_by_root(self, roots: "list[bytes]") -> "list[bytes]":
         """BeaconBlocksByRoot (p2p/src/network.rs:911-912): resolve a
         delayed block's unknown parent without waiting for range sync."""
+        self._count_rpc("beacon_blocks_by_root")
         out = []
         store = self.controller.store
         for root in roots:
@@ -642,6 +765,7 @@ class Network:
         return out
 
     def _serve_blobs_by_range(self, start_slot: int, count: int) -> "list[bytes]":
+        self._count_rpc("blob_sidecars_by_range")
         out = []
         store = self.controller.store
         for node in sorted(store.blocks.values(), key=lambda n: n.slot):
@@ -652,6 +776,7 @@ class Network:
 
     def _serve_blobs_by_root(self, ids: "list") -> "list[bytes]":
         """ids: [(block_root, index), ...] (spec BlobIdentifier)."""
+        self._count_rpc("blob_sidecars_by_root")
         out = []
         for root, index in ids:
             for sc in self.controller.blob_sidecars_for(bytes(root)):
@@ -660,6 +785,7 @@ class Network:
         return out
 
     def _serve_status(self) -> dict:
+        self._count_rpc("status")
         snap = self.controller.snapshot()
         return {
             "head_slot": int(snap.head_state.slot),

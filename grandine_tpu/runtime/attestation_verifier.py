@@ -17,6 +17,7 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
+from contextlib import contextmanager
 from typing import Callable, Optional, Sequence
 
 from grandine_tpu.consensus import accessors, keys, signing
@@ -24,6 +25,7 @@ from grandine_tpu.consensus.verifier import SignatureInvalid
 from grandine_tpu.crypto import bls as A
 from grandine_tpu.fork_choice.store import ForkChoiceError, ValidAttestation
 from grandine_tpu.runtime.thread_pool import Priority
+from grandine_tpu.tracing import NULL_TRACER
 
 MAX_BATCH = 64  # attestation_verifier.rs:37
 
@@ -56,11 +58,23 @@ class AttestationVerifier:
         use_device: bool = True,
         slasher=None,
         operation_pool=None,
+        metrics=None,
+        tracer=None,
     ) -> None:
         self.controller = controller
         self.cfg = controller.cfg
         self.backend = backend
         self.use_device = use_device
+        #: observability: default to whatever the controller carries so
+        #: node wiring stays one assignment; NULL_TRACER keeps span calls
+        #: branch-free when tracing is off
+        self.metrics = (
+            metrics if metrics is not None
+            else getattr(controller, "metrics", None)
+        )
+        self.tracer = (
+            tracer or getattr(controller, "tracer", None) or NULL_TRACER
+        )
         self.max_batch = max_batch
         self.deadline_s = deadline_s
         self.max_active = max_active or controller.pool.n_threads
@@ -139,11 +153,39 @@ class AttestationVerifier:
 
     # ------------------------------------------------------------- verify
 
+    @contextmanager
+    def _stage(self, stage: str, **attrs):
+        """One pipeline stage: a child span under the current trace
+        context plus a `verify_stage_seconds{stage=...}` observation."""
+        t0 = time.perf_counter()
+        with self.tracer.span(stage, attrs or None):
+            yield
+        if self.metrics is not None:
+            self.metrics.verify_stage_seconds.labels(stage).observe(
+                time.perf_counter() - t0
+            )
+
     def _verify_batch(self, batch: "Sequence[GossipAttestation]") -> None:
+        t_batch = time.perf_counter()
         try:
-            snapshot = self.controller.snapshot()
-            state = snapshot.head_state
-            prepared = []
+            with self.tracer.span("verify_batch", {"batch": len(batch)}):
+                self._verify_batch_traced(batch)
+        finally:
+            with self._cond:
+                self._active -= 1
+                self._cond.notify()
+            self.stats["batches"] += 1
+            if self.metrics is not None:
+                self.metrics.att_batches.inc()
+                self.metrics.att_batch_times.observe(
+                    time.perf_counter() - t_batch
+                )
+
+    def _verify_batch_traced(self, batch: "Sequence[GossipAttestation]") -> None:
+        snapshot = self.controller.snapshot()
+        state = snapshot.head_state
+        prepared = []
+        with self._stage("host_prep", items=len(batch)):
             for item in batch:
                 try:
                     prepared.append(self._prevalidate(state, item.attestation))
@@ -151,43 +193,43 @@ class AttestationVerifier:
                     # KeyError: raced the mutator's finalization prune (the
                     # same race the block task path catches)
                     self.stats["rejected"] += 1
-            if not prepared:
-                return
-            messages = [p[0] for p in prepared]
-            signatures = [p[1] for p in prepared]
-            members = [p[2] for p in prepared]
-            ok = self._batch_check(messages, signatures, members)
-            if ok:
-                self.stats["accepted"] += len(prepared)
+        if not prepared:
+            return
+        messages = [p[0] for p in prepared]
+        signatures = [p[1] for p in prepared]
+        members = [p[2] for p in prepared]
+        ok = self._batch_check(messages, signatures, members)
+        if ok:
+            self.stats["accepted"] += len(prepared)
+            with self._stage("feedback", items=len(prepared)):
                 self.controller.on_valid_attestation_batch(
                     [p[3] for p in prepared]
                 )
                 # AFTER delivery: a slasher problem must never cost fork
                 # choice its verified votes
                 self._feed_slasher([(p[4], p[3]) for p in prepared])
-                return
-            # batch failed: BISECT to the bad items with batch checks —
-            # O(k·log n) verifies for k bad signatures instead of n
-            # singular host pairings. The singular-per-item fallback
-            # (attestation_verifier.rs:231-239) costs ~0.7 s/item on the
-            # host anchor; at the adversarial operating point of ~1 bad
-            # signature per batch that re-verifies EVERY item and blows
-            # the 4 s deadline — this is the DoS surface of batch
-            # verification, and bisection caps it.
-            self.stats["fallbacks"] += 1
+            return
+        # batch failed: BISECT to the bad items with batch checks —
+        # O(k·log n) verifies for k bad signatures instead of n
+        # singular host pairings. The singular-per-item fallback
+        # (attestation_verifier.rs:231-239) costs ~0.7 s/item on the
+        # host anchor; at the adversarial operating point of ~1 bad
+        # signature per batch that re-verifies EVERY item and blows
+        # the 4 s deadline — this is the DoS surface of batch
+        # verification, and bisection caps it.
+        self.stats["fallbacks"] += 1
+        if self.metrics is not None:
+            self.metrics.att_fallbacks.inc()
+        with self._stage("fallback", items=len(prepared)):
             good_items, bad_count = self._isolate(prepared)
-            self.stats["accepted"] += len(good_items)
-            self.stats["rejected"] += bad_count
-            if good_items:
+        self.stats["accepted"] += len(good_items)
+        self.stats["rejected"] += bad_count
+        if good_items:
+            with self._stage("feedback", items=len(good_items)):
                 self.controller.on_valid_attestation_batch(
                     [p[3] for p in good_items]
                 )
                 self._feed_slasher([(p[4], p[3]) for p in good_items])
-        finally:
-            with self._cond:
-                self._active -= 1
-                self._cond.notify()
-            self.stats["batches"] += 1
 
     def _isolate(self, prepared):
         """Recursive bisection over a FAILED batch: re-check halves as
@@ -386,17 +428,20 @@ class AttestationVerifier:
             if backend is None:
                 from grandine_tpu.tpu.bls import TpuBlsBackend
 
-                backend = self.backend = TpuBlsBackend()
+                backend = self.backend = TpuBlsBackend(
+                    metrics=self.metrics, tracer=self.tracer
+                )
             try:
                 # decompress WITHOUT the per-signature host subgroup
                 # scalar-mul (~9 ms each — it dominated batch latency);
                 # the device checks the whole batch in one ψ ladder.
                 # A failed batch falls to the singular path, which uses
                 # the fully-checked from_bytes and isolates the item.
-                points = [
-                    A.g2_from_bytes(bytes(s), subgroup_check=False)
-                    for s in signatures
-                ]
+                with self._stage("host_prep", op="g2_decompress"):
+                    points = [
+                        A.g2_from_bytes(bytes(s), subgroup_check=False)
+                        for s in signatures
+                    ]
             except A.BlsError:
                 return False
             if any(p.is_infinity() for p in points):
@@ -404,15 +449,19 @@ class AttestationVerifier:
             if not bool(backend.g2_subgroup_check_batch(points).all()):
                 return False
             sigs = [A.Signature(p) for p in points]
+            if self.metrics is not None:
+                self.metrics.device_batch_sigs.inc(len(sigs))
             return backend.fast_aggregate_verify_batch(messages, sigs, members)
-        # host anchor path (small batches / tests)
-        try:
-            return all(
-                A.Signature.from_bytes(sig).fast_aggregate_verify(msg, mems)
-                for msg, sig, mems in zip(messages, signatures, members)
-            )
-        except A.BlsError:
-            return False
+        # host anchor path (small batches / tests): all host work, so the
+        # whole check is the "execute" stage of this batch
+        with self._stage("execute", path="host", items=len(messages)):
+            try:
+                return all(
+                    A.Signature.from_bytes(sig).fast_aggregate_verify(msg, mems)
+                    for msg, sig, mems in zip(messages, signatures, members)
+                )
+            except A.BlsError:
+                return False
 
     # ------------------------------------------------------------ control
 

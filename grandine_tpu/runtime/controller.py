@@ -76,18 +76,22 @@ class Controller:
         wait_group: "Optional[WaitGroup]" = None,
         storage=None,
         metrics=None,
+        tracer=None,
     ) -> None:
         self.cfg = cfg
         self.verifier_factory = verifier_factory
         self.storage = storage
         self.metrics = metrics
+        #: optional tracing.Tracer — handed to the pool so task spans nest
+        #: under whatever span spawned them
+        self.tracer = tracer
         self._persisted_finalized = -1
         self.store = Store(anchor_state, cfg, execution_engine=execution_engine)
         if storage is not None:
             # persist the finalized chain BEFORE the store prunes it away
             self.store.pre_prune_hook = self._persist_finalized
         self.wait_group = wait_group or WaitGroup()
-        self.pool = pool or ThreadPool(wait_group=self.wait_group)
+        self.pool = pool or ThreadPool(wait_group=self.wait_group, tracer=tracer)
         self._owns_pool = pool is None
 
         self._delayed_by_parent: "dict[bytes, list]" = {}
@@ -232,11 +236,39 @@ class Controller:
                     sidecar, ns.BeaconBlockBody, self.cfg.preset,
                     self.kzg_setup,
                 )
+                self._check_sidecar_header(sidecar)
             except Exception:
                 return  # invalid sidecar: drop (gossip penalty is P2P-level)
             self._send(("blob_sidecar", (header_root, sidecar)))
 
         self.pool.spawn(task, Priority.LOW)
+
+    def _check_sidecar_header(self, sidecar) -> None:
+        """The inclusion proof binds the commitment to the header, but
+        nothing binds the header to its claimed proposer — verify the
+        proposer signature on `signed_block_header` (and bound the slot)
+        before the sidecar can enter the cache, so a peer can't fill
+        `_blob_cache` with sidecars for headers nobody signed (spec
+        blob_sidecar gossip condition [REJECT] proposer signature)."""
+        from grandine_tpu.consensus import accessors, keys, signing
+        from grandine_tpu.crypto import bls as A
+
+        header = sidecar.signed_block_header.message
+        state = self._snapshot.head_state
+        horizon = self.store.slot + 2 * self.cfg.preset.SLOTS_PER_EPOCH
+        if int(header.slot) > horizon:
+            raise ForkChoiceError("sidecar header slot beyond horizon")
+        cols = accessors.registry_columns(state)
+        idx = int(header.proposer_index)
+        if idx >= len(cols.pubkeys):
+            raise ForkChoiceError("sidecar proposer index out of range")
+        root = signing.header_signing_root(state, header, self.cfg)
+        pk = keys.decompress_pubkey(cols.pubkeys[idx], trusted=True)
+        sig = A.Signature.from_bytes(
+            bytes(sidecar.signed_block_header.signature)
+        )
+        if not sig.verify(root, pk):
+            raise SignatureInvalid("sidecar header signature invalid")
 
     def blob_sidecars_for(self, block_root: bytes) -> "list":
         """Validated sidecars for a block (ordered by index) — the
@@ -533,7 +565,13 @@ class Controller:
         for cb in self.on_blob_sidecar:
             cb(header_root, sidecar)
         while len(self._blob_cache) > self.MAX_BLOB_ROOTS:
-            evicted = next(iter(self._blob_cache))
+            # prefer evicting roots no delayed block is waiting on — FIFO
+            # would let sidecar spam evict exactly the blobs that gate an
+            # import; fall back to oldest only when everything is referenced
+            evicted = next(
+                (r for r in self._blob_cache if r not in self._delayed_by_blobs),
+                next(iter(self._blob_cache)),
+            )
             for idx in self._blob_cache.pop(evicted):
                 self._blob_seen.discard((evicted, idx))
         delayed = self._delayed_by_blobs.get(header_root)

@@ -33,21 +33,29 @@ class InProcessNode:
         full_sync_participation: bool = False,
         slasher=None,
         operation_pool=None,
+        metrics=None,
+        tracer=None,
     ) -> None:
         from grandine_tpu.consensus.verifier import MultiVerifier
 
         self.cfg = cfg
+        self.metrics = metrics
+        self.tracer = tracer
         self.controller = Controller(
             genesis_state,
             cfg,
             execution_engine=execution_engine,
             verifier_factory=verifier_factory or MultiVerifier,
+            metrics=metrics,
+            tracer=tracer,
         )
         self.attestation_verifier = AttestationVerifier(
             self.controller,
             use_device=use_device_firehose,
             slasher=slasher,
             operation_pool=operation_pool,
+            metrics=metrics,
+            tracer=tracer,
         )
         self.clock = SlotClock(
             int(genesis_state.genesis_time), cfg.seconds_per_slot

@@ -68,9 +68,14 @@ class ThreadPool:
     two VecDeques under one mutex)."""
 
     def __init__(self, n_threads: "Optional[int]" = None,
-                 wait_group: "Optional[WaitGroup]" = None) -> None:
+                 wait_group: "Optional[WaitGroup]" = None,
+                 tracer=None) -> None:
         self.n_threads = n_threads or max(1, (os.cpu_count() or 2))
         self.wait_group = wait_group or WaitGroup()
+        #: optional grandine_tpu.tracing.Tracer — when set, the spawning
+        #: thread's current span is captured at spawn() and re-installed
+        #: on the worker so task spans nest under their submitter
+        self.tracer = tracer
         self._queues = {Priority.HIGH: deque(), Priority.LOW: deque()}
         self._cond = threading.Condition()
         self._stop = False
@@ -85,6 +90,10 @@ class ThreadPool:
 
     def spawn(self, fn: Callable[[], None],
               priority: Priority = Priority.HIGH) -> None:
+        tracer = self.tracer
+        if tracer is not None and tracer.enabled:
+            parent = tracer.capture()
+            task, fn = fn, lambda: self._traced(task, parent)
         self.wait_group.add()
         with self._cond:
             if self._stop:
@@ -92,6 +101,10 @@ class ThreadPool:
                 raise RuntimeError("pool stopped")
             self._queues[priority].append(fn)
             self._cond.notify()
+
+    def _traced(self, task: Callable[[], None], parent) -> None:
+        with self.tracer.attach(parent):
+            task()
 
     def _next_task(self):
         for prio in (Priority.HIGH, Priority.LOW):

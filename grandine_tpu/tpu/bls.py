@@ -38,6 +38,8 @@ all-gather of one Fp12 element per chip (see __graft_entry__.py).
 from __future__ import annotations
 
 import secrets
+import time
+from contextlib import contextmanager
 from typing import Sequence
 
 import jax
@@ -904,8 +906,17 @@ class TpuBlsBackend:
     fast_aggregate_verify — same edge-case semantics (empty batch, identity
     pubkeys), differential-tested against the anchor."""
 
-    def __init__(self) -> None:
+    def __init__(self, metrics=None, tracer=None) -> None:
         self._h2c_cache: dict = {}
+        #: observability seams (wired by runtime/attestation_verifier):
+        #: per-stage histograms/spans + per-kernel-variant counters when
+        #: set; with both None every hook is a cheap early return
+        self.metrics = metrics
+        self.tracer = tracer
+        #: (kernel, arg shapes) pairs already dispatched — a miss means
+        #: the next dispatch blocks on XLA compilation, so its host-side
+        #: call time is attributed to the `compile` stage
+        self._seen_shapes: set = set()
 
     # -- conversions -------------------------------------------------------
 
@@ -921,6 +932,89 @@ class TpuBlsBackend:
 
     def _jitted(self, name: str, fn):
         return _jitted_global(name, fn)
+
+    # -- observability -----------------------------------------------------
+
+    def _observed(self) -> bool:
+        return self.metrics is not None or self.tracer is not None
+
+    @contextmanager
+    def _stage(self, stage: str, **attrs):
+        """One device-plane stage: span (when tracing) + one
+        `verify_stage_seconds{stage=...}` observation (when metered)."""
+        if not self._observed():
+            yield
+            return
+        t0 = time.perf_counter()
+        if self.tracer is not None:
+            with self.tracer.span(stage, attrs or None):
+                yield
+        else:
+            yield
+        if self.metrics is not None:
+            self.metrics.verify_stage_seconds.labels(stage).observe(
+                time.perf_counter() - t0
+            )
+
+    def _count_kernel(self, kernel: str, sigs: int) -> None:
+        if self.metrics is not None:
+            self.metrics.device_kernel_calls.labels(kernel).inc()
+            if sigs:
+                self.metrics.device_kernel_sigs.labels(kernel).inc(sigs)
+
+    @staticmethod
+    def _block(out):
+        for leaf in jax.tree_util.tree_leaves(out):
+            if hasattr(leaf, "block_until_ready"):
+                leaf.block_until_ready()
+        return out
+
+    def _upload(self, args: tuple) -> tuple:
+        """upload_bytes stage: push host arrays to the device explicitly
+        so the transfer is attributable (dispatch would do the identical
+        transfer implicitly). No-op when unobserved."""
+        if not self._observed():
+            return args
+        nbytes = sum(int(getattr(a, "nbytes", 0)) for a in args)
+        with self._stage("upload_bytes", bytes=nbytes):
+            return self._block(jax.device_put(args))
+
+    def _run_kernel(self, kernel: str, fn, args: tuple, sigs: int = 0,
+                    block: bool = True):
+        """Dispatch with compile/execute attribution. The first dispatch
+        for a (kernel, shapes) pair blocks on trace+XLA compilation, so
+        its host-side call time IS the compile stage; warm dispatches are
+        async µs and the device run is timed via block_until_ready. With
+        block=False the caller keeps the async seam and settles later
+        (see _settle)."""
+        self._count_kernel(kernel, sigs)
+        if not self._observed():
+            return fn(*args)
+        shapes = tuple(
+            (tuple(a.shape), str(a.dtype)) if hasattr(a, "shape") else repr(a)
+            for a in args
+        )
+        key = (kernel, shapes)
+        if key not in self._seen_shapes:
+            with self._stage("compile", kernel=kernel):
+                out = fn(*args)
+            self._seen_shapes.add(key)
+        else:
+            out = fn(*args)
+        if block:
+            with self._stage("execute", kernel=kernel):
+                self._block(out)
+        return out
+
+    def _settle(self, kernel: str, result) -> bool:
+        """Force an async dispatch: remaining device time under execute,
+        the host conversion under readback."""
+        if not self._observed():
+            return bool(result)
+        with self._stage("execute", kernel=kernel):
+            self._block(result)
+        with self._stage("readback", kernel=kernel):
+            return bool(result)
 
     # -- verification ------------------------------------------------------
 
@@ -980,15 +1074,21 @@ class TpuBlsBackend:
             return settle_chunks
         if any(pk.point.is_infinity() for pk in public_keys):
             return lambda: False
-        # batched host conversions: one inversion + one limb pass per class
-        g1x, g1y, g1inf = C.g1_points_to_dev([pk.point for pk in public_keys])
-        g2x, g2y, g2inf = C.g2_points_to_dev([s.point for s in signatures])
+        with self._stage("host_prep", op="point_convert", items=n):
+            # batched host conversions: one inversion + one limb pass per
+            # class
+            g1x, g1y, g1inf = C.g1_points_to_dev(
+                [pk.point for pk in public_keys]
+            )
+            g2x, g2y, g2inf = C.g2_points_to_dev(
+                [s.point for s in signatures]
+            )
 
-        # group triples by message: Miller loops collapse from N to the
-        # number of DISTINCT messages (grouped_multi_verify_msm_kernel)
-        groups: "dict[bytes, list[int]]" = {}
-        for i, msg in enumerate(messages):
-            groups.setdefault(bytes(msg), []).append(i)
+            # group triples by message: Miller loops collapse from N to the
+            # number of DISTINCT messages (grouped_multi_verify_msm_kernel)
+            groups: "dict[bytes, list[int]]" = {}
+            for i, msg in enumerate(messages):
+                groups.setdefault(bytes(msg), []).append(i)
         n_groups = len(groups)
         if 2 * n_groups <= n:
             bm = _bucket(n_groups)
@@ -999,33 +1099,38 @@ class TpuBlsBackend:
                     bm, bk, dst, rng,
                 )
 
-        b = _bucket(n)
-        pk_x = np.zeros((b, L.NLIMBS), np.int32)
-        pk_y = np.zeros((b, L.NLIMBS), np.int32)
-        pk_inf = np.ones((b,), bool)
-        sig_x = np.zeros((b, 2, L.NLIMBS), np.int32)
-        sig_y = np.zeros((b, 2, L.NLIMBS), np.int32)
-        sig_inf = np.ones((b,), bool)
-        msg_x = np.zeros((b, 2, L.NLIMBS), np.int32)
-        msg_y = np.zeros((b, 2, L.NLIMBS), np.int32)
-        msg_inf = np.ones((b,), bool)
-        pk_x[:n], pk_y[:n], pk_inf[:n] = g1x, g1y, g1inf
-        sig_x[:n], sig_y[:n], sig_inf[:n] = g2x, g2y, g2inf
-        for i in range(n):
-            x, y, inf = self._hash_to_g2_dev(messages[i], dst)
-            msg_x[i], msg_y[i], msg_inf[i] = x, y, inf
-        pairs = [self._rlc_pair(rng) for _ in range(n)]
-        r_bits = rlc_bits_host(pairs, b)
-        g2_plan = self._g2_plan(pairs, b, sig_inf)
+        with self._stage("host_prep", op="pack", items=n):
+            b = _bucket(n)
+            pk_x = np.zeros((b, L.NLIMBS), np.int32)
+            pk_y = np.zeros((b, L.NLIMBS), np.int32)
+            pk_inf = np.ones((b,), bool)
+            sig_x = np.zeros((b, 2, L.NLIMBS), np.int32)
+            sig_y = np.zeros((b, 2, L.NLIMBS), np.int32)
+            sig_inf = np.ones((b,), bool)
+            msg_x = np.zeros((b, 2, L.NLIMBS), np.int32)
+            msg_y = np.zeros((b, 2, L.NLIMBS), np.int32)
+            msg_inf = np.ones((b,), bool)
+            pk_x[:n], pk_y[:n], pk_inf[:n] = g1x, g1y, g1inf
+            sig_x[:n], sig_y[:n], sig_inf[:n] = g2x, g2y, g2inf
+            for i in range(n):
+                x, y, inf = self._hash_to_g2_dev(messages[i], dst)
+                msg_x[i], msg_y[i], msg_inf[i] = x, y, inf
+            pairs = [self._rlc_pair(rng) for _ in range(n)]
+            r_bits = rlc_bits_host(pairs, b)
+            g2_plan = self._g2_plan(pairs, b, sig_inf)
         fn = self._jitted_msm(
             "multi_verify_msm", multi_verify_msm_kernel,
             g2_windows=g2_plan.windows, g2_wbits=g2_plan.window_bits,
         )
-        result = fn(
+        args = self._upload((
             pk_x, pk_y, pk_inf, sig_x, sig_y, sig_inf, msg_x, msg_y, msg_inf,
             r_bits, *g2_plan.arrays,
-        )  # async dispatch; forcing happens in the returned closure
-        return lambda: bool(result)
+        ))
+        # async dispatch; forcing happens in the returned closure
+        result = self._run_kernel(
+            "multi_verify_msm", fn, args, sigs=n, block=False
+        )
+        return lambda: self._settle("multi_verify_msm", result)
 
     @staticmethod
     def _g2_plan(pairs, b, sig_inf):
@@ -1059,48 +1164,54 @@ class TpuBlsBackend:
         Kernel-flat point index f ↔ grouped slot (f mod bm, f div bm), so
         the MSM plans carry scalars in f = kk·bm + j order with
         group(f) = f mod bm."""
-        pk_x = np.zeros((bm, bk, L.NLIMBS), np.int32)
-        pk_y = np.zeros((bm, bk, L.NLIMBS), np.int32)
-        pk_inf = np.ones((bm, bk), bool)
-        sig_x = np.zeros((bm, bk, 2, L.NLIMBS), np.int32)
-        sig_y = np.zeros((bm, bk, 2, L.NLIMBS), np.int32)
-        sig_inf = np.ones((bm, bk), bool)
-        msg_x = np.zeros((bm, 2, L.NLIMBS), np.int32)
-        msg_y = np.zeros((bm, 2, L.NLIMBS), np.int32)
-        msg_inf = np.ones((bm,), bool)
-        r_lo = np.zeros(bm * bk, np.uint64)
-        r_hi = np.zeros(bm * bk, np.uint64)
-        n_real = 0
-        for j, (msg, idxs) in enumerate(groups.items()):
-            x, y, inf = self._hash_to_g2_dev(msg, dst)
-            msg_x[j], msg_y[j], msg_inf[j] = x, y, inf
-            for kk, i in enumerate(idxs):
-                pk_x[j, kk], pk_y[j, kk], pk_inf[j, kk] = g1x[i], g1y[i], g1inf[i]
-                sig_x[j, kk], sig_y[j, kk], sig_inf[j, kk] = (
-                    g2x[i], g2y[i], g2inf[i],
-                )
-                r_lo[kk * bm + j], r_hi[kk * bm + j] = self._rlc_pair(rng)
-                n_real += 1
-        flat_inf = pk_inf.T.reshape(-1)  # f = kk·bm + j order; pads True
-        flat_groups = np.arange(bm * bk) % bm
-        g1_plan = M.plan_msm(
-            r_lo, r_hi, flat_inf, flat_groups, bm,
-            window_bits=pick_msm_window(n_real, bm),
-        )
-        g2_plan = M.plan_msm(
-            r_lo, r_hi, sig_inf.T.reshape(-1), None, 1,
-            window_bits=pick_msm_window(n_real, 1),
-        )
+        with self._stage("host_prep", op="pack_grouped", items=bm * bk):
+            pk_x = np.zeros((bm, bk, L.NLIMBS), np.int32)
+            pk_y = np.zeros((bm, bk, L.NLIMBS), np.int32)
+            pk_inf = np.ones((bm, bk), bool)
+            sig_x = np.zeros((bm, bk, 2, L.NLIMBS), np.int32)
+            sig_y = np.zeros((bm, bk, 2, L.NLIMBS), np.int32)
+            sig_inf = np.ones((bm, bk), bool)
+            msg_x = np.zeros((bm, 2, L.NLIMBS), np.int32)
+            msg_y = np.zeros((bm, 2, L.NLIMBS), np.int32)
+            msg_inf = np.ones((bm,), bool)
+            r_lo = np.zeros(bm * bk, np.uint64)
+            r_hi = np.zeros(bm * bk, np.uint64)
+            n_real = 0
+            for j, (msg, idxs) in enumerate(groups.items()):
+                x, y, inf = self._hash_to_g2_dev(msg, dst)
+                msg_x[j], msg_y[j], msg_inf[j] = x, y, inf
+                for kk, i in enumerate(idxs):
+                    pk_x[j, kk], pk_y[j, kk], pk_inf[j, kk] = (
+                        g1x[i], g1y[i], g1inf[i],
+                    )
+                    sig_x[j, kk], sig_y[j, kk], sig_inf[j, kk] = (
+                        g2x[i], g2y[i], g2inf[i],
+                    )
+                    r_lo[kk * bm + j], r_hi[kk * bm + j] = self._rlc_pair(rng)
+                    n_real += 1
+            flat_inf = pk_inf.T.reshape(-1)  # f = kk·bm + j order; pads True
+            flat_groups = np.arange(bm * bk) % bm
+            g1_plan = M.plan_msm(
+                r_lo, r_hi, flat_inf, flat_groups, bm,
+                window_bits=pick_msm_window(n_real, bm),
+            )
+            g2_plan = M.plan_msm(
+                r_lo, r_hi, sig_inf.T.reshape(-1), None, 1,
+                window_bits=pick_msm_window(n_real, 1),
+            )
         fn = self._jitted_msm(
             "grouped_multi_verify_msm", grouped_multi_verify_msm_kernel,
             g1_windows=g1_plan.windows, g1_wbits=g1_plan.window_bits,
             g2_windows=g2_plan.windows, g2_wbits=g2_plan.window_bits,
         )
-        result = fn(
+        args = self._upload((
             pk_x, pk_y, pk_inf, sig_x, sig_y, sig_inf,
             msg_x, msg_y, msg_inf, *g1_plan.arrays, *g2_plan.arrays,
+        ))
+        result = self._run_kernel(
+            "grouped_multi_verify_msm", fn, args, sigs=n_real, block=False
         )
-        return lambda: bool(result)
+        return lambda: self._settle("grouped_multi_verify_msm", result)
 
     def verify(
         self,
@@ -1140,52 +1251,54 @@ class TpuBlsBackend:
             )
         if any(pk.point.is_infinity() for ks in member_keys for pk in ks):
             return False
-        if max(len(ks) for ks in member_keys) > MAX_BUCKET:
-            # committee wider than a device bucket: host-aggregate those
-            # committees to a single key (same check: e(agg_pk, H(m)))
-            member_keys = [
-                ks if len(ks) <= MAX_BUCKET else [A.PublicKey.aggregate(ks)]
-                for ks in member_keys
-            ]
-        bm = _bucket(m)
-        bk = _bucket(max(len(ks) for ks in member_keys), lo=4)
-        mem_x = np.zeros((bm, bk, L.NLIMBS), np.int32)
-        mem_y = np.zeros((bm, bk, L.NLIMBS), np.int32)
-        mem_inf = np.ones((bm, bk), bool)
-        slot_pad = np.arange(bm) >= m
-        sig_x = np.zeros((bm, 2, L.NLIMBS), np.int32)
-        sig_y = np.zeros((bm, 2, L.NLIMBS), np.int32)
-        sig_inf = np.ones((bm,), bool)
-        msg_x = np.zeros((bm, 2, L.NLIMBS), np.int32)
-        msg_y = np.zeros((bm, 2, L.NLIMBS), np.int32)
-        msg_inf = np.ones((bm,), bool)
-        flat_keys = [pk.point for ks in member_keys for pk in ks]
-        fx, fy, finf = C.g1_points_to_dev(flat_keys)
-        pos = 0
-        for i in range(m):
-            k = len(member_keys[i])
-            mem_x[i, :k] = fx[pos : pos + k]
-            mem_y[i, :k] = fy[pos : pos + k]
-            mem_inf[i, :k] = finf[pos : pos + k]
-            pos += k
-        g2x, g2y, g2inf = C.g2_points_to_dev([s.point for s in signatures])
-        sig_x[:m], sig_y[:m], sig_inf[:m] = g2x, g2y, g2inf
-        for i in range(m):
-            x, y, inf = self._hash_to_g2_dev(messages[i], dst)
-            msg_x[i], msg_y[i], msg_inf[i] = x, y, inf
-        pairs = [self._rlc_pair(rng) for _ in range(m)]
-        r_bits = rlc_bits_host(pairs, bm)
-        g2_plan = self._g2_plan(pairs, bm, sig_inf)
+        with self._stage("host_prep", op="pack_aggregate", items=m):
+            if max(len(ks) for ks in member_keys) > MAX_BUCKET:
+                # committee wider than a device bucket: host-aggregate those
+                # committees to a single key (same check: e(agg_pk, H(m)))
+                member_keys = [
+                    ks if len(ks) <= MAX_BUCKET else [A.PublicKey.aggregate(ks)]
+                    for ks in member_keys
+                ]
+            bm = _bucket(m)
+            bk = _bucket(max(len(ks) for ks in member_keys), lo=4)
+            mem_x = np.zeros((bm, bk, L.NLIMBS), np.int32)
+            mem_y = np.zeros((bm, bk, L.NLIMBS), np.int32)
+            mem_inf = np.ones((bm, bk), bool)
+            slot_pad = np.arange(bm) >= m
+            sig_x = np.zeros((bm, 2, L.NLIMBS), np.int32)
+            sig_y = np.zeros((bm, 2, L.NLIMBS), np.int32)
+            sig_inf = np.ones((bm,), bool)
+            msg_x = np.zeros((bm, 2, L.NLIMBS), np.int32)
+            msg_y = np.zeros((bm, 2, L.NLIMBS), np.int32)
+            msg_inf = np.ones((bm,), bool)
+            flat_keys = [pk.point for ks in member_keys for pk in ks]
+            fx, fy, finf = C.g1_points_to_dev(flat_keys)
+            pos = 0
+            for i in range(m):
+                k = len(member_keys[i])
+                mem_x[i, :k] = fx[pos : pos + k]
+                mem_y[i, :k] = fy[pos : pos + k]
+                mem_inf[i, :k] = finf[pos : pos + k]
+                pos += k
+            g2x, g2y, g2inf = C.g2_points_to_dev([s.point for s in signatures])
+            sig_x[:m], sig_y[:m], sig_inf[:m] = g2x, g2y, g2inf
+            for i in range(m):
+                x, y, inf = self._hash_to_g2_dev(messages[i], dst)
+                msg_x[i], msg_y[i], msg_inf[i] = x, y, inf
+            pairs = [self._rlc_pair(rng) for _ in range(m)]
+            r_bits = rlc_bits_host(pairs, bm)
+            g2_plan = self._g2_plan(pairs, bm, sig_inf)
         fn = self._jitted_msm(
             "agg_fast_verify_msm", aggregate_fast_verify_msm_kernel,
             g2_windows=g2_plan.windows, g2_wbits=g2_plan.window_bits,
         )
-        return bool(
-            fn(
-                mem_x, mem_y, mem_inf, slot_pad, sig_x, sig_y, sig_inf,
-                msg_x, msg_y, msg_inf, r_bits, *g2_plan.arrays,
-            )
-        )
+        args = self._upload((
+            mem_x, mem_y, mem_inf, slot_pad, sig_x, sig_y, sig_inf,
+            msg_x, msg_y, msg_inf, r_bits, *g2_plan.arrays,
+        ))
+        out = self._run_kernel("agg_fast_verify_msm", fn, args, sigs=m)
+        with self._stage("readback", kernel="agg_fast_verify_msm"):
+            return bool(out)
 
     def fast_aggregate_verify(
         self,
@@ -1206,17 +1319,21 @@ class TpuBlsBackend:
         n = len(points)
         if n == 0:
             return np.zeros((0,), bool)
-        bn = _bucket(n)
-        sx = np.zeros((bn, 2, L.NLIMBS), np.int32)
-        sy = np.zeros((bn, 2, L.NLIMBS), np.int32)
-        s_inf = np.ones((bn,), bool)
-        gx, gy, ginf = C.g2_points_to_dev(points)
-        sx[:n], sy[:n], s_inf[:n] = gx, gy, ginf
-        x_bits = np.ascontiguousarray(
-            C.scalars_to_bits_msb([_ABS_X] * bn, 64).T
-        )
+        with self._stage("host_prep", op="pack_subgroup", items=n):
+            bn = _bucket(n)
+            sx = np.zeros((bn, 2, L.NLIMBS), np.int32)
+            sy = np.zeros((bn, 2, L.NLIMBS), np.int32)
+            s_inf = np.ones((bn,), bool)
+            gx, gy, ginf = C.g2_points_to_dev(points)
+            sx[:n], sy[:n], s_inf[:n] = gx, gy, ginf
+            x_bits = np.ascontiguousarray(
+                C.scalars_to_bits_msb([_ABS_X] * bn, 64).T
+            )
         fn = self._jitted("g2_subgroup_check", g2_subgroup_check_kernel)
-        out = np.asarray(fn(sx, sy, s_inf, x_bits))
+        args = self._upload((sx, sy, s_inf, x_bits))
+        dev_out = self._run_kernel("g2_subgroup_check", fn, args, sigs=n)
+        with self._stage("readback", kernel="g2_subgroup_check"):
+            out = np.asarray(dev_out)
         return out[:n]
 
     # -- signing -----------------------------------------------------------
@@ -1243,17 +1360,22 @@ class TpuBlsBackend:
                     )
                 )
             return out
-        b = _bucket(n)
-        msg_x = np.zeros((b, 2, L.NLIMBS), np.int32)
-        msg_y = np.zeros((b, 2, L.NLIMBS), np.int32)
-        msg_inf = np.ones((b,), bool)
-        for i in range(n):
-            x, y, inf = self._hash_to_g2_dev(messages[i], dst)
-            msg_x[i], msg_y[i], msg_inf[i] = x, y, inf
-        sk_bits, sk_neg = sign_bits_host([sk.scalar for sk in secret_keys], b)
+        with self._stage("host_prep", op="pack_sign", items=n):
+            b = _bucket(n)
+            msg_x = np.zeros((b, 2, L.NLIMBS), np.int32)
+            msg_y = np.zeros((b, 2, L.NLIMBS), np.int32)
+            msg_inf = np.ones((b,), bool)
+            for i in range(n):
+                x, y, inf = self._hash_to_g2_dev(messages[i], dst)
+                msg_x[i], msg_y[i], msg_inf[i] = x, y, inf
+            sk_bits, sk_neg = sign_bits_host(
+                [sk.scalar for sk in secret_keys], b
+            )
         fn = self._jitted("batch_sign", batch_sign_kernel)
-        X, Y, Z = fn(msg_x, msg_y, msg_inf, sk_bits, sk_neg)
-        X, Y, Z = np.asarray(X), np.asarray(Y), np.asarray(Z)
+        args = self._upload((msg_x, msg_y, msg_inf, sk_bits, sk_neg))
+        X, Y, Z = self._run_kernel("batch_sign", fn, args, sigs=n)
+        with self._stage("readback", kernel="batch_sign"):
+            X, Y, Z = np.asarray(X), np.asarray(Y), np.asarray(Z)
         return [A.Signature(C.dev_to_g2_point(X[i], Y[i], Z[i])) for i in range(n)]
 
     @staticmethod

@@ -1,0 +1,286 @@
+"""Structured tracing: monotonic-clock spans with parent context that
+survives thread hops, a bounded ring buffer of completed spans, and
+Chrome trace-event JSON export (load the dump in `chrome://tracing` or
+Perfetto).
+
+Design mirrors the reference client's tracing feature flag: spans are
+cheap enough to leave on (two `perf_counter` calls and a deque append),
+carry string attributes, and nest via an explicit parent id rather than
+global state — the current span is tracked per-thread, and
+`Tracer.capture()` / `Tracer.attach()` move that context across the
+runtime's thread pool (see runtime/thread_pool.py, which captures at
+`spawn` and attaches in the worker).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+__all__ = ["Span", "Tracer", "NULL_TRACER"]
+
+#: process-wide epoch for trace timestamps: Chrome trace-event `ts` is in
+#: microseconds from an arbitrary origin; anchoring every tracer at import
+#: keeps spans from different tracers on one comparable timeline.
+_EPOCH = time.perf_counter()
+
+
+class Span:
+    """One timed operation. Use as a context manager (finishes on exit)
+    or call `finish()` explicitly for hand-rolled begin/end pairs."""
+
+    __slots__ = (
+        "name",
+        "trace_id",
+        "span_id",
+        "parent_id",
+        "start",
+        "end",
+        "attrs",
+        "thread_id",
+        "thread_name",
+        "_tracer",
+        "_token",
+    )
+
+    def __init__(
+        self,
+        tracer: "Tracer",
+        name: str,
+        trace_id: int,
+        span_id: int,
+        parent_id: Optional[int],
+        attrs: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.start = time.perf_counter()
+        self.end: Optional[float] = None
+        self.attrs: Dict[str, Any] = dict(attrs) if attrs else {}
+        t = threading.current_thread()
+        self.thread_id = t.ident or 0
+        self.thread_name = t.name
+        self._tracer = tracer
+        self._token: Optional[Span] = None
+
+    # ------------------------------------------------------------ lifecycle
+
+    @property
+    def duration(self) -> float:
+        """Seconds; 0.0 while the span is still open."""
+        if self.end is None:
+            return 0.0
+        return self.end - self.start
+
+    def set_attr(self, key: str, value: Any) -> "Span":
+        self.attrs[key] = value
+        return self
+
+    def finish(self) -> None:
+        if self.end is not None:  # idempotent
+            return
+        self.end = time.perf_counter()
+        self._tracer._on_finish(self)
+
+    def __enter__(self) -> "Span":
+        self._token = self._tracer._push(self)
+        return self
+
+    def __exit__(self, *_exc) -> None:
+        self._tracer._pop(self, self._token)
+        self.finish()
+
+    # -------------------------------------------------------------- export
+
+    def to_chrome_event(self) -> Dict[str, Any]:
+        """Chrome trace-event "complete" event (ph=X, µs timestamps)."""
+        dur = self.duration
+        ev: Dict[str, Any] = {
+            "name": self.name,
+            "ph": "X",
+            "ts": round((self.start - _EPOCH) * 1e6, 3),
+            "dur": round(dur * 1e6, 3),
+            "pid": os.getpid(),
+            "tid": self.thread_id,
+            "args": dict(self.attrs),
+        }
+        ev["args"]["trace_id"] = self.trace_id
+        ev["args"]["span_id"] = self.span_id
+        if self.parent_id is not None:
+            ev["args"]["parent_id"] = self.parent_id
+        return ev
+
+
+class _NullSpan:
+    """Do-nothing span so instrumented code never branches on tracer
+    presence: `with tracer.span(...)` works whether tracing is live."""
+
+    __slots__ = ()
+    name = "null"
+    trace_id = 0
+    span_id = 0
+    parent_id = None
+    duration = 0.0
+    attrs: Dict[str, Any] = {}
+
+    def set_attr(self, *_a, **_k) -> "_NullSpan":
+        return self
+
+    def finish(self) -> None:
+        pass
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *_exc) -> None:
+        pass
+
+    def to_chrome_event(self) -> Dict[str, Any]:
+        return {}
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class Tracer:
+    """Span factory + bounded ring buffer of completed spans.
+
+    Thread-safe: span-id allocation and buffer appends take a lock; the
+    per-thread "current span" lives in a `threading.local`, so nesting is
+    tracked independently on every thread. To carry context across a
+    thread hop, call `capture()` on the submitting thread and `attach()`
+    (a context manager) on the worker.
+    """
+
+    def __init__(self, capacity: int = 4096, enabled: bool = True) -> None:
+        self.capacity = int(capacity)
+        self.enabled = bool(enabled)
+        self._lock = threading.Lock()
+        self._finished: deque = deque(maxlen=self.capacity)
+        self._next_id = 1
+        self._local = threading.local()
+        self._jsonl_path: Optional[str] = None
+        self._jsonl_lock = threading.Lock()
+
+    # ----------------------------------------------------------- span API
+
+    def _alloc_id(self) -> int:
+        with self._lock:
+            i = self._next_id
+            self._next_id += 1
+            return i
+
+    def current(self) -> Optional[Span]:
+        return getattr(self._local, "span", None)
+
+    def span(
+        self,
+        name: str,
+        attrs: Optional[Dict[str, Any]] = None,
+        parent: Optional[Span] = None,
+    ):
+        """New span parented on `parent` (or the thread's current span).
+        Returns a no-op span when the tracer is disabled."""
+        if not self.enabled:
+            return _NULL_SPAN
+        if parent is None:
+            parent = self.current()
+        if parent is not None and not isinstance(parent, Span):
+            parent = None  # a _NullSpan or foreign token: no parent
+        sid = self._alloc_id()
+        if parent is not None:
+            return Span(self, name, parent.trace_id, sid, parent.span_id, attrs)
+        return Span(self, name, sid, sid, None, attrs)
+
+    def _push(self, span: Span):
+        prev = getattr(self._local, "span", None)
+        self._local.span = span
+        return prev
+
+    def _pop(self, span: Span, prev) -> None:
+        if getattr(self._local, "span", None) is span:
+            self._local.span = prev
+
+    def _on_finish(self, span: Span) -> None:
+        with self._lock:
+            self._finished.append(span)
+        path = self._jsonl_path
+        if path is not None:
+            line = json.dumps(span.to_chrome_event(), separators=(",", ":"))
+            with self._jsonl_lock:
+                try:
+                    with open(path, "a") as fh:
+                        fh.write(line + "\n")
+                except OSError:
+                    self._jsonl_path = None  # dead sink: stop trying
+
+    # --------------------------------------------------- cross-thread hops
+
+    def capture(self) -> Optional[Span]:
+        """Current span on this thread, to hand to `attach()` elsewhere."""
+        return self.current()
+
+    def attach(self, parent: Optional[Span]):
+        """Context manager installing `parent` as the current span on the
+        calling (worker) thread for the duration of a task."""
+        return _Attach(self, parent)
+
+    # -------------------------------------------------------------- export
+
+    def finished_spans(self) -> List[Span]:
+        with self._lock:
+            return list(self._finished)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._finished.clear()
+
+    def chrome_trace(self) -> Dict[str, Any]:
+        """The whole ring buffer as a Chrome trace-event JSON object."""
+        spans = self.finished_spans()
+        return {
+            "traceEvents": [s.to_chrome_event() for s in spans],
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "clock": "perf_counter",
+                "span_count": len(spans),
+                "capacity": self.capacity,
+            },
+        }
+
+    def set_jsonl_path(self, path: Optional[str]) -> None:
+        """Mirror every finished span to `path` as one JSON line each
+        (Chrome trace-event objects; `jq -s '{traceEvents:.}'` rebuilds a
+        loadable trace). Truncates any existing file."""
+        if path is not None:
+            with open(path, "w"):
+                pass
+        self._jsonl_path = path
+
+
+class _Attach:
+    __slots__ = ("_tracer", "_parent", "_prev")
+
+    def __init__(self, tracer: Tracer, parent: Optional[Span]) -> None:
+        self._tracer = tracer
+        self._parent = parent if isinstance(parent, Span) else None
+        self._prev: Optional[Span] = None
+
+    def __enter__(self) -> "_Attach":
+        self._prev = self._tracer.current()
+        self._tracer._local.span = self._parent
+        return self
+
+    def __exit__(self, *_exc) -> None:
+        self._tracer._local.span = self._prev
+
+
+#: shared disabled tracer: modules can default to this and never check
+#: for None before opening spans.
+NULL_TRACER = Tracer(capacity=1, enabled=False)

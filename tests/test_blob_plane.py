@@ -197,6 +197,7 @@ def test_breadth_topics_roundtrip(genesis):
     topics land in their pools on the receiving node — PROPERLY SIGNED;
     forged signatures are rejected at the gossip boundary."""
     from grandine_tpu.consensus import misc, signing
+    from grandine_tpu.metrics import Metrics
     from grandine_tpu.pools.operation_pool import OperationPool
     from grandine_tpu.pools.sync_committee_pool import SyncCommitteeAggPool
     from grandine_tpu.validator.duties import _interop_keys
@@ -209,7 +210,8 @@ def test_breadth_topics_roundtrip(genesis):
         sync_pool = SyncCommitteeAggPool(CFG)
         op_pool = OperationPool(CFG)
         net_b = Network(hub.join("b"), ctrl_b, CFG,
-                        sync_pool=sync_pool, operation_pool=op_pool)
+                        sync_pool=sync_pool, operation_pool=op_pool,
+                        metrics=Metrics())
 
         # --- sync-committee message, signed by its validator ------------
         head_root = ctrl_a.snapshot().head_root
@@ -303,18 +305,70 @@ def test_breadth_topics_roundtrip(genesis):
         assert net_b.stats["attester_slashings_rejected"] == 1
         assert len(ctrl_b.store.equivocating) == before
 
-        # --- bls-to-execution-change ------------------------------------
+        # --- proposer slashing: two conflicting headers, REALLY signed --
+        pidx = 1
+        pkey = _interop_keys(pidx)
+
+        def signed_header(body_root):
+            header = NS.BeaconBlockHeader(
+                slot=0, proposer_index=pidx, parent_root=b"\x00" * 32,
+                state_root=b"\x00" * 32, body_root=body_root,
+            )
+            sroot = signing.header_signing_root(genesis, header, CFG)
+            return NS.SignedBeaconBlockHeader(
+                message=header, signature=pkey.sign(sroot).to_bytes()
+            )
+
+        pslashing = NS.ProposerSlashing(
+            signed_header_1=signed_header(b"\x01" * 32),
+            signed_header_2=signed_header(b"\x02" * 32),
+        )
+        net_a.publish_proposer_slashing(pslashing)
+        assert net_b.stats["proposer_slashings_in"] == 1
+        assert net_b.stats.get("proposer_slashings_rejected", 0) == 0
+        assert op_pool.contents()["proposer_slashings"]
+
+        # forged header signature: rejected, pool unchanged
+        bad_ps = pslashing.replace(
+            signed_header_2=pslashing.signed_header_2.replace(
+                signature=b"\xc0" + b"\x00" * 95
+            )
+        )
+        net_a.publish_proposer_slashing(bad_ps)
+        assert net_b.stats["proposer_slashings_rejected"] == 1
+        assert len(op_pool.contents()["proposer_slashings"]) == 1
+
+        # --- bls-to-execution-change, signed by the claimed BLS key -----
+        ckey = _interop_keys(3)
+        change_msg = NS.BLSToExecutionChange(
+            validator_index=3,
+            from_bls_pubkey=ckey.public_key().to_bytes(),
+            to_execution_address=b"\x02" * 20,
+        )
+        croot = signing.bls_to_execution_change_signing_root(
+            genesis, change_msg, CFG
+        )
         change = NS.SignedBLSToExecutionChange(
-            message=NS.BLSToExecutionChange(
-                validator_index=3,
-                from_bls_pubkey=b"\x01" * 48,
-                to_execution_address=b"\x02" * 20,
-            ),
-            signature=b"\x00" * 96,
+            message=change_msg, signature=ckey.sign(croot).to_bytes(),
         )
         net_a.publish_bls_change(change)
         assert net_b.stats["bls_changes_in"] == 1
+        assert net_b.stats.get("bls_changes_rejected", 0) == 0
         assert op_pool.contents()["bls_to_execution_changes"]
+
+        # forged change signature: rejected at the gossip boundary
+        forged_change = change.replace(signature=b"\xc0" + b"\x00" * 95)
+        net_a.publish_bls_change(forged_change)
+        assert net_b.stats["bls_changes_rejected"] == 1
+        assert len(op_pool.contents()["bls_to_execution_changes"]) == 1
+
+        # labeled gossip counters on node B saw accepts and rejects
+        fam = net_b.metrics.gossip_messages
+        assert fam.value("proposer_slashing", "accept") == 1
+        assert fam.value("proposer_slashing", "reject") == 1
+        assert fam.value("bls_to_execution_change", "accept") == 1
+        assert fam.value("bls_to_execution_change", "reject") == 1
+        assert fam.value("sync_committee", "reject") == 1
     finally:
         ctrl_a.stop()
         ctrl_b.stop()

@@ -1,0 +1,420 @@
+"""Observability plane: labeled metric exposition (validated through a
+hand-written Prometheus text parser), stage histograms, cross-thread span
+propagation, the Chrome-trace debug endpoint, span coverage of the
+batch-verify pipeline, and an instrumentation-overhead guard.
+"""
+
+import hashlib
+import json
+import re
+import time
+
+import pytest
+
+from grandine_tpu.consensus.verifier import NullVerifier
+from grandine_tpu.fork_choice.store import Tick, TickKind
+from grandine_tpu.http_api.routing import ApiContext, build_router
+from grandine_tpu.metrics import (
+    LabeledCounter,
+    LabeledGauge,
+    LabeledHistogram,
+    Metrics,
+)
+from grandine_tpu.runtime import AttestationVerifier, Controller, ThreadPool
+from grandine_tpu.tracing import NULL_TRACER, Tracer
+from grandine_tpu.transition.genesis import interop_genesis_state
+from grandine_tpu.types.config import Config
+from grandine_tpu.validator.duties import produce_attestations, produce_block
+
+CFG = Config.minimal()
+
+
+@pytest.fixture()
+def genesis():
+    return interop_genesis_state(32, CFG)
+
+
+# ------------------------------------------------- prometheus text parser
+# A deliberately independent reimplementation of the text-format grammar:
+# if our exposition round-trips through THIS, a real scraper can read it.
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)$"
+)
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _unescape(v: str) -> str:
+    return v.replace("\\n", "\n").replace('\\"', '"').replace("\\\\", "\\")
+
+
+def parse_prometheus(text: str):
+    """Returns (families, samples): families maps name -> {"type", "help"};
+    samples is a list of (metric_name, labels_dict, float_value). Raises
+    on any line the grammar rejects."""
+    families = {}
+    samples = []
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            name, _, help_ = rest.partition(" ")
+            families.setdefault(name, {})["help"] = help_
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, type_ = rest.partition(" ")
+            families.setdefault(name, {})["type"] = type_
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m is not None, f"unparseable exposition line: {line!r}"
+        name, labelstr, value = m.groups()
+        labels = {}
+        if labelstr:
+            consumed = 0
+            for lm in _LABEL_RE.finditer(labelstr):
+                labels[lm.group(1)] = _unescape(lm.group(2))
+                consumed = lm.end()
+            # everything between label pairs must be separators only
+            leftovers = _LABEL_RE.sub("", labelstr).replace(",", "").strip()
+            assert not leftovers, f"bad label block: {labelstr!r}"
+            assert consumed  # at least one label parsed
+        samples.append((name, labels, float(value)))
+    return families, samples
+
+
+def _sample(samples, name, **labels):
+    got = [
+        v for n, ls, v in samples
+        if n == name and all(ls.get(k) == val for k, val in labels.items())
+    ]
+    assert len(got) == 1, f"{name} {labels}: {got}"
+    return got[0]
+
+
+# ----------------------------------------------------- labeled exposition
+
+
+def test_labeled_counter_exposition_roundtrip():
+    c = LabeledCounter("gossip_test_total", "per-topic results",
+                       ("topic", "result"))
+    c.inc("beacon_block", "accept")
+    c.inc("beacon_block", "accept")
+    c.inc("beacon_attestation", "reject", amount=3)
+    families, samples = parse_prometheus(c.expose())
+    assert families["gossip_test_total"]["type"] == "counter"
+    assert families["gossip_test_total"]["help"] == "per-topic results"
+    assert _sample(samples, "gossip_test_total",
+                   topic="beacon_block", result="accept") == 2
+    assert _sample(samples, "gossip_test_total",
+                   topic="beacon_attestation", result="reject") == 3
+    # child caching: same labels -> same child object
+    assert c.labels("beacon_block", "accept") is c.labels(
+        topic="beacon_block", result="accept"
+    )
+    with pytest.raises(ValueError):
+        c.labels("only_one")
+
+
+def test_label_value_escaping_roundtrip():
+    c = LabeledCounter("escape_test_total", "esc", ("weird",))
+    nasty = 'a"b\\c\nd'
+    c.inc(nasty)
+    _families, samples = parse_prometheus(c.expose())
+    assert _sample(samples, "escape_test_total", weird=nasty) == 1
+
+
+def test_labeled_gauge_set_and_dec():
+    g = LabeledGauge("queue_depth", "depth", ("queue",))
+    g.set("high", value=7)
+    g.labels("high").dec()
+    _families, samples = parse_prometheus(g.expose())
+    assert _sample(samples, "queue_depth", queue="high") == 6
+
+
+def test_labeled_histogram_bucket_cumulativity():
+    h = LabeledHistogram("stage_test_seconds", "stages", ("stage",),
+                         buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.005, 0.05, 0.5, 5.0):
+        h.observe("execute", value=v)
+    h.observe("host_prep", value=0.02)
+    families, samples = parse_prometheus(h.expose())
+    assert families["stage_test_seconds"]["type"] == "histogram"
+    buckets = [
+        (ls["le"], v) for n, ls, v in samples
+        if n == "stage_test_seconds_bucket" and ls["stage"] == "execute"
+    ]
+    assert buckets == [("0.01", 2), ("0.1", 3), ("1.0", 4), ("+Inf", 5)]
+    counts = [v for _le, v in buckets]
+    assert counts == sorted(counts)  # cumulative => non-decreasing
+    assert _sample(samples, "stage_test_seconds_count", stage="execute") == 5
+    assert _sample(samples, "stage_test_seconds_sum",
+                   stage="execute") == pytest.approx(5.56)
+    # the other child is independent
+    assert _sample(samples, "stage_test_seconds_count",
+                   stage="host_prep") == 1
+
+
+def test_full_metrics_exposition_parses():
+    """Every family the registry exposes — plain and labeled, with and
+    without children — must pass the independent parser."""
+    m = Metrics()
+    m.fc_blocks_applied.inc()
+    m.att_batch_times.observe(0.02)
+    m.gossip_messages.labels("beacon_block", "accept").inc()
+    m.rpc_requests.labels("status").inc()
+    m.device_kernel_calls.labels("multi_verify_msm").inc()
+    m.verify_stage_seconds.observe("execute", value=0.003)
+    families, samples = parse_prometheus(m.expose())
+    assert families["gossip_messages_total"]["type"] == "counter"
+    assert families["verify_stage_seconds"]["type"] == "histogram"
+    assert _sample(samples, "gossip_messages_total",
+                   topic="beacon_block", result="accept") == 1
+    assert _sample(samples, "rpc_requests_total", protocol="status") == 1
+    le_inf = _sample(samples, "verify_stage_seconds_bucket",
+                     stage="execute", le="+Inf")
+    assert le_inf == 1
+
+
+# ------------------------------------------------------------ span basics
+
+
+def test_span_nesting_same_thread():
+    tracer = Tracer()
+    with tracer.span("outer") as outer:
+        with tracer.span("inner") as inner:
+            assert inner.parent_id == outer.span_id
+            assert inner.trace_id == outer.trace_id
+    spans = tracer.finished_spans()
+    assert [s.name for s in spans] == ["inner", "outer"]  # finish order
+    assert all(s.duration > 0 for s in spans)
+
+
+def test_span_ring_buffer_bounded():
+    tracer = Tracer(capacity=8)
+    for i in range(20):
+        with tracer.span(f"s{i}"):
+            pass
+    spans = tracer.finished_spans()
+    assert len(spans) == 8
+    assert spans[0].name == "s12" and spans[-1].name == "s19"
+
+
+def test_null_tracer_is_inert():
+    with NULL_TRACER.span("anything", {"k": 1}) as s:
+        s.set_attr("x", 2)
+    assert NULL_TRACER.finished_spans() == []
+
+
+def test_cross_thread_span_parenting():
+    """A span opened on the submitting thread becomes the parent of spans
+    opened inside pool tasks — the capture-at-spawn / attach-on-worker
+    hop in ThreadPool."""
+    tracer = Tracer()
+    children = []
+    with ThreadPool(n_threads=2, tracer=tracer) as pool:
+        with tracer.span("submit") as root:
+            for i in range(4):
+                def task(i=i):
+                    with tracer.span("work", {"i": i}) as c:
+                        children.append(c)
+                pool.spawn(task)
+            pool.wait_group.wait(10)
+    assert len(children) == 4
+    for c in children:
+        assert c.parent_id == root.span_id
+        assert c.trace_id == root.trace_id
+        assert c.thread_id != root.thread_id  # really ran on a worker
+    # without a current span at spawn time, tasks are roots
+    orphans = []
+    with ThreadPool(n_threads=1, tracer=tracer) as pool:
+        pool.spawn(lambda: orphans.append(tracer.span("free").__enter__()))
+        pool.wait_group.wait(10)
+    assert orphans[0].parent_id is None
+
+
+def test_jsonl_sink(tmp_path):
+    path = str(tmp_path / "trace.jsonl")
+    tracer = Tracer()
+    tracer.set_jsonl_path(path)
+    with tracer.span("a", {"n": 1}):
+        with tracer.span("b"):
+            pass
+    with open(path) as fh:
+        lines = [json.loads(line) for line in fh]
+    assert [ev["name"] for ev in lines] == ["b", "a"]
+    assert all(ev["ph"] == "X" for ev in lines)
+    assert lines[0]["args"]["parent_id"] == lines[1]["args"]["span_id"]
+
+
+# ----------------------------------------------------------- trace route
+
+
+def test_trace_endpoint_returns_chrome_trace(genesis):
+    tracer = Tracer()
+    with tracer.span("verify_batch", {"batch": 3}):
+        with tracer.span("execute"):
+            time.sleep(0.001)
+    ctrl = Controller(genesis, CFG, verifier_factory=NullVerifier)
+    try:
+        ctx = ApiContext(ctrl, CFG, tracer=tracer)
+        router = build_router()
+        status, payload = router.dispatch(
+            ctx, "GET", "/eth/v1/debug/grandine/trace"
+        )
+        assert status == 200
+        # must be JSON-serializable and structurally a Chrome trace
+        decoded = json.loads(json.dumps(payload))
+        events = decoded["traceEvents"]
+        assert decoded["displayTimeUnit"] == "ms"
+        assert {e["name"] for e in events} == {"verify_batch", "execute"}
+        for e in events:
+            assert e["ph"] == "X"
+            assert isinstance(e["ts"], (int, float))
+            assert e["dur"] >= 0
+            assert "span_id" in e["args"]
+        execute = next(e for e in events if e["name"] == "execute")
+        root = next(e for e in events if e["name"] == "verify_batch")
+        assert execute["args"]["parent_id"] == root["args"]["span_id"]
+        assert root["args"]["batch"] == 3
+        # ?clear=true drains the ring buffer after the dump
+        status, payload = router.dispatch(
+            ctx, "GET", "/eth/v1/debug/grandine/trace", {"clear": "true"}
+        )
+        assert status == 200 and len(payload["traceEvents"]) == 2
+        _status, payload = router.dispatch(
+            ctx, "GET", "/eth/v1/debug/grandine/trace"
+        )
+        assert payload["traceEvents"] == []
+        # unwired tracer -> 503, like the other optional services
+        bare = ApiContext(ctrl, CFG)
+        status, _payload = router.dispatch(
+            bare, "GET", "/eth/v1/debug/grandine/trace"
+        )
+        assert status == 503
+    finally:
+        ctrl.stop()
+
+
+# -------------------------------------------- pipeline stage attribution
+
+
+def _run_firehose_batch(genesis, metrics, tracer):
+    ctrl = Controller(
+        genesis, CFG, verifier_factory=NullVerifier,
+        metrics=metrics, tracer=tracer,
+    )
+    verifier = AttestationVerifier(ctrl, use_device=False, deadline_s=0.01)
+    try:
+        blk, post = produce_block(
+            genesis, 1, CFG, full_sync_participation=False
+        )
+        ctrl.on_tick(Tick(1, TickKind.PROPOSE))
+        ctrl.on_own_block(blk)
+        ctrl.wait()
+        atts = produce_attestations(post, CFG, slot=1)
+        verifier.submit_many(atts)
+        verifier.flush()
+        ctrl.wait()
+        assert verifier.stats["accepted"] == len(atts)
+    finally:
+        verifier.stop()
+        ctrl.stop()
+
+
+def test_verify_stages_land_in_histogram_and_spans(genesis):
+    metrics = Metrics()
+    tracer = Tracer()
+    _run_firehose_batch(genesis, metrics, tracer)
+    # stage histogram saw the host pipeline stages
+    stages = {k[0] for k in metrics.verify_stage_seconds.children()}
+    assert {"host_prep", "execute", "feedback"} <= stages
+    assert metrics.verify_stage_seconds.labels("execute").count >= 1
+    assert metrics.att_batches.value >= 1
+    # the exposition of the recorded run parses
+    parse_prometheus(metrics.expose())
+    # spans: every batch has a root with stage children
+    spans = tracer.finished_spans()
+    roots = [s for s in spans if s.name == "verify_batch"]
+    assert roots, [s.name for s in spans]
+    by_parent = {}
+    for s in spans:
+        by_parent.setdefault(s.parent_id, []).append(s)
+    for root in roots:
+        names = {c.name for c in by_parent.get(root.span_id, [])}
+        assert "execute" in names or "host_prep" in names
+
+
+def test_span_coverage_of_batch_verify_wall_time(genesis):
+    """Acceptance bar: child stage spans account for >= 90% of the
+    measured wall time of a batch verify (the root verify_batch span)."""
+    tracer = Tracer()
+    _run_firehose_batch(genesis, Metrics(), tracer)
+    spans = tracer.finished_spans()
+    roots = [s for s in spans if s.name == "verify_batch"]
+    assert roots
+    # judge the slowest batch: the one whose wall time matters
+    root = max(roots, key=lambda s: s.duration)
+    children = [s for s in spans if s.parent_id == root.span_id]
+    covered = sum(c.duration for c in children)
+    assert root.duration > 0
+    assert covered / root.duration >= 0.90, (
+        f"stage spans cover {covered / root.duration:.1%} of "
+        f"{root.duration * 1e3:.2f}ms "
+        f"({[(c.name, round(c.duration * 1e3, 3)) for c in children]})"
+    )
+
+
+# --------------------------------------------------------- overhead guard
+
+
+def _staged_workload(verifier, rounds: int) -> float:
+    """A 1k-signature-shaped CPU batch: 16 batches of 64, each split into
+    the real pipeline stages via the verifier's own _stage helper (the
+    same span+histogram path production batches take). Returns seconds."""
+    payload = b"\x5a" * (1 << 17)
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        for _batch in range(16):
+            with verifier._stage("host_prep", items=64):
+                h = hashlib.sha256(payload).digest()
+            with verifier._stage("execute", items=64):
+                for _ in range(8):
+                    h = hashlib.sha256(payload + h).digest()
+            with verifier._stage("feedback", items=64):
+                hashlib.sha256(h).digest()
+    return time.perf_counter() - t0
+
+
+def test_instrumentation_overhead_within_5_percent(genesis):
+    """The stage helpers must be cheap enough to leave on: instrumented
+    (live tracer + histogram) vs uninstrumented (NULL_TRACER, no metrics)
+    on the same synthetic 1k-sig batch shape, min-of-5 each way, with a
+    small absolute epsilon so scheduler noise can't flake the ratio."""
+    ctrl = Controller(genesis, CFG, verifier_factory=NullVerifier)
+    plain = AttestationVerifier(ctrl, use_device=False)
+    traced = AttestationVerifier(
+        ctrl, use_device=False, metrics=Metrics(),
+        tracer=Tracer(capacity=65536),
+    )
+    try:
+        assert plain.tracer is NULL_TRACER and plain.metrics is None
+        _staged_workload(traced, 1)  # warm both paths
+        _staged_workload(plain, 1)
+        t_off = min(_staged_workload(plain, 1) for _ in range(5))
+        t_on = min(_staged_workload(traced, 1) for _ in range(5))
+        assert t_on <= t_off * 1.05 + 0.002, (
+            f"instrumented {t_on * 1e3:.2f}ms vs plain {t_off * 1e3:.2f}ms"
+        )
+        # and the instrumented run actually recorded its stages
+        assert traced.metrics.verify_stage_seconds.labels(
+            "execute"
+        ).count >= 16
+        assert any(
+            s.name == "execute" for s in traced.tracer.finished_spans()
+        )
+    finally:
+        plain.stop()
+        traced.stop()
+        ctrl.stop()
