@@ -177,9 +177,14 @@ def main() -> None:
         # re-uploads them every iteration below.
         (pk_x, pk_y, pk_inf, sig_x, sig_y, sig_inf,
          msg_x, msg_y, msg_inf) = args
-        pk_x, pk_y, pk_inf, msg_x, msg_y, msg_inf = (
-            jax.device_put(a)
-            for a in (pk_x, pk_y, pk_inf, msg_x, msg_y, msg_inf)
+        host_pk = (pk_x, pk_y)  # kept for the registry-cold comparison
+        t_pk = time.time()
+        pk_x, pk_y = (jax.device_put(pk_x), jax.device_put(pk_y))
+        for a in (pk_x, pk_y):
+            a.block_until_ready()
+        pk_upload_s = time.time() - t_pk  # the once-per-set registry cost
+        pk_inf, msg_x, msg_y, msg_inf = (
+            jax.device_put(a) for a in (pk_inf, msg_x, msg_y, msg_inf)
         )
         if grouped:
             # signatures upload as packed canonical words (52 B/coord vs
@@ -313,6 +318,39 @@ def main() -> None:
             if elapsed > 15.0 or iters >= 30:
                 break
         assert ok
+
+        # Registry-COLD comparison: charge the pubkey plane (208 B/key of
+        # affine G1 limbs) to every batch, serial with execution — what a
+        # node without the device-resident registry pays. The delta
+        # against the warm path is the registry's per-batch win.
+        cold_lat = []
+        for ci in range(3):
+            plans = make_plans(1009 + ci)
+            tc = time.time()
+            cold_staged = upload(plans)
+            cpk_x = jax.device_put(np.copy(host_pk[0]))
+            cpk_y = jax.device_put(np.copy(host_pk[1]))
+            cpk_x.block_until_ready()
+            cpk_y.block_until_ready()
+            if grouped:
+                d1, d2, dsig = cold_staged
+                pending = fn(
+                    cpk_x, cpk_y, pk_inf, *dsig, msg_x, msg_y, msg_inf,
+                    *d1, *d2,
+                )
+            else:
+                bits, d2, dsig = cold_staged
+                pending = fn(
+                    cpk_x, cpk_y, pk_inf, *dsig, msg_x, msg_y, msg_inf,
+                    bits, *d2,
+                )
+            assert bool(pending)
+            cold_lat.append(time.time() - tc)
+        cold_p50 = sorted(cold_lat)[len(cold_lat) // 2]
+        cold_sigs_per_sec = n / cold_p50
+        # once-per-set registry upload amortized over the run's signatures
+        amortized_prep_us = pk_upload_s * 1e6 / (n * iters)
+
         # Headline = n / MEDIAN batch latency: the steady-state pipelined
         # throughput. The shared axon tunnel stalls individual round
         # trips by seconds at random (observed p50 swings of 2× between
@@ -340,6 +378,9 @@ def main() -> None:
             f"prep={prep_s:.1f}s compile+first={compile_s:.1f}s "
             f"p50_batch_latency={p50 * 1000:.0f}ms "
             f"wall_mean={mean_sigs_per_sec:.0f}sigs/s "
+            f"registry_warm={sigs_per_sec:.0f}sigs/s "
+            f"registry_cold={cold_sigs_per_sec:.0f}sigs/s "
+            f"amortized_pk_prep={amortized_prep_us:.3f}us/sig "
             f"platform={jax.devices()[0].platform}",
             file=sys.stderr,
         )

@@ -45,6 +45,16 @@ def decompress_pubkey(
     return pk
 
 
+def decompress_pubkeys(
+    pubkey_bytes_seq: "Iterable[bytes]", trusted: bool = False
+) -> "list[A.PublicKey]":
+    """Batch decompression through the process-wide cache — the bulk
+    entry point for registry builds (tpu/registry.py uploads the whole
+    validator set) and committee resolution. Raises BlsError on the
+    first invalid encoding."""
+    return [decompress_pubkey(b, trusted=trusted) for b in pubkey_bytes_seq]
+
+
 def try_decompress_pubkey(pubkey_bytes: bytes) -> "Optional[A.PublicKey]":
     try:
         return decompress_pubkey(pubkey_bytes)
@@ -67,6 +77,7 @@ def aggregate_pubkey_bytes(pubkeys: "Iterable[bytes]") -> bytes:
 
 __all__ = [
     "decompress_pubkey",
+    "decompress_pubkeys",
     "try_decompress_pubkey",
     "aggregate_pubkeys",
     "aggregate_pubkey_bytes",

@@ -380,6 +380,43 @@ class Metrics:
             "signatures processed per kernel variant",
             ("kernel",),
         )
+        # host→device transfer accounting, per kernel variant: the basis
+        # of the no-per-batch-pubkey-upload guard
+        # (tools/check_no_per_batch_upload.py) — registry uploads land
+        # under kernel="pubkey_registry", per-batch uploads under the
+        # dispatching kernel's name
+        self.device_upload_bytes = LabeledCounter(
+            "device_upload_bytes_total",
+            "host to device bytes uploaded, by kernel variant",
+            ("kernel",),
+        )
+        # device-resident pubkey registry (tpu/registry.py)
+        self.pubkey_registry_size = Gauge(
+            "pubkey_registry_size",
+            "validator pubkeys resident on the accelerator")
+        self.pubkey_registry_events = LabeledCounter(
+            "pubkey_registry_events_total",
+            "registry lifecycle events "
+            "(hit/miss/append/refresh/invalidate)",
+            ("event",),
+        )
+        # bounded host-side device-point caches (hash-to-curve, …)
+        self.device_cache_size = LabeledGauge(
+            "device_cache_size",
+            "entries held in bounded device-point caches, by cache",
+            ("cache",),
+        )
+        self.device_cache_events = LabeledCounter(
+            "device_cache_events_total",
+            "cache lookups and evictions, by cache and event "
+            "(hit/miss/evict)",
+            ("cache", "event"),
+        )
+        # two-deep verify dispatch queue occupancy (0..2): batches
+        # dispatched to the device whose readback has not completed
+        self.verify_pipeline_depth = Gauge(
+            "verify_pipeline_depth",
+            "device verify batches in flight (dispatched, not settled)")
         # verify-plane stage attribution: host_prep / upload_bytes /
         # compile / execute / readback / fallback. Finer low end than
         # the defaults: host prep for a 64-att batch is ~100 µs.

@@ -14,6 +14,7 @@ the batch bound keeps device launches dense when it's fast.
 
 from __future__ import annotations
 
+import queue
 import threading
 import time
 from collections import deque
@@ -56,6 +57,8 @@ class AttestationVerifier:
         deadline_s: float = 0.050,
         max_active: "Optional[int]" = None,
         use_device: bool = True,
+        use_registry: bool = True,
+        pipeline_depth: int = 2,
         slasher=None,
         operation_pool=None,
         metrics=None,
@@ -98,6 +101,39 @@ class AttestationVerifier:
         self._active = 0
         self._stop = False
         self.stats = {"batches": 0, "accepted": 0, "rejected": 0, "fallbacks": 0}
+
+        #: device-resident pubkey registry (tpu/registry.py): the verify
+        #: plane's warm path gathers committee pubkeys on-device by
+        #: validator index instead of re-uploading 208 B/member per batch.
+        #: Kept fresh via the controller's validator-set-change hook
+        #: (deposits / finalization → mark_stale → prefix re-check).
+        self.use_registry = use_registry
+        self.registry = None
+        if use_device and use_registry:
+            from grandine_tpu.tpu.registry import DevicePubkeyRegistry
+
+            self.registry = DevicePubkeyRegistry(metrics=self.metrics)
+            hooks = getattr(controller, "on_validator_set_change", None)
+            if hooks is not None:
+                hooks.append(lambda old, new: self.registry.mark_stale())
+
+        #: two-deep dispatch pipeline: batch tasks hand their device
+        #: dispatch a zero-arg settle callable and return immediately, so
+        #: batch N+1's host_prep/upload overlaps batch N's device execute
+        #: (JAX async dispatch). The semaphore bounds device residency;
+        #: the completion thread forces results in dispatch order.
+        self.pipeline_depth = max(1, int(pipeline_depth))
+        self._dispatch_sem = threading.BoundedSemaphore(self.pipeline_depth)
+        self._inflight = 0
+        self._completion: "Optional[queue.Queue]" = None
+        self._completion_thread: "Optional[threading.Thread]" = None
+        if use_device:
+            self._completion = queue.Queue()
+            self._completion_thread = threading.Thread(
+                target=self._complete, name="attestation-settle", daemon=True
+            )
+            self._completion_thread.start()
+
         self._collector = threading.Thread(
             target=self._collect, name="attestation-verifier", daemon=True
         )
@@ -195,10 +231,25 @@ class AttestationVerifier:
                     self.stats["rejected"] += 1
         if not prepared:
             return
+        if self.use_device and self._completion is not None:
+            settle = self._device_dispatch(prepared)
+            if settle is not None:
+                # pipelined path: readback is deferred to the completion
+                # thread so this pool thread (and the collector behind it)
+                # can start the NEXT batch's host_prep while the device
+                # executes this one
+                self._enqueue_settle(settle, prepared)
+                return
         messages = [p[0] for p in prepared]
         signatures = [p[1] for p in prepared]
         members = [p[2] for p in prepared]
         ok = self._batch_check(messages, signatures, members)
+        self._resolve_batch(prepared, ok)
+
+    def _resolve_batch(self, prepared, ok: bool) -> None:
+        """Deliver a settled batch verdict: feedback on success, bisection
+        on failure. Runs on the pool thread (sync path) or the completion
+        thread (pipelined path)."""
         if ok:
             self.stats["accepted"] += len(prepared)
             with self._stage("feedback", items=len(prepared)):
@@ -230,6 +281,122 @@ class AttestationVerifier:
                     [p[3] for p in good_items]
                 )
                 self._feed_slasher([(p[4], p[3]) for p in good_items])
+
+    # ------------------------------------------------------------ pipeline
+
+    def _device_dispatch(self, prepared):
+        """Host prep + async device dispatch for one prepared batch.
+        Returns a zero-arg settle callable producing the batch verdict, or
+        None when the backend lacks the async seam (foreign backends keep
+        the synchronous `_batch_check` path)."""
+        backend = self.backend
+        if backend is None:
+            from grandine_tpu.tpu.bls import TpuBlsBackend
+
+            backend = self.backend = TpuBlsBackend(
+                metrics=self.metrics, tracer=self.tracer
+            )
+        if not (
+            hasattr(backend, "fast_aggregate_verify_batch_async")
+            and hasattr(backend, "g2_subgroup_check_batch_async")
+        ):
+            return None
+        messages = [p[0] for p in prepared]
+        try:
+            # decompress WITHOUT the per-signature host subgroup
+            # scalar-mul; the device checks the whole batch in one ψ
+            # ladder (see _batch_check for the rationale)
+            with self._stage("host_prep", op="g2_decompress"):
+                points = [
+                    A.g2_from_bytes(bytes(p[1]), subgroup_check=False)
+                    for p in prepared
+                ]
+        except A.BlsError:
+            return lambda: False
+        if any(p.is_infinity() for p in points):
+            return lambda: False
+        # stack both dispatches before any readback: subgroup ladder and
+        # verify kernel queue back-to-back on the device. Verifying a
+        # not-yet-subgroup-checked (but on-curve) point is safe — if the
+        # subgroup check fails the batch verdict is False and the items
+        # fall to bisection, whose singular path is fully checked.
+        sub_settle = backend.g2_subgroup_check_batch_async(points)
+        sigs = [A.Signature(p) for p in points]
+        if self.metrics is not None:
+            self.metrics.device_batch_sigs.inc(len(sigs))
+        registry = self._sync_registry(prepared)
+        if registry is not None:
+            ver_settle = backend.fast_aggregate_verify_batch_indexed_async(
+                messages, sigs, [p[5] for p in prepared], registry
+            )
+        else:
+            ver_settle = backend.fast_aggregate_verify_batch_async(
+                messages, sigs, [p[2] for p in prepared]
+            )
+
+        def settle() -> bool:
+            if not bool(sub_settle().all()):
+                return False
+            return bool(ver_settle())
+
+        return settle
+
+    def _sync_registry(self, prepared):
+        """Bring the registry up to date with the batch's head-state
+        pubkey columns (identity hit when nothing changed); None → take
+        the upload path."""
+        registry = self.registry
+        if registry is None:
+            return None
+        try:
+            with self._stage("host_prep", op="registry_sync"):
+                if registry.ensure(prepared[0][6]):
+                    return registry
+        except A.BlsError:
+            # corrupted registry bytes: keep the upload path (and its
+            # per-key validation) rather than poisoning the device mirror
+            pass
+        return None
+
+    def _enqueue_settle(self, settle, prepared) -> None:
+        """Hand a dispatched batch to the completion thread. Blocks when
+        `pipeline_depth` batches are already in flight — backpressure that
+        bounds device residency."""
+        self._dispatch_sem.acquire()
+        with self._cond:
+            self._inflight += 1
+            depth = self._inflight
+        if self.metrics is not None:
+            self.metrics.verify_pipeline_depth.set(depth)
+        self._completion.put((settle, prepared, self.tracer.capture()))
+
+    def _complete(self) -> None:
+        """Completion thread: force settled batch verdicts in dispatch
+        order and deliver feedback. Readback happens HERE, off the
+        dispatch path, so the pool threads never block on the device."""
+        while True:
+            item = self._completion.get()
+            if item is None:
+                return
+            settle, prepared, span_ctx = item
+            try:
+                with self.tracer.attach(span_ctx):
+                    ok = bool(settle())
+                    self._resolve_batch(prepared, ok)
+            except Exception:
+                # the completion thread must survive backend faults; the
+                # batch is dropped (counted), not silently accepted
+                self.stats["settle_errors"] = (
+                    self.stats.get("settle_errors", 0) + 1
+                )
+            finally:
+                self._dispatch_sem.release()
+                with self._cond:
+                    self._inflight -= 1
+                    depth = self._inflight
+                    self._cond.notify_all()
+                if self.metrics is not None:
+                    self.metrics.verify_pipeline_depth.set(depth)
 
     def _isolate(self, prepared):
         """Recursive bisection over a FAILED batch: re-check halves as
@@ -271,7 +438,10 @@ class AttestationVerifier:
 
     def _prevalidate(self, state, attestation):
         """Committee lookup + fork-choice windows; returns
-        (signing_root, signature_bytes, member_keys, ValidAttestation)."""
+        (signing_root, signature_bytes, member_keys, ValidAttestation,
+        attestation, member_indices, state_pubkey_columns) — the index
+        list and the state's compressed-pubkey tuple ride along so the
+        registry path can gather on-device without touching the keys."""
         p = self.cfg.preset
         data = attestation.data
         indices = accessors.get_attesting_indices(
@@ -279,21 +449,25 @@ class AttestationVerifier:
         )
         if len(indices) == 0:
             raise ValueError("empty attestation")
+        idx_list = [int(i) for i in indices]
         valid = self.controller.store.validate_attestation(
             int(data.slot),
             int(data.index),
             int(data.target.epoch),
             bytes(data.beacon_block_root),
             bytes(data.target.root),
-            [int(i) for i in indices],
+            idx_list,
         )
         root = signing.attestation_signing_root(state, data, self.cfg)
         cols = accessors.registry_columns(state)
         members = [
-            keys.decompress_pubkey(cols.pubkeys[int(i)], trusted=True)
-            for i in indices
+            keys.decompress_pubkey(cols.pubkeys[i], trusted=True)
+            for i in idx_list
         ]
-        return root, bytes(attestation.signature), members, valid, attestation
+        return (
+            root, bytes(attestation.signature), members, valid, attestation,
+            idx_list, cols.pubkeys,
+        )
 
     #: evidence retention window (epochs) for building slashing ops
     SLASHER_EVIDENCE_EPOCHS = 64
@@ -466,13 +640,14 @@ class AttestationVerifier:
     # ------------------------------------------------------------ control
 
     def flush(self, timeout: float = 30.0) -> None:
-        """Drain the queue and all in-flight batches (test barrier)."""
+        """Drain the queue, all in-flight batches, and the pipelined
+        settle queue (test barrier)."""
         deadline = time.monotonic() + timeout
         with self._cond:
             self._cond.notify()
         while time.monotonic() < deadline:
             with self._cond:
-                if not self._queue and self._active == 0:
+                if not self._queue and self._active == 0 and self._inflight == 0:
                     return
                 self._cond.notify()
             time.sleep(0.01)
@@ -483,6 +658,12 @@ class AttestationVerifier:
             self._stop = True
             self._cond.notify_all()
         self._collector.join(timeout=5)
+        if self._completion is not None:
+            # sentinel queues BEHIND any still-pending settles, so they
+            # drain before the thread exits
+            self._completion.put(None)
+            if self._completion_thread is not None:
+                self._completion_thread.join(timeout=10)
 
 
 __all__ = ["AttestationVerifier", "GossipAttestation", "MAX_BATCH"]

@@ -49,6 +49,7 @@ class Snapshot:
         "finalized_checkpoint",
         "block_count",
         "is_optimistic",
+        "validator_count",
     )
 
     def __init__(self, store: Store) -> None:
@@ -60,6 +61,9 @@ class Snapshot:
         self.block_count = len(store)
         # head chain contains an EL-unjudged payload (optimistic sync)
         self.is_optimistic = store.is_optimistic(self.head_root)
+        #: registry size of the head state — drives the device pubkey
+        #: registry's staleness hook (tpu/registry.py)
+        self.validator_count = len(self.head_state.validators.items)
 
 
 class Controller:
@@ -122,6 +126,12 @@ class Controller:
         #: (valid_block, old_head_root, snapshot) — the event-stream
         #: publication point (http_api events.rs)
         self.on_block_applied: "list[Callable]" = []
+        #: called on the mutator thread with (old_snapshot, new_snapshot)
+        #: when the head state's validator count or the finalized epoch
+        #: changes — the device pubkey registry's staleness hook
+        #: (deposits extend the set; finalization is the natural
+        #: re-check point for everything else)
+        self.on_validator_set_change: "list[Callable]" = []
 
         # on every head change, notify the EL (engine_forkchoiceUpdated)
         # off-thread and feed its verdict back as a payload-status mutation
@@ -619,6 +629,13 @@ class Controller:
                 self.metrics.fc_head_changes.inc()
             for cb in self.on_head_change:
                 cb(old.head_root, self._snapshot)
+        if (
+            self._snapshot.validator_count != old.validator_count
+            or int(self._snapshot.finalized_checkpoint.epoch)
+            != int(old.finalized_checkpoint.epoch)
+        ):
+            for cb in self.on_validator_set_change:
+                cb(old, self._snapshot)
 
 
 __all__ = ["Controller", "Snapshot"]

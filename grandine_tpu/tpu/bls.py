@@ -37,8 +37,11 @@ all-gather of one Fp12 element per chip (see __graft_entry__.py).
 
 from __future__ import annotations
 
+import os
 import secrets
+import threading
 import time
+from collections import OrderedDict
 from contextlib import contextmanager
 from typing import Sequence
 
@@ -252,29 +255,14 @@ def pick_msm_window(n_points: int, n_groups: int = 1) -> int:
     return best
 
 
-def grouped_multi_verify_msm_kernel(
-    pk_x, pk_y, pk_inf, sig_x, sig_y, sig_inf, msg_x, msg_y, msg_inf,
+def _grouped_msm_verify_tail(
+    pk, sig, msg, pk_inf_f, sig_inf_f, msg_inf, m, k,
     g1_pidx, g1_valid, g1_flush, g1_gidx, g1_gvalid,
     g2_pidx, g2_valid, g2_flush, g2_gidx, g2_gvalid,
     g1_windows: int, g1_wbits: int, g2_windows: int, g2_wbits: int,
 ):
-    """Message-grouped RLC batch verify with BOTH scalar planes as device
-    Pippenger MSMs (msm.py) instead of per-signature ladders: per-group
-    Σᵢ∈ⱼ rᵢ·pkᵢ (M-group MSM) and the global Σᵢ rᵢ·sigᵢ (1-group MSM).
-    Point layouts as grouped_multi_verify_kernel; the RLC scalars travel as
-    MsmPlan index arrays (flat k-major point order, group of point f =
-    f mod M) built by the host, which draws the randomizers.
-
-    Replaces the ladder plane per VERDICT r3 #1; matches blst's
-    Pippenger-backed multi_verify (bls/src/signature.rs:96-129)."""
-    m, k = pk_inf.shape
-    pk = _g1_in(_flat_km(pk_x, m, k), _flat_km(pk_y, m, k))
-    sig = _g2_in(_flat_km(sig_x, m, k), _flat_km(sig_y, m, k))
-    msg = _g2_in(msg_x, msg_y)
-    pk_inf_f = jnp.asarray(_flat_km(pk_inf, m, k))
-    sig_inf_f = jnp.asarray(_flat_km(sig_inf, m, k))
-    msg_inf = jnp.asarray(msg_inf)
-
+    """Shared tail of the grouped MSM verify kernels: per-group pubkey MSM,
+    global signature MSM, then the RLC pairing check over M messages."""
     epx, epy, eplive = M.expand_glv_points(
         pk[0], pk[1], pk_inf_f, _g1_endo(m * k), C.FP_OPS
     )
@@ -294,6 +282,36 @@ def grouped_multi_verify_msm_kernel(
     sig_acc = tuple(C.FP2_OPS.index(e, 0) for e in sig_acc_g)
     pair_inf = L.is_zero_val(gpk[2]) | msg_inf
     return _rlc_pairing_check(gpk, pair_inf, msg[0], msg[1], sig_acc)
+
+
+def grouped_multi_verify_msm_kernel(
+    pk_x, pk_y, pk_inf, sig_x, sig_y, sig_inf, msg_x, msg_y, msg_inf,
+    g1_pidx, g1_valid, g1_flush, g1_gidx, g1_gvalid,
+    g2_pidx, g2_valid, g2_flush, g2_gidx, g2_gvalid,
+    g1_windows: int, g1_wbits: int, g2_windows: int, g2_wbits: int,
+):
+    """Message-grouped RLC batch verify with BOTH scalar planes as device
+    Pippenger MSMs (msm.py) instead of per-signature ladders: per-group
+    Σᵢ∈ⱼ rᵢ·pkᵢ (M-group MSM) and the global Σᵢ rᵢ·sigᵢ (1-group MSM).
+    Point layouts as grouped_multi_verify_kernel; the RLC scalars travel as
+    MsmPlan index arrays (flat k-major point order, group of point f =
+    f mod M) built by the host, which draws the randomizers.
+
+    Replaces the ladder plane per VERDICT r3 #1; matches blst's
+    Pippenger-backed multi_verify (bls/src/signature.rs:96-129)."""
+    m, k = pk_inf.shape
+    return _grouped_msm_verify_tail(
+        _g1_in(_flat_km(pk_x, m, k), _flat_km(pk_y, m, k)),
+        _g2_in(_flat_km(sig_x, m, k), _flat_km(sig_y, m, k)),
+        _g2_in(msg_x, msg_y),
+        jnp.asarray(_flat_km(pk_inf, m, k)),
+        jnp.asarray(_flat_km(sig_inf, m, k)),
+        jnp.asarray(msg_inf), m, k,
+        g1_pidx, g1_valid, g1_flush, g1_gidx, g1_gvalid,
+        g2_pidx, g2_valid, g2_flush, g2_gidx, g2_gvalid,
+        g1_windows=g1_windows, g1_wbits=g1_wbits,
+        g2_windows=g2_windows, g2_wbits=g2_wbits,
+    )
 
 
 def _g2_packed_in(sig_words, m: int, k: int):
@@ -321,44 +339,29 @@ def grouped_multi_verify_msm_packed_kernel(
     execution on the per-batch clock, so halving sig bytes cuts batch
     latency directly (bench.py pipeline notes)."""
     m, k = pk_inf.shape
-    pk = _g1_in(_flat_km(pk_x, m, k), _flat_km(pk_y, m, k))
-    sig = _g2_packed_in(sig_words, m, k)
-    msg = _g2_in(msg_x, msg_y)
-    pk_inf_f = jnp.asarray(_flat_km(pk_inf, m, k))
-    sig_inf_f = jnp.asarray(_flat_km(sig_inf, m, k))
-    msg_inf = jnp.asarray(msg_inf)
-
-    epx, epy, eplive = M.expand_glv_points(
-        pk[0], pk[1], pk_inf_f, _g1_endo(m * k), C.FP_OPS
-    )
-    gpk = M.msm_bucket_scan(
-        epx, epy, eplive,
+    return _grouped_msm_verify_tail(
+        _g1_in(_flat_km(pk_x, m, k), _flat_km(pk_y, m, k)),
+        _g2_packed_in(sig_words, m, k),
+        _g2_in(msg_x, msg_y),
+        jnp.asarray(_flat_km(pk_inf, m, k)),
+        jnp.asarray(_flat_km(sig_inf, m, k)),
+        jnp.asarray(msg_inf), m, k,
         g1_pidx, g1_valid, g1_flush, g1_gidx, g1_gvalid,
-        windows=g1_windows, window_bits=g1_wbits, n_groups=m, ops=C.FP_OPS,
-    )
-    esx, esy, eslive = M.expand_glv_points(
-        sig[0], sig[1], sig_inf_f, _g2_endo(m * k), C.FP2_OPS
-    )
-    sig_acc_g = M.msm_bucket_scan(
-        esx, esy, eslive,
         g2_pidx, g2_valid, g2_flush, g2_gidx, g2_gvalid,
-        windows=g2_windows, window_bits=g2_wbits, n_groups=1, ops=C.FP2_OPS,
+        g1_windows=g1_windows, g1_wbits=g1_wbits,
+        g2_windows=g2_windows, g2_wbits=g2_wbits,
     )
-    sig_acc = tuple(C.FP2_OPS.index(e, 0) for e in sig_acc_g)
-    pair_inf = L.is_zero_val(gpk[2]) | msg_inf
-    return _rlc_pairing_check(gpk, pair_inf, msg[0], msg[1], sig_acc)
 
 
-def multi_verify_msm_kernel(
-    pk_x, pk_y, pk_inf, sig_x, sig_y, sig_inf, msg_x, msg_y, msg_inf, r_bits,
+def _flat_msm_verify_tail(
+    pk, pk_inf, sig_x, sig_y, sig_inf, msg_x, msg_y, msg_inf, r_bits,
     g2_pidx, g2_valid, g2_flush, g2_gidx, g2_gvalid,
     g2_windows: int, g2_wbits: int,
 ):
-    """Flat RLC batch verify (one Miller loop per signature) with the G2
-    scalar plane as a device MSM. The G1 side keeps per-signature GLV
-    ladders — each rᵢ·pkᵢ is needed individually for its Miller loop —
-    while Σ rᵢ·sigᵢ is a single Pippenger sum."""
-    pk = _g1_in(pk_x, pk_y)
+    """Shared tail of the flat MSM verify kernels: per-signature G1 GLV
+    ladders (each rᵢ·pkᵢ feeds its own Miller loop), Σ rᵢ·sigᵢ as one
+    Pippenger sum, then the RLC pairing check. `pk` arrives as a limb-list
+    pair — built either from uploaded coords or a registry gather."""
     sig = _g2_in(sig_x, sig_y)
     msg = _g2_in(msg_x, msg_y)
     pk_inf = jnp.asarray(pk_inf)
@@ -378,6 +381,48 @@ def multi_verify_msm_kernel(
     sig_acc = tuple(C.FP2_OPS.index(e, 0) for e in sig_acc_g)
     pair_inf = pk_inf | msg_inf
     return _rlc_pairing_check(rpk, pair_inf, msg[0], msg[1], sig_acc)
+
+
+def multi_verify_msm_kernel(
+    pk_x, pk_y, pk_inf, sig_x, sig_y, sig_inf, msg_x, msg_y, msg_inf, r_bits,
+    g2_pidx, g2_valid, g2_flush, g2_gidx, g2_gvalid,
+    g2_windows: int, g2_wbits: int,
+):
+    """Flat RLC batch verify (one Miller loop per signature) with the G2
+    scalar plane as a device MSM. The G1 side keeps per-signature GLV
+    ladders — each rᵢ·pkᵢ is needed individually for its Miller loop —
+    while Σ rᵢ·sigᵢ is a single Pippenger sum."""
+    return _flat_msm_verify_tail(
+        _g1_in(pk_x, pk_y), pk_inf,
+        sig_x, sig_y, sig_inf, msg_x, msg_y, msg_inf, r_bits,
+        g2_pidx, g2_valid, g2_flush, g2_gidx, g2_gvalid,
+        g2_windows=g2_windows, g2_wbits=g2_wbits,
+    )
+
+
+def multi_verify_msm_idx_kernel(
+    reg_x, reg_y, pk_idx, pk_inf,
+    sig_x, sig_y, sig_inf, msg_x, msg_y, msg_inf, r_bits,
+    g2_pidx, g2_valid, g2_flush, g2_gidx, g2_gvalid,
+    g2_windows: int, g2_wbits: int,
+):
+    """multi_verify_msm_kernel with the PUBKEY plane gathered on-device
+    from the resident registry (tpu/registry.py): reg_x/reg_y are the
+    (capacity, L) registry arrays (already device-resident — NOT part of
+    the per-batch upload), pk_idx (N,) int32 selects each signer's row.
+    Padding slots carry pk_idx 0 under pk_inf True (registry rows are
+    never the identity, so only the batch mask matters)."""
+    idx = jnp.asarray(pk_idx)
+    pk = _g1_in(
+        jnp.take(jnp.asarray(reg_x), idx, axis=0),
+        jnp.take(jnp.asarray(reg_y), idx, axis=0),
+    )
+    return _flat_msm_verify_tail(
+        pk, pk_inf,
+        sig_x, sig_y, sig_inf, msg_x, msg_y, msg_inf, r_bits,
+        g2_pidx, g2_valid, g2_flush, g2_gidx, g2_gvalid,
+        g2_windows=g2_windows, g2_wbits=g2_wbits,
+    )
 
 
 def aggregate_fast_verify_kernel(
@@ -427,19 +472,17 @@ def aggregate_fast_verify_kernel(
     return jnp.logical_and(ok, jnp.logical_not(forged))
 
 
-def aggregate_fast_verify_msm_kernel(
-    mem_x, mem_y, mem_inf, slot_pad,
+def _aggregate_msm_verify_tail(
+    mem, mem_inf_f, m, k, slot_pad,
     sig_x, sig_y, sig_inf, msg_x, msg_y, msg_inf, r_bits,
     g2_pidx, g2_valid, g2_flush, g2_gidx, g2_gvalid,
     g2_windows: int, g2_wbits: int,
 ):
-    """Firehose kernel with the Σ rᵢ·sigᵢ side as a device MSM. The G1 side
-    keeps the per-aggregate Jacobian GLV ladder — each rᵢ·(Σ memᵢₖ) is
-    needed individually for its Miller loop. Layouts and rejection
-    semantics identical to aggregate_fast_verify_kernel."""
-    m, k = mem_inf.shape
-    mem = _g1_in(_flat_km(mem_x, m, k), _flat_km(mem_y, m, k))
-    mem_inf_f = _flat_km(mem_inf, m, k)
+    """Shared tail of the firehose MSM kernels: member aggregation tree,
+    identity-forgery rejection, per-aggregate G1 ladder, Σ rᵢ·sigᵢ as one
+    MSM, then the RLC pairing check. `mem` arrives as a k-major flat
+    limb-list pair — built either from uploaded coords or a registry
+    gather."""
     one = C.FP_OPS.one_like(mem[0])
     zero = C.FP_OPS.zeros_like(mem[0])
     mem_jac = (
@@ -469,6 +512,54 @@ def aggregate_fast_verify_msm_kernel(
     pair_inf = agg_inf | msg_inf
     ok = _rlc_pairing_check(rpk, pair_inf, msg[0], msg[1], sig_acc)
     return jnp.logical_and(ok, jnp.logical_not(forged))
+
+
+def aggregate_fast_verify_msm_kernel(
+    mem_x, mem_y, mem_inf, slot_pad,
+    sig_x, sig_y, sig_inf, msg_x, msg_y, msg_inf, r_bits,
+    g2_pidx, g2_valid, g2_flush, g2_gidx, g2_gvalid,
+    g2_windows: int, g2_wbits: int,
+):
+    """Firehose kernel with the Σ rᵢ·sigᵢ side as a device MSM. The G1 side
+    keeps the per-aggregate Jacobian GLV ladder — each rᵢ·(Σ memᵢₖ) is
+    needed individually for its Miller loop. Layouts and rejection
+    semantics identical to aggregate_fast_verify_kernel."""
+    m, k = mem_inf.shape
+    mem = _g1_in(_flat_km(mem_x, m, k), _flat_km(mem_y, m, k))
+    return _aggregate_msm_verify_tail(
+        mem, _flat_km(mem_inf, m, k), m, k, slot_pad,
+        sig_x, sig_y, sig_inf, msg_x, msg_y, msg_inf, r_bits,
+        g2_pidx, g2_valid, g2_flush, g2_gidx, g2_gvalid,
+        g2_windows=g2_windows, g2_wbits=g2_wbits,
+    )
+
+
+def aggregate_fast_verify_msm_idx_kernel(
+    reg_x, reg_y, mem_idx, mem_inf, slot_pad,
+    sig_x, sig_y, sig_inf, msg_x, msg_y, msg_inf, r_bits,
+    g2_pidx, g2_valid, g2_flush, g2_gidx, g2_gvalid,
+    g2_windows: int, g2_wbits: int,
+):
+    """Firehose kernel with MEMBER PUBKEYS gathered on-device from the
+    resident registry: reg_x/reg_y are the (capacity, L) registry arrays
+    (device-resident, not uploaded per batch); mem_idx (M, K) int32 selects
+    each committee member's registry row, with mem_inf (M, K) masking the
+    padding slots (which carry index 0 — registry rows are never the
+    identity, so the mask alone is authoritative). The per-batch upload
+    shrinks to signatures + messages + the index plane: 4 B/member instead
+    of 208 B/member of affine G1 coordinates."""
+    m, k = mem_inf.shape
+    idx_f = _flat_km(mem_idx, m, k)  # k-major flat, like the coord layout
+    mem = _g1_in(
+        jnp.take(jnp.asarray(reg_x), idx_f, axis=0),
+        jnp.take(jnp.asarray(reg_y), idx_f, axis=0),
+    )
+    return _aggregate_msm_verify_tail(
+        mem, _flat_km(mem_inf, m, k), m, k, slot_pad,
+        sig_x, sig_y, sig_inf, msg_x, msg_y, msg_inf, r_bits,
+        g2_pidx, g2_valid, g2_flush, g2_gidx, g2_gvalid,
+        g2_windows=g2_windows, g2_wbits=g2_wbits,
+    )
 
 
 def batch_sign_kernel(msg_x, msg_y, msg_inf, sk_bits, sk_neg):
@@ -899,6 +990,57 @@ def _jitted_global(name: str, fn):
 _ZERO2 = np.zeros((2, L.NLIMBS), np.int32)
 
 
+#: cap on the per-backend hash-to-curve device-point cache; gossip traffic
+#: churns through distinct AttestationData roots, so an unbounded cache is a
+#: slow leak (~1.3 KB/entry) — override for benchmarking via the environment
+H2C_CACHE_CAP = int(os.environ.get("GT_H2C_CACHE_CAP", "4096"))
+
+
+class _LruCache:
+    """Bounded thread-safe LRU keyed by hashables, with labeled metrics.
+
+    Used for the hash-to-G2 message-point cache: hits remove a ~1 ms host
+    hash_to_curve from the batch clock, but gossip churn means the key
+    space is unbounded, so eviction (not clearing) keeps the hot working
+    set — the current epoch's AttestationData points — resident."""
+
+    def __init__(self, cap: int, name: str, metrics=None) -> None:
+        self.cap = max(1, int(cap))
+        self.name = name
+        self.metrics = metrics
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict" = OrderedDict()
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def _event(self, event: str) -> None:
+        if self.metrics is not None:
+            self.metrics.device_cache_events.labels(self.name, event).inc()
+
+    def get(self, key):
+        with self._lock:
+            hit = self._entries.get(key)
+            if hit is None:
+                self._event("miss")
+                return None
+            self._entries.move_to_end(key)
+            self._event("hit")
+            return hit
+
+    def put(self, key, value) -> None:
+        with self._lock:
+            self._entries[key] = value
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.cap:
+                self._entries.popitem(last=False)
+                self._event("evict")
+            if self.metrics is not None:
+                self.metrics.device_cache_size.set(
+                    self.name, value=float(len(self._entries))
+                )
+
+
 class TpuBlsBackend:
     """Host façade: anchor-typed in/out, device execution, bucket-padded jit.
 
@@ -907,12 +1049,14 @@ class TpuBlsBackend:
     pubkeys), differential-tested against the anchor."""
 
     def __init__(self, metrics=None, tracer=None) -> None:
-        self._h2c_cache: dict = {}
         #: observability seams (wired by runtime/attestation_verifier):
         #: per-stage histograms/spans + per-kernel-variant counters when
         #: set; with both None every hook is a cheap early return
         self.metrics = metrics
         self.tracer = tracer
+        self._h2c_cache = _LruCache(
+            H2C_CACHE_CAP, "hash_to_g2_dev", metrics=metrics
+        )
         #: (kernel, arg shapes) pairs already dispatched — a miss means
         #: the next dispatch blocks on XLA compilation, so its host-side
         #: call time is attributed to the `compile` stage
@@ -925,9 +1069,7 @@ class TpuBlsBackend:
         hit = self._h2c_cache.get(key)
         if hit is None:
             hit = C.g2_point_to_dev(hash_to_g2(message, dst))
-            if len(self._h2c_cache) > 4096:
-                self._h2c_cache.clear()
-            self._h2c_cache[key] = hit
+            self._h2c_cache.put(key, hit)
         return hit
 
     def _jitted(self, name: str, fn):
@@ -969,14 +1111,19 @@ class TpuBlsBackend:
                 leaf.block_until_ready()
         return out
 
-    def _upload(self, args: tuple) -> tuple:
+    def _upload(self, args: tuple, kernel: str = "unlabeled") -> tuple:
         """upload_bytes stage: push host arrays to the device explicitly
-        so the transfer is attributable (dispatch would do the identical
-        transfer implicitly). No-op when unobserved."""
+        so the transfer is attributable PER KERNEL (dispatch would do the
+        identical transfer implicitly). Device-resident operands — the
+        pubkey registry arrays — must bypass this seam: the per-kernel
+        `device_upload_bytes_total` counter is the accounting that
+        tools/check_no_per_batch_upload.py audits. No-op when unobserved."""
         if not self._observed():
             return args
         nbytes = sum(int(getattr(a, "nbytes", 0)) for a in args)
-        with self._stage("upload_bytes", bytes=nbytes):
+        if self.metrics is not None:
+            self.metrics.device_upload_bytes.labels(kernel).inc(nbytes)
+        with self._stage("upload_bytes", bytes=nbytes, kernel=kernel):
             return self._block(jax.device_put(args))
 
     def _run_kernel(self, kernel: str, fn, args: tuple, sigs: int = 0,
@@ -1125,7 +1272,7 @@ class TpuBlsBackend:
         args = self._upload((
             pk_x, pk_y, pk_inf, sig_x, sig_y, sig_inf, msg_x, msg_y, msg_inf,
             r_bits, *g2_plan.arrays,
-        ))
+        ), kernel="multi_verify_msm")
         # async dispatch; forcing happens in the returned closure
         result = self._run_kernel(
             "multi_verify_msm", fn, args, sigs=n, block=False
@@ -1207,7 +1354,7 @@ class TpuBlsBackend:
         args = self._upload((
             pk_x, pk_y, pk_inf, sig_x, sig_y, sig_inf,
             msg_x, msg_y, msg_inf, *g1_plan.arrays, *g2_plan.arrays,
-        ))
+        ), kernel="grouped_multi_verify_msm")
         result = self._run_kernel(
             "grouped_multi_verify_msm", fn, args, sigs=n_real, block=False
         )
@@ -1231,26 +1378,55 @@ class TpuBlsBackend:
         rng=secrets,
     ) -> bool:
         """M aggregates, each over its own committee (the gossip firehose)."""
+        return self.fast_aggregate_verify_batch_async(
+            messages, signatures, member_keys, dst, rng
+        )()
+
+    def fast_aggregate_verify_batch_async(
+        self,
+        messages: Sequence[bytes],
+        signatures: Sequence["A.Signature"],
+        member_keys: Sequence[Sequence["A.PublicKey"]],
+        dst: bytes = constants.DST_SIGNATURE,
+        rng=secrets,
+    ):
+        """Async firehose verify: host prep + dispatch now, a zero-arg
+        settle callable forces the device result. This is the seam the
+        pipelined AttestationVerifier uses to overlap batch N+1's host
+        prep with batch N's device execute."""
         m = len(messages)
         if not (m == len(signatures) == len(member_keys)):
-            return False
+            return lambda: False
         if m == 0:
-            return True
+            return lambda: True
         if any(not ks for ks in member_keys):
-            return False
+            return lambda: False
         if m > MAX_BUCKET:
-            return all(
-                self.fast_aggregate_verify_batch(
+            # Two-deep chunk pipeline, same shape as multi_verify_async:
+            # settle() dispatches chunk k+1 before forcing chunk k.
+            def chunk(i):
+                return self.fast_aggregate_verify_batch_async(
                     messages[i : i + MAX_BUCKET],
                     signatures[i : i + MAX_BUCKET],
                     member_keys[i : i + MAX_BUCKET],
                     dst,
                     rng,
                 )
-                for i in range(0, m, MAX_BUCKET)
-            )
+
+            first = chunk(0)
+
+            def settle_chunks() -> bool:
+                pending = first
+                for i in range(MAX_BUCKET, m, MAX_BUCKET):
+                    nxt = chunk(i)
+                    if not pending():
+                        return False
+                    pending = nxt
+                return pending()
+
+            return settle_chunks
         if any(pk.point.is_infinity() for ks in member_keys for pk in ks):
-            return False
+            return lambda: False
         with self._stage("host_prep", op="pack_aggregate", items=m):
             if max(len(ks) for ks in member_keys) > MAX_BUCKET:
                 # committee wider than a device bucket: host-aggregate those
@@ -1295,10 +1471,196 @@ class TpuBlsBackend:
         args = self._upload((
             mem_x, mem_y, mem_inf, slot_pad, sig_x, sig_y, sig_inf,
             msg_x, msg_y, msg_inf, r_bits, *g2_plan.arrays,
-        ))
-        out = self._run_kernel("agg_fast_verify_msm", fn, args, sigs=m)
-        with self._stage("readback", kernel="agg_fast_verify_msm"):
-            return bool(out)
+        ), kernel="agg_fast_verify_msm")
+        out = self._run_kernel(
+            "agg_fast_verify_msm", fn, args, sigs=m, block=False
+        )
+        return lambda: self._settle("agg_fast_verify_msm", out)
+
+    def fast_aggregate_verify_batch_indexed(
+        self,
+        messages: Sequence[bytes],
+        signatures: Sequence["A.Signature"],
+        member_indices: Sequence[Sequence[int]],
+        registry,
+        dst: bytes = constants.DST_SIGNATURE,
+        rng=secrets,
+    ) -> bool:
+        return self.fast_aggregate_verify_batch_indexed_async(
+            messages, signatures, member_indices, registry, dst, rng
+        )()
+
+    def fast_aggregate_verify_batch_indexed_async(
+        self,
+        messages: Sequence[bytes],
+        signatures: Sequence["A.Signature"],
+        member_indices: Sequence[Sequence[int]],
+        registry,
+        dst: bytes = constants.DST_SIGNATURE,
+        rng=secrets,
+    ):
+        """Registry firehose verify: committee pubkeys stay device-resident
+        (tpu/registry.py), gathered on-device by validator index — the
+        per-batch upload shrinks from 208 B/member of affine coordinates to
+        4 B/member of int32 indices. Registry rows never hold the identity
+        (decompress raises), so the infinity policy reduces to the padding
+        mask. A committee wider than a device bucket falls back to the
+        upload path through the registry's host mirror; an index the
+        registry does not cover (cold registry, out-of-range) is a
+        verification failure — it names a validator outside the set the
+        caller synced the registry to."""
+        m = len(messages)
+        if not (m == len(signatures) == len(member_indices)):
+            return lambda: False
+        if m == 0:
+            return lambda: True
+        if any(len(ix) == 0 for ix in member_indices):
+            return lambda: False
+        if m > MAX_BUCKET:
+            def chunk(i):
+                return self.fast_aggregate_verify_batch_indexed_async(
+                    messages[i : i + MAX_BUCKET],
+                    signatures[i : i + MAX_BUCKET],
+                    member_indices[i : i + MAX_BUCKET],
+                    registry,
+                    dst,
+                    rng,
+                )
+
+            first = chunk(0)
+
+            def settle_chunks() -> bool:
+                pending = first
+                for i in range(MAX_BUCKET, m, MAX_BUCKET):
+                    nxt = chunk(i)
+                    if not pending():
+                        return False
+                    pending = nxt
+                return pending()
+
+            return settle_chunks
+        reg_x, reg_y, reg_n = registry.arrays()
+        widest = max(len(ix) for ix in member_indices)
+        if reg_x is None or any(
+            not 0 <= int(i) < reg_n for ix in member_indices for i in ix
+        ):
+            # an index the registry has never seen names a validator
+            # outside the head state's set — the signature cannot verify
+            return lambda: False
+        if widest > MAX_BUCKET:
+            # committee wider than a device bucket: resolve through the
+            # host mirror and take the upload path (which host-aggregates
+            # oversized committees to a single key)
+            return self.fast_aggregate_verify_batch_async(
+                messages,
+                signatures,
+                [registry.public_keys(ix) for ix in member_indices],
+                dst,
+                rng,
+            )
+        with self._stage("host_prep", op="pack_aggregate_idx", items=m):
+            bm = _bucket(m)
+            bk = _bucket(widest, lo=4)
+            mem_idx = np.zeros((bm, bk), np.int32)
+            mem_inf = np.ones((bm, bk), bool)  # True = padding slot
+            slot_pad = np.arange(bm) >= m
+            sig_x = np.zeros((bm, 2, L.NLIMBS), np.int32)
+            sig_y = np.zeros((bm, 2, L.NLIMBS), np.int32)
+            sig_inf = np.ones((bm,), bool)
+            msg_x = np.zeros((bm, 2, L.NLIMBS), np.int32)
+            msg_y = np.zeros((bm, 2, L.NLIMBS), np.int32)
+            msg_inf = np.ones((bm,), bool)
+            for i, ix in enumerate(member_indices):
+                k = len(ix)
+                mem_idx[i, :k] = np.fromiter(
+                    (int(v) for v in ix), np.int32, count=k
+                )
+                mem_inf[i, :k] = False
+            g2x, g2y, g2inf = C.g2_points_to_dev([s.point for s in signatures])
+            sig_x[:m], sig_y[:m], sig_inf[:m] = g2x, g2y, g2inf
+            for i in range(m):
+                x, y, inf = self._hash_to_g2_dev(messages[i], dst)
+                msg_x[i], msg_y[i], msg_inf[i] = x, y, inf
+            pairs = [self._rlc_pair(rng) for _ in range(m)]
+            r_bits = rlc_bits_host(pairs, bm)
+            g2_plan = self._g2_plan(pairs, bm, sig_inf)
+        fn = self._jitted_msm(
+            "agg_fast_verify_msm_idx", aggregate_fast_verify_msm_idx_kernel,
+            g2_windows=g2_plan.windows, g2_wbits=g2_plan.window_bits,
+        )
+        # registry arrays are already device-resident: they are passed to
+        # the kernel directly, NOT through _upload, so the per-batch
+        # upload accounting stays honest (check_no_per_batch_upload.py)
+        args = self._upload((
+            mem_idx, mem_inf, slot_pad, sig_x, sig_y, sig_inf,
+            msg_x, msg_y, msg_inf, r_bits, *g2_plan.arrays,
+        ), kernel="agg_fast_verify_msm_idx")
+        out = self._run_kernel(
+            "agg_fast_verify_msm_idx", fn, (reg_x, reg_y, *args),
+            sigs=m, block=False,
+        )
+        return lambda: self._settle("agg_fast_verify_msm_idx", out)
+
+    def multi_verify_indexed(
+        self,
+        messages: Sequence[bytes],
+        signatures: Sequence["A.Signature"],
+        indices: Sequence[int],
+        registry,
+        dst: bytes = constants.DST_SIGNATURE,
+        rng=secrets,
+    ) -> bool:
+        """Flat RLC batch verify with signer pubkeys gathered on-device
+        from the registry by validator index (one signer per triple).
+        Batches beyond one bucket fall back to the upload path through
+        the host mirror; an index the registry does not cover fails."""
+        n = len(messages)
+        if not (n == len(signatures) == len(indices)):
+            return False
+        if n == 0:
+            return True
+        reg_x, reg_y, reg_n = registry.arrays()
+        if reg_x is None or any(not 0 <= int(i) < reg_n for i in indices):
+            return False  # unknown validator index → cannot verify
+        if n > MAX_BUCKET:
+            return self.multi_verify(
+                messages, signatures, registry.public_keys(indices), dst, rng
+            )
+        with self._stage("host_prep", op="pack_idx", items=n):
+            b = _bucket(n)
+            pk_idx = np.zeros((b,), np.int32)
+            pk_inf = np.ones((b,), bool)  # True = padding slot
+            pk_idx[:n] = np.fromiter(
+                (int(v) for v in indices), np.int32, count=n
+            )
+            pk_inf[:n] = False
+            sig_x = np.zeros((b, 2, L.NLIMBS), np.int32)
+            sig_y = np.zeros((b, 2, L.NLIMBS), np.int32)
+            sig_inf = np.ones((b,), bool)
+            msg_x = np.zeros((b, 2, L.NLIMBS), np.int32)
+            msg_y = np.zeros((b, 2, L.NLIMBS), np.int32)
+            msg_inf = np.ones((b,), bool)
+            g2x, g2y, g2inf = C.g2_points_to_dev([s.point for s in signatures])
+            sig_x[:n], sig_y[:n], sig_inf[:n] = g2x, g2y, g2inf
+            for i in range(n):
+                x, y, inf = self._hash_to_g2_dev(messages[i], dst)
+                msg_x[i], msg_y[i], msg_inf[i] = x, y, inf
+            pairs = [self._rlc_pair(rng) for _ in range(n)]
+            r_bits = rlc_bits_host(pairs, b)
+            g2_plan = self._g2_plan(pairs, b, sig_inf)
+        fn = self._jitted_msm(
+            "multi_verify_msm_idx", multi_verify_msm_idx_kernel,
+            g2_windows=g2_plan.windows, g2_wbits=g2_plan.window_bits,
+        )
+        args = self._upload((
+            pk_idx, pk_inf, sig_x, sig_y, sig_inf, msg_x, msg_y, msg_inf,
+            r_bits, *g2_plan.arrays,
+        ), kernel="multi_verify_msm_idx")
+        result = self._run_kernel(
+            "multi_verify_msm_idx", fn, (reg_x, reg_y, *args),
+            sigs=n, block=False,
+        )
+        return self._settle("multi_verify_msm_idx", result)
 
     def fast_aggregate_verify(
         self,
@@ -1316,9 +1678,16 @@ class TpuBlsBackend:
         points — ONE device ladder replaces N host scalar-muls. Accepts
         anchor `Point[Fq2]` values; returns an (N,) bool array (infinity
         rows True; reject them separately by policy)."""
+        return self.g2_subgroup_check_batch_async(points)()
+
+    def g2_subgroup_check_batch_async(self, points):
+        """Async variant of g2_subgroup_check_batch: dispatch now, force
+        via the returned zero-arg callable. The pipelined verifier stacks
+        this dispatch with the verify-kernel dispatch so both device runs
+        queue back-to-back ahead of any host readback."""
         n = len(points)
         if n == 0:
-            return np.zeros((0,), bool)
+            return lambda: np.zeros((0,), bool)
         with self._stage("host_prep", op="pack_subgroup", items=n):
             bn = _bucket(n)
             sx = np.zeros((bn, 2, L.NLIMBS), np.int32)
@@ -1330,11 +1699,20 @@ class TpuBlsBackend:
                 C.scalars_to_bits_msb([_ABS_X] * bn, 64).T
             )
         fn = self._jitted("g2_subgroup_check", g2_subgroup_check_kernel)
-        args = self._upload((sx, sy, s_inf, x_bits))
-        dev_out = self._run_kernel("g2_subgroup_check", fn, args, sigs=n)
-        with self._stage("readback", kernel="g2_subgroup_check"):
-            out = np.asarray(dev_out)
-        return out[:n]
+        args = self._upload((sx, sy, s_inf, x_bits), kernel="g2_subgroup_check")
+        dev_out = self._run_kernel(
+            "g2_subgroup_check", fn, args, sigs=n, block=False
+        )
+
+        def settle() -> "np.ndarray":
+            if not self._observed():
+                return np.asarray(dev_out)[:n]
+            with self._stage("execute", kernel="g2_subgroup_check"):
+                self._block(dev_out)
+            with self._stage("readback", kernel="g2_subgroup_check"):
+                return np.asarray(dev_out)[:n]
+
+        return settle
 
     # -- signing -----------------------------------------------------------
 
@@ -1372,7 +1750,9 @@ class TpuBlsBackend:
                 [sk.scalar for sk in secret_keys], b
             )
         fn = self._jitted("batch_sign", batch_sign_kernel)
-        args = self._upload((msg_x, msg_y, msg_inf, sk_bits, sk_neg))
+        args = self._upload(
+            (msg_x, msg_y, msg_inf, sk_bits, sk_neg), kernel="batch_sign"
+        )
         X, Y, Z = self._run_kernel("batch_sign", fn, args, sigs=n)
         with self._stage("readback", kernel="batch_sign"):
             X, Y, Z = np.asarray(X), np.asarray(Y), np.asarray(Z)
@@ -1394,11 +1774,13 @@ __all__ = [
     "pick_msm_window",
     "multi_verify_kernel",
     "multi_verify_msm_kernel",
+    "multi_verify_msm_idx_kernel",
     "grouped_multi_verify_kernel",
     "grouped_multi_verify_msm_kernel",
     "grouped_multi_verify_msm_packed_kernel",
     "aggregate_fast_verify_kernel",
     "aggregate_fast_verify_msm_kernel",
+    "aggregate_fast_verify_msm_idx_kernel",
     "batch_sign_kernel",
     "batch_pubkey_kernel",
     "g1_normalize_kernel",

@@ -1,0 +1,238 @@
+"""Device-resident validator pubkey registry.
+
+Committee-based consensus re-verifies the SAME validator keys every slot,
+yet the verify plane used to re-upload each batch's pubkey rows (26 limbs
+× 2 coords × 4 B = 208 B/key) on the per-batch clock — ~4× the device
+execute time at the 50k-validator operating point (BENCH r5). This module
+keeps the whole validator set's decompressed G1 pubkeys resident on the
+accelerator as flat rest-format limb arrays; the indexed verify kernels
+(`tpu/bls.py` *_idx_kernel) `gather` rows on-device from an int32 index
+vector, so per-batch host→device traffic shrinks to signatures + message
+points + indices.
+
+Freshness model (the registry is an append-mostly mirror of
+`state.validators`):
+  - `ensure(pubkeys)` is called with the head state's compressed-pubkey
+    tuple (`accessors.registry_columns(state).pubkeys`). States sharing an
+    unmodified registry share ONE tuple object, so the hot check is a
+    single identity comparison.
+  - Validator-set GROWTH (deposits) extends the registry without touching
+    existing rows: a prefix match appends only the new rows (an O(new)
+    device scatter into spare capacity; capacity grows in powers of two so
+    the gather kernels recompile only on capacity doubling).
+  - `mark_stale()` (wired to the controller's `on_validator_set_change`
+    hook: validator-count or finalized-epoch change) demotes the next
+    `ensure` from the identity fast path to the full prefix check;
+    `invalidate()` drops everything and forces a cold rebuild.
+
+Rows are guaranteed non-identity: `keys.decompress_pubkey` raises on the
+identity encoding, so indexed kernels need no per-row infinity handling
+beyond the batch padding mask the caller supplies.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+from grandine_tpu.consensus import keys
+from grandine_tpu.tpu import curve as C
+from grandine_tpu.tpu import limbs as L
+
+#: smallest device capacity — below this, padding waste is noise and a
+#: stable floor avoids recompiling the gather kernels for tiny devnets
+MIN_CAPACITY = 16
+
+
+def _next_pow2(n: int, lo: int = MIN_CAPACITY) -> int:
+    b = lo
+    while b < n:
+        b <<= 1
+    return b
+
+
+class DevicePubkeyRegistry:
+    """The validator set's G1 pubkeys, device-resident and index-addressable.
+
+    Thread-safe: `ensure` may be called from any verify-pool thread; the
+    controller's mutator thread calls `mark_stale`/`invalidate` through the
+    validator-set-change hook.
+    """
+
+    def __init__(self, metrics=None) -> None:
+        self.metrics = metrics
+        self._lock = threading.RLock()
+        #: host mirror: the exact compressed-bytes tuple the device arrays
+        #: were built from (identity-compared against head-state columns)
+        self._pubkeys: "Optional[tuple]" = None
+        self._stale = False
+        #: host rest-format rows (count, NLIMBS) — kept so capacity growth
+        #: re-uploads without re-decompressing the whole set
+        self._hx: "Optional[np.ndarray]" = None
+        self._hy: "Optional[np.ndarray]" = None
+        #: device arrays, (capacity, NLIMBS) int32 Montgomery limbs
+        self._x = None
+        self._y = None
+        self.stats = {
+            "hits": 0, "misses": 0, "appends": 0, "refreshes": 0,
+            "uploaded_bytes": 0,
+        }
+
+    # --------------------------------------------------------------- state
+
+    @property
+    def count(self) -> int:
+        return 0 if self._pubkeys is None else len(self._pubkeys)
+
+    @property
+    def capacity(self) -> int:
+        return 0 if self._x is None else int(self._x.shape[0])
+
+    def arrays(self):
+        """(device_x, device_y, count) — rows past `count` are zero
+        padding and must be masked by the caller's batch padding mask."""
+        with self._lock:
+            return self._x, self._y, self.count
+
+    def public_keys(self, indices: "Sequence[int]"):
+        """Decompressed PublicKeys for `indices` from the host mirror —
+        the upload-path fallback for batches the indexed kernels cannot
+        take (out-of-range index, committee wider than a bucket)."""
+        with self._lock:
+            pks = self._pubkeys or ()
+        return keys.decompress_pubkeys(
+            (pks[int(i)] for i in indices), trusted=True
+        )
+
+    # ------------------------------------------------------------- metrics
+
+    def _event(self, event: str) -> None:
+        if self.metrics is not None:
+            self.metrics.pubkey_registry_events.labels(event).inc()
+
+    def _sync_gauges(self) -> None:
+        if self.metrics is not None:
+            self.metrics.pubkey_registry_size.set(self.count)
+
+    def _count_upload(self, nbytes: int) -> None:
+        self.stats["uploaded_bytes"] += nbytes
+        if self.metrics is not None:
+            # labeled apart from the per-batch verify kernels: registry
+            # uploads are amortized over the set's lifetime, not charged
+            # to any batch (tools/check_no_per_batch_upload.py relies on
+            # this separation)
+            self.metrics.device_upload_bytes.labels("pubkey_registry").inc(
+                nbytes
+            )
+
+    # ------------------------------------------------------------ lifecycle
+
+    def mark_stale(self) -> None:
+        """Demote the next ensure() from the identity fast path to the
+        full prefix check (controller validator-set-change hook)."""
+        with self._lock:
+            self._stale = True
+
+    def invalidate(self) -> None:
+        """Drop device arrays and the host mirror; the next ensure() does
+        a cold rebuild."""
+        with self._lock:
+            self._pubkeys = None
+            self._hx = self._hy = None
+            self._x = self._y = None
+            self._stale = False
+            self._event("invalidate")
+            self._sync_gauges()
+
+    # --------------------------------------------------------------- ensure
+
+    def ensure(self, pubkeys: "Sequence[bytes]") -> bool:
+        """Make the registry cover `pubkeys` (the head state's compressed
+        pubkey tuple). Identity match → free hit; prefix growth → O(new)
+        append; anything else → full refresh. Returns True when the
+        device arrays are usable (always, barring an empty set)."""
+        if not isinstance(pubkeys, tuple):
+            pubkeys = tuple(bytes(b) for b in pubkeys)
+        if len(pubkeys) == 0:
+            return False
+        with self._lock:
+            old = self._pubkeys
+            if old is pubkeys and not self._stale:
+                self.stats["hits"] += 1
+                self._event("hit")
+                return True
+            self.stats["misses"] += 1
+            self._event("miss")
+            if (
+                old is not None
+                and len(pubkeys) >= len(old)
+                and pubkeys[: len(old)] == old
+            ):
+                if len(pubkeys) > len(old):
+                    self._append(pubkeys, start=len(old))
+                # equal prefix, equal length: same set under a new tuple
+                # object (or a stale-flag re-check) — adopt the new tuple
+                # so the next ensure() hits on identity
+                self._pubkeys = pubkeys
+            else:
+                self._refresh(pubkeys)
+            self._stale = False
+            self._sync_gauges()
+            return True
+
+    # ------------------------------------------------------------ internals
+
+    def _rows_for(self, pubkey_bytes: "Sequence[bytes]"):
+        """Compressed bytes → ((n, NLIMBS) x, (n, NLIMBS) y) rest-format
+        rows. Raises BlsError on an invalid/identity encoding — registry
+        bytes passed KeyValidate at deposit time, so this only fires on
+        corrupted input (and then the caller keeps the upload path)."""
+        pks = keys.decompress_pubkeys(pubkey_bytes, trusted=True)
+        x, y, inf = C.g1_points_to_dev([pk.point for pk in pks])
+        assert not inf.any(), "identity pubkey can not enter the registry"
+        return x, y
+
+    def _append(self, pubkeys: tuple, start: int) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        nx, ny = self._rows_for(pubkeys[start:])
+        self._hx = np.concatenate([self._hx, nx], axis=0)
+        self._hy = np.concatenate([self._hy, ny], axis=0)
+        end = len(pubkeys)
+        if end <= self.capacity:
+            # in-place device scatter: uploads O(new) bytes
+            self._x = self._x.at[start:end].set(jnp.asarray(nx))
+            self._y = self._y.at[start:end].set(jnp.asarray(ny))
+            self._count_upload(int(nx.nbytes + ny.nbytes))
+        else:
+            self._upload_full(end)
+        self._pubkeys = pubkeys
+        self.stats["appends"] += 1
+        self._event("append")
+
+    def _refresh(self, pubkeys: tuple) -> None:
+        self._hx, self._hy = self._rows_for(pubkeys)
+        self._pubkeys = pubkeys
+        self._upload_full(len(pubkeys))
+        self.stats["refreshes"] += 1
+        self._event("refresh")
+
+    def _upload_full(self, count: int) -> None:
+        """(Re)build the device arrays at power-of-two capacity from the
+        host mirror; zero rows pad count..capacity."""
+        import jax
+
+        cap = _next_pow2(count)
+        px = np.zeros((cap, L.NLIMBS), np.int32)
+        py = np.zeros((cap, L.NLIMBS), np.int32)
+        px[:count] = self._hx
+        py[:count] = self._hy
+        self._x = jax.device_put(px)
+        self._y = jax.device_put(py)
+        self._count_upload(int(px.nbytes + py.nbytes))
+
+
+__all__ = ["DevicePubkeyRegistry", "MIN_CAPACITY"]

@@ -1,0 +1,442 @@
+"""Device-resident pubkey registry + pipelined verify plane.
+
+Three tiers in one module:
+  - host-only unit tests: `_bucket` padding, the bounded `_LruCache`,
+    registry lifecycle bookkeeping, controller staleness wiring;
+  - a pipeline-overlap test driving the real AttestationVerifier with a
+    stub backend whose settle is slow — the span timeline must show batch
+    N+1's host_prep starting inside batch N's readback window;
+  - kernel-tier differential tests (marked `kernel`): the index-gather
+    verify kernels must agree with the upload-path kernels on the same
+    batch, including after an incremental registry append and after an
+    invalidation/refresh, with the warm path uploading no pubkey bytes.
+"""
+
+import random
+import time
+
+import numpy as np
+import pytest
+
+from grandine_tpu.crypto import bls as A
+from grandine_tpu.metrics import Metrics
+from grandine_tpu.tpu.bls import (
+    MAX_BUCKET,
+    TpuBlsBackend,
+    _JITTED,
+    _LruCache,
+    _bucket,
+)
+from grandine_tpu.tpu.registry import MIN_CAPACITY, DevicePubkeyRegistry
+
+_seed_rng = random.Random(0x9E61)
+
+
+def _rng_bytes(n: int) -> bytes:
+    return bytes(_seed_rng.randrange(256) for _ in range(n))
+
+
+class _Rng:
+    """random.Random behind the secrets-style randbits interface the
+    backend's RLC draw expects."""
+
+    def __init__(self, seed: int) -> None:
+        self._rng = random.Random(seed)
+
+    def randbits(self, n: int) -> int:
+        return self._rng.getrandbits(n)
+
+
+# ------------------------------------------------------------- _bucket
+
+
+def test_bucket_monotone_and_covers_range():
+    prev = 0
+    for n in range(1, 1025):
+        b = _bucket(n)
+        assert b >= n, "bucket must cover the batch"
+        assert b >= prev, "buckets must be monotone in n"
+        assert b & (b - 1) == 0, "buckets are powers of two"
+        prev = b
+    # lo floor and custom lo
+    assert _bucket(1) == 4
+    assert _bucket(1, lo=16) == 16
+
+
+def test_bucket_covers_max_and_rejects_beyond():
+    assert _bucket(MAX_BUCKET) == MAX_BUCKET
+    assert _bucket(MAX_BUCKET - 1) == MAX_BUCKET
+    with pytest.raises(ValueError):
+        _bucket(MAX_BUCKET + 1)
+
+
+# ------------------------------------------------------------ LRU cache
+
+
+def test_lru_cache_bound_eviction_and_metrics():
+    m = Metrics()
+    c = _LruCache(3, "testcache", metrics=m)
+    for i in range(5):
+        c.put(i, i * 10)
+    assert len(c) == 3
+    ev = m.device_cache_events.value
+    assert ev("testcache", "evict") == 2
+    assert m.device_cache_size.value("testcache") == 3
+    # oldest entries evicted, newest retained
+    assert c.get(0) is None and c.get(1) is None
+    assert c.get(4) == 40
+    assert ev("testcache", "miss") == 2 and ev("testcache", "hit") == 1
+    # LRU order: touching 2 protects it from the next eviction
+    c.get(2)
+    c.put(99, 990)
+    assert c.get(2) == 20
+    assert c.get(3) is None  # 3 was the least recent → evicted
+
+
+def test_backend_h2c_cache_is_bounded():
+    m = Metrics()
+    backend = TpuBlsBackend(metrics=m)
+    backend._h2c_cache.cap = 2  # shrink for the test
+    for i in range(4):
+        backend._hash_to_g2_dev(b"h2c-%d" % i, b"dst")
+    assert len(backend._h2c_cache) == 2
+    # repeat of the newest is a hit, no growth
+    backend._hash_to_g2_dev(b"h2c-3", b"dst")
+    assert len(backend._h2c_cache) == 2
+    assert m.device_cache_events.value("hash_to_g2_dev", "hit") == 1
+    assert m.device_cache_events.value("hash_to_g2_dev", "evict") == 2
+
+
+# ------------------------------------------------- registry bookkeeping
+
+
+def _fresh_keypairs(n: int):
+    sks = [A.SecretKey.keygen(_rng_bytes(32)) for _ in range(n)]
+    return sks, tuple(sk.public_key().to_bytes() for sk in sks)
+
+
+def test_registry_lifecycle_hit_append_refresh():
+    m = Metrics()
+    reg = DevicePubkeyRegistry(metrics=m)
+    _, pkb = _fresh_keypairs(5)
+    assert not reg.ensure(())  # empty set: unusable
+    first3 = pkb[:3]  # the SAME tuple object, as head-state columns are
+    assert reg.ensure(first3)
+    assert reg.count == 3 and reg.capacity == MIN_CAPACITY
+    assert reg.stats["refreshes"] == 1
+    # identity re-ensure is a free hit
+    assert reg.ensure(first3)
+    assert reg.stats["hits"] == 1
+    # prefix growth appends without a refresh
+    assert reg.ensure(pkb)
+    assert reg.count == 5
+    assert reg.stats["appends"] == 1 and reg.stats["refreshes"] == 1
+    # same content under a NEW tuple object: miss, prefix-adopt, then hit
+    clone = tuple(bytes(b) for b in pkb)
+    assert clone is not pkb and reg.ensure(clone)
+    assert reg.stats["appends"] == 1 and reg.stats["refreshes"] == 1
+    assert reg.ensure(clone) and reg.stats["hits"] >= 2
+    # mark_stale demotes the identity fast path exactly once
+    reg.mark_stale()
+    misses_before = reg.stats["misses"]
+    assert reg.ensure(clone)
+    assert reg.stats["misses"] == misses_before + 1
+    assert reg.ensure(clone)
+    assert reg.stats["misses"] == misses_before + 1  # hit again
+    # a NON-prefix set forces a refresh
+    _, other = _fresh_keypairs(2)
+    assert reg.ensure(other)
+    assert reg.count == 2 and reg.stats["refreshes"] == 2
+    # invalidate drops everything
+    reg.invalidate()
+    assert reg.count == 0 and reg.capacity == 0
+    assert m.pubkey_registry_events.value("invalidate") == 1
+    assert m.pubkey_registry_size.value == 0
+
+
+def test_registry_append_uploads_only_new_rows():
+    m = Metrics()
+    reg = DevicePubkeyRegistry(metrics=m)
+    _, pkb = _fresh_keypairs(6)
+    assert reg.ensure(pkb[:4])
+    base = reg.stats["uploaded_bytes"]
+    assert reg.ensure(pkb)  # +2 rows, within MIN_CAPACITY
+    import grandine_tpu.tpu.limbs as L
+
+    assert reg.stats["uploaded_bytes"] - base == 2 * 2 * L.NLIMBS * 4
+    assert m.device_upload_bytes.value("pubkey_registry") == (
+        reg.stats["uploaded_bytes"]
+    )
+    # host mirror serves the fallback path
+    pks = reg.public_keys([5, 0])
+    assert pks[0].to_bytes() == pkb[5] and pks[1].to_bytes() == pkb[0]
+
+
+def test_verifier_wires_registry_staleness_hook():
+    from grandine_tpu.consensus.verifier import NullVerifier
+    from grandine_tpu.runtime import AttestationVerifier, Controller
+    from grandine_tpu.transition.genesis import interop_genesis_state
+    from grandine_tpu.types.config import Config
+
+    cfg = Config.minimal()
+    genesis = interop_genesis_state(32, cfg)
+    ctrl = Controller(genesis, cfg, verifier_factory=NullVerifier)
+    verifier = AttestationVerifier(ctrl, use_device=True, deadline_s=0.01)
+    try:
+        assert verifier.registry is not None
+        assert ctrl.snapshot().validator_count == 32
+        assert len(ctrl.on_validator_set_change) == 1
+        _, pkb = _fresh_keypairs(2)
+        assert verifier.registry.ensure(pkb)
+        assert verifier.registry._stale is False
+        # the controller-side hook demotes the next ensure to a recheck
+        ctrl.on_validator_set_change[0](None, ctrl.snapshot())
+        assert verifier.registry._stale is True
+    finally:
+        verifier.stop()
+        ctrl.stop()
+
+
+# ------------------------------------------------------ pipeline overlap
+
+
+class _SlowSettleBackend:
+    """Async-seam stub: dispatch returns instantly; settle sleeps inside a
+    `readback` span, so overlap between one batch's readback and the next
+    batch's host_prep is visible on the span timeline."""
+
+    def __init__(self, tracer, settle_s: float = 0.25) -> None:
+        self.tracer = tracer
+        self.settle_s = settle_s
+        self.dispatches = 0
+
+    def g2_subgroup_check_batch_async(self, points):
+        n = len(points)
+        return lambda: np.ones((n,), bool)
+
+    def fast_aggregate_verify_batch_async(self, messages, sigs, members):
+        self.dispatches += 1
+
+        def settle() -> bool:
+            with self.tracer.span("readback", {"stub": True}):
+                time.sleep(self.settle_s)
+            return True
+
+        return settle
+
+
+def test_pipelined_dispatch_overlaps_prep_with_readback():
+    """Acceptance: with max_active=1 (no task-level parallelism), batch
+    N+1's host_prep must START before batch N's readback ENDS — only the
+    two-deep dispatch queue makes that possible."""
+    from grandine_tpu.consensus.verifier import NullVerifier
+    from grandine_tpu.fork_choice.store import Tick, TickKind
+    from grandine_tpu.runtime import AttestationVerifier, Controller
+    from grandine_tpu.tracing import Tracer
+    from grandine_tpu.transition.genesis import interop_genesis_state
+    from grandine_tpu.types.config import Config
+    from grandine_tpu.validator.duties import produce_attestations, produce_block
+
+    cfg = Config.minimal()
+    genesis = interop_genesis_state(32, cfg)
+    tracer = Tracer()
+    ctrl = Controller(genesis, cfg, verifier_factory=NullVerifier)
+    stub = _SlowSettleBackend(tracer, settle_s=0.25)
+    verifier = AttestationVerifier(
+        ctrl,
+        backend=stub,
+        use_device=True,
+        use_registry=False,
+        max_batch=1,
+        max_active=1,
+        deadline_s=0.005,
+        tracer=tracer,
+    )
+    try:
+        blk, post = produce_block(
+            genesis, 1, cfg, full_sync_participation=False
+        )
+        ctrl.on_tick(Tick(1, TickKind.PROPOSE))
+        ctrl.on_own_block(blk)
+        ctrl.wait()
+        att = produce_attestations(post, cfg, slot=1)[0]
+        # four copies → four single-item batches through the pipeline
+        verifier.submit_many([att, att, att, att])
+        verifier.flush(timeout=30.0)
+        assert verifier.stats["accepted"] == 4
+        assert stub.dispatches == 4
+    finally:
+        verifier.stop()
+        ctrl.stop()
+
+    spans = tracer.finished_spans()
+    readbacks = [s for s in spans if s.name == "readback"]
+    preps = [s for s in spans if s.name == "host_prep"]
+    assert len(readbacks) == 4
+    overlapped = any(
+        h.trace_id != r.trace_id and r.start < h.start < r.end
+        for r in readbacks
+        for h in preps
+    )
+    assert overlapped, (
+        "no host_prep span of a later batch started inside an earlier "
+        "batch's readback window — the dispatch queue is not pipelining"
+    )
+
+
+# ----------------------------------------------------- kernel differential
+
+kernel = pytest.mark.kernel
+
+
+@pytest.fixture(scope="module")
+def metrics():
+    return Metrics()
+
+
+@pytest.fixture(scope="module")
+def backend(metrics):
+    return TpuBlsBackend(metrics=metrics)
+
+
+@pytest.fixture(scope="module")
+def keyring():
+    sks = [A.SecretKey.keygen(_rng_bytes(32)) for _ in range(6)]
+    return sks, tuple(sk.public_key().to_bytes() for sk in sks)
+
+
+@kernel
+def test_indexed_flat_verify_agrees_with_upload_path(
+    backend, metrics, keyring
+):
+    sks, pkb = keyring
+    pks = [sk.public_key() for sk in sks]
+    reg = DevicePubkeyRegistry(metrics=metrics)
+    assert reg.ensure(pkb[:4])
+
+    msgs = [b"flat-%d" % i for i in range(3)]
+    sigs = [sks[i].sign(msgs[i]) for i in range(3)]
+    rng = _Rng(0xA1)
+    assert backend.multi_verify_indexed(msgs, sigs, [0, 1, 2], reg, rng=rng)
+    assert backend.multi_verify(msgs, sigs, pks[:3], rng=rng)
+    # wrong signer index fails exactly like wrong key
+    assert not backend.multi_verify_indexed(
+        msgs, sigs, [1, 0, 2], reg, rng=rng
+    )
+    # an index the registry does not cover fails
+    assert not backend.multi_verify_indexed(
+        msgs, sigs, [0, 1, 5], reg, rng=rng
+    )
+    # after an incremental append the new rows verify
+    assert reg.ensure(pkb)
+    assert reg.stats["appends"] == 1
+    msgs5 = [b"flat-append"]
+    sigs5 = [sks[5].sign(msgs5[0])]
+    assert backend.multi_verify_indexed(msgs5, sigs5, [5], reg, rng=rng)
+    # after invalidation: unusable, then a refresh restores agreement
+    reg.invalidate()
+    assert not backend.multi_verify_indexed(msgs, sigs, [0, 1, 2], reg, rng=rng)
+    assert reg.ensure(pkb)
+    assert reg.stats["refreshes"] == 2
+    assert backend.multi_verify_indexed(msgs, sigs, [0, 1, 2], reg, rng=rng)
+
+
+@kernel
+def test_indexed_aggregate_verify_agrees_and_skips_pubkey_upload(
+    backend, metrics, keyring
+):
+    sks, pkb = keyring
+    pks = [sk.public_key() for sk in sks]
+    reg = DevicePubkeyRegistry(metrics=metrics)
+    assert reg.ensure(pkb)
+
+    committees = [[0, 1, 2], [3, 4], [5]]
+    msgs = [b"agg-%d" % i for i in range(3)]
+    aggs = [
+        A.Signature.aggregate([sks[j].sign(msgs[i]) for j in committees[i]])
+        for i in range(3)
+    ]
+    member_keys = [[pks[j] for j in c] for c in committees]
+    rng = _Rng(0xB2)
+    assert backend.fast_aggregate_verify_batch_indexed(
+        msgs, aggs, committees, reg, rng=rng
+    )
+    assert backend.fast_aggregate_verify_batch(
+        msgs, aggs, member_keys, rng=rng
+    )
+    # a committee missing a signer fails on both paths
+    short = [c[:-1] or c for c in committees[:1]] + committees[1:]
+    short[0] = [0, 1]  # signature includes sks[2]
+    assert not backend.fast_aggregate_verify_batch_indexed(
+        msgs, aggs, short, reg, rng=rng
+    )
+    assert not backend.fast_aggregate_verify_batch(
+        msgs, aggs, [[pks[j] for j in c] for c in short], rng=rng
+    )
+
+    # WARM-PATH ACCOUNTING: the verifier's per-batch registry sync is an
+    # ensure() on the same head-state tuple — an identity hit that uploads
+    # zero registry bytes; the indexed verify then moves well under the
+    # pubkey plane the upload path would carry.
+    upload = metrics.device_upload_bytes.value
+    hits_before = reg.stats["hits"]
+    assert reg.ensure(pkb)  # what _sync_registry does on a warm batch
+    assert reg.stats["hits"] == hits_before + 1
+    reg_bytes = upload("pubkey_registry")
+    idx_bytes = upload("agg_fast_verify_msm_idx")
+    up_bytes = upload("agg_fast_verify_msm")
+    assert backend.fast_aggregate_verify_batch_indexed(
+        msgs, aggs, committees, reg, rng=rng
+    )
+    assert backend.fast_aggregate_verify_batch(
+        msgs, aggs, member_keys, rng=rng
+    )
+    assert upload("pubkey_registry") == reg_bytes, (
+        "warm verify re-uploaded registry bytes"
+    )
+    import grandine_tpu.tpu.limbs as L
+
+    batch_bytes = upload("agg_fast_verify_msm_idx") - idx_bytes
+    upload_path_bytes = upload("agg_fast_verify_msm") - up_bytes
+    # the two arg tuples differ ONLY in mem_x+mem_y (the pubkey plane)
+    # vs mem_idx (an int32 index plane) — the rest is shape-identical
+    # per bucket, so the saving is exactly plane-minus-indices
+    bm, bk = _bucket(3), _bucket(3, lo=4)
+    pk_plane = bm * bk * 2 * L.NLIMBS * 4
+    idx_plane = bm * bk * 4
+    assert upload_path_bytes - batch_bytes == pk_plane - idx_plane, (
+        f"warm indexed batch moved {batch_bytes} B vs upload path's "
+        f"{upload_path_bytes} B; expected the {pk_plane} B pubkey plane "
+        f"to be replaced by a {idx_plane} B index plane"
+    )
+
+
+@kernel
+def test_one_compile_per_bucket(backend, keyring):
+    """Varying batch sizes inside one padding bucket must NOT trigger new
+    jit compiles: the padded shapes (and the data-independent MSM plan
+    geometry) are identical, so each kernel compiles once per bucket."""
+    sks, _ = keyring
+    pks = [sk.public_key() for sk in sks]
+    rng = _Rng(0xC3)
+
+    def flat_verify(n: int) -> bool:
+        msgs = [b"compile-%d" % i for i in range(n)]  # distinct → flat path
+        sigs = [sks[i].sign(msgs[i]) for i in range(n)]
+        return backend.multi_verify(msgs, sigs, pks[:n], rng=rng)
+
+    def sizes(prefix: str) -> int:
+        total = 0
+        for key, fn in _JITTED.items():
+            if key.startswith(prefix) and not key.startswith(prefix + "_idx"):
+                total += int(fn._cache_size())
+        return total
+
+    assert flat_verify(3)  # bucket 4: compile happens here (or is cached)
+    baseline = sizes("multi_verify_msm")
+    assert baseline >= 1
+    for n in (2, 4):  # both inside bucket 4
+        assert flat_verify(n)
+        assert sizes("multi_verify_msm") == baseline, (
+            f"batch size {n} inside one bucket triggered a recompile"
+        )
