@@ -145,15 +145,26 @@ class _LabeledFamily:
     histograms. `labels(*values)` returns (creating on first use) the
     child for that label-value tuple; children are never evicted, so
     label cardinality must stay bounded by construction (topic names,
-    protocol ids, kernel names — not peer ids)."""
+    protocol ids, kernel names — not peer ids).
+
+    `defaults` maps TRAILING label names to fill-in values so a family
+    can grow a dimension without breaking existing call sites: after
+    widening `verify_stage_seconds` from ("stage",) to ("stage", "lane")
+    with defaults={"lane": "attestation"}, `labels("execute")` keeps
+    resolving to the pre-existing attestation series."""
 
     def __init__(self, name: str, help_: str,
-                 labelnames: "Sequence[str]") -> None:
+                 labelnames: "Sequence[str]",
+                 defaults: "Optional[dict]" = None) -> None:
         if not labelnames:
             raise ValueError(f"{name}: labeled family needs >= 1 label")
         self.name = name
         self.help = help_
         self.labelnames = tuple(str(n) for n in labelnames)
+        self.defaults = {str(k): str(v) for k, v in (defaults or {}).items()}
+        for k in self.defaults:
+            if k not in self.labelnames:
+                raise ValueError(f"{name}: default for unknown label {k!r}")
         self._children: dict = {}
         self._lock = threading.Lock()
 
@@ -165,10 +176,17 @@ class _LabeledFamily:
             if values:
                 raise ValueError("pass label values positionally or by name")
             try:
-                values = tuple(kwargs[n] for n in self.labelnames)
+                values = tuple(
+                    kwargs[n] if n in kwargs else self.defaults[n]
+                    for n in self.labelnames
+                )
             except KeyError as e:
                 raise ValueError(f"{self.name}: missing label {e}") from e
         values = tuple(str(v) for v in values)
+        if len(values) < len(self.labelnames):
+            tail = self.labelnames[len(values):]
+            if all(n in self.defaults for n in tail):
+                values = values + tuple(self.defaults[n] for n in tail)
         if len(values) != len(self.labelnames):
             raise ValueError(
                 f"{self.name}: expected {len(self.labelnames)} label "
@@ -250,8 +268,9 @@ class LabeledGauge(LabeledCounter):
 class LabeledHistogram(_LabeledFamily):
     def __init__(self, name: str, help_: str,
                  labelnames: "Sequence[str]",
-                 buckets: "Sequence[float]" = _DEFAULT_BUCKETS) -> None:
-        super().__init__(name, help_, labelnames)
+                 buckets: "Sequence[float]" = _DEFAULT_BUCKETS,
+                 defaults: "Optional[dict]" = None) -> None:
+        super().__init__(name, help_, labelnames, defaults=defaults)
         self.buckets = tuple(buckets)
 
     class Child:
@@ -418,16 +437,50 @@ class Metrics:
             "verify_pipeline_depth",
             "device verify batches in flight (dispatched, not settled)")
         # verify-plane stage attribution: host_prep / upload_bytes /
-        # compile / execute / readback / fallback. Finer low end than
-        # the defaults: host prep for a 64-att batch is ~100 µs.
+        # compile / execute / readback / fallback, split by lane since
+        # the verify scheduler shares the device plane across object
+        # kinds. lane defaults to "attestation" so pre-lane dashboards
+        # and call sites keep resolving to the same series. Finer low
+        # end than the defaults: host prep for a 64-att batch is
+        # ~100 µs.
         self.verify_stage_seconds = LabeledHistogram(
             "verify_stage_seconds",
-            "attestation batch-verify latency, by pipeline stage",
-            ("stage",),
+            "batch-verify latency, by pipeline stage and lane",
+            ("stage", "lane"),
             buckets=(
                 0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
                 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
             ),
+            defaults={"lane": "attestation"},
+        )
+        # verify scheduler (runtime/verify_scheduler.py): per-lane
+        # queue occupancy, flushed batches by outcome, enqueue→flush
+        # wait, and overload sheds (low lanes drop oldest-first rather
+        # than stall block import)
+        self.verify_lane_depth = LabeledGauge(
+            "verify_lane_depth",
+            "verify-scheduler jobs queued, by lane",
+            ("lane",),
+        )
+        self.verify_lane_batches = LabeledCounter(
+            "verify_lane_batches_total",
+            "verify-scheduler batches flushed, by lane and result "
+            "(ok/invalid/degraded)",
+            ("lane", "result"),
+        )
+        self.verify_lane_wait_seconds = LabeledHistogram(
+            "verify_lane_wait_seconds",
+            "enqueue-to-flush wait of verify-scheduler jobs, by lane",
+            ("lane",),
+            buckets=(
+                0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+            ),
+        )
+        self.verify_lane_dropped = LabeledCounter(
+            "verify_lane_dropped_total",
+            "verify-scheduler jobs shed under overload, by lane",
+            ("lane",),
         )
 
     def collect_system_stats(self, data_dir: "str | None" = None) -> None:

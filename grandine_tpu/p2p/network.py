@@ -222,6 +222,7 @@ class Network:
         sync_pool=None,
         operation_pool=None,
         metrics=None,
+        verify_scheduler=None,
     ) -> None:
         self.transport = transport
         self.controller = controller
@@ -230,6 +231,12 @@ class Network:
         self.storage = storage
         self.sync_pool = sync_pool
         self.operation_pool = operation_pool
+        #: central verify scheduler (runtime/verify_scheduler.py): when
+        #: wired, gossip handlers submit signature checks to its lanes
+        #: and apply effects from the ticket callback; when None the
+        #: handlers verify eagerly inline (the historical synchronous
+        #: path — tests and minimal deployments)
+        self.verify_scheduler = verify_scheduler
         #: shared Metrics struct (labeled per-topic gossip counters +
         #: per-protocol req/resp counters); defaults to the controller's
         self.metrics = (
@@ -243,6 +250,14 @@ class Network:
         #: behavior); otherwise the active set maintained by SubnetService
         #: (attestation_subnets.rs) — gossip on other subnets is dropped
         self.active_attestation_subnets: "Optional[set[int]]" = None
+        #: pubkey → committee positions for the CURRENT sync-committee
+        #: period, built once per period instead of re-scanning the
+        #: 512-entry committee per gossip message; invalidated on the
+        #: period key AND the validator-set-change hook
+        self._sync_positions: "Optional[tuple[int, dict]]" = None
+        hooks = getattr(controller, "on_validator_set_change", None)
+        if hooks is not None:
+            hooks.append(lambda old, new: self._invalidate_sync_positions())
 
         transport.subscribe(
             GossipTopics.beacon_block(self.digest), self._on_gossip_block
@@ -290,6 +305,10 @@ class Network:
             GossipTopics.bls_to_execution_change(self.digest),
             self._on_gossip_bls_change,
         )
+        transport.subscribe(
+            GossipTopics.voluntary_exit(self.digest),
+            self._on_gossip_voluntary_exit,
+        )
         try:
             transport.register_provider(
                 self._serve_blocks_by_range, self._serve_status,
@@ -325,6 +344,74 @@ class Network:
     def _count_rpc(self, protocol: str) -> None:
         if self.metrics is not None:
             self.metrics.rpc_requests.labels(protocol).inc()
+
+    # --------------------------------------------- signature dispatching
+
+    def _eager_verify_items(self, items) -> bool:
+        """WHITELISTED eager fallback (tools/check_no_inline_gossip_verify
+        audits that gossip handlers hold no other verification calls):
+        SingleVerifier-equivalent per-item host checks, used when no
+        verify scheduler is wired so handler semantics stay synchronous."""
+        from grandine_tpu.runtime.verify_scheduler import host_check_item
+
+        return all(host_check_item(it) for it in items)
+
+    def _dispatch_verify(
+        self, lane: str, items, topic: str, reject_key: str, on_accept
+    ) -> None:
+        """Route one handler's deferred signature checks: submit to the
+        scheduler lane (effects run from the ticket callback) or fall
+        back to the eager inline path. A job shed under overload counts
+        as gossipsub "ignore" — dropped without prejudice — never as a
+        validation reject."""
+
+        def deliver(ok: bool, dropped: bool = False) -> None:
+            if dropped:
+                self.stats["verify_shed"] += 1
+                self._count_gossip(topic, "ignore")
+                return
+            if not ok:
+                self.stats[reject_key] += 1
+                self._count_gossip(topic, "reject")
+                return
+            self._count_gossip(topic, "accept")
+            on_accept()
+
+        sched = self.verify_scheduler
+        if sched is not None:
+            sched.submit(
+                lane, items,
+                callback=lambda t: deliver(t.ok, t.dropped),
+            )
+            return
+        deliver(self._eager_verify_items(items))
+
+    def _invalidate_sync_positions(self) -> None:
+        self._sync_positions = None
+
+    def _sync_committee_positions(self, state, pubkey: bytes):
+        """Committee position(s) of `pubkey` in the CURRENT sync
+        committee — one table build per sync-committee period (the
+        period key catches rotation; the validator-set-change hook
+        catches deposits/finalization) instead of an O(committee) scan
+        per gossip message."""
+        p = self.cfg.preset
+        period = (
+            int(state.slot)
+            // p.SLOTS_PER_EPOCH
+            // p.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+        )
+        cache = self._sync_positions
+        if cache is None or cache[0] != period:
+            table: "dict[bytes, tuple]" = {}
+            for pos, pk_bytes in enumerate(
+                state.current_sync_committee.pubkeys
+            ):
+                key = bytes(pk_bytes)
+                table[key] = table.get(key, ()) + (pos,)
+            cache = (period, table)
+            self._sync_positions = cache
+        return cache[1].get(bytes(pubkey), ())
 
     def _on_gossip_block(self, topic: str, payload: bytes) -> None:
         from grandine_tpu.types.combined import decode_signed_block
@@ -447,8 +534,8 @@ class Network:
         # signature inserted into the pool would poison the produced
         # sync aggregate and invalidate this node's own proposals
         # (p2p gossip rules; sync_committee_agg_pool tasks.rs)
-        from grandine_tpu.consensus import misc, signing
-        from grandine_tpu.crypto import bls as A
+        from grandine_tpu.consensus import accessors, misc, signing
+        from grandine_tpu.runtime.verify_scheduler import VerifyItem
 
         try:
             root = signing.sync_committee_message_signing_root(
@@ -456,21 +543,29 @@ class Network:
                 misc.compute_epoch_at_slot(int(msg.slot), self.cfg.preset),
                 self.cfg,
             )
-            sig = A.Signature.from_bytes(bytes(msg.signature))
-            pk = A.PublicKey.from_bytes(pubkey)
-            if not sig.verify(root, pk):
-                raise ValueError("bad signature")
+            cols = accessors.registry_columns(state)
         except Exception:
             self.stats["sync_messages_rejected"] += 1
             self._count_gossip(topic, "reject")
             return
-        self._count_gossip(topic, "accept")
-        for pos, pk_bytes in enumerate(state.current_sync_committee.pubkeys):
-            if bytes(pk_bytes) == pubkey:
-                self.sync_pool.insert_message(
-                    int(msg.slot), bytes(msg.beacon_block_root),
-                    pos, bytes(msg.signature),
-                )
+        positions = self._sync_committee_positions(state, pubkey)
+        slot = int(msg.slot)
+        block_root = bytes(msg.beacon_block_root)
+        signature = bytes(msg.signature)
+
+        def insert() -> None:
+            self.sync_pool.insert_message_at_positions(
+                slot, block_root, positions, signature
+            )
+
+        # the index+columns form lets the scheduler's device path gather
+        # the pubkey from the registry instead of uploading it
+        self._dispatch_verify(
+            "sync_message",
+            [VerifyItem(root, signature, member_indices=(vidx,),
+                        pubkey_columns=cols.pubkeys)],
+            topic, "sync_messages_rejected", insert,
+        )
 
     def _on_gossip_sync_contribution(self, topic: str, payload: bytes) -> None:
         self.stats["sync_contributions_in"] += 1
@@ -490,6 +585,7 @@ class Network:
         # subcommittee members before it can poison the pool's aggregates
         from grandine_tpu.consensus import misc, signing
         from grandine_tpu.crypto import bls as A
+        from grandine_tpu.runtime.verify_scheduler import VerifyItem
 
         state = self.controller.snapshot().head_state
         p = self.cfg.preset
@@ -512,15 +608,17 @@ class Network:
                 misc.compute_epoch_at_slot(int(contribution.slot), p),
                 self.cfg,
             )
-            sig = A.Signature.from_bytes(bytes(contribution.signature))
-            if not sig.fast_aggregate_verify(root, pks):
-                raise ValueError("bad aggregate signature")
         except Exception:
             self.stats["sync_contributions_rejected"] += 1
             self._count_gossip(topic, "reject")
             return
-        self._count_gossip(topic, "accept")
-        self.sync_pool.insert_contribution(contribution)
+        self._dispatch_verify(
+            "sync_contribution",
+            [VerifyItem(root, bytes(contribution.signature),
+                        public_keys=pks)],
+            topic, "sync_contributions_rejected",
+            lambda: self.sync_pool.insert_contribution(contribution),
+        )
 
     def _on_gossip_proposer_slashing(self, topic: str, payload: bytes) -> None:
         self.stats["proposer_slashings_in"] += 1
@@ -540,9 +638,9 @@ class Network:
         # signatures. Without this any peer could stuff the pool with
         # junk that invalidates our own block proposals at pack time.
         from grandine_tpu.consensus import (
-            accessors, keys, misc, predicates, signing,
+            accessors, misc, predicates, signing,
         )
-        from grandine_tpu.crypto import bls as A
+        from grandine_tpu.runtime.verify_scheduler import VerifyItem
 
         h1 = slashing.signed_header_1.message
         h2 = slashing.signed_header_2.message
@@ -565,21 +663,26 @@ class Network:
             ):
                 raise ValueError("proposer is not slashable")
             cols = accessors.registry_columns(state)
-            pk = keys.decompress_pubkey(cols.pubkeys[idx], trusted=True)
-            for signed in (slashing.signed_header_1,
-                           slashing.signed_header_2):
-                root = signing.header_signing_root(
-                    state, signed.message, self.cfg
+            items = [
+                VerifyItem(
+                    signing.header_signing_root(
+                        state, signed.message, self.cfg
+                    ),
+                    bytes(signed.signature),
+                    member_indices=(idx,),
+                    pubkey_columns=cols.pubkeys,
                 )
-                sig = A.Signature.from_bytes(bytes(signed.signature))
-                if not sig.verify(root, pk):
-                    raise ValueError("bad header signature")
+                for signed in (slashing.signed_header_1,
+                               slashing.signed_header_2)
+            ]
         except Exception:
             self.stats["proposer_slashings_rejected"] += 1
             self._count_gossip(topic, "reject")
             return
-        self._count_gossip(topic, "accept")
-        self.operation_pool.insert_proposer_slashing(slashing)
+        self._dispatch_verify(
+            "slashing", items, topic, "proposer_slashings_rejected",
+            lambda: self.operation_pool.insert_proposer_slashing(slashing),
+        )
 
     def _on_gossip_attester_slashing(self, topic: str, payload: bytes) -> None:
         self.stats["attester_slashings_in"] += 1
@@ -595,9 +698,12 @@ class Network:
         # attestation signatures. An unvalidated slashing would let any
         # peer zero arbitrary validators' fork-choice weight and poison
         # this node's own block proposals (spec p2p gossip validation;
-        # process_attester_slashing preconditions).
+        # process_attester_slashing preconditions). The structural checks
+        # stay inline; the signatures are COLLECTED (MultiVerifier defers
+        # them as triples) and routed through the slashing lane.
         from grandine_tpu.consensus import predicates
-        from grandine_tpu.consensus.verifier import SingleVerifier
+        from grandine_tpu.consensus.verifier import MultiVerifier
+        from grandine_tpu.runtime.verify_scheduler import VerifyItem
 
         att1, att2 = slashing.attestation_1, slashing.attestation_2
         state = self.controller.snapshot().head_state
@@ -606,23 +712,34 @@ class Network:
                 att1.data, att2.data
             ):
                 raise ValueError("attestations are not slashable")
+            collector = MultiVerifier()
             for indexed in (att1, att2):
                 predicates.validate_indexed_attestation(
-                    indexed, state, SingleVerifier(), self.cfg
+                    indexed, state, collector, self.cfg
                 )
+            items = [
+                VerifyItem(t.message, t.signature,
+                           public_keys=(t.public_key,))
+                for t in collector.triples
+            ]
         except Exception:
             self.stats["attester_slashings_rejected"] += 1
             self._count_gossip(topic, "reject")
             return
-        self._count_gossip(topic, "accept")
-        if self.operation_pool is not None:
-            self.operation_pool.insert_attester_slashing(slashing)
-        # fork choice marks the intersection equivocating
-        a = set(int(i) for i in att1.attesting_indices)
-        b = set(int(i) for i in att2.attesting_indices)
-        both = sorted(a & b)
-        if both:
-            self.controller.on_attester_slashing(both)
+
+        def apply() -> None:
+            if self.operation_pool is not None:
+                self.operation_pool.insert_attester_slashing(slashing)
+            # fork choice marks the intersection equivocating
+            a = set(int(i) for i in att1.attesting_indices)
+            b = set(int(i) for i in att2.attesting_indices)
+            both = sorted(a & b)
+            if both:
+                self.controller.on_attester_slashing(both)
+
+        self._dispatch_verify(
+            "slashing", items, topic, "attester_slashings_rejected", apply,
+        )
 
     def _on_gossip_bls_change(self, topic: str, payload: bytes) -> None:
         self.stats["bls_changes_in"] += 1
@@ -642,21 +759,75 @@ class Network:
         # reach the pool. The withdrawal-credential hash binding stays in
         # OperationPool.pack, where the packing state is authoritative.
         from grandine_tpu.consensus import signing
-        from grandine_tpu.consensus.verifier import SingleVerifier
+        from grandine_tpu.consensus.verifier import MultiVerifier
+        from grandine_tpu.runtime.verify_scheduler import VerifyItem
 
         state = self.controller.snapshot().head_state
         try:
             if int(signed.message.validator_index) >= len(state.validators):
                 raise ValueError("validator index out of range")
+            collector = MultiVerifier()
             signing.extend_with_bls_to_execution_change(
-                SingleVerifier(), state, signed, self.cfg
+                collector, state, signed, self.cfg
             )
+            items = [
+                VerifyItem(t.message, t.signature,
+                           public_keys=(t.public_key,))
+                for t in collector.triples
+            ]
         except Exception:
             self.stats["bls_changes_rejected"] += 1
             self._count_gossip(topic, "reject")
             return
-        self._count_gossip(topic, "accept")
-        self.operation_pool.insert_bls_to_execution_change(signed)
+        self._dispatch_verify(
+            "bls_change", items, topic, "bls_changes_rejected",
+            lambda: self.operation_pool.insert_bls_to_execution_change(
+                signed
+            ),
+        )
+
+    def _on_gossip_voluntary_exit(self, topic: str, payload: bytes) -> None:
+        self.stats["voluntary_exits_in"] += 1
+        if self.operation_pool is None:
+            self._count_gossip(topic, "ignore")
+            return
+        try:
+            signed = self._deneb_ns().SignedVoluntaryExit.deserialize(
+                frame_decompress(payload)
+            )
+        except Exception:
+            self.stats["decode_failures"] += 1
+            self._count_gossip(topic, "reject")
+            return
+        # verify the exit signature (EIP-7044-aware domain) against the
+        # exiting validator's key before the pool can pack it
+        from grandine_tpu.consensus import signing
+        from grandine_tpu.consensus.verifier import MultiVerifier
+        from grandine_tpu.runtime.verify_scheduler import VerifyItem
+        from grandine_tpu.types.combined import state_phase_of
+
+        state = self.controller.snapshot().head_state
+        try:
+            if int(signed.message.validator_index) >= len(state.validators):
+                raise ValueError("validator index out of range")
+            collector = MultiVerifier()
+            signing.extend_with_voluntary_exit(
+                collector, state, signed, self.cfg,
+                state_phase_of(state, self.cfg),
+            )
+            items = [
+                VerifyItem(t.message, t.signature,
+                           public_keys=(t.public_key,))
+                for t in collector.triples
+            ]
+        except Exception:
+            self.stats["voluntary_exits_rejected"] += 1
+            self._count_gossip(topic, "reject")
+            return
+        self._dispatch_verify(
+            "exit", items, topic, "voluntary_exits_rejected",
+            lambda: self.operation_pool.insert_voluntary_exit(signed),
+        )
 
     # ----------------------------------------------------------- outbound
 
@@ -724,6 +895,13 @@ class Network:
         self.transport.publish(
             GossipTopics.bls_to_execution_change(self.digest),
             frame_compress(signed_change.serialize()),
+        )
+
+    def publish_voluntary_exit(self, signed_exit) -> None:
+        self.stats["voluntary_exits_out"] += 1
+        self.transport.publish(
+            GossipTopics.voluntary_exit(self.digest),
+            frame_compress(signed_exit.serialize()),
         )
 
     # ------------------------------------------------------------ serving

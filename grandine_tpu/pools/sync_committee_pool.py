@@ -26,31 +26,50 @@ class SyncCommitteeAggPool:
 
     def insert_message(
         self, slot: int, beacon_block_root: bytes,
-        committee_position: int, signature: bytes,
+        committee_position: int, signature,
     ) -> None:
         """One validator's SyncCommitteeMessage placed at its position(s)
-        in the committee (positions map to subcommittees)."""
+        in the committee (positions map to subcommittees). `signature`
+        may be compressed bytes or an already-decompressed
+        `A.Signature` (the verify scheduler decompressed it to batch)."""
+        self.insert_message_at_positions(
+            slot, beacon_block_root, (committee_position,), signature
+        )
+
+    def insert_message_at_positions(
+        self, slot: int, beacon_block_root: bytes,
+        positions, signature,
+    ) -> None:
+        """One message inserted at every committee position its
+        validator holds — the signature is decompressed ONCE, not per
+        position (a validator can hold several positions)."""
+        if not positions:
+            return
         sub_size = self.p.SYNC_COMMITTEE_SIZE // self.subcommittees
-        sub = committee_position // sub_size
-        pos_in_sub = committee_position % sub_size
         key = (int(slot), bytes(beacon_block_root))
+        sig = (
+            signature if isinstance(signature, A.Signature)
+            else A.Signature.from_bytes(bytes(signature))
+        )
         with self._lock:
             subs = self._contribs.setdefault(key, {})
-            entry = subs.get(sub)
-            bits = np.zeros(sub_size, dtype=bool)
-            bits[pos_in_sub] = True
-            sig = A.Signature.from_bytes(bytes(signature))
-            if entry is None:
-                subs[sub] = (bits, sig)
-            else:
-                old_bits, old_sig = entry
-                if old_bits[pos_in_sub]:
-                    return  # already have this participant
-                merged = old_bits | bits
-                subs[sub] = (
-                    merged,
-                    A.Signature.aggregate([old_sig, sig]),
-                )
+            for committee_position in positions:
+                sub = committee_position // sub_size
+                pos_in_sub = committee_position % sub_size
+                entry = subs.get(sub)
+                bits = np.zeros(sub_size, dtype=bool)
+                bits[pos_in_sub] = True
+                if entry is None:
+                    subs[sub] = (bits, sig)
+                else:
+                    old_bits, old_sig = entry
+                    if old_bits[pos_in_sub]:
+                        continue  # already have this participant
+                    merged = old_bits | bits
+                    subs[sub] = (
+                        merged,
+                        A.Signature.aggregate([old_sig, sig]),
+                    )
 
     def insert_contribution(self, contribution) -> None:
         """An aggregated SyncCommitteeContribution (gossip aggregate)."""

@@ -10,6 +10,9 @@ runtime/src/runtime.rs:49-597), `fork_choice_control` threading
                             delayed-object retry (controller.rs, mutator.rs)
   attestation_verifier.py — accumulate→deadline→batch→fallback firehose
                             (p2p/src/attestation_verifier.rs)
+  verify_scheduler.py     — multi-lane batch-verify scheduler for every
+                            OTHER signed-object kind (priority lanes,
+                            deadline coalescing, shed-under-overload)
   node.py                 — in-process node: clock + controller + duties
                             ticking through slots on synthetic data
 """
@@ -24,5 +27,12 @@ from grandine_tpu.runtime.thread_pool import (  # noqa: F401
 from grandine_tpu.runtime.attestation_verifier import (  # noqa: F401
     AttestationVerifier,
     GossipAttestation,
+)
+from grandine_tpu.runtime.verify_scheduler import (  # noqa: F401
+    DeferredVerifier,
+    LaneConfig,
+    VerifyItem,
+    VerifyScheduler,
+    VerifyTicket,
 )
 from grandine_tpu.runtime.node import InProcessNode  # noqa: F401

@@ -189,17 +189,22 @@ class AttestationVerifier:
 
     # ------------------------------------------------------------- verify
 
+    #: lane label on verify_stage_seconds — the attestation firehose is
+    #: the scheduler's sibling "attestation" lane
+    lane = "attestation"
+
     @contextmanager
     def _stage(self, stage: str, **attrs):
         """One pipeline stage: a child span under the current trace
-        context plus a `verify_stage_seconds{stage=...}` observation."""
+        context plus a `verify_stage_seconds{stage=...,lane=...}`
+        observation."""
         t0 = time.perf_counter()
         with self.tracer.span(stage, attrs or None):
             yield
         if self.metrics is not None:
-            self.metrics.verify_stage_seconds.labels(stage).observe(
-                time.perf_counter() - t0
-            )
+            self.metrics.verify_stage_seconds.labels(
+                stage, self.lane
+            ).observe(time.perf_counter() - t0)
 
     def _verify_batch(self, batch: "Sequence[GossipAttestation]") -> None:
         t_batch = time.perf_counter()

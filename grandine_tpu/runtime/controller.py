@@ -85,6 +85,11 @@ class Controller:
         self.cfg = cfg
         self.verifier_factory = verifier_factory
         self.storage = storage
+        #: optional central VerifyScheduler (runtime/verify_scheduler.py):
+        #: when set, blob-sidecar header signatures ride its HIGH
+        #: "blob_header" lane instead of verifying eagerly on the pool
+        #: thread (which still blocks on the ticket — semantics unchanged)
+        self.verify_scheduler = None
         self.metrics = metrics
         #: optional tracing.Tracer — handed to the pool so task spans nest
         #: under whatever span spawned them
@@ -273,6 +278,20 @@ class Controller:
         if idx >= len(cols.pubkeys):
             raise ForkChoiceError("sidecar proposer index out of range")
         root = signing.header_signing_root(state, header, self.cfg)
+        sched = self.verify_scheduler
+        if sched is not None:
+            from grandine_tpu.runtime.verify_scheduler import VerifyItem
+
+            ticket = sched.submit(
+                "blob_header",
+                [VerifyItem(
+                    root, bytes(sidecar.signed_block_header.signature),
+                    member_indices=(idx,), pubkey_columns=cols.pubkeys,
+                )],
+            )
+            if not ticket.result(30.0):
+                raise SignatureInvalid("sidecar header signature invalid")
+            return
         pk = keys.decompress_pubkey(cols.pubkeys[idx], trusted=True)
         sig = A.Signature.from_bytes(
             bytes(sidecar.signed_block_header.signature)

@@ -30,6 +30,7 @@ class InProcessNode:
         execution_engine=None,
         verifier_factory=None,
         use_device_firehose: bool = False,
+        use_verify_scheduler: bool = False,
         full_sync_participation: bool = False,
         slasher=None,
         operation_pool=None,
@@ -41,6 +42,20 @@ class InProcessNode:
         self.cfg = cfg
         self.metrics = metrics
         self.tracer = tracer
+        self.verify_scheduler = None
+        if use_verify_scheduler:
+            from grandine_tpu.runtime.verify_scheduler import VerifyScheduler
+
+            self.verify_scheduler = VerifyScheduler(
+                use_device=use_device_firehose,
+                metrics=metrics,
+                tracer=tracer,
+            )
+            if verifier_factory is None:
+                # block proposer-signature batches ride the HIGH lane
+                verifier_factory = self.verify_scheduler.verifier_factory(
+                    "block"
+                )
         self.controller = Controller(
             genesis_state,
             cfg,
@@ -49,6 +64,7 @@ class InProcessNode:
             metrics=metrics,
             tracer=tracer,
         )
+        self.controller.verify_scheduler = self.verify_scheduler
         self.attestation_verifier = AttestationVerifier(
             self.controller,
             use_device=use_device_firehose,
@@ -57,6 +73,16 @@ class InProcessNode:
             metrics=metrics,
             tracer=tracer,
         )
+        if (
+            self.verify_scheduler is not None
+            and self.attestation_verifier.registry is not None
+        ):
+            # share the device-resident pubkey registry (one device
+            # mirror; the firehose already hooked its staleness to
+            # on_validator_set_change)
+            self.verify_scheduler.registry = (
+                self.attestation_verifier.registry
+            )
         self.clock = SlotClock(
             int(genesis_state.genesis_time), cfg.seconds_per_slot
         )
@@ -204,6 +230,8 @@ class InProcessNode:
 
     def stop(self) -> None:
         self.attestation_verifier.stop()
+        if self.verify_scheduler is not None:
+            self.verify_scheduler.stop()
         self.controller.stop()
 
     def __enter__(self) -> "InProcessNode":

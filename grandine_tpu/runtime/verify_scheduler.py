@@ -1,0 +1,721 @@
+"""Unified verify scheduler: every signed-object kind funnels into one
+multi-lane batch-verification plane — the generalization of the
+attestation firehose (runtime/attestation_verifier.py) to sync-committee
+messages, contributions, slashings, exits, BLS changes, blob-sidecar
+headers, and block proposer signatures.
+
+Shape (reference: fork_choice_control/src/thread_pool.rs's 2-priority
+split + p2p/src/attestation_verifier.rs's accumulate→deadline→batch):
+
+  lanes     — each signed-object kind gets a LaneConfig: priority class
+              (HIGH: blocks, blob headers, contributions; LOW: sync
+              messages, slashings, exits, BLS changes), a flush policy
+              (max_batch or max_wait, whichever first), and a bounded
+              queue. Under overload LOW lanes shed oldest-first with a
+              counted drop (`verify_lane_dropped_total`); HIGH lanes
+              backpressure the producer instead — block import is never
+              starved by a saturated gossip lane.
+  tickets   — `submit` returns a VerifyTicket future; callers wait
+              (`result`) or attach a callback. Shed tickets resolve
+              False with `dropped=True` so gossip accounting can tell
+              "ignored under load" from "rejected as invalid".
+  batches   — a dispatcher thread coalesces each lane into ONE padded
+              device batch on the fast-aggregate kernels in tpu/bls.py,
+              gathering pubkeys on-device via the shared
+              DevicePubkeyRegistry when items carry validator indices.
+              Dispatch is async (two-deep, like the attestation
+              pipeline); a completion thread settles verdicts.
+  failure   — a failed batch bisects down to a SingleVerifier-checked
+              leaf, quarantining only the bad items; a faulted device
+              backend degrades the batch to the eager host path (the
+              pre-scheduler behavior) without dropping anything.
+
+`DeferredVerifier` adapts the scheduler to the existing `Verifier` seam
+(consensus/verifier.py), so transition/fork-choice code can route block
+signature batches through a lane with zero changes.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Callable, Optional, Sequence
+
+from grandine_tpu.consensus.verifier import (
+    SignatureInvalid,
+    SingleVerifier,
+    Verifier,
+)
+from grandine_tpu.crypto import bls as A
+from grandine_tpu.runtime.thread_pool import Priority
+from grandine_tpu.tracing import NULL_TRACER
+
+
+class LaneConfig:
+    """One lane's flush/backpressure policy."""
+
+    __slots__ = ("name", "priority", "max_batch", "max_wait_s",
+                 "max_queue", "shed")
+
+    def __init__(self, name: str, priority: Priority, max_batch: int,
+                 max_wait_s: float, max_queue: int, shed: bool) -> None:
+        self.name = name
+        self.priority = priority
+        self.max_batch = int(max_batch)
+        self.max_wait_s = float(max_wait_s)
+        self.max_queue = int(max_queue)
+        #: LOW lanes shed oldest-first at max_queue; HIGH lanes block
+        #: the submitter (bounded producer) and never drop
+        self.shed = bool(shed)
+
+
+#: the lane table (README "Verify scheduler" section mirrors this)
+DEFAULT_LANES = (
+    LaneConfig("block", Priority.HIGH, 64, 0.002, 8192, shed=False),
+    LaneConfig("blob_header", Priority.HIGH, 32, 0.005, 4096, shed=False),
+    LaneConfig("sync_contribution", Priority.HIGH, 32, 0.025, 4096,
+               shed=False),
+    LaneConfig("sync_message", Priority.LOW, 128, 0.050, 2048, shed=True),
+    LaneConfig("slashing", Priority.LOW, 16, 0.100, 512, shed=True),
+    LaneConfig("exit", Priority.LOW, 16, 0.100, 512, shed=True),
+    LaneConfig("bls_change", Priority.LOW, 32, 0.100, 1024, shed=True),
+)
+
+
+class VerifyItem:
+    """One signature check in fast-aggregate geometry: a 32-byte signing
+    root, a 96-byte compressed signature, and the signer set — either
+    materialized `public_keys`, or `member_indices` into the state's
+    compressed `pubkey_columns` so the device path can gather pubkeys
+    from the registry without the host ever decompressing them."""
+
+    __slots__ = ("message", "signature", "public_keys", "member_indices",
+                 "pubkey_columns")
+
+    def __init__(self, message: bytes, signature: bytes,
+                 public_keys: "Optional[Sequence]" = None,
+                 member_indices: "Optional[Sequence[int]]" = None,
+                 pubkey_columns=None) -> None:
+        self.message = bytes(message)
+        self.signature = bytes(signature)
+        self.public_keys = (
+            tuple(public_keys) if public_keys is not None else None
+        )
+        self.member_indices = (
+            tuple(int(i) for i in member_indices)
+            if member_indices is not None else None
+        )
+        self.pubkey_columns = pubkey_columns
+
+    def resolve_keys(self) -> list:
+        """Materialize the signer keys (host fallback / bisection leaf);
+        raises SignatureInvalid when the item carries no usable keys."""
+        if self.public_keys is not None:
+            if not self.public_keys:
+                raise SignatureInvalid("aggregate with no public keys")
+            return list(self.public_keys)
+        if self.member_indices is None or self.pubkey_columns is None:
+            raise SignatureInvalid("verify item has no key material")
+        if not self.member_indices:
+            raise SignatureInvalid("aggregate with no public keys")
+        from grandine_tpu.consensus import keys as _keys
+
+        try:
+            return [
+                _keys.decompress_pubkey(self.pubkey_columns[i], trusted=True)
+                for i in self.member_indices
+            ]
+        except (IndexError, A.BlsError) as e:
+            raise SignatureInvalid(f"bad member index/pubkey: {e}") from e
+
+
+def host_check_item(item: VerifyItem) -> bool:
+    """The eager host path — SingleVerifier semantics (full decompression
+    + subgroup checks), the bisection leaf and the degradation target."""
+    sv = SingleVerifier()
+    try:
+        resolved = item.resolve_keys()
+        if len(resolved) == 1:
+            sv.verify_singular(item.message, item.signature, resolved[0])
+        else:
+            sv.verify_aggregate(item.message, item.signature, resolved)
+    except SignatureInvalid:
+        return False
+    return True
+
+
+class VerifyTicket:
+    """Future handed back by `submit`: resolves True (all the job's items
+    verified), or False (some item invalid — or `dropped` when the job
+    was shed under overload / at shutdown, so callers can count an
+    "ignore" rather than a "reject")."""
+
+    __slots__ = ("lane", "enqueued_at", "settled_at", "dropped",
+                 "_ok", "_event", "_callbacks", "_lock")
+
+    def __init__(self, lane: str) -> None:
+        self.lane = lane
+        self.enqueued_at = time.monotonic()
+        self.settled_at: "Optional[float]" = None
+        self.dropped = False
+        self._ok = False
+        self._event = threading.Event()
+        self._callbacks: "list[Callable]" = []
+        self._lock = threading.Lock()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    @property
+    def ok(self) -> bool:
+        """The settled verdict (False until resolved)."""
+        return self._ok
+
+    def result(self, timeout: "Optional[float]" = None) -> bool:
+        if not self._event.wait(timeout):
+            raise TimeoutError(f"{self.lane} verify ticket not settled")
+        return self._ok
+
+    def add_callback(self, fn: "Callable[[VerifyTicket], None]") -> None:
+        """Run fn(ticket) once settled (immediately if already done)."""
+        with self._lock:
+            if not self._event.is_set():
+                self._callbacks.append(fn)
+                return
+        fn(self)
+
+    def _resolve(self, ok: bool, dropped: bool = False) -> None:
+        with self._lock:
+            if self._event.is_set():
+                return
+            self._ok = bool(ok)
+            self.dropped = dropped
+            self.settled_at = time.monotonic()
+            callbacks, self._callbacks = self._callbacks, []
+            self._event.set()
+        for fn in callbacks:
+            try:
+                fn(self)
+            except Exception:
+                pass  # a consumer's callback must not break settling
+
+
+class _Job:
+    __slots__ = ("items", "ticket")
+
+    def __init__(self, items, ticket) -> None:
+        self.items = tuple(items)
+        self.ticket = ticket
+
+
+class VerifyScheduler:
+    """The central lane scheduler: submit → coalesce → device batch →
+    settle. One dispatcher thread forms batches (HIGH-priority lanes flush
+    first among due lanes); a completion thread forces async device
+    verdicts so dispatch overlaps execution, two deep."""
+
+    def __init__(
+        self,
+        backend=None,
+        registry=None,
+        lanes: "Optional[Sequence[LaneConfig]]" = None,
+        use_device: bool = True,
+        pipeline_depth: int = 2,
+        metrics=None,
+        tracer=None,
+    ) -> None:
+        self.metrics = metrics
+        self.tracer = tracer or NULL_TRACER
+        self.use_device = use_device
+        #: a shared injected backend (tests: fault injection) or one
+        #: lazily-built TpuBlsBackend per lane, so device stage spans
+        #: attribute to the dispatching lane (kernels stay shared via
+        #: the global jit cache)
+        self._shared_backend = backend
+        self._backends: dict = {}
+        self.registry = registry
+        self.lanes = {l.name: l for l in (lanes or DEFAULT_LANES)}
+        self._queues = {n: deque() for n in self.lanes}
+        self._item_counts = {n: 0 for n in self.lanes}
+        self._cond = threading.Condition()
+        self._stop = False
+        self._pending = 0  # submitted jobs not yet settled (flush barrier)
+        self.stats = {
+            n: {
+                "submitted": 0, "batches": 0, "accepted": 0,
+                "rejected": 0, "shed": 0, "device_faults": 0,
+                "max_batch_items": 0,
+            }
+            for n in self.lanes
+        }
+
+        self.pipeline_depth = max(1, int(pipeline_depth))
+        self._sem = threading.BoundedSemaphore(self.pipeline_depth)
+        self._completion: "queue.Queue" = queue.Queue()
+        self._completion_thread = threading.Thread(
+            target=self._complete, name="verify-settle", daemon=True
+        )
+        self._completion_thread.start()
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, name="verify-scheduler", daemon=True
+        )
+        self._dispatcher.start()
+
+    # ------------------------------------------------------------ submit
+
+    def submit(self, lane_name: str, items: "Sequence[VerifyItem]",
+               callback=None) -> VerifyTicket:
+        """Queue one job (all `items` must verify for the ticket to
+        resolve True). Returns immediately; LOW lanes shed oldest-first
+        at capacity, HIGH lanes block the caller until there is room."""
+        lane = self.lanes[lane_name]
+        ticket = VerifyTicket(lane_name)
+        if callback is not None:
+            ticket.add_callback(callback)
+        job = _Job(items, ticket)
+        shed: "list[_Job]" = []
+        with self._cond:
+            if self._stop:
+                ticket._resolve(False, dropped=True)
+                return ticket
+            q = self._queues[lane_name]
+            if lane.shed:
+                while len(q) >= lane.max_queue:
+                    old = q.popleft()
+                    self._item_counts[lane_name] -= len(old.items)
+                    self._pending -= 1
+                    shed.append(old)
+            else:
+                while len(q) >= lane.max_queue and not self._stop:
+                    self._cond.wait(0.05)
+                if self._stop:
+                    ticket._resolve(False, dropped=True)
+                    return ticket
+            q.append(job)
+            self._item_counts[lane_name] += len(job.items)
+            self._pending += 1
+            self.stats[lane_name]["submitted"] += 1
+            self._set_depth(lane_name)
+            self._cond.notify_all()
+        for old in shed:
+            self._count_shed(lane_name)
+            old.ticket._resolve(False, dropped=True)
+        return ticket
+
+    def deferred(self, lane: str = "block",
+                 timeout: float = 30.0) -> "DeferredVerifier":
+        return DeferredVerifier(self, lane=lane, timeout=timeout)
+
+    def verifier_factory(self, lane: str = "block", timeout: float = 30.0):
+        """A `Controller(verifier_factory=...)`-shaped callable routing
+        block signature batches through `lane`."""
+        return lambda: DeferredVerifier(self, lane=lane, timeout=timeout)
+
+    # -------------------------------------------------------- dispatcher
+
+    def _pick_lane(self, now: float) -> "Optional[str]":
+        """The due lane to flush next: full (max_batch) or overdue
+        (max_wait since its oldest job); HIGH priority wins, then the
+        most-overdue lane."""
+        best, best_key = None, None
+        for name, lane in self.lanes.items():
+            q = self._queues[name]
+            if not q:
+                continue
+            overdue = now - q[0].ticket.enqueued_at - lane.max_wait_s
+            if self._item_counts[name] >= lane.max_batch or overdue >= 0:
+                key = (int(lane.priority), -overdue)
+                if best_key is None or key < best_key:
+                    best, best_key = name, key
+        return best
+
+    def _nearest_deadline(self, now: float) -> "Optional[float]":
+        soonest = None
+        for name, lane in self.lanes.items():
+            q = self._queues[name]
+            if not q:
+                continue
+            wait = q[0].ticket.enqueued_at + lane.max_wait_s - now
+            if soonest is None or wait < soonest:
+                soonest = wait
+        if soonest is None:
+            return None
+        return max(soonest, 0.0)
+
+    def _pop_batch(self, lane: LaneConfig) -> "list[_Job]":
+        q = self._queues[lane.name]
+        jobs, n_items = [], 0
+        while q and n_items < lane.max_batch:
+            jobs.append(q.popleft())
+            n_items += len(jobs[-1].items)
+        self._item_counts[lane.name] -= n_items
+        self._set_depth(lane.name)
+        return jobs
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._stop:
+                    name = self._pick_lane(time.monotonic())
+                    if name is not None:
+                        break
+                    self._cond.wait(self._nearest_deadline(time.monotonic()))
+                if self._stop:
+                    # drain: settle everything still queued so no ticket
+                    # ever hangs past stop() (HIGH first, same as live)
+                    remaining = []
+                    for lane in sorted(
+                        self.lanes.values(), key=lambda l: int(l.priority)
+                    ):
+                        while self._queues[lane.name]:
+                            remaining.append((lane, self._pop_batch(lane)))
+                else:
+                    lane = self.lanes[name]
+                    jobs = self._pop_batch(lane)
+                    # wake HIGH-lane submitters blocked on a full queue
+                    self._cond.notify_all()
+            if self._stop:
+                for lane, jobs in remaining:
+                    if jobs:
+                        self._flush(lane, jobs)
+                return
+            if jobs:
+                self._flush(lane, jobs)
+
+    # ------------------------------------------------------------- flush
+
+    @contextmanager
+    def _stage(self, lane: LaneConfig, stage: str, **attrs):
+        """PR-1 stage-span vocabulary, lane-attributed."""
+        t0 = time.perf_counter()
+        with self.tracer.span(stage, attrs or None):
+            yield
+        if self.metrics is not None:
+            self.metrics.verify_stage_seconds.labels(
+                stage, lane.name
+            ).observe(time.perf_counter() - t0)
+
+    def _set_depth(self, lane_name: str) -> None:
+        if self.metrics is not None:
+            self.metrics.verify_lane_depth.labels(lane_name).set(
+                len(self._queues[lane_name])
+            )
+
+    def _count_batch(self, lane: LaneConfig, result: str) -> None:
+        if self.metrics is not None:
+            self.metrics.verify_lane_batches.labels(lane.name, result).inc()
+
+    def _count_shed(self, lane_name: str) -> None:
+        self.stats[lane_name]["shed"] += 1
+        if self.metrics is not None:
+            self.metrics.verify_lane_dropped.labels(lane_name).inc()
+
+    def _backend_for(self, lane: LaneConfig):
+        if self._shared_backend is not None:
+            return self._shared_backend
+        backend = self._backends.get(lane.name)
+        if backend is None:
+            from grandine_tpu.tpu.bls import TpuBlsBackend
+
+            backend = self._backends[lane.name] = TpuBlsBackend(
+                metrics=self.metrics, tracer=self.tracer, lane=lane.name
+            )
+        return backend
+
+    def _flush(self, lane: LaneConfig, jobs: "list[_Job]") -> None:
+        items = [it for j in jobs for it in j.items]
+        now = time.monotonic()
+        if self.metrics is not None:
+            waits = self.metrics.verify_lane_wait_seconds.labels(lane.name)
+            for j in jobs:
+                waits.observe(now - j.ticket.enqueued_at)
+        st = self.stats[lane.name]
+        st["batches"] += 1
+        st["max_batch_items"] = max(st["max_batch_items"], len(items))
+        settle = None
+        with self.tracer.span(
+            "verify_lane_flush",
+            {"lane": lane.name, "jobs": len(jobs), "items": len(items)},
+        ):
+            if self.use_device:
+                try:
+                    settle = self._device_dispatch(lane, items)
+                except Exception:
+                    st["device_faults"] += 1
+                    settle = None
+            if settle is None:
+                # graceful degradation: no device/async seam (or a
+                # faulted dispatch) → the eager host path, item by item
+                if self.use_device:
+                    self._count_batch(lane, "degraded")
+                verdicts = self._host_check_all(lane, items)
+                if not self.use_device:
+                    self._count_batch(
+                        lane, "ok" if all(verdicts) else "invalid"
+                    )
+                self._deliver(lane, jobs, verdicts)
+                return
+            ctx = self.tracer.capture()
+        # two-deep pipelined handoff (backpressure bounds device residency)
+        self._sem.acquire()
+        self._completion.put((lane, jobs, items, settle, ctx))
+
+    def _device_dispatch(self, lane: LaneConfig, items):
+        """Host prep + async device dispatch of one coalesced batch;
+        returns a zero-arg settle callable (the batch verdict) or None
+        when no async device seam is available. Mirrors the attestation
+        pipeline: decompress signatures WITHOUT the per-item host
+        subgroup scalar-mul, stack the device ψ-ladder subgroup check
+        and the verify kernel(s), read back nothing yet."""
+        backend = self._backend_for(lane)
+        if backend is None or not (
+            hasattr(backend, "fast_aggregate_verify_batch_async")
+            and hasattr(backend, "g2_subgroup_check_batch_async")
+        ):
+            return None
+        try:
+            with self._stage(lane, "host_prep", op="g2_decompress",
+                             items=len(items)):
+                points = [
+                    A.g2_from_bytes(it.signature, subgroup_check=False)
+                    for it in items
+                ]
+        except A.BlsError:
+            return lambda: False
+        if any(p.is_infinity() for p in points):
+            return lambda: False
+        registry = self._sync_registry(lane, items)
+        indexed, keyed = [], []
+        for i, it in enumerate(items):
+            if registry is not None and it.member_indices is not None:
+                indexed.append(i)
+            else:
+                keyed.append(i)
+        try:
+            with self._stage(lane, "host_prep", op="resolve_keys"):
+                keyed_keys = [items[i].resolve_keys() for i in keyed]
+        except SignatureInvalid:
+            # a keyless/malformed item: fail the batch, bisection isolates
+            return lambda: False
+        sub_settle = backend.g2_subgroup_check_batch_async(points)
+        sigs = [A.Signature(p) for p in points]
+        if self.metrics is not None:
+            self.metrics.device_batch_sigs.inc(len(sigs))
+        settles = []
+        if indexed:
+            settles.append(backend.fast_aggregate_verify_batch_indexed_async(
+                [items[i].message for i in indexed],
+                [sigs[i] for i in indexed],
+                [list(items[i].member_indices) for i in indexed],
+                registry,
+            ))
+        if keyed:
+            settles.append(backend.fast_aggregate_verify_batch_async(
+                [items[i].message for i in keyed],
+                [sigs[i] for i in keyed],
+                keyed_keys,
+            ))
+
+        def settle() -> bool:
+            if not bool(sub_settle().all()):
+                return False
+            return all(bool(s()) for s in settles)
+
+        return settle
+
+    def _sync_registry(self, lane: LaneConfig, items):
+        """The shared device pubkey registry, brought up to date against
+        the batch's pubkey columns (identity hit when unchanged); None →
+        indexed items fall back to host key resolution + upload path."""
+        registry = self.registry
+        if registry is None:
+            return None
+        cols = next(
+            (it.pubkey_columns for it in items
+             if it.member_indices is not None
+             and it.pubkey_columns is not None),
+            None,
+        )
+        if cols is None:
+            return None
+        try:
+            with self._stage(lane, "host_prep", op="registry_sync"):
+                if registry.ensure(cols):
+                    return registry
+        except A.BlsError:
+            pass
+        return None
+
+    # ------------------------------------------------------------ settle
+
+    def _complete(self) -> None:
+        while True:
+            entry = self._completion.get()
+            if entry is None:
+                return
+            lane, jobs, items, settle, ctx = entry
+            try:
+                with self.tracer.attach(ctx):
+                    self._settle_batch(lane, jobs, items, settle)
+            except Exception:
+                # the settle thread must survive anything; no ticket may
+                # hang — degrade the whole batch to the host path
+                try:
+                    self._deliver(
+                        lane, jobs, self._host_check_all(lane, items)
+                    )
+                except Exception:
+                    for j in jobs:
+                        j.ticket._resolve(False, dropped=True)
+            finally:
+                self._sem.release()
+
+    def _settle_batch(self, lane, jobs, items, settle) -> None:
+        try:
+            ok = bool(settle())
+        except Exception:
+            # device fault at readback: degrade to the host path
+            self.stats[lane.name]["device_faults"] += 1
+            self._count_batch(lane, "degraded")
+            self._deliver(lane, jobs, self._host_check_all(lane, items))
+            return
+        if ok:
+            self._count_batch(lane, "ok")
+            self._deliver(lane, jobs, [True] * len(items))
+            return
+        with self._stage(lane, "fallback", items=len(items)):
+            verdicts = self._isolate(lane, list(items))
+        self._count_batch(lane, "ok" if all(verdicts) else "invalid")
+        self._deliver(lane, jobs, verdicts)
+
+    def _isolate(self, lane: LaneConfig, items) -> "list[bool]":
+        """Recursive bisection of a failed batch — batch-check halves,
+        descend only into failing halves, SingleVerifier at the leaf —
+        so k bad items cost O(k·log n) checks, not n."""
+        if len(items) == 1:
+            return [host_check_item(items[0])]
+        mid = len(items) // 2
+        out: "list[bool]" = []
+        for half in (items[:mid], items[mid:]):
+            try:
+                ok = self._batch_check(lane, half)
+            except Exception:
+                self.stats[lane.name]["device_faults"] += 1
+                ok = False  # descend; leaves verify on the host
+            out.extend(
+                [True] * len(half) if ok else self._isolate(lane, half)
+            )
+        return out
+
+    def _batch_check(self, lane: LaneConfig, items) -> bool:
+        if self.use_device:
+            settle = self._device_dispatch(lane, items)
+            if settle is not None:
+                return bool(settle())
+        return all(host_check_item(it) for it in items)
+
+    def _host_check_all(self, lane: LaneConfig, items) -> "list[bool]":
+        with self._stage(lane, "execute", path="host", items=len(items)):
+            return [host_check_item(it) for it in items]
+
+    def _deliver(self, lane: LaneConfig, jobs, verdicts) -> None:
+        st = self.stats[lane.name]
+        i = 0
+        for job in jobs:
+            n = len(job.items)
+            ok = all(verdicts[i:i + n])
+            i += n
+            st["accepted" if ok else "rejected"] += 1
+            job.ticket._resolve(ok)
+        with self._cond:
+            self._pending -= len(jobs)
+            self._cond.notify_all()
+
+    # ----------------------------------------------------------- control
+
+    def flush(self, timeout: float = 30.0) -> None:
+        """Test barrier: wait until every submitted job has settled."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            self._cond.notify_all()
+        while time.monotonic() < deadline:
+            with self._cond:
+                if self._pending == 0:
+                    return
+                self._cond.notify_all()
+            time.sleep(0.005)
+        raise TimeoutError("verify scheduler did not drain")
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        self._dispatcher.join(timeout=10)
+        # sentinel queues BEHIND pending settles so they drain first
+        self._completion.put(None)
+        self._completion_thread.join(timeout=10)
+
+
+class DeferredVerifier(Verifier):
+    """The `Verifier`-seam adapter: accumulate items, then `finish()`
+    submits ONE job to the configured lane and waits the ticket
+    (`finish_async` returns the zero-arg settle, preserving the
+    verify-∥-process overlap). Aggregates keep their signer sets so the
+    device kernel — not the host — does the key aggregation."""
+
+    def __init__(self, scheduler: VerifyScheduler, lane: str = "block",
+                 timeout: float = 30.0) -> None:
+        self.scheduler = scheduler
+        self.lane = lane
+        self.timeout = timeout
+        self.items: "list[VerifyItem]" = []
+
+    def verify_singular(self, message, signature, public_key) -> None:
+        self.items.append(
+            VerifyItem(message, signature, public_keys=(public_key,))
+        )
+
+    def verify_aggregate(self, message, signature, public_keys) -> None:
+        if not public_keys:
+            raise SignatureInvalid("aggregate with no public keys")
+        self.items.append(
+            VerifyItem(message, signature, public_keys=public_keys)
+        )
+
+    def extend(self, triples) -> None:
+        for t in triples:
+            self.verify_singular(t.message, t.signature, t.public_key)
+
+    def finish(self) -> None:
+        self.finish_async()()
+
+    def finish_async(self):
+        if not self.items:
+            return lambda: None
+        items, self.items = self.items, []
+        n = len(items)
+        lane = self.lane
+        ticket = self.scheduler.submit(lane, items)
+        timeout = self.timeout
+
+        def settle() -> None:
+            if not ticket.result(timeout):
+                raise SignatureInvalid(
+                    f"batch of {n} failed {lane}-lane verification"
+                )
+
+        return settle
+
+
+__all__ = [
+    "DEFAULT_LANES",
+    "DeferredVerifier",
+    "LaneConfig",
+    "VerifyItem",
+    "VerifyScheduler",
+    "VerifyTicket",
+    "host_check_item",
+]

@@ -1048,12 +1048,17 @@ class TpuBlsBackend:
     fast_aggregate_verify — same edge-case semantics (empty batch, identity
     pubkeys), differential-tested against the anchor."""
 
-    def __init__(self, metrics=None, tracer=None) -> None:
+    def __init__(self, metrics=None, tracer=None,
+                 lane: str = "attestation") -> None:
         #: observability seams (wired by runtime/attestation_verifier):
         #: per-stage histograms/spans + per-kernel-variant counters when
         #: set; with both None every hook is a cheap early return
         self.metrics = metrics
         self.tracer = tracer
+        #: lane label on verify_stage_seconds — the verify scheduler
+        #: builds one façade per lane so device stages attribute to the
+        #: lane that dispatched them (jitted kernels stay shared)
+        self.lane = lane
         self._h2c_cache = _LruCache(
             H2C_CACHE_CAP, "hash_to_g2_dev", metrics=metrics
         )
@@ -1094,9 +1099,9 @@ class TpuBlsBackend:
         else:
             yield
         if self.metrics is not None:
-            self.metrics.verify_stage_seconds.labels(stage).observe(
-                time.perf_counter() - t0
-            )
+            self.metrics.verify_stage_seconds.labels(
+                stage, self.lane
+            ).observe(time.perf_counter() - t0)
 
     def _count_kernel(self, kernel: str, sigs: int) -> None:
         if self.metrics is not None:
