@@ -137,7 +137,43 @@ def _enable_compilation_cache() -> None:
         pass  # cache is best-effort
 
 
+def _lint_preflight() -> None:
+    """Refuse to bench a tree that violates the verify-plane invariants
+    (host sync on the dispatch path, inline gossip verify, …): the
+    number would not describe the architecture this repo claims.
+    BENCH_SKIP_LINT=1 skips; the runtime upload audit is not run here
+    (it compiles kernels — invoke it via
+    `python -m tools.lint --rules no-per-batch-upload`)."""
+    if os.environ.get("BENCH_SKIP_LINT") == "1":
+        return
+    import subprocess
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.lint"],
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    if proc.returncode != 0:
+        # still emit the parseable zero line the harness looks for
+        print(
+            json.dumps(
+                {
+                    "metric": "bls_multi_verify_throughput",
+                    "value": 0,
+                    "unit": "sigs/s",
+                    "vs_baseline": 0,
+                }
+            )
+        )
+        print(
+            "# bench aborted: grandine-lint preflight failed "
+            "(BENCH_SKIP_LINT=1 overrides)",
+            file=sys.stderr,
+        )
+        raise SystemExit(1)
+
+
 def main() -> None:
+    _lint_preflight()
     # default batch = 32,768: the measured throughput sweet spot (MSM cost
     # amortizes with batch size until ~64k, where memory pressure inverts
     # the curve); p50 batch latency ~1 s stays far inside the 4 s

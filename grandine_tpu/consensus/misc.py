@@ -74,6 +74,31 @@ def compute_activation_exit_epoch(epoch: int, p: Preset) -> int:
     return epoch + 1 + p.MAX_SEED_LOOKAHEAD
 
 
+def sync_committee_period(slot: int, p: Preset) -> int:
+    """Which sync-committee rotation a slot belongs to (altair
+    `compute_sync_committee_period` over compute_epoch_at_slot)."""
+    return slot // p.SLOTS_PER_EPOCH // p.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+
+
+def is_sync_committee_aggregator(
+    signature: bytes, p: Preset, subnet_count: int
+) -> bool:
+    """Altair `is_sync_committee_aggregator`: the selection proof elects
+    its signer when sha256(proof)[:8] mod the per-subcommittee modulo is
+    zero (validator/sync_committee.md)."""
+    from grandine_tpu.types.primitives import (
+        TARGET_AGGREGATORS_PER_SYNC_SUBCOMMITTEE,
+    )
+
+    modulo = max(
+        1,
+        p.SYNC_COMMITTEE_SIZE
+        // subnet_count
+        // TARGET_AGGREGATORS_PER_SYNC_SUBCOMMITTEE,
+    )
+    return bytes_to_uint64(sha256(bytes(signature))[:8]) % modulo == 0
+
+
 # --- committees ------------------------------------------------------------
 
 
@@ -231,6 +256,8 @@ __all__ = [
     "compute_epoch_at_slot",
     "compute_start_slot_at_epoch",
     "compute_activation_exit_epoch",
+    "sync_committee_period",
+    "is_sync_committee_aggregator",
     "committee_count_per_slot",
     "compute_committee_partition",
     "compute_proposer_index",

@@ -389,29 +389,50 @@ class Network:
     def _invalidate_sync_positions(self) -> None:
         self._sync_positions = None
 
-    def _sync_committee_positions(self, state, pubkey: bytes):
-        """Committee position(s) of `pubkey` in the CURRENT sync
-        committee — one table build per sync-committee period (the
-        period key catches rotation; the validator-set-change hook
-        catches deposits/finalization) instead of an O(committee) scan
-        per gossip message."""
+    def _sync_committee_for_slot(self, state, slot: int):
+        """The sync committee that signs at `slot`: the head state
+        carries the CURRENT committee and (near a rotation boundary)
+        the NEXT one — a message timestamped one period ahead of the
+        state must resolve against next_sync_committee, not current.
+        Returns (committee, period) — committee is None when the slot's
+        period is outside the two the state knows."""
+        from grandine_tpu.consensus import misc
+
         p = self.cfg.preset
-        period = (
-            int(state.slot)
-            // p.SLOTS_PER_EPOCH
-            // p.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
-        )
+        state_period = misc.sync_committee_period(int(state.slot), p)
+        period = misc.sync_committee_period(int(slot), p)
+        if period == state_period:
+            return state.current_sync_committee, period
+        if period == state_period + 1:
+            return state.next_sync_committee, period
+        return None, period
+
+    def _sync_committee_positions(self, state, slot: int, pubkey: bytes):
+        """Committee position(s) of `pubkey` in the sync committee of
+        `slot`'s PERIOD (current vs next, resolved against the head
+        state) — one table build per period (the period key catches
+        rotation; the validator-set-change hook catches deposits/
+        finalization) instead of an O(committee) scan per message."""
+        committee, period = self._sync_committee_for_slot(state, slot)
+        if committee is None:
+            return ()
         cache = self._sync_positions
-        if cache is None or cache[0] != period:
-            table: "dict[bytes, tuple]" = {}
-            for pos, pk_bytes in enumerate(
-                state.current_sync_committee.pubkeys
-            ):
+        if cache is None:
+            cache = {}
+            self._sync_positions = cache
+        table = cache.get(period)
+        if table is None:
+            table = {}
+            for pos, pk_bytes in enumerate(committee.pubkeys):
                 key = bytes(pk_bytes)
                 table[key] = table.get(key, ()) + (pos,)
-            cache = (period, table)
-            self._sync_positions = cache
-        return cache[1].get(bytes(pubkey), ())
+            cache[period] = table
+            # only the state's own and the next period are resolvable —
+            # drop rotated-out tables instead of accreting one per period
+            for stale in [k for k in cache if k not in (period, period + 1,
+                                                        period - 1)]:
+                del cache[stale]
+        return table.get(bytes(pubkey), ())
 
     def _on_gossip_block(self, topic: str, payload: bytes) -> None:
         from grandine_tpu.types.combined import decode_signed_block
@@ -548,8 +569,8 @@ class Network:
             self.stats["sync_messages_rejected"] += 1
             self._count_gossip(topic, "reject")
             return
-        positions = self._sync_committee_positions(state, pubkey)
         slot = int(msg.slot)
+        positions = self._sync_committee_positions(state, slot, pubkey)
         block_root = bytes(msg.beacon_block_root)
         signature = bytes(msg.signature)
 
@@ -581,9 +602,13 @@ class Network:
             self._count_gossip(topic, "reject")
             return
         contribution = signed.message.contribution
-        # verify the contribution's aggregate signature against the set
-        # subcommittee members before it can poison the pool's aggregates
-        from grandine_tpu.consensus import misc, signing
+        # full gossip validation before the pool: the aggregator's
+        # selection proof (proves the right to aggregate this slot/
+        # subcommittee), the outer SignedContributionAndProof signature,
+        # and the contribution's aggregate signature against the set
+        # subcommittee members — any one forged could poison the pool's
+        # aggregates or let a non-aggregator flood the topic
+        from grandine_tpu.consensus import accessors, misc, signing
         from grandine_tpu.crypto import bls as A
         from grandine_tpu.runtime.verify_scheduler import VerifyItem
 
@@ -592,7 +617,12 @@ class Network:
         try:
             sub = int(contribution.subcommittee_index)
             sub_size = p.SYNC_COMMITTEE_SIZE // self.cfg.sync_committee_subnet_count
-            members = state.current_sync_committee.pubkeys[
+            committee, _period = self._sync_committee_for_slot(
+                state, int(contribution.slot)
+            )
+            if committee is None:
+                raise ValueError("slot outside known sync periods")
+            members = committee.pubkeys[
                 sub * sub_size : (sub + 1) * sub_size
             ]
             bits = list(contribution.aggregation_bits)
@@ -603,19 +633,53 @@ class Network:
             ]
             if not pks:
                 raise ValueError("empty contribution")
+            agg_idx = int(signed.message.aggregator_index)
+            if agg_idx >= len(state.validators):
+                raise ValueError("aggregator index out of range")
+            agg_pubkey = bytes(state.validators[agg_idx].pubkey)
+            if not any(bytes(pk) == agg_pubkey for pk in members):
+                raise ValueError("aggregator not in declared subcommittee")
+            selection_proof = bytes(signed.message.selection_proof)
+            if not misc.is_sync_committee_aggregator(
+                selection_proof, p, self.cfg.sync_committee_subnet_count
+            ):
+                raise ValueError("selection proof does not elect aggregator")
+            ns = self._deneb_ns()
+            selection_root = signing.sync_selection_proof_signing_root(
+                state,
+                ns.SyncAggregatorSelectionData(
+                    slot=contribution.slot, subcommittee_index=sub
+                ),
+                self.cfg,
+            )
+            outer_root = signing.contribution_and_proof_signing_root(
+                state, signed.message, self.cfg
+            )
             root = signing.sync_committee_message_signing_root(
                 state, bytes(contribution.beacon_block_root),
                 misc.compute_epoch_at_slot(int(contribution.slot), p),
                 self.cfg,
             )
+            cols = accessors.registry_columns(state)
         except Exception:
             self.stats["sync_contributions_rejected"] += 1
             self._count_gossip(topic, "reject")
             return
+        # one ticket, three signatures: selection proof + outer proof
+        # ride the registry's indexed path (aggregator index known);
+        # the contribution aggregate carries its member keys
         self._dispatch_verify(
             "sync_contribution",
-            [VerifyItem(root, bytes(contribution.signature),
-                        public_keys=pks)],
+            [
+                VerifyItem(selection_root, selection_proof,
+                           member_indices=(agg_idx,),
+                           pubkey_columns=cols.pubkeys),
+                VerifyItem(outer_root, bytes(signed.signature),
+                           member_indices=(agg_idx,),
+                           pubkey_columns=cols.pubkeys),
+                VerifyItem(root, bytes(contribution.signature),
+                           public_keys=pks),
+            ],
             topic, "sync_contributions_rejected",
             lambda: self.sync_pool.insert_contribution(contribution),
         )

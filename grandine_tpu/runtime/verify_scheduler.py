@@ -171,13 +171,16 @@ class VerifyTicket:
 
     @property
     def ok(self) -> bool:
-        """The settled verdict (False until resolved)."""
-        return self._ok
+        """The settled verdict (False until resolved). Safe bare read:
+        _resolve writes _ok before _event.set(), and the advertised
+        contract is done()-then-ok."""
+        return self._ok  # lint: disable=lock-order
 
     def result(self, timeout: "Optional[float]" = None) -> bool:
         if not self._event.wait(timeout):
             raise TimeoutError(f"{self.lane} verify ticket not settled")
-        return self._ok
+        # Event.wait() is the happens-before edge for the _ok write
+        return self._ok  # lint: disable=lock-order
 
     def add_callback(self, fn: "Callable[[VerifyTicket], None]") -> None:
         """Run fn(ticket) once settled (immediately if already done)."""
@@ -373,11 +376,15 @@ class VerifyScheduler:
                         while self._queues[lane.name]:
                             remaining.append((lane, self._pop_batch(lane)))
                 else:
+                    remaining = None
                     lane = self.lanes[name]
                     jobs = self._pop_batch(lane)
                     # wake HIGH-lane submitters blocked on a full queue
                     self._cond.notify_all()
-            if self._stop:
+            # decide from the state observed UNDER the lock: re-reading
+            # self._stop bare here could see a stop() that landed after
+            # the lock was released, with `remaining` never built
+            if remaining is not None:
                 for lane, jobs in remaining:
                     if jobs:
                         self._flush(lane, jobs)

@@ -927,32 +927,39 @@ def _no_persistent_cache_first_call(jitted):
     serializing one ABORTS inside XLA (proto-size CHECK in
     put_executable_and_time), and deserializing an entry written by an
     earlier/killed run SEGFAULTS in get_executable_and_time — both
-    observed on the 8-device CPU mesh. The cache-enabled decision is
-    LATCHED per process (compilation_cache.is_cache_used memoizes its
-    first config read), so the flag flip must be paired with a latch
-    reset on both sides. A depth-counted lock makes concurrent sharded
-    calls nest instead of racing the window shut; unrelated kernels that
-    compile inside an open window merely skip their cache entry (benign)."""
+    observed on the 8-device CPU mesh. The bypass is SCOPED: the
+    thread-local config context manager (enable_compilation_cache)
+    disables the cache for this call stack only — the process-global
+    jax_enable_compilation_cache flag is never touched, so threads
+    outside the wrapper keep their own setting. The cache-enabled
+    decision is LATCHED per process (compilation_cache.is_cache_used
+    memoizes its first config read), so the scoped flag is paired with
+    a latch reset on both sides, and the latch is re-primed from THIS
+    thread (whose scoped view is "disabled") before the jitted call so
+    a concurrent compile cannot latch it enabled first. A depth-counted
+    lock makes concurrent sharded calls nest instead of racing the
+    window shut; unrelated kernels that compile inside an open window
+    merely skip their cache entry (benign, unchanged from before)."""
     from jax._src import compilation_cache as _cc
-
-    name = "jax_enable_compilation_cache"
-    saved = [True]
+    from jax._src import config as _jcfg
 
     def call(*args):
-        with _CACHE_BYPASS_LOCK:
-            _CACHE_BYPASS_DEPTH[0] += 1
-            if _CACHE_BYPASS_DEPTH[0] == 1:
-                saved[0] = getattr(jax.config, name)
-                _cc.reset_cache()
-                jax.config.update(name, False)
-        try:
-            return jitted(*args)
-        finally:
+        with _jcfg.enable_compilation_cache(False):
             with _CACHE_BYPASS_LOCK:
-                _CACHE_BYPASS_DEPTH[0] -= 1
-                if _CACHE_BYPASS_DEPTH[0] == 0:
-                    jax.config.update(name, saved[0])
-                    _cc.reset_cache()  # re-latch with the restored setting
+                _CACHE_BYPASS_DEPTH[0] += 1
+                if _CACHE_BYPASS_DEPTH[0] == 1:
+                    _cc.reset_cache()
+                    try:  # prime the latch under the scoped "disabled"
+                        _cc.is_cache_used(jax.devices()[0].client)
+                    except Exception:
+                        pass  # latch priming is best-effort
+            try:
+                return jitted(*args)
+            finally:
+                with _CACHE_BYPASS_LOCK:
+                    _CACHE_BYPASS_DEPTH[0] -= 1
+                    if _CACHE_BYPASS_DEPTH[0] == 0:
+                        _cc.reset_cache()  # re-latch lazily outside
 
     return call
 

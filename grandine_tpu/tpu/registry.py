@@ -84,11 +84,13 @@ class DevicePubkeyRegistry:
 
     @property
     def count(self) -> int:
-        return 0 if self._pubkeys is None else len(self._pubkeys)
+        with self._lock:  # RLock: fine from already-locked callers
+            return 0 if self._pubkeys is None else len(self._pubkeys)
 
     @property
     def capacity(self) -> int:
-        return 0 if self._x is None else int(self._x.shape[0])
+        with self._lock:
+            return 0 if self._x is None else int(self._x.shape[0])
 
     def arrays(self):
         """(device_x, device_y, count) — rows past `count` are zero

@@ -45,6 +45,10 @@ DOMAIN_CONTRIBUTION_AND_PROOF = bytes.fromhex("09000000")
 DOMAIN_BLS_TO_EXECUTION_CHANGE = bytes.fromhex("0A000000")
 DOMAIN_APPLICATION_MASK = bytes.fromhex("00000001")
 
+# altair sync-committee aggregation (p2p spec constant: target number of
+# aggregators electing themselves per subcommittee per slot)
+TARGET_AGGREGATORS_PER_SYNC_SUBCOMMITTEE = 16
+
 # altair participation flag indices
 TIMELY_SOURCE_FLAG_INDEX = 0
 TIMELY_TARGET_FLAG_INDEX = 1

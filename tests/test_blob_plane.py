@@ -247,12 +247,26 @@ def test_breadth_topics_roundtrip(genesis):
             aggregation_bits=bits,
             signature=key.sign(root).to_bytes(),
         )
+        # selection proof + outer signature are verified on gossip now
+        proof_msg = NS.ContributionAndProof(
+            aggregator_index=vidx, contribution=contribution,
+            selection_proof=key.sign(
+                signing.sync_selection_proof_signing_root(
+                    genesis,
+                    NS.SyncAggregatorSelectionData(
+                        slot=1, subcommittee_index=0
+                    ),
+                    CFG,
+                )
+            ).to_bytes(),
+        )
         signed_contrib = NS.SignedContributionAndProof(
-            message=NS.ContributionAndProof(
-                aggregator_index=vidx, contribution=contribution,
-                selection_proof=b"\x00" * 96,
-            ),
-            signature=b"\x00" * 96,
+            message=proof_msg,
+            signature=key.sign(
+                signing.contribution_and_proof_signing_root(
+                    genesis, proof_msg, CFG
+                )
+            ).to_bytes(),
         )
         net_a.publish_sync_contribution(signed_contrib)
         assert net_b.stats["sync_contributions_in"] == 1

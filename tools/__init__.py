@@ -1,0 +1,1 @@
+"""Repo tooling (benchmarks, guards, the grandine-lint suite)."""

@@ -1,112 +1,30 @@
 #!/usr/bin/env python
-"""CI guard: gossip handlers route signature checks through the verify
-scheduler, never inline.
+"""CI guard shim: gossip handlers route signature checks through the
+verify scheduler, never inline.
 
-The unified verify scheduler (grandine_tpu/runtime/verify_scheduler.py)
-exists so every signed gossip object — sync-committee messages,
-contributions, slashings, exits, BLS changes — rides a coalesced,
-priority-laned device batch instead of an eager per-signature host check
-in the handler. This script parses grandine_tpu/p2p/network.py and
-asserts that no `_on_gossip_*` method (or helper reachable only from
-them) calls `.verify(...)` / `.fast_aggregate_verify(...)` /
-`.aggregate_verify(...)` or constructs a `SingleVerifier` — the only
-sanctioned eager path is the whitelisted fallback helper
-`_eager_verify_items`, which the handlers reach via `_dispatch_verify`
-when no scheduler is wired.
-
-Checks (exit 0 = all pass, 1 = regression):
-  1. No direct verification call inside any `_on_gossip_*` method.
-  2. The whitelisted fallback helper still exists (so the guard cannot
-     be "passed" by deleting the degradation path).
-
-Pure AST — runs anywhere: `python tools/check_no_inline_gossip_verify.py`.
+The analysis now lives in the grandine-lint suite as the
+`no-inline-gossip-verify` rule (tools/lint/rules/no_inline_gossip_verify.py);
+this entry point is kept so existing wiring (`python
+tools/check_no_inline_gossip_verify.py`, exit 0 = pass) keeps working.
+Prefer `python -m tools.lint` for the full suite.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
-NETWORK_PY = os.path.join(
-    os.path.dirname(__file__), "..", "grandine_tpu", "p2p", "network.py"
-)
-
-#: eager-verification surface a handler must not touch directly
-FORBIDDEN_CALLS = {"verify", "fast_aggregate_verify", "aggregate_verify"}
-FORBIDDEN_NAMES = {"SingleVerifier"}
-#: the sanctioned degradation path (reached through _dispatch_verify)
-WHITELISTED_HELPERS = {"_eager_verify_items"}
-
-
-def _violations_in(method: ast.FunctionDef) -> "list[tuple[int, str]]":
-    out = []
-    for node in ast.walk(method):
-        if isinstance(node, ast.Call):
-            fn = node.func
-            if (
-                isinstance(fn, ast.Attribute)
-                and fn.attr in FORBIDDEN_CALLS
-            ):
-                out.append((node.lineno, f".{fn.attr}(...)"))
-            if isinstance(fn, ast.Name) and fn.id in FORBIDDEN_NAMES:
-                out.append((node.lineno, f"{fn.id}(...)"))
-        elif isinstance(node, ast.Name) and node.id in FORBIDDEN_NAMES:
-            out.append((node.lineno, node.id))
-    return out
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
 def main() -> int:
-    with open(os.path.abspath(NETWORK_PY)) as f:
-        tree = ast.parse(f.read(), filename=NETWORK_PY)
+    from tools.lint import core
 
-    network = next(
-        (
-            n for n in tree.body
-            if isinstance(n, ast.ClassDef) and n.name == "Network"
-        ),
-        None,
+    res = core.run(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        rules=["no-inline-gossip-verify"],
     )
-    if network is None:
-        print("FAIL: class Network not found in p2p/network.py",
-              file=sys.stderr)
-        return 1
-
-    methods = {
-        n.name: n for n in network.body if isinstance(n, ast.FunctionDef)
-    }
-    failures = []
-    checked = 0
-    for name, method in sorted(methods.items()):
-        if not name.startswith("_on_gossip_"):
-            continue
-        checked += 1
-        for lineno, what in _violations_in(method):
-            failures.append(
-                f"p2p/network.py:{lineno}: {name} verifies inline via "
-                f"{what} — submit to the verify scheduler (or let "
-                f"_dispatch_verify degrade to the whitelisted fallback)"
-            )
-    if checked == 0:
-        failures.append("no _on_gossip_* handlers found — wrong file?")
-
-    missing = WHITELISTED_HELPERS - set(methods)
-    for name in sorted(missing):
-        failures.append(
-            f"whitelisted fallback helper Network.{name} is gone — the "
-            f"no-scheduler degradation path must keep existing"
-        )
-
-    if failures:
-        for f_ in failures:
-            print(f"FAIL: {f_}", file=sys.stderr)
-        return 1
-    print(
-        f"OK: {checked} gossip handlers hold no inline signature "
-        f"verification (fallback helpers intact: "
-        f"{', '.join(sorted(WHITELISTED_HELPERS))})"
-    )
-    return 0
+    return res.exit_code
 
 
 if __name__ == "__main__":

@@ -1,22 +1,12 @@
 #!/usr/bin/env python
-"""CI guard: the warm-registry verify path must not re-upload the pubkey
-plane per batch.
+"""CI guard shim: the warm-registry verify path must not re-upload the
+pubkey plane per batch.
 
-The device-resident pubkey registry (grandine_tpu/tpu/registry.py) exists
-so per-batch host→device traffic is O(batch) — signatures + message points
-+ an int32 index plane — instead of O(batch × 208 B) of affine G1 pubkey
-limbs. This script audits that claim through the backend's own
-`device_upload_bytes_total{kernel=...}` accounting (the `_upload` seam in
-tpu/bls.py): registry uploads land under kernel="pubkey_registry";
-per-batch uploads land under the dispatching kernel's name.
-
-Checks (exit 0 = all pass, 1 = regression):
-  1. The second warm verify uploads zero registry bytes (identity hit).
-  2. The indexed path's per-batch upload equals the upload-path kernel's
-     minus exactly the pubkey plane (bm·bk·2·26·4 B) plus the int32 index
-     plane (bm·bk·4 B) — i.e. no pubkey limbs ride the per-batch clock.
-
-Runs anywhere JAX does: `JAX_PLATFORMS=cpu python tools/check_no_per_batch_upload.py`.
+The audit now lives in the grandine-lint suite as the runtime rule
+`no-per-batch-upload` (tools/lint/rules/no_per_batch_upload.py); this
+entry point is kept so existing wiring (`JAX_PLATFORMS=cpu python
+tools/check_no_per_batch_upload.py`, exit 0 = pass) keeps working.
+Prefer `python -m tools.lint --rules no-per-batch-upload`.
 """
 
 from __future__ import annotations
@@ -28,105 +18,15 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-import random  # noqa: E402
-
-
-class _Rng:
-    """random.Random with the secrets-style randbits interface."""
-
-    def __init__(self, seed: int) -> None:
-        self._rng = random.Random(seed)
-
-    def randbits(self, n: int) -> int:
-        return self._rng.getrandbits(n)
-
 
 def main() -> int:
-    import bench
+    from tools.lint import core
 
-    bench._enable_compilation_cache()  # pairing compiles cost minutes cold
-
-    from grandine_tpu.crypto import bls as A
-    from grandine_tpu.metrics import Metrics
-    from grandine_tpu.tpu import limbs as L
-    from grandine_tpu.tpu.bls import TpuBlsBackend, _bucket
-    from grandine_tpu.tpu.registry import DevicePubkeyRegistry
-
-    rng = _Rng(0x5EED)
-    metrics = Metrics()
-    backend = TpuBlsBackend(metrics=metrics)
-    registry = DevicePubkeyRegistry(metrics=metrics)
-
-    n_keys, m = 8, 3
-    sks = [A.SecretKey.keygen(bytes([i + 1]) * 32) for i in range(n_keys)]
-    pubkeys = tuple(sk.public_key().to_bytes() for sk in sks)
-    committees = [[0, 1, 2], [3, 4], [5, 6, 7]]
-    messages = [b"upload-guard-%d" % i for i in range(m)]
-    aggs = [
-        A.Signature.aggregate([sks[j].sign(messages[i]) for j in committees[i]])
-        for i in range(m)
-    ]
-
-    assert registry.ensure(pubkeys), "registry build failed"
-
-    upload = metrics.device_upload_bytes.value
-    idx_kernel = "agg_fast_verify_msm_idx"
-
-    def run_indexed() -> bool:
-        return backend.fast_aggregate_verify_batch_indexed(
-            messages, aggs, committees, registry, rng=rng
-        )
-
-    # warm-up (compiles); then measure a warm batch
-    assert run_indexed(), "indexed verify rejected a valid batch"
-    b0, r0 = upload(idx_kernel), upload("pubkey_registry")
-    assert run_indexed(), "indexed verify rejected a valid batch (warm)"
-    batch_bytes = upload(idx_kernel) - b0
-    registry_bytes = upload("pubkey_registry") - r0
-
-    bm = _bucket(m)
-    bk = _bucket(max(len(c) for c in committees), lo=4)
-    pk_plane_bytes = bm * bk * 2 * L.NLIMBS * 4  # x+y int32 limb rows
-    idx_plane_bytes = bm * bk * 4  # the int32 index plane that replaces it
-
-    failures = []
-    if registry_bytes != 0:
-        failures.append(
-            f"warm verify re-uploaded {registry_bytes} registry bytes "
-            f"(expected 0: identity hit)"
-        )
-
-    # the upload-path kernel on the same batch: its arg tuple differs from
-    # the indexed path's ONLY in the pubkey plane vs the index plane, so
-    # the byte saving must be exactly plane-minus-indices
-    member_keys = [registry.public_keys(c) for c in committees]
-    u0 = upload("agg_fast_verify_msm")
-    assert backend.fast_aggregate_verify_batch(
-        messages, aggs, member_keys, rng=rng
-    ), "upload-path verify rejected a valid batch"
-    upload_path_bytes = upload("agg_fast_verify_msm") - u0
-    saving = upload_path_bytes - batch_bytes
-    if saving != pk_plane_bytes - idx_plane_bytes:
-        failures.append(
-            f"indexed path saved {saving} B over the upload path; expected "
-            f"the {pk_plane_bytes} B pubkey plane replaced by the "
-            f"{idx_plane_bytes} B index plane "
-            f"({pk_plane_bytes - idx_plane_bytes} B) — pubkey limbs are "
-            f"riding the per-batch clock"
-        )
-
-    print(
-        f"warm indexed batch: {batch_bytes} B "
-        f"(upload-path kernel moved {upload_path_bytes} B; pubkey plane "
-        f"{pk_plane_bytes} B -> index plane {idx_plane_bytes} B; "
-        f"registry re-upload {registry_bytes} B)"
+    res = core.run(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        rules=["no-per-batch-upload"],
     )
-    if failures:
-        for f in failures:
-            print(f"FAIL: {f}", file=sys.stderr)
-        return 1
-    print("OK: warm verify path transfers O(batch) bytes, no pubkey plane")
-    return 0
+    return res.exit_code
 
 
 if __name__ == "__main__":

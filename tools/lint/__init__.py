@@ -1,0 +1,29 @@
+"""grandine-lint: the verify plane's static-analysis suite.
+
+The threaded, pipelined verify plane (registry kernels → two-deep
+dispatch → multi-lane scheduler) rests on invariants no single test
+states: no blocking host sync inside dispatch, consistent lock ordering
+across scheduler/completion threads, bounded metric label sets, pure
+jitted kernels, no inline gossip verification, no per-batch pubkey
+uploads. The reference Grandine enforces this class of invariant at
+compile time (`unsafe_code = 'forbid'` workspace-wide); this package is
+the Python/JAX equivalent: a shared AST-visitor framework plus one rule
+per invariant.
+
+Usage:  python -m tools.lint [paths...] [--rules r1,r2] [--disable r]
+        python -m tools.lint --list-rules
+        python -m tools.lint --runtime          # include runtime audits
+
+Suppression:
+    some_call()  # lint: disable=host-sync        (line)
+    # lint: disable-file=lock-order               (whole file)
+
+Baseline: tools/lint/baseline.txt holds grandfathered finding keys with
+reasons; findings whose key appears there don't fail the run. Regenerate
+with --write-baseline (then annotate each line's reason).
+"""
+
+from tools.lint.core import Context, Finding, Rule, run  # noqa: F401
+from tools.lint.registry import all_rules  # noqa: F401
+
+__all__ = ["Context", "Finding", "Rule", "run", "all_rules"]

@@ -1,0 +1,104 @@
+"""CLI: `python -m tools.lint [targets...] [options]`.
+
+Exit 0 when every finding is baselined/suppressed, 1 on new findings.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    from tools.lint import core
+    from tools.lint.registry import all_rules
+
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.lint",
+        description="grandine-lint: AST analyses for the verify plane",
+    )
+    parser.add_argument(
+        "targets", nargs="*",
+        help="repo-relative files to scan (default: each rule's own "
+             "path set)",
+    )
+    parser.add_argument(
+        "--rules", help="comma-separated rules to run (runtime rules "
+                        "included when named explicitly)",
+    )
+    parser.add_argument(
+        "--disable", help="comma-separated rules to skip",
+    )
+    parser.add_argument(
+        "--runtime", action="store_true",
+        help="also run runtime audits (execute backend code; needs JAX)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule table and exit",
+    )
+    parser.add_argument(
+        "--baseline", default=core.BASELINE_PATH,
+        help=f"baseline file (default {core.BASELINE_PATH})",
+    )
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore the baseline (report grandfathered findings too)",
+    )
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="rewrite the baseline from the current findings and exit 0",
+    )
+    parser.add_argument(
+        "--root", default=os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        ),
+        help="repo root (default: the checkout containing tools/)",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for r in all_rules():
+            kind = "" if r.kind == "ast" else f"  [{r.kind}]"
+            print(f"{r.name}{kind}\n    {r.description}")
+        return 0
+
+    rules = args.rules.split(",") if args.rules else None
+    disable = args.disable.split(",") if args.disable else None
+    baseline_path = None if args.no_baseline else args.baseline
+
+    if args.write_baseline:
+        ctx = core.Context(args.root)
+        old = core.load_baseline(ctx, args.baseline)
+        findings: "list[core.Finding]" = []
+        known = {r.name: r for r in all_rules()}
+        selected = (
+            [known[n] for n in rules] if rules
+            else [r for r in known.values()
+                  if r.kind == "ast" or args.runtime]
+        )
+        if disable:
+            selected = [r for r in selected if r.name not in disable]
+        for rule in selected:
+            for f in rule.check(ctx, rule.files(ctx, args.targets or None)):
+                if not ctx.suppressed(f):
+                    findings.append(f)
+        core.write_baseline(ctx, args.baseline, findings, old)
+        print(f"wrote {len(set(f.key for f in findings))} baseline "
+              f"entries to {args.baseline}")
+        return 0
+
+    res = core.run(
+        args.root,
+        targets=args.targets or None,
+        rules=rules,
+        disable=disable,
+        include_runtime=args.runtime,
+        baseline_path=baseline_path,
+    )
+    return res.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,263 @@
+"""Framework: findings, suppression comments, baseline, rule base, runner.
+
+Rules are AST visitors over a shared parsed-file cache (`Context`); a
+few are runtime audits (kind="runtime") that execute code instead of
+parsing it and only run when asked. Every finding carries a stable
+`key` (no line numbers) so the checked-in baseline survives unrelated
+edits to the same file.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str  # repo-relative, forward slashes
+    line: int
+    message: str
+    #: stable baseline fingerprint — rule:path:slug, NO line numbers
+    key: str = ""
+
+    def __post_init__(self) -> None:
+        if not self.key:
+            self.key = f"{self.rule}:{self.path}:{self.message}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+#: line suppression:  expr  # lint: disable=rule-a,rule-b
+_LINE_RE = re.compile(r"#\s*lint:\s*disable=([\w,\-]+)")
+#: file suppression (own line anywhere):  # lint: disable-file=rule-a
+_FILE_RE = re.compile(r"#\s*lint:\s*disable-file=([\w,\-]+)")
+
+
+class Context:
+    """Shared parsed-file cache rooted at the repo; rules ask for
+    sources/trees by repo-relative path and never touch the filesystem
+    directly, so fixtures can point rules at arbitrary files."""
+
+    def __init__(self, root: str) -> None:
+        self.root = os.path.abspath(root)
+        self._sources: "dict[str, str | None]" = {}
+        self._trees: "dict[str, ast.AST | None]" = {}
+
+    def abspath(self, relpath: str) -> str:
+        return os.path.join(self.root, relpath)
+
+    def source(self, relpath: str) -> "str | None":
+        if relpath not in self._sources:
+            try:
+                with open(self.abspath(relpath), encoding="utf-8") as f:
+                    self._sources[relpath] = f.read()
+            except OSError:
+                self._sources[relpath] = None
+        return self._sources[relpath]
+
+    def tree(self, relpath: str) -> "ast.AST | None":
+        if relpath not in self._trees:
+            src = self.source(relpath)
+            try:
+                self._trees[relpath] = (
+                    None if src is None else ast.parse(src, filename=relpath)
+                )
+            except SyntaxError:
+                self._trees[relpath] = None
+        return self._trees[relpath]
+
+    def suppressed(self, finding: Finding) -> bool:
+        src = self.source(finding.path)
+        if src is None:
+            return False
+        lines = src.splitlines()
+        for m in _FILE_RE.finditer(src):
+            if finding.rule in m.group(1).split(",") or (
+                m.group(1) == "all"
+            ):
+                return True
+        if 1 <= finding.line <= len(lines):
+            m = _LINE_RE.search(lines[finding.line - 1])
+            if m and (
+                finding.rule in m.group(1).split(",") or m.group(1) == "all"
+            ):
+                return True
+        return False
+
+
+class Rule:
+    """One invariant. Subclasses set `name`, `description`,
+    `default_paths` (repo-relative files scanned when the CLI names no
+    targets) and implement `check(ctx, files)`."""
+
+    name = "base"
+    description = ""
+    kind = "ast"  # "ast" rules run by default; "runtime" only on demand
+    default_paths: "tuple[str, ...]" = ()
+
+    def files(self, ctx: Context, targets: "list[str] | None"):
+        if targets:
+            return [t for t in targets if ctx.source(t) is not None]
+        return [p for p in self.default_paths if ctx.source(p) is not None]
+
+    def check(self, ctx: Context, files: "list[str]") -> "list[Finding]":
+        raise NotImplementedError
+
+
+# --------------------------------------------------- shared AST helpers
+
+
+def dotted(node: "ast.AST | None") -> "str | None":
+    """`jax.device_get` from a Name/Attribute chain, else None."""
+    parts: "list[str]" = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def walk_functions(tree: ast.AST):
+    """Yield (classname_or_None, FunctionDef) for every def, including
+    nested ones (classname is the nearest enclosing class)."""
+
+    def visit(node, cls):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                yield from visit(child, child.name)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield cls, child
+                yield from visit(child, cls)
+            else:
+                yield from visit(child, cls)
+
+    yield from visit(tree, None)
+
+
+# ------------------------------------------------------------- baseline
+
+
+BASELINE_PATH = os.path.join("tools", "lint", "baseline.txt")
+
+
+def load_baseline(ctx: Context, path: str) -> "dict[str, str]":
+    """key -> reason. Lines:  <key> | <reason>  ('#' comments)."""
+    src = ctx.source(path)
+    out: "dict[str, str]" = {}
+    if src is None:
+        return out
+    for raw in src.splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        key, _, reason = line.partition("|")
+        out[key.strip()] = reason.strip()
+    return out
+
+
+def write_baseline(ctx: Context, path: str, findings: "list[Finding]",
+                   old: "dict[str, str]") -> None:
+    lines = [
+        "# grandine-lint baseline: grandfathered findings, one per line as",
+        "#   <key> | <reason>",
+        "# A finding whose key appears here does not fail the run. Keys are",
+        "# line-number-free fingerprints; annotate WHY each entry is",
+        "# acceptable when you add it.",
+    ]
+    for f in sorted(set(f.key for f in findings)):
+        reason = old.get(f, "TODO: justify or fix")
+        lines.append(f"{f} | {reason}")
+    with open(ctx.abspath(path), "w", encoding="utf-8") as fh:
+        fh.write("\n".join(lines) + "\n")
+
+
+# --------------------------------------------------------------- runner
+
+
+@dataclass
+class RunResult:
+    new: "list[Finding]" = field(default_factory=list)
+    baselined: "list[Finding]" = field(default_factory=list)
+    suppressed: "list[Finding]" = field(default_factory=list)
+    stale_baseline: "list[str]" = field(default_factory=list)
+    checked_rules: "list[str]" = field(default_factory=list)
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.new else 0
+
+
+def run(
+    root: str,
+    targets: "list[str] | None" = None,
+    rules: "list[str] | None" = None,
+    disable: "list[str] | None" = None,
+    include_runtime: bool = False,
+    baseline_path: "str | None" = BASELINE_PATH,
+    out=None,
+    err=None,
+) -> RunResult:
+    from tools.lint.registry import all_rules
+
+    # resolve at call time, not def time, so stream redirection works
+    out = sys.stdout if out is None else out
+    err = sys.stderr if err is None else err
+
+    ctx = Context(root)
+    selected = []
+    known = {r.name: r for r in all_rules()}
+    if rules:
+        for name in rules:
+            if name not in known:
+                raise SystemExit(
+                    f"unknown rule {name!r} (known: {', '.join(sorted(known))})"
+                )
+            selected.append(known[name])
+    else:
+        selected = [
+            r for r in known.values()
+            if r.kind == "ast" or include_runtime
+        ]
+    if disable:
+        selected = [r for r in selected if r.name not in disable]
+
+    baseline = (
+        load_baseline(ctx, baseline_path) if baseline_path else {}
+    )
+    res = RunResult()
+    seen_keys: "set[str]" = set()
+    for rule in selected:
+        res.checked_rules.append(rule.name)
+        files = rule.files(ctx, targets)
+        for f in rule.check(ctx, files):
+            if f.key in seen_keys:
+                continue  # same logical finding reported twice
+            seen_keys.add(f.key)
+            if ctx.suppressed(f):
+                res.suppressed.append(f)
+            elif f.key in baseline:
+                res.baselined.append(f)
+            else:
+                res.new.append(f)
+    res.stale_baseline = sorted(k for k in baseline if k not in seen_keys)
+
+    for f in sorted(res.new, key=lambda f: (f.path, f.line)):
+        print(f"FAIL: {f.render()}", file=err)
+    for k in res.stale_baseline:
+        print(f"warning: stale baseline entry (fixed? drop it): {k}",
+              file=err)
+    summary = (
+        f"{'FAIL' if res.new else 'OK'}: rules={','.join(res.checked_rules)} "
+        f"findings={len(res.new)} baselined={len(res.baselined)} "
+        f"suppressed={len(res.suppressed)}"
+    )
+    print(summary, file=out)
+    return res

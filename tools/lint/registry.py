@@ -1,0 +1,24 @@
+"""Rule registry: one instance of every rule, import-cheap (runtime
+rules import their heavy dependencies inside check(), never here)."""
+
+from __future__ import annotations
+
+
+def all_rules():
+    from tools.lint.rules.host_sync import HostSyncRule
+    from tools.lint.rules.jit_purity import JitPurityRule
+    from tools.lint.rules.lock_order import LockOrderRule
+    from tools.lint.rules.metrics_cardinality import MetricsCardinalityRule
+    from tools.lint.rules.no_inline_gossip_verify import (
+        NoInlineGossipVerifyRule,
+    )
+    from tools.lint.rules.no_per_batch_upload import NoPerBatchUploadRule
+
+    return [
+        NoInlineGossipVerifyRule(),
+        HostSyncRule(),
+        LockOrderRule(),
+        MetricsCardinalityRule(),
+        JitPurityRule(),
+        NoPerBatchUploadRule(),
+    ]

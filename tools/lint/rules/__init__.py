@@ -1,0 +1,1 @@
+"""One module per rule; see tools.lint.registry for the active set."""

@@ -1,0 +1,104 @@
+"""Rule: no blocking host sync inside dispatch-path functions.
+
+The verify plane's throughput rests on async dispatch: while batch N
+runs on the device, the host preps batch N+1. Any call that forces a
+device value on the dispatch path — `block_until_ready`,
+`jax.device_get`, `np.asarray(dev)`, `.item()`, `bool(pending())` /
+`float(pending())` — serializes host and device and silently halves the
+pipeline. Readback belongs in settle closures, which the completion
+thread forces OFF the dispatch path.
+
+Scope: functions named `*_async`, `_device_dispatch`, `_dispatch_loop`,
+`_flush`, or `_dispatch*` in the dispatch-plane modules. Allowlist:
+nested `settle*` closures (the sanctioned readback seam) are skipped
+wholesale, as are nested defs listed in ALLOWED_NESTED.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.lint.core import Context, Finding, Rule, dotted, walk_functions
+
+DISPATCH_RE = re.compile(
+    r"(_async$|^_device_dispatch$|^_dispatch_loop$|^_flush$|^_dispatch)"
+)
+#: nested closures exempt from the scan (settle/readback seams)
+ALLOWED_NESTED = re.compile(r"^(settle|chunk)")
+
+#: dotted call names that force a host<->device sync (exact — the
+#: device-side tracer jnp.asarray must NOT match np.asarray)
+BLOCKING_DOTTED = {"jax.device_get", "np.asarray", "numpy.asarray"}
+BLOCKING_ATTRS = {"block_until_ready", "item"}
+#: builtins that force a pending verdict when fed a call result
+FORCING_BUILTINS = {"bool", "float"}
+
+
+class HostSyncRule(Rule):
+    name = "host-sync"
+    description = (
+        "no blocking host sync (block_until_ready / device_get / "
+        "np.asarray / .item() / bool(pending())) inside dispatch-path "
+        "functions; settle closures are the sanctioned readback seam"
+    )
+    default_paths = (
+        "grandine_tpu/tpu/bls.py",
+        "grandine_tpu/runtime/attestation_verifier.py",
+        "grandine_tpu/runtime/verify_scheduler.py",
+    )
+
+    def check(self, ctx: Context, files):
+        out: "list[Finding]" = []
+        for path in files:
+            tree = ctx.tree(path)
+            if tree is None:
+                continue
+            for cls, fn in walk_functions(tree):
+                if not DISPATCH_RE.search(fn.name):
+                    continue
+                where = f"{cls}.{fn.name}" if cls else fn.name
+                for lineno, what in self._blocking_calls(fn):
+                    out.append(Finding(
+                        self.name, path, lineno,
+                        f"{where} blocks the dispatch path via {what} — "
+                        f"move the readback into the settle closure",
+                        key=f"{self.name}:{path}:{where}:{what}",
+                    ))
+        return out
+
+    def _blocking_calls(self, fn: ast.FunctionDef):
+        """Walk fn's own body, skipping nested allowlisted closures."""
+
+        def visit(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ) and ALLOWED_NESTED.match(child.name):
+                    continue  # settle closures may force
+                if isinstance(child, ast.Call):
+                    hit = self._classify(child)
+                    if hit:
+                        yield child.lineno, hit
+                yield from visit(child)
+
+        yield from visit(fn)
+
+    @staticmethod
+    def _classify(call: ast.Call) -> "str | None":
+        fn = call.func
+        name = dotted(fn)
+        if name in BLOCKING_DOTTED:
+            return f"{name}(...)"
+        if isinstance(fn, ast.Attribute) and fn.attr in BLOCKING_ATTRS:
+            if fn.attr == "item" and call.args:
+                return None  # dict.item(...) lookalikes take no args here
+            return f".{fn.attr}()"
+        if (
+            isinstance(fn, ast.Name)
+            and fn.id in FORCING_BUILTINS
+            and len(call.args) == 1
+            and isinstance(call.args[0], ast.Call)
+        ):
+            return f"{fn.id}(<pending call>)"
+        return None

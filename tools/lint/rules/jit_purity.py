@@ -1,0 +1,216 @@
+"""Rule: jit-traced functions stay pure, and nothing flips process-wide
+JAX config from inside a function.
+
+A jitted function's Python body runs ONCE at trace time; side effects
+(clocks, RNG, global mutation) silently bake a single value into the
+compiled executable or corrupt shared state under the compile lock.
+The seed finding for this rule was `_no_persistent_cache_first_call`
+toggling the process-global `jax_enable_compilation_cache` flag around
+a call — racing every concurrent compile in the process.
+
+Detections, over `grandine_tpu/tpu/*.py`:
+
+1. In functions reachable from a `jax.jit` call / decorator (directly,
+   via `functools.partial(f, ...)`, or via `X = jax.shard_map(f, ...)`
+   / `X = functools.partial(f, ...)` aliases): calls into
+   time/random/np.random/secrets/os.urandom, `global` declarations,
+   and reads of module-level MUTABLE literals (dict/list/set bound to a
+   non-UPPERCASE name — UPPERCASE names are constant tables by
+   convention).
+
+2. In ANY function: `jax.config.update(...)` — process-global config
+   belongs in module-level setup; scoped behavior uses the thread-local
+   config context managers instead.
+"""
+
+from __future__ import annotations
+
+import ast
+import glob
+import os
+
+from tools.lint.core import Context, Finding, Rule, dotted
+
+_JIT_NAMES = {"jax.jit", "jit"}
+_PARTIAL_NAMES = {"functools.partial", "partial"}
+_ALIAS_FACTORIES = _PARTIAL_NAMES | {"jax.shard_map", "shard_map"}
+#: dotted-name prefixes whose calls are impure at trace time
+_IMPURE_PREFIXES = ("time", "random", "np.random", "numpy.random",
+                    "secrets")
+_IMPURE_EXACT = {"os.urandom"}
+_CONFIG_UPDATE = {"jax.config.update"}
+
+
+def _prefix_match(name: str) -> bool:
+    return any(
+        name == p or name.startswith(p + ".") for p in _IMPURE_PREFIXES
+    )
+
+
+def _jit_target(call: ast.Call) -> "ast.AST | None":
+    """The function expression handed to jax.jit(...), unwrapping one
+    functools.partial layer."""
+    if not call.args:
+        return None
+    arg = call.args[0]
+    if isinstance(arg, ast.Call) and dotted(arg.func) in _PARTIAL_NAMES:
+        return arg.args[0] if arg.args else None
+    return arg
+
+
+class JitPurityRule(Rule):
+    name = "jit-purity"
+    description = (
+        "jitted functions call no clock/RNG, declare no globals, read "
+        "no module-level mutable config; jax.config.update never runs "
+        "inside a function"
+    )
+
+    def files(self, ctx: Context, targets):
+        if targets:
+            return [t for t in targets if ctx.source(t) is not None]
+        pattern = os.path.join(ctx.root, "grandine_tpu", "tpu", "*.py")
+        return sorted(
+            os.path.relpath(p, ctx.root).replace(os.sep, "/")
+            for p in glob.glob(pattern)
+        )
+
+    def check(self, ctx: Context, files):
+        out: "list[Finding]" = []
+        for path in files:
+            tree = ctx.tree(path)
+            if tree is None:
+                continue
+            out.extend(self._check_file(path, tree))
+        return out
+
+    def _check_file(self, path, tree):
+        defs: "dict[str, list[ast.FunctionDef]]" = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, []).append(node)
+
+        # X = functools.partial(f, ...) / jax.shard_map(f, ...) aliases
+        aliases: "dict[str, str]" = {}
+        mutable_globals: "set[str]" = set()
+        for node in tree.body if isinstance(tree, ast.Module) else []:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target = node.targets[0]
+                if not isinstance(target, ast.Name):
+                    continue
+                value = node.value
+                if (
+                    isinstance(value, ast.Call)
+                    and dotted(value.func) in _ALIAS_FACTORIES
+                    and value.args
+                    and isinstance(value.args[0], ast.Name)
+                ):
+                    aliases[target.id] = value.args[0].id
+                if isinstance(
+                    value, (ast.Dict, ast.List, ast.Set, ast.ListComp,
+                            ast.DictComp, ast.SetComp)
+                ) and not target.id.isupper():
+                    mutable_globals.add(target.id)
+
+        # resolve every jit root to FunctionDefs in this file
+        jitted: "dict[str, ast.FunctionDef]" = {}
+
+        def add_target(expr):
+            name = None
+            if isinstance(expr, ast.Name):
+                name = expr.id
+                for _ in range(4):  # bounded alias chase
+                    if name in aliases:
+                        name = aliases[name]
+                    else:
+                        break
+            if name:
+                for fn in defs.get(name, ()):
+                    jitted.setdefault(f"{fn.name}:{fn.lineno}", fn)
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and dotted(node.func) in _JIT_NAMES:
+                add_target(_jit_target(node))
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if dotted(dec) in _JIT_NAMES:
+                        jitted.setdefault(f"{node.name}:{node.lineno}", node)
+                    elif (
+                        isinstance(dec, ast.Call)
+                        and (
+                            dotted(dec.func) in _JIT_NAMES
+                            or (
+                                dotted(dec.func) in _PARTIAL_NAMES
+                                and dec.args
+                                and dotted(dec.args[0]) in _JIT_NAMES
+                            )
+                        )
+                    ):
+                        jitted.setdefault(f"{node.name}:{node.lineno}", node)
+
+        for fn in jitted.values():
+            yield from self._impurities(path, fn, mutable_globals)
+
+        # jax.config.update inside any function (check 2); attributed
+        # to the innermost enclosing def
+        from tools.lint.core import walk_functions
+
+        def own_calls(fn):
+            def visit(node):
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(
+                        child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        continue  # walk_functions yields it separately
+                    if isinstance(child, ast.Call):
+                        yield child
+                    yield from visit(child)
+            yield from visit(fn)
+
+        for _cls, fn in walk_functions(tree):
+            for call in own_calls(fn):
+                if dotted(call.func) in _CONFIG_UPDATE:
+                    yield Finding(
+                        self.name, path, call.lineno,
+                        f"{fn.name} calls jax.config.update — "
+                        f"process-global config flip inside a function "
+                        f"races concurrent compiles; use the "
+                        f"thread-local config context manager",
+                        key=f"{self.name}:{path}:{fn.name}:config-update",
+                    )
+
+    def _impurities(self, path, fn: ast.FunctionDef,
+                    mutable_globals: "set[str]"):
+        where = fn.name
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                name = dotted(node.func)
+                if name and (_prefix_match(name) or name in _IMPURE_EXACT):
+                    yield Finding(
+                        self.name, path, node.lineno,
+                        f"jitted {where} calls {name}(...) — evaluated "
+                        f"once at trace time, baked into the "
+                        f"executable",
+                        key=f"{self.name}:{path}:{where}:{name}",
+                    )
+            elif isinstance(node, ast.Global):
+                yield Finding(
+                    self.name, path, node.lineno,
+                    f"jitted {where} declares global "
+                    f"{', '.join(node.names)} — trace-time global "
+                    f"mutation",
+                    key=(f"{self.name}:{path}:{where}:global:"
+                         f"{','.join(node.names)}"),
+                )
+            elif (
+                isinstance(node, ast.Name)
+                and isinstance(node.ctx, ast.Load)
+                and node.id in mutable_globals
+            ):
+                yield Finding(
+                    self.name, path, node.lineno,
+                    f"jitted {where} reads module-level mutable "
+                    f"{node.id} — its trace-time contents are frozen "
+                    f"into the compiled fn; pass it as an argument",
+                    key=f"{self.name}:{path}:{where}:mutable:{node.id}",
+                )

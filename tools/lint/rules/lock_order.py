@@ -1,0 +1,285 @@
+"""Rule: lock discipline across the scheduler/completion/registry
+threads.
+
+Two analyses over the lock-acquisition graph:
+
+1. ORDER — every `with self.<lock>:` acquisition is a node; an edge
+   A→B means B is (or can be, one call level deep within the same
+   class) acquired while A is held. A cycle (A→B and B→A reachable)
+   means two threads can deadlock by taking the locks in opposite
+   orders.
+
+2. GUARDED ATTRS — an attribute written under a lock in one method but
+   read with no lock held in another is a data race (torn reads on the
+   scheduler's queue state, stale registry views). `__init__` writes
+   (pre-publication) are exempt; reads inside any `with <lock>:` of the
+   same class are considered guarded (coarse but race-free).
+
+Lock attributes are recognized from `self.x = threading.Lock() /
+RLock() / Condition() / Semaphore() / BoundedSemaphore()` assignments
+anywhere in the class.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint.core import Context, Finding, Rule, dotted
+
+_LOCK_FACTORIES = {
+    "Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore",
+}
+#: methods whose bare reads are reporting/teardown-only by convention
+_EXEMPT_READERS = {"__init__", "__repr__", "__str__", "__len__"}
+
+
+def _is_lock_factory(call: ast.Call) -> bool:
+    name = dotted(call.func)
+    if name is None:
+        return False
+    leaf = name.rsplit(".", 1)[-1]
+    return leaf in _LOCK_FACTORIES
+
+
+def _self_attr(node: ast.AST) -> "str | None":
+    """'x' from a `self.x` attribute node."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+class _ClassInfo:
+    def __init__(self, cls: ast.ClassDef) -> None:
+        self.node = cls
+        self.name = cls.name
+        self.methods = {
+            n.name: n for n in cls.body if isinstance(n, ast.FunctionDef)
+        }
+        self.locks: "set[str]" = set()
+        for m in self.methods.values():
+            for node in ast.walk(m):
+                if isinstance(node, ast.Assign):
+                    attr = (
+                        _self_attr(node.targets[0])
+                        if len(node.targets) == 1 else None
+                    )
+                    if (
+                        attr
+                        and isinstance(node.value, ast.Call)
+                        and _is_lock_factory(node.value)
+                    ):
+                        self.locks.add(attr)
+
+
+class LockOrderRule(Rule):
+    name = "lock-order"
+    description = (
+        "consistent lock-acquisition order (no A→B plus B→A) and no "
+        "attribute written under a lock in one method but read bare in "
+        "another"
+    )
+    default_paths = (
+        "grandine_tpu/runtime/verify_scheduler.py",
+        "grandine_tpu/runtime/thread_pool.py",
+        "grandine_tpu/tpu/registry.py",
+    )
+
+    def check(self, ctx: Context, files):
+        out: "list[Finding]" = []
+        edges: "dict[tuple[str, str], tuple[str, int]]" = {}
+        infos: "list[tuple[str, _ClassInfo]]" = []
+        for path in files:
+            tree = ctx.tree(path)
+            if tree is None:
+                continue
+            for node in ast.walk(tree):
+                if isinstance(node, ast.ClassDef):
+                    info = _ClassInfo(node)
+                    if info.locks:
+                        infos.append((path, info))
+
+        for path, info in infos:
+            self._collect_edges(path, info, edges)
+            out.extend(self._guarded_attr_findings(path, info))
+
+        # cycle = both directions of an edge pair present anywhere in
+        # the scanned set (cross-class, cross-file pairs included)
+        for (a, b), (path, line) in sorted(edges.items()):
+            if (b, a) in edges and a < b:
+                other_path, other_line = edges[(b, a)]
+                out.append(Finding(
+                    self.name, path, line,
+                    f"inconsistent lock order: {a} is held while "
+                    f"acquiring {b} here, but {other_path}:{other_line} "
+                    f"acquires them in the opposite order — deadlock "
+                    f"window",
+                    key=f"{self.name}:cycle:{a}<->{b}",
+                ))
+        return out
+
+    # ------------------------------------------------ acquisition graph
+
+    def _collect_edges(self, path, info: _ClassInfo, edges) -> None:
+        """Intra-method nesting plus one level of same-class calls:
+        `with self.A: self.m()` where m acquires B adds A→B."""
+        acquires: "dict[str, set[str]]" = {}
+        for mname, m in info.methods.items():
+            acquires[mname] = {
+                a for node in ast.walk(m)
+                for a in self._with_locks(node, info)
+            }
+
+        def walk(node, held: "tuple[str, ...]"):
+            for child in ast.iter_child_nodes(node):
+                locks = self._with_locks(child, info)
+                if locks:
+                    for new in locks:
+                        for h in held:
+                            if h != new:
+                                edges.setdefault(
+                                    (f"{info.name}.{h}",
+                                     f"{info.name}.{new}"),
+                                    (path, child.lineno),
+                                )
+                    walk(child, held + tuple(locks))
+                    continue
+                if isinstance(child, ast.Call) and held:
+                    attr = (
+                        child.func.attr
+                        if isinstance(child.func, ast.Attribute)
+                        and isinstance(child.func.value, ast.Name)
+                        and child.func.value.id == "self"
+                        else None
+                    )
+                    if attr in acquires:
+                        for new in acquires[attr]:
+                            for h in held:
+                                if h != new:
+                                    edges.setdefault(
+                                        (f"{info.name}.{h}",
+                                         f"{info.name}.{new}"),
+                                        (path, child.lineno),
+                                    )
+                walk(child, held)
+
+        for m in info.methods.values():
+            walk(m, ())
+
+    @staticmethod
+    def _with_locks(node: ast.AST, info: _ClassInfo) -> "list[str]":
+        if not isinstance(node, ast.With):
+            return []
+        out = []
+        for item in node.items:
+            attr = _self_attr(item.context_expr)
+            if attr in info.locks:
+                out.append(attr)
+        return out
+
+    # ------------------------------------------------- guarded attrs
+
+    def _guarded_attr_findings(self, path, info: _ClassInfo):
+        held_methods = self._held_methods(info)
+        guarded: "dict[str, str]" = {}  # attr -> lock it's written under
+        for mname, m in info.methods.items():
+            if mname == "__init__":
+                continue
+            start = "a caller-held lock" if mname in held_methods else None
+            for attr, lock in self._writes_under_lock(m, info, start):
+                guarded.setdefault(attr, lock)
+        if not guarded:
+            return
+        for mname, m in info.methods.items():
+            if mname in _EXEMPT_READERS or mname in held_methods:
+                continue
+            for attr, line in self._bare_reads(m, info, set(guarded)):
+                yield Finding(
+                    self.name, path, line,
+                    f"{info.name}.{attr} is written under "
+                    f"{info.name}.{guarded[attr]} elsewhere but read "
+                    f"here in {mname} with no lock held — torn/stale "
+                    f"read",
+                    key=(f"{self.name}:{path}:{info.name}.{attr}"
+                         f":bare-read:{mname}"),
+                )
+
+    def _held_methods(self, info: _ClassInfo) -> "set[str]":
+        """Private methods whose every in-class call site runs with a
+        lock held (lexically, or from another held method — greatest
+        fixpoint, so mutually-recursive helpers stay held). These are
+        lock-held-by-contract: their bare attr accesses are guarded."""
+        sites: "dict[str, list[tuple[str, bool]]]" = {}
+
+        def collect(caller: str, node, held: bool):
+            for child in ast.iter_child_nodes(node):
+                now = held or bool(self._with_locks(child, info))
+                if isinstance(child, ast.Call):
+                    fn = child.func
+                    if (
+                        isinstance(fn, ast.Attribute)
+                        and isinstance(fn.value, ast.Name)
+                        and fn.value.id == "self"
+                        and fn.attr in info.methods
+                    ):
+                        sites.setdefault(fn.attr, []).append((caller, now))
+                collect(caller, child, now)
+
+        for mname, m in info.methods.items():
+            collect(mname, m, False)
+
+        held = {
+            m for m in sites
+            if m.startswith("_") and not m.startswith("__")
+        }
+        changed = True
+        while changed:
+            changed = False
+            for m in sorted(held):
+                if any(
+                    not lex and caller not in held
+                    for caller, lex in sites[m]
+                ):
+                    held.discard(m)
+                    changed = True
+        return held
+
+    def _writes_under_lock(self, m: ast.FunctionDef, info: _ClassInfo,
+                           start: "str | None" = None):
+        def walk(node, held: "str | None"):
+            for child in ast.iter_child_nodes(node):
+                locks = self._with_locks(child, info)
+                now = locks[0] if locks else held
+                if isinstance(child, (ast.Assign, ast.AugAssign)) and now:
+                    targets = (
+                        child.targets
+                        if isinstance(child, ast.Assign)
+                        else [child.target]
+                    )
+                    for t in targets:
+                        attr = _self_attr(t)
+                        if attr and attr not in info.locks:
+                            yield attr, now
+                yield from walk(child, now)
+
+        yield from walk(m, start)
+
+    def _bare_reads(self, m: ast.FunctionDef, info: _ClassInfo,
+                    guarded: "set[str]"):
+        def walk(node, held: bool):
+            for child in ast.iter_child_nodes(node):
+                now = held or bool(self._with_locks(child, info))
+                if (
+                    not now
+                    and isinstance(child, ast.Attribute)
+                    and isinstance(child.ctx, ast.Load)
+                ):
+                    attr = _self_attr(child)
+                    if attr in guarded:
+                        yield attr, child.lineno
+                yield from walk(child, now)
+
+        yield from walk(m, False)
