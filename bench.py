@@ -563,8 +563,165 @@ def bench_verify_scheduler() -> None:
     )
 
 
+def bench_chaos() -> None:
+    """Chaos soak for the verify plane's health supervisor (`--chaos` /
+    BENCH_CHAOS=1): a seeded FaultPlan injects all five fault kinds
+    (dispatch raise, settle raise, hang, wrong verdict, slow settle)
+    over a KnownAnswerBackend while a mixed HIGH+LOW workload runs
+    through the real scheduler. The headline check: every ticket
+    settles, every verdict matches the fault-free truth table, and the
+    breaker demonstrably opens/probes/re-closes. No accelerator needed —
+    the device is a truth-table stub; this soaks the SUPERVISOR.
+
+    Knobs: BENCH_CHAOS_SEED, BENCH_CHAOS_JOBS, BENCH_CHAOS_RATE (total
+    fault probability split evenly over the five kinds)."""
+    import threading
+
+    from grandine_tpu.crypto import bls as A
+    from grandine_tpu.runtime import health as _health
+    from grandine_tpu.runtime import verify_scheduler as vs
+    from grandine_tpu.testing.chaos import (
+        ChaosBackend,
+        FAULT_KINDS,
+        FaultPlan,
+        KnownAnswerBackend,
+    )
+    from grandine_tpu.transition.genesis import interop_secret_key
+
+    seed = int(os.environ.get("BENCH_CHAOS_SEED", "7"))
+    n_jobs = int(os.environ.get("BENCH_CHAOS_JOBS", "400"))
+    rate = float(os.environ.get("BENCH_CHAOS_RATE", "0.15"))
+
+    # one REAL signature's bytes reused for every item: the scheduler's
+    # host prep decompresses each signature (and rejects infinity), but
+    # the truth-table backend and host path judge by message only
+    sk = interop_secret_key(0)
+    sig_bytes = sk.sign(b"chaos-bench").to_bytes()
+    pk = sk.public_key()
+
+    # all-valid truth: a wrong_verdict flip can then only turn
+    # valid->invalid, which host bisection corrects — the soak's
+    # verdict-equivalence invariant holds for EVERY seed (a corrupt
+    # device validating a truly-invalid batch is uncatchable per-batch;
+    # that failure mode is the canary probe's job, tests/test_chaos.py)
+    messages = [b"chaos-msg-%03d" % i + b"\x00" * 18 for i in range(64)]
+    truth: "dict[bytes, bool]" = {m: True for m in messages}
+    good_msg = b"canary-good" + b"\x00" * 21
+    bad_msg = b"canary-bad" + b"\x00" * 22
+    truth[good_msg] = True  # bad_msg absent -> False
+    canary_sig = A.Signature(A.g2_from_bytes(sig_bytes, subgroup_check=False))
+    specimens = [
+        _health.CanarySpecimen(good_msg, canary_sig, [pk], expected=True),
+        _health.CanarySpecimen(bad_msg, canary_sig, [pk], expected=False),
+    ]
+
+    plan = FaultPlan(seed=seed, rates={k: rate / 5.0 for k in FAULT_KINDS})
+    chaos = ChaosBackend(KnownAnswerBackend(truth), plan, slow_s=0.02)
+    supervisor = _health.BackendHealthSupervisor(
+        settle_timeout_s=0.2,  # hangs cost 200ms, not the 5s default
+        probe=_health.make_canary_probe(chaos, specimens, timeout_s=0.2),
+        backoff_initial_s=0.05,
+        backoff_max_s=0.4,
+        rng=__import__("random").Random(seed),
+    )
+    sched = vs.VerifyScheduler(
+        backend=chaos, use_device=True, health=supervisor
+    )
+    # the host path (degradation target + bisection leaf) answers from
+    # the same truth table -- the fault-free expectation is exact
+    real_host_check = vs.host_check_item
+    vs.host_check_item = lambda item: truth.get(bytes(item.message), False)
+
+    tickets: "list[tuple]" = []
+    lock = threading.Lock()
+    rng_jobs = __import__("random").Random(seed ^ 0xCAFE)
+    job_specs = [
+        (
+            "sync_message" if rng_jobs.random() < 0.75 else "block",
+            [rng_jobs.choice(messages)
+             for _ in range(rng_jobs.randrange(1, 4))],
+        )
+        for _ in range(n_jobs)
+    ]
+
+    def producer(specs) -> None:
+        mine = []
+        for lane, msgs in specs:
+            items = [
+                vs.VerifyItem(m, sig_bytes, public_keys=(pk,)) for m in msgs
+            ]
+            expected = all(truth[m] for m in msgs)
+            mine.append((sched.submit(lane, items), expected))
+        with lock:
+            tickets.extend(mine)
+
+    t0 = time.time()
+    threads = [
+        threading.Thread(target=producer, args=(job_specs[i::4],))
+        for i in range(4)
+    ]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        sched.flush(120.0)
+    finally:
+        sched.stop()
+        chaos.release_hangs()
+    wall_s = time.time() - t0
+
+    unsettled = sum(1 for tk, _ in tickets if not tk.done())
+    mismatches = sum(
+        1 for tk, expected in tickets
+        if tk.done() and not tk.dropped and tk.ok is not expected
+    )
+    dropped = sum(1 for tk, _ in tickets if tk.dropped)
+    br = supervisor.breaker.stats
+    agg = {
+        k: sum(st[k] for st in sched.stats.values())
+        for k in ("batches", "device_faults", "breaker_skips", "retries")
+    }
+    vs.host_check_item = real_host_check
+    soak_ok = unsettled == 0 and mismatches == 0
+    print(
+        json.dumps({
+            "metric": "verify_chaos_soak",
+            "unit": "faults survived",
+            "value": sum(plan.injected.values()),
+            "seed": seed,
+            "jobs": n_jobs,
+            "wall_s": round(wall_s, 2),
+            "injected": plan.injected,
+            "seam_calls": plan.calls,
+            "breaker": {
+                "opens": br["opens"], "closes": br["closes"],
+                "probes_passed": br["probes_passed"],
+                "probes_failed": br["probes_failed"],
+                "faults": br["faults"],
+            },
+            "scheduler": agg,
+            "dropped": dropped,
+            "unsettled": unsettled,
+            "verdict_mismatches": mismatches,
+            "soak_ok": soak_ok,
+        })
+    )
+    print(
+        f"# chaos soak: {sum(plan.injected.values())} faults over "
+        f"{plan.calls} seam calls; breaker opened {br['opens']}x, "
+        f"re-closed {br['closes']}x; "
+        f"{'OK' if soak_ok else 'FAILED (see verdict_mismatches)'}",
+        file=sys.stderr,
+    )
+    if not soak_ok:
+        raise SystemExit(1)
+
+
 if __name__ == "__main__":
-    if os.environ.get("BENCH_SCHED_ONLY") == "1":
+    if "--chaos" in sys.argv or os.environ.get("BENCH_CHAOS") == "1":
+        bench_chaos()
+    elif os.environ.get("BENCH_SCHED_ONLY") == "1":
         bench_verify_scheduler()
     else:
         main()

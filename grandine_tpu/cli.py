@@ -181,7 +181,11 @@ def _node_once(args, cfg) -> int:
             raise SystemExit("--engine-url requires --jwt-secret")
         with open(args.jwt_secret) as f:
             secret = bytes.fromhex(f.read().strip().removeprefix("0x"))
-        engine = EngineApiClient(args.engine_url, secret)
+        # transient EL failures retry with capped exponential backoff
+        # (el_retry_total) instead of waiting for the next head
+        engine = EngineApiClient(args.engine_url, secret).with_retries(
+            metrics=metrics
+        )
 
     if getattr(args, "checkpoint_sync_url", None) and (
         storage.load_anchor_state() is None

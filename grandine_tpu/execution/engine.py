@@ -1,14 +1,20 @@
-"""ExecutionEngine interface + Null/Mock test seams.
+"""ExecutionEngine interface + Null/Mock test seams + retry decorator.
 
 Reference: execution_engine/src/execution_engine.rs:21-54 (trait with
 `notify_new_payload` / `notify_forkchoice_updated`), :176 (Null), :210
 (Mock with scripted payload statuses) — the two I/O boundaries SURVEY.md §4.3
 swaps to run integration tests without a real chain.
+
+`RetryingExecutionEngine` wraps any engine with capped exponential
+backoff + jitter on transient failures, replacing the bare "stay
+optimistic, retry on next head" behavior when the EL is unreachable.
 """
 
 from __future__ import annotations
 
 import enum
+import random
+import time
 from typing import Optional
 
 
@@ -82,9 +88,128 @@ class MockExecutionEngine(ExecutionEngine):
         return self.status_for.get(bytes(head_block_hash), self.default)
 
 
+def _is_transient(error: BaseException) -> bool:
+    """Transient = worth retrying: socket-level failures (OSError, or an
+    HttpClientError whose `status` is None) and EL-side 5xx. Duck-typed
+    on the `status` attribute so this module never imports http_clients
+    (which imports this module)."""
+    if isinstance(error, OSError):
+        return True
+    status = getattr(error, "status", False)
+    if status is False:
+        return False  # no status attribute at all: not an HTTP error
+    return status is None or (
+        isinstance(status, int) and 500 <= status < 600
+    )
+
+
+class RetryingExecutionEngine(ExecutionEngine):
+    """Capped-exponential-backoff retry wrapper around any
+    ExecutionEngine (in practice http_clients.EngineApiClient — built
+    via its `.with_retries()`).
+
+    Two cooperating mechanisms:
+      in-call retries — a transient failure re-issues the call up to
+          `max_attempts` times, sleeping a jittered, capped exponential
+          delay between attempts (counted on `el_retry_total`);
+      cross-call fail-fast — when a call exhausts its attempts, further
+          calls raise the last error immediately until a backoff window
+          (growing with consecutive failed calls, capped) expires, so a
+          down EL costs one probe per window instead of a full retry
+          ladder per fork-choice update.
+
+    Non-transient errors (4xx, auth failures) propagate immediately.
+    `clock`/`sleep`/`rng` are injectable for deterministic tests."""
+
+    def __init__(
+        self,
+        inner: ExecutionEngine,
+        max_attempts: int = 3,
+        backoff_initial_s: float = 0.5,
+        backoff_max_s: float = 30.0,
+        jitter_frac: float = 0.1,
+        metrics=None,
+        clock=time.monotonic,
+        sleep=time.sleep,
+        rng: "Optional[random.Random]" = None,
+    ) -> None:
+        self.inner = inner
+        self.max_attempts = max(1, int(max_attempts))
+        self.backoff_initial_s = float(backoff_initial_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self.jitter_frac = float(jitter_frac)
+        self.metrics = metrics
+        self.clock = clock
+        self.sleep = sleep
+        self.rng = rng if rng is not None else random.Random()
+        self._failures = 0  # consecutive exhausted calls
+        self._blocked_until = 0.0
+        self._last_error: "Optional[BaseException]" = None
+        self.stats = {"retries": 0, "fast_fails": 0, "giveups": 0}
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def _delay(self, attempt: int) -> float:
+        base = min(
+            self.backoff_initial_s * (2.0 ** (attempt - 1)),
+            self.backoff_max_s,
+        )
+        return base * (1.0 + self.jitter_frac * (2.0 * self.rng.random() - 1.0))
+
+    def _invoke(self, fn, *args, **kwargs):
+        if self._last_error is not None and self.clock() < self._blocked_until:
+            # fail-fast window: the EL just exhausted a retry ladder —
+            # don't pay another one per head until the window expires
+            self.stats["fast_fails"] += 1
+            raise self._last_error
+        attempt = 1
+        while True:
+            try:
+                result = fn(*args, **kwargs)
+            except Exception as e:
+                if not _is_transient(e):
+                    raise
+                if attempt >= self.max_attempts:
+                    self.stats["giveups"] += 1
+                    self._failures += 1
+                    self._last_error = e
+                    self._blocked_until = (
+                        self.clock() + self._delay(self._failures)
+                    )
+                    raise
+                self.stats["retries"] += 1
+                if self.metrics is not None:
+                    self.metrics.el_retries.inc()
+                self.sleep(self._delay(attempt))
+                attempt += 1
+                continue
+            self._failures = 0
+            self._last_error = None
+            self._blocked_until = 0.0
+            return result
+
+    def notify_new_payload(self, payload) -> PayloadStatus:
+        return self._invoke(self.inner.notify_new_payload, payload)
+
+    def notify_forkchoice_updated(
+        self, head_block_hash, safe_block_hash, finalized_block_hash,
+        payload_attributes=None,
+    ) -> PayloadStatus:
+        return self._invoke(
+            self.inner.notify_forkchoice_updated,
+            head_block_hash, safe_block_hash, finalized_block_hash,
+            payload_attributes,
+        )
+
+    def allow_optimistic_import(self) -> bool:
+        return self.inner.allow_optimistic_import()
+
+
 __all__ = [
     "PayloadStatus",
     "ExecutionEngine",
     "NullExecutionEngine",
     "MockExecutionEngine",
+    "RetryingExecutionEngine",
 ]

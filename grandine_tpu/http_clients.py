@@ -173,6 +173,14 @@ class EngineApiClient(ExecutionEngine):
         self.last_payload_id: "Optional[str]" = None
         self._id = 0
 
+    def with_retries(self, metrics=None, **kwargs) -> "ExecutionEngine":
+        """This client behind capped-exponential-backoff retries for
+        transient failures (socket errors, EL 5xx) — the node wiring's
+        default; see execution/engine.py RetryingExecutionEngine."""
+        from grandine_tpu.execution.engine import RetryingExecutionEngine
+
+        return RetryingExecutionEngine(self, metrics=metrics, **kwargs)
+
     # -- JSON-RPC plumbing ------------------------------------------------
 
     def call(self, method: str, params: list) -> object:

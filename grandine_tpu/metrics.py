@@ -482,6 +482,54 @@ class Metrics:
             "verify-scheduler jobs shed under overload, by lane",
             ("lane",),
         )
+        # device health supervisor (runtime/health.py): breaker state
+        # machine, canary re-promotion probes, settle watchdog, bounded
+        # transient retries, and daemon-loop crash containment
+        self.verify_breaker_state = LabeledGauge(
+            "verify_breaker_state",
+            "device circuit-breaker state (0=closed 1=open 2=half_open), "
+            "by backend",
+            ("backend",),
+        )
+        self.verify_breaker_transitions = LabeledCounter(
+            "verify_breaker_transitions_total",
+            "device circuit-breaker state transitions, by backend and "
+            "entered state",
+            ("backend", "state"),
+        )
+        self.verify_breaker_faults = LabeledCounter(
+            "verify_breaker_faults_total",
+            "faults filed with the device circuit breaker, by backend "
+            "and kind (dispatch/settle/watchdog/verdict)",
+            ("backend", "kind"),
+        )
+        self.verify_canary_probes = LabeledCounter(
+            "verify_canary_probes_total",
+            "HALF_OPEN canary probe batches, by backend and result "
+            "(pass/fail)",
+            ("backend", "result"),
+        )
+        self.verify_watchdog_fired = LabeledCounter(
+            "verify_watchdog_fired_total",
+            "device settles abandoned by the watchdog deadline, by lane",
+            ("lane",),
+        )
+        self.verify_retry = LabeledCounter(
+            "verify_retry_total",
+            "bounded transient re-dispatches of a faulted device batch, "
+            "by lane",
+            ("lane",),
+        )
+        self.el_retries = Counter(
+            "el_retry_total",
+            "execution-engine call retries (capped exponential backoff "
+            "with jitter)",
+        )
+        self.daemon_loop_failures = LabeledCounter(
+            "daemon_loop_failures_total",
+            "contained crashes of long-running daemon loops, by thread",
+            ("thread",),
+        )
 
     def collect_system_stats(self, data_dir: "str | None" = None) -> None:
         """Refresh the /proc-sourced gauges (metrics/src/service.rs

@@ -368,6 +368,15 @@ class Network:
         def deliver(ok: bool, dropped: bool = False) -> None:
             if dropped:
                 self.stats["verify_shed"] += 1
+                sched = self.verify_scheduler
+                if sched is not None and getattr(
+                    sched, "device_degraded", lambda: False
+                )():
+                    # sheds while the device breaker is quarantining the
+                    # backend: overload-under-degradation, not plain
+                    # overload — the operator's cue that host-path
+                    # throughput, not gossip volume, is the bottleneck
+                    self.stats["verify_shed_degraded"] += 1
                 self._count_gossip(topic, "ignore")
                 return
             if not ok:

@@ -25,6 +25,7 @@ from grandine_tpu.consensus import accessors, keys, signing
 from grandine_tpu.consensus.verifier import SignatureInvalid
 from grandine_tpu.crypto import bls as A
 from grandine_tpu.fork_choice.store import ForkChoiceError, ValidAttestation
+from grandine_tpu.runtime import health as _health
 from grandine_tpu.runtime.thread_pool import Priority
 from grandine_tpu.tracing import NULL_TRACER
 
@@ -63,6 +64,8 @@ class AttestationVerifier:
         operation_pool=None,
         metrics=None,
         tracer=None,
+        health: "Optional[_health.BackendHealthSupervisor]" = None,
+        settle_timeout_s: float = 5.0,
     ) -> None:
         self.controller = controller
         self.cfg = controller.cfg
@@ -96,11 +99,23 @@ class AttestationVerifier:
         #: serializes slasher spans + the evidence store across the
         #: concurrent batch-verify pool threads
         self._slasher_lock = threading.Lock()
+        #: breaker + settle watchdog + canary gating; node.py passes the
+        #: scheduler's supervisor so both verify planes quarantine the
+        #: device together
+        self.health = (
+            health if health is not None
+            else _health.BackendHealthSupervisor(
+                metrics=self.metrics, settle_timeout_s=settle_timeout_s
+            )
+        )
         self._queue: "deque[GossipAttestation]" = deque()
         self._cond = threading.Condition()
         self._active = 0
         self._stop = False
-        self.stats = {"batches": 0, "accepted": 0, "rejected": 0, "fallbacks": 0}
+        self.stats = {
+            "batches": 0, "accepted": 0, "rejected": 0, "fallbacks": 0,
+            "breaker_skips": 0, "retries": 0,
+        }
 
         #: device-resident pubkey registry (tpu/registry.py): the verify
         #: plane's warm path gathers committee pubkeys on-device by
@@ -155,37 +170,62 @@ class AttestationVerifier:
 
     def _collect(self) -> None:
         while True:
-            with self._cond:
-                # wait for the first item
-                while not self._stop and not self._queue:
-                    self._cond.wait()
-                if self._stop and not self._queue:
+            # crash containment: the collector must outlive any single
+            # batch-forming failure (thread-crash-containment rule) —
+            # account it and keep collecting
+            try:
+                if self._collect_once():
                     return
-                # accumulate: dispatch when the batch bound is reached, the
-                # deadline since the first item expires, or on shutdown —
-                # this is what makes device launches dense under load
-                deadline = time.monotonic() + self.deadline_s
-                while (
-                    not self._stop
-                    and len(self._queue) < self.max_batch
-                    and (remaining := deadline - time.monotonic()) > 0
-                ):
-                    self._cond.wait(remaining)
-                # respect the concurrent-batch bound before dispatching
-                while not self._stop and self._active >= self.max_active:
-                    self._cond.wait()
-                if self._stop and not self._queue:
-                    return
-                batch = [
-                    self._queue.popleft()
-                    for _ in range(min(self.max_batch, len(self._queue)))
-                ]
-                if not batch:
-                    continue
-                self._active += 1
+            except Exception:
+                self._count_daemon_failure("attestation-verifier")
+                with self._cond:
+                    if self._stop:
+                        return
+                time.sleep(0.01)
+
+    def _collect_once(self) -> bool:
+        """One accumulate→spawn round; True when the collector should
+        exit (stop() with an empty queue)."""
+        with self._cond:
+            # wait for the first item
+            while not self._stop and not self._queue:
+                self._cond.wait()
+            if self._stop and not self._queue:
+                return True
+            # accumulate: dispatch when the batch bound is reached, the
+            # deadline since the first item expires, or on shutdown —
+            # this is what makes device launches dense under load
+            deadline = time.monotonic() + self.deadline_s
+            while (
+                not self._stop
+                and len(self._queue) < self.max_batch
+                and (remaining := deadline - time.monotonic()) > 0
+            ):
+                self._cond.wait(remaining)
+            # respect the concurrent-batch bound before dispatching
+            while not self._stop and self._active >= self.max_active:
+                self._cond.wait()
+            if self._stop and not self._queue:
+                return True
+            batch = [
+                self._queue.popleft()
+                for _ in range(min(self.max_batch, len(self._queue)))
+            ]
+            if not batch:
+                return False
+            self._active += 1
+        try:
             self.controller.pool.spawn(
                 lambda b=batch: self._verify_batch(b), Priority.LOW
             )
+        except Exception:
+            # pool stopped / spawn failure: release the active slot so
+            # the collector cannot wedge on max_active
+            with self._cond:
+                self._active -= 1
+                self._cond.notify_all()
+            raise
+        return False
 
     # ------------------------------------------------------------- verify
 
@@ -237,14 +277,24 @@ class AttestationVerifier:
         if not prepared:
             return
         if self.use_device and self._completion is not None:
-            settle = self._device_dispatch(prepared)
-            if settle is not None:
-                # pipelined path: readback is deferred to the completion
-                # thread so this pool thread (and the collector behind it)
-                # can start the NEXT batch's host_prep while the device
-                # executes this one
-                self._enqueue_settle(settle, prepared)
-                return
+            if not self.health.allow_device():
+                # breaker OPEN: zero device dispatch attempts — straight
+                # to the host anchor below, no per-batch fault tax
+                self.stats["breaker_skips"] += 1
+            else:
+                try:
+                    settle = self._device_dispatch(prepared)
+                except Exception:
+                    self.health.record_fault("dispatch")
+                    # bounded transient retry: one immediate re-dispatch
+                    settle = self._retry_dispatch(prepared)
+                if settle is not None:
+                    # pipelined path: readback is deferred to the
+                    # completion thread so this pool thread (and the
+                    # collector behind it) can start the NEXT batch's
+                    # host_prep while the device executes this one
+                    self._enqueue_settle(settle, prepared)
+                    return
         messages = [p[0] for p in prepared]
         signatures = [p[1] for p in prepared]
         members = [p[2] for p in prepared]
@@ -278,6 +328,11 @@ class AttestationVerifier:
             self.metrics.att_fallbacks.inc()
         with self._stage("fallback", items=len(prepared)):
             good_items, bad_count = self._isolate(prepared)
+        if bad_count == 0:
+            # the batch verdict said "invalid" yet bisection cleared
+            # every item: a wrong-verdict device — file the fault kind
+            # only canary probes catch at re-promotion
+            self.health.record_fault("verdict")
         self.stats["accepted"] += len(good_items)
         self.stats["rejected"] += bad_count
         if good_items:
@@ -294,17 +349,8 @@ class AttestationVerifier:
         Returns a zero-arg settle callable producing the batch verdict, or
         None when the backend lacks the async seam (foreign backends keep
         the synchronous `_batch_check` path)."""
-        backend = self.backend
-        if backend is None:
-            from grandine_tpu.tpu.bls import TpuBlsBackend
-
-            backend = self.backend = TpuBlsBackend(
-                metrics=self.metrics, tracer=self.tracer
-            )
-        if not (
-            hasattr(backend, "fast_aggregate_verify_batch_async")
-            and hasattr(backend, "g2_subgroup_check_batch_async")
-        ):
+        backend = self._ensure_backend()
+        if not _health.has_async_seam(backend):
             return None
         messages = [p[0] for p in prepared]
         try:
@@ -346,6 +392,40 @@ class AttestationVerifier:
 
         return settle
 
+    def _ensure_backend(self):
+        """The verify backend, lazily building the real TpuBlsBackend
+        (which then also answers the supervisor's canary probes;
+        injected backends keep whatever probe the caller wired)."""
+        backend = self.backend
+        if backend is None:
+            from grandine_tpu.tpu.bls import TpuBlsBackend
+
+            backend = self.backend = TpuBlsBackend(
+                metrics=self.metrics, tracer=self.tracer
+            )
+            self.health.ensure_probe(_health.make_canary_probe(
+                backend, timeout_s=self.health.settle_timeout_s
+            ))
+        return backend
+
+    def _retry_dispatch(self, prepared):
+        """Bounded transient retry: ONE immediate re-dispatch after a
+        dispatch fault, breaker permitting."""
+        if not self.health.allow_device():
+            return None
+        self.stats["retries"] += 1
+        if self.metrics is not None:
+            self.metrics.verify_retry.inc(self.lane)
+        try:
+            return self._device_dispatch(prepared)
+        except Exception:
+            self.health.record_fault("dispatch")
+            return None
+
+    def _count_daemon_failure(self, thread: str) -> None:
+        if self.metrics is not None:
+            self.metrics.daemon_loop_failures.inc(thread)
+
     def _sync_registry(self, prepared):
         """Bring the registry up to date with the batch's head-state
         pubkey columns (identity hit when nothing changed); None → take
@@ -386,8 +466,7 @@ class AttestationVerifier:
             settle, prepared, span_ctx = item
             try:
                 with self.tracer.attach(span_ctx):
-                    ok = bool(settle())
-                    self._resolve_batch(prepared, ok)
+                    self._settle_one(settle, prepared)
             except Exception:
                 # the completion thread must survive backend faults; the
                 # batch is dropped (counted), not silently accepted
@@ -402,6 +481,35 @@ class AttestationVerifier:
                     self._cond.notify_all()
                 if self.metrics is not None:
                     self.metrics.verify_pipeline_depth.set(depth)
+
+    def _settle_one(self, settle, prepared) -> None:
+        """Force one batch verdict under the settle watchdog. A fault or
+        watchdog expiry files a breaker fault and DEGRADES the batch to a
+        fresh (breaker-gated device or host) re-check — honest votes are
+        never dropped on a backend hiccup."""
+        outcome = self.health.guard_settle(
+            settle, thread_name="attestation-settle-watchdog"
+        )
+        if outcome.status == _health.OK:
+            self.health.record_success()
+            self._resolve_batch(prepared, bool(outcome.value))
+            return
+        if outcome.status == _health.TIMEOUT:
+            # abandon the hung settle (its thread is an expendable
+            # daemon); the pipeline slot is released by the caller's
+            # finally, so backpressure clears immediately
+            if self.metrics is not None:
+                self.metrics.verify_watchdog_fired.inc(self.lane)
+            self.health.record_fault("watchdog")
+        else:
+            self.health.record_fault("settle")
+        self.stats["settle_errors"] = self.stats.get("settle_errors", 0) + 1
+        ok = self._batch_check(
+            [p[0] for p in prepared],
+            [p[1] for p in prepared],
+            [p[2] for p in prepared],
+        )
+        self._resolve_batch(prepared, ok)
 
     def _isolate(self, prepared):
         """Recursive bisection over a FAILED batch: re-check halves as
@@ -602,37 +710,22 @@ class AttestationVerifier:
         return set(prev_indices) & set(indices)
 
     def _batch_check(self, messages, signatures, members) -> bool:
-        if self.use_device:
-            backend = self.backend
-            if backend is None:
-                from grandine_tpu.tpu.bls import TpuBlsBackend
-
-                backend = self.backend = TpuBlsBackend(
-                    metrics=self.metrics, tracer=self.tracer
-                )
+        if self.use_device and self.health.allow_device():
             try:
-                # decompress WITHOUT the per-signature host subgroup
-                # scalar-mul (~9 ms each — it dominated batch latency);
-                # the device checks the whole batch in one ψ ladder.
-                # A failed batch falls to the singular path, which uses
-                # the fully-checked from_bytes and isolates the item.
-                with self._stage("host_prep", op="g2_decompress"):
-                    points = [
-                        A.g2_from_bytes(bytes(s), subgroup_check=False)
-                        for s in signatures
-                    ]
-            except A.BlsError:
-                return False
-            if any(p.is_infinity() for p in points):
-                return False
-            if not bool(backend.g2_subgroup_check_batch(points).all()):
-                return False
-            sigs = [A.Signature(p) for p in points]
-            if self.metrics is not None:
-                self.metrics.device_batch_sigs.inc(len(sigs))
-            return backend.fast_aggregate_verify_batch(messages, sigs, members)
-        # host anchor path (small batches / tests): all host work, so the
-        # whole check is the "execute" stage of this batch
+                ok = self._device_batch_check(messages, signatures, members)
+            except ValueError:
+                # crypto-malformed input (BlsError): the item's problem,
+                # not the device's — no breaker fault
+                raise
+            except Exception:
+                # device/runtime fault: feed the breaker, then PROPAGATE
+                # (see _isolate — honest votes are not silently rejected)
+                self.health.record_fault("settle")
+                raise
+            self.health.record_success()
+            return ok
+        # host anchor path (small batches / tests / breaker OPEN): all
+        # host work, so the whole check is the "execute" stage
         with self._stage("execute", path="host", items=len(messages)):
             try:
                 return all(
@@ -641,6 +734,30 @@ class AttestationVerifier:
                 )
             except A.BlsError:
                 return False
+
+    def _device_batch_check(self, messages, signatures, members) -> bool:
+        backend = self._ensure_backend()
+        try:
+            # decompress WITHOUT the per-signature host subgroup
+            # scalar-mul (~9 ms each — it dominated batch latency);
+            # the device checks the whole batch in one ψ ladder.
+            # A failed batch falls to the singular path, which uses
+            # the fully-checked from_bytes and isolates the item.
+            with self._stage("host_prep", op="g2_decompress"):
+                points = [
+                    A.g2_from_bytes(bytes(s), subgroup_check=False)
+                    for s in signatures
+                ]
+        except A.BlsError:
+            return False
+        if any(p.is_infinity() for p in points):
+            return False
+        if not bool(backend.g2_subgroup_check_batch(points).all()):
+            return False
+        sigs = [A.Signature(p) for p in points]
+        if self.metrics is not None:
+            self.metrics.device_batch_sigs.inc(len(sigs))
+        return backend.fast_aggregate_verify_batch(messages, sigs, members)
 
     # ------------------------------------------------------------ control
 

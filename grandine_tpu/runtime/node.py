@@ -39,9 +39,15 @@ class InProcessNode:
     ) -> None:
         from grandine_tpu.consensus.verifier import MultiVerifier
 
+        from grandine_tpu.runtime.health import BackendHealthSupervisor
+
         self.cfg = cfg
         self.metrics = metrics
         self.tracer = tracer
+        #: ONE health supervisor for the whole device verify plane: a
+        #: breaker fault observed by either the scheduler or the
+        #: attestation firehose quarantines the device for both
+        self.health = BackendHealthSupervisor(metrics=metrics)
         self.verify_scheduler = None
         if use_verify_scheduler:
             from grandine_tpu.runtime.verify_scheduler import VerifyScheduler
@@ -50,6 +56,7 @@ class InProcessNode:
                 use_device=use_device_firehose,
                 metrics=metrics,
                 tracer=tracer,
+                health=self.health,
             )
             if verifier_factory is None:
                 # block proposer-signature batches ride the HIGH lane
@@ -72,6 +79,7 @@ class InProcessNode:
             operation_pool=operation_pool,
             metrics=metrics,
             tracer=tracer,
+            health=self.health,
         )
         if (
             self.verify_scheduler is not None

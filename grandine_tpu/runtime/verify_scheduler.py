@@ -50,6 +50,7 @@ from grandine_tpu.consensus.verifier import (
     Verifier,
 )
 from grandine_tpu.crypto import bls as A
+from grandine_tpu.runtime import health as _health
 from grandine_tpu.runtime.thread_pool import Priority
 from grandine_tpu.tracing import NULL_TRACER
 
@@ -229,10 +230,21 @@ class VerifyScheduler:
         pipeline_depth: int = 2,
         metrics=None,
         tracer=None,
+        health: "Optional[_health.BackendHealthSupervisor]" = None,
+        settle_timeout_s: float = 5.0,
     ) -> None:
         self.metrics = metrics
         self.tracer = tracer or NULL_TRACER
         self.use_device = use_device
+        #: breaker + settle watchdog + canary gating; node.py shares one
+        #: supervisor with the attestation pipeline so a fault on either
+        #: plane quarantines the device for both
+        self.health = (
+            health if health is not None
+            else _health.BackendHealthSupervisor(
+                metrics=metrics, settle_timeout_s=settle_timeout_s
+            )
+        )
         #: a shared injected backend (tests: fault injection) or one
         #: lazily-built TpuBlsBackend per lane, so device stage spans
         #: attribute to the dispatching lane (kernels stay shared via
@@ -250,6 +262,7 @@ class VerifyScheduler:
             n: {
                 "submitted": 0, "batches": 0, "accepted": 0,
                 "rejected": 0, "shed": 0, "device_faults": 0,
+                "breaker_skips": 0, "retries": 0,
                 "max_batch_items": 0,
             }
             for n in self.lanes
@@ -360,37 +373,67 @@ class VerifyScheduler:
 
     def _dispatch_loop(self) -> None:
         while True:
+            # crash containment: one poisoned batch must not kill the
+            # dispatcher — resolve its tickets dropped, account the
+            # failure, keep scheduling (thread-crash-containment rule)
+            jobs: "list[_Job]" = []
+            try:
+                with self._cond:
+                    while not self._stop:
+                        name = self._pick_lane(time.monotonic())
+                        if name is not None:
+                            break
+                        self._cond.wait(
+                            self._nearest_deadline(time.monotonic())
+                        )
+                    if self._stop:
+                        # drain: everything still queued resolves
+                        # dropped=True — no result() caller hangs to its
+                        # full timeout during shutdown, and no verify
+                        # work runs against torn-down state
+                        to_drop = []
+                        for lname in self.lanes:
+                            q = self._queues[lname]
+                            to_drop.extend(q)
+                            q.clear()
+                            self._item_counts[lname] = 0
+                            self._set_depth(lname)
+                    else:
+                        to_drop = None
+                        lane = self.lanes[name]
+                        jobs = self._pop_batch(lane)
+                        # wake HIGH-lane submitters blocked on a full
+                        # queue
+                        self._cond.notify_all()
+                # decide from the state observed UNDER the lock:
+                # re-reading self._stop bare here could see a stop()
+                # that landed after the lock was released, with
+                # `to_drop` never built
+                if to_drop is not None:
+                    # tickets resolve outside _cond: a resolve callback
+                    # may re-enter the scheduler
+                    for job in to_drop:
+                        job.ticket._resolve(False, dropped=True)
+                    with self._cond:
+                        self._pending -= len(to_drop)
+                        self._cond.notify_all()
+                    return
+                if jobs:
+                    self._flush(lane, jobs)
+            except Exception:
+                self._count_daemon_failure("verify-scheduler")
+                self._abandon_jobs(jobs)
+
+    def _abandon_jobs(self, jobs: "list[_Job]") -> None:
+        """Containment cleanup: resolve a failed batch's unsettled
+        tickets dropped and release their flush barrier."""
+        undelivered = [j for j in jobs if not j.ticket.done()]
+        for job in undelivered:
+            job.ticket._resolve(False, dropped=True)
+        if undelivered:
             with self._cond:
-                while not self._stop:
-                    name = self._pick_lane(time.monotonic())
-                    if name is not None:
-                        break
-                    self._cond.wait(self._nearest_deadline(time.monotonic()))
-                if self._stop:
-                    # drain: settle everything still queued so no ticket
-                    # ever hangs past stop() (HIGH first, same as live)
-                    remaining = []
-                    for lane in sorted(
-                        self.lanes.values(), key=lambda l: int(l.priority)
-                    ):
-                        while self._queues[lane.name]:
-                            remaining.append((lane, self._pop_batch(lane)))
-                else:
-                    remaining = None
-                    lane = self.lanes[name]
-                    jobs = self._pop_batch(lane)
-                    # wake HIGH-lane submitters blocked on a full queue
-                    self._cond.notify_all()
-            # decide from the state observed UNDER the lock: re-reading
-            # self._stop bare here could see a stop() that landed after
-            # the lock was released, with `remaining` never built
-            if remaining is not None:
-                for lane, jobs in remaining:
-                    if jobs:
-                        self._flush(lane, jobs)
-                return
-            if jobs:
-                self._flush(lane, jobs)
+                self._pending -= len(undelivered)
+                self._cond.notify_all()
 
     # ------------------------------------------------------------- flush
 
@@ -420,6 +463,18 @@ class VerifyScheduler:
         if self.metrics is not None:
             self.metrics.verify_lane_dropped.labels(lane_name).inc()
 
+    def _count_watchdog(self, lane_name: str) -> None:
+        if self.metrics is not None:
+            self.metrics.verify_watchdog_fired.inc(lane_name)
+
+    def _count_retry(self, lane_name: str) -> None:
+        if self.metrics is not None:
+            self.metrics.verify_retry.inc(lane_name)
+
+    def _count_daemon_failure(self, thread: str) -> None:
+        if self.metrics is not None:
+            self.metrics.daemon_loop_failures.inc(thread)
+
     def _backend_for(self, lane: LaneConfig):
         if self._shared_backend is not None:
             return self._shared_backend
@@ -430,7 +485,28 @@ class VerifyScheduler:
             backend = self._backends[lane.name] = TpuBlsBackend(
                 metrics=self.metrics, tracer=self.tracer, lane=lane.name
             )
+            # the first real backend also answers canary probes for
+            # HALF_OPEN re-promotion (injected backends keep whatever
+            # probe the caller wired — tests drive their own canaries)
+            self.health.ensure_probe(_health.make_canary_probe(
+                backend, timeout_s=self.health.settle_timeout_s
+            ))
         return backend
+
+    def _retry_dispatch(self, lane: LaneConfig, items):
+        """Bounded transient retry: ONE immediate re-dispatch after a
+        dispatch/settle fault, breaker permitting. The retry's faults
+        feed the breaker but not the per-lane `device_faults` stat (the
+        batch's first failure already counted)."""
+        if not self.health.allow_device():
+            return None
+        self.stats[lane.name]["retries"] += 1
+        self._count_retry(lane.name)
+        try:
+            return self._device_dispatch(lane, items)
+        except Exception:
+            self.health.record_fault("dispatch")
+            return None
 
     def _flush(self, lane: LaneConfig, jobs: "list[_Job]") -> None:
         items = [it for j in jobs for it in j.items]
@@ -443,21 +519,34 @@ class VerifyScheduler:
         st["batches"] += 1
         st["max_batch_items"] = max(st["max_batch_items"], len(items))
         settle = None
+        device_allowed = False
         with self.tracer.span(
             "verify_lane_flush",
             {"lane": lane.name, "jobs": len(jobs), "items": len(items)},
         ):
             if self.use_device:
-                try:
-                    settle = self._device_dispatch(lane, items)
-                except Exception:
-                    st["device_faults"] += 1
-                    settle = None
+                device_allowed = self.health.allow_device()
+                if not device_allowed:
+                    # breaker OPEN: no per-batch device fault tax —
+                    # straight to the host path, zero dispatch attempts
+                    st["breaker_skips"] += 1
+                else:
+                    try:
+                        settle = self._device_dispatch(lane, items)
+                    except Exception:
+                        st["device_faults"] += 1
+                        self.health.record_fault("dispatch")
+                        # bounded transient retry: one immediate
+                        # re-dispatch before paying a full host pass
+                        settle = self._retry_dispatch(lane, items)
             if settle is None:
-                # graceful degradation: no device/async seam (or a
-                # faulted dispatch) → the eager host path, item by item
+                # graceful degradation: breaker-open, no device/async
+                # seam, or a faulted dispatch → the eager host path
                 if self.use_device:
-                    self._count_batch(lane, "degraded")
+                    self._count_batch(
+                        lane,
+                        "degraded" if device_allowed else "breaker_open",
+                    )
                 verdicts = self._host_check_all(lane, items)
                 if not self.use_device:
                     self._count_batch(
@@ -580,25 +669,59 @@ class VerifyScheduler:
             finally:
                 self._sem.release()
 
-    def _settle_batch(self, lane, jobs, items, settle) -> None:
-        try:
-            ok = bool(settle())
-        except Exception:
-            # device fault at readback: degrade to the host path
+    def _guarded_settle(self, lane: LaneConfig, settle,
+                        count_stats: bool = True) -> "_health.SettleOutcome":
+        """One watchdog-bounded settle with breaker accounting: OK
+        records a success; a fault or watchdog expiry files the breaker
+        fault (and, for the batch's FIRST failure, the per-lane stat)."""
+        outcome = self.health.guard_settle(settle)
+        if outcome.status == _health.OK:
+            self.health.record_success()
+            return outcome
+        if outcome.status == _health.TIMEOUT:
+            # abandon the hung settle: its daemon thread is expendable,
+            # the pipeline slot is released by _complete's finally
+            self._count_watchdog(lane.name)
+            self.health.record_fault("watchdog")
+        else:
+            self.health.record_fault("settle")
+        if count_stats:
             self.stats[lane.name]["device_faults"] += 1
+        return outcome
+
+    def _settle_batch(self, lane, jobs, items, settle) -> None:
+        outcome = self._guarded_settle(lane, settle)
+        if outcome.status == _health.FAULT:
+            # fast fault: one bounded re-dispatch before degrading. A
+            # TIMEOUT never retries — the ticket already spent its
+            # watchdog budget, the host pass must start now.
+            retry = self._retry_dispatch(lane, items)
+            if retry is not None:
+                outcome = self._guarded_settle(lane, retry,
+                                               count_stats=False)
+        if outcome.status != _health.OK:
             self._count_batch(lane, "degraded")
             self._deliver(lane, jobs, self._host_check_all(lane, items))
             return
-        if ok:
+        if bool(outcome.value):
             self._count_batch(lane, "ok")
             self._deliver(lane, jobs, [True] * len(items))
             return
         with self._stage(lane, "fallback", items=len(items)):
-            verdicts = self._isolate(lane, list(items))
+            # the bisection shares ONE watchdog budget so a failed
+            # batch still meets the deadline + one-host-pass bound
+            deadline = time.monotonic() + self.health.settle_timeout_s
+            verdicts = self._isolate(lane, list(items), deadline)
+        if verdicts and all(verdicts):
+            # device said "invalid", host verified every item: a
+            # wrong-verdict device — the fault kind only canary probes
+            # catch at re-promotion time
+            self.health.record_fault("verdict")
         self._count_batch(lane, "ok" if all(verdicts) else "invalid")
         self._deliver(lane, jobs, verdicts)
 
-    def _isolate(self, lane: LaneConfig, items) -> "list[bool]":
+    def _isolate(self, lane: LaneConfig, items,
+                 deadline: "Optional[float]" = None) -> "list[bool]":
         """Recursive bisection of a failed batch — batch-check halves,
         descend only into failing halves, SingleVerifier at the leaf —
         so k bad items cost O(k·log n) checks, not n."""
@@ -608,20 +731,43 @@ class VerifyScheduler:
         out: "list[bool]" = []
         for half in (items[:mid], items[mid:]):
             try:
-                ok = self._batch_check(lane, half)
+                ok = self._batch_check(lane, half, deadline)
             except Exception:
                 self.stats[lane.name]["device_faults"] += 1
                 ok = False  # descend; leaves verify on the host
             out.extend(
-                [True] * len(half) if ok else self._isolate(lane, half)
+                [True] * len(half)
+                if ok else self._isolate(lane, half, deadline)
             )
         return out
 
-    def _batch_check(self, lane: LaneConfig, items) -> bool:
-        if self.use_device:
-            settle = self._device_dispatch(lane, items)
-            if settle is not None:
-                return bool(settle())
+    def _batch_check(self, lane: LaneConfig, items,
+                     deadline: "Optional[float]" = None) -> bool:
+        """Bisection probe of one half: device when the breaker allows
+        and the shared time budget has room, host otherwise."""
+        if self.use_device and self.health.allow_device():
+            budget = self.health.settle_timeout_s
+            if deadline is not None:
+                budget = min(budget, deadline - time.monotonic())
+            if budget > 0:
+                try:
+                    settle = self._device_dispatch(lane, items)
+                except Exception:
+                    self.health.record_fault("dispatch")
+                    raise
+                if settle is not None:
+                    outcome = self.health.guard_settle(
+                        settle, timeout_s=budget
+                    )
+                    if outcome.status == _health.OK:
+                        self.health.record_success()
+                        return bool(outcome.value)
+                    if outcome.status == _health.TIMEOUT:
+                        self._count_watchdog(lane.name)
+                        self.health.record_fault("watchdog")
+                    else:
+                        self.health.record_fault("settle")
+                    # fall through: host verdict for this half
         return all(host_check_item(it) for it in items)
 
     def _host_check_all(self, lane: LaneConfig, items) -> "list[bool]":
@@ -643,18 +789,24 @@ class VerifyScheduler:
 
     # ----------------------------------------------------------- control
 
+    def device_degraded(self) -> bool:
+        """True while the device plane is quarantined (breaker not
+        CLOSED) — lets gossip shed accounting (p2p/network.py) tell
+        overload-under-degradation from plain overload."""
+        return self.use_device and self.health.state != _health.CLOSED
+
     def flush(self, timeout: float = 30.0) -> None:
-        """Test barrier: wait until every submitted job has settled."""
+        """Test barrier: wait until every submitted job has settled.
+        Condition-variable wait, no polling: every _pending decrement
+        (_deliver, stop-drain, containment) notifies _cond."""
         deadline = time.monotonic() + timeout
         with self._cond:
-            self._cond.notify_all()
-        while time.monotonic() < deadline:
-            with self._cond:
-                if self._pending == 0:
-                    return
-                self._cond.notify_all()
-            time.sleep(0.005)
-        raise TimeoutError("verify scheduler did not drain")
+            self._cond.notify_all()  # nudge the dispatcher awake
+            while self._pending != 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError("verify scheduler did not drain")
+                self._cond.wait(remaining)
 
     def stop(self) -> None:
         with self._cond:

@@ -1,0 +1,1 @@
+"""Deterministic test/bench instrumentation for the verify plane."""

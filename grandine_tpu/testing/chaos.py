@@ -1,0 +1,198 @@
+"""Seeded fault injection over the async device seam.
+
+`ChaosBackend` wraps any backend implementing the verify plane's async
+seam (health.REQUIRED_SEAM_METHODS) and injects faults scheduled by a
+deterministic `FaultPlan` — the same seed always produces the same
+fault sequence, so a chaos soak is a reproducible test, not a flake
+generator. Five fault kinds, matching the real failure modes the health
+supervisor defends against:
+
+  raise_dispatch — the seam call itself raises (XLA compile/transfer
+      error at dispatch time)
+  raise_settle   — dispatch succeeds, the returned settle raises
+      (readback fault)
+  hang           — the settle blocks until released (wedged device);
+      pairs with the settle watchdog, released at teardown via
+      `release_hangs()` so abandoned threads don't linger
+  wrong_verdict  — dispatch and settle succeed but the verdict is
+      INVERTED (silently corrupt accelerator) — the kind only canary
+      probes and host bisection can catch
+  slow_settle    — the settle sleeps before answering (degraded link);
+      must NOT trip the breaker when within the watchdog deadline
+
+`KnownAnswerBackend` is the truth-table stub used underneath the chaos
+wrapper by tests and `bench.py --chaos`: verdicts come from a dict
+keyed by message bytes, so the fault-free expectation is known exactly.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Optional, Sequence
+
+import numpy as np
+
+from grandine_tpu.runtime.health import REQUIRED_SEAM_METHODS
+
+#: injectable fault kinds, in plan-draw order
+FAULT_KINDS = (
+    "raise_dispatch",
+    "raise_settle",
+    "hang",
+    "wrong_verdict",
+    "slow_settle",
+)
+
+
+class ChaosFault(RuntimeError):
+    """The injected failure (distinguishable from real bugs in logs)."""
+
+
+class FaultPlan:
+    """Deterministic fault schedule over seam calls.
+
+    Either scripted — `script[i]` is the fault kind (or None) for the
+    i-th seam call, with calls past the end of the script fault-free —
+    or rate-driven: per-call, one seeded uniform draw selects a fault
+    kind by cumulative `rates` (mapping kind -> probability; the
+    remainder is fault-free). `injected` counts draws per kind."""
+
+    def __init__(self, seed: int = 0,
+                 rates: "Optional[dict]" = None,
+                 script: "Optional[Sequence[Optional[str]]]" = None) -> None:
+        self.rng = random.Random(seed)
+        self.rates = dict(rates or {})
+        for kind in self.rates:
+            if kind not in FAULT_KINDS:
+                raise ValueError(f"unknown fault kind {kind!r}")
+        self.script = list(script) if script is not None else None
+        self.calls = 0
+        self.injected = {k: 0 for k in FAULT_KINDS}
+        self._lock = threading.Lock()
+
+    def next_fault(self) -> "Optional[str]":
+        with self._lock:
+            i = self.calls
+            self.calls += 1
+            if self.script is not None:
+                kind = self.script[i] if i < len(self.script) else None
+            else:
+                draw = self.rng.random()
+                kind = None
+                edge = 0.0
+                for k in FAULT_KINDS:
+                    edge += self.rates.get(k, 0.0)
+                    if draw < edge:
+                        kind = k
+                        break
+            if kind is not None:
+                self.injected[kind] += 1
+            return kind
+
+
+class ChaosBackend:
+    """Async-seam wrapper injecting `plan`-scheduled faults around an
+    inner backend. Everything else delegates to the inner backend via
+    `__getattr__`, so the wrapper is transparent to registry/tracer
+    plumbing."""
+
+    def __init__(self, inner, plan: FaultPlan, slow_s: float = 0.05) -> None:
+        assert all(hasattr(inner, m) for m in REQUIRED_SEAM_METHODS)
+        self.inner = inner
+        self.plan = plan
+        self.slow_s = float(slow_s)
+        self.dispatches = 0  # seam calls that reached past the breaker
+        self._lock = threading.Lock()
+        self._hung: "list[threading.Event]" = []
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def release_hangs(self) -> None:
+        """Unblock every injected hang (teardown: lets abandoned
+        watchdog threads finish instead of sleeping forever)."""
+        with self._lock:
+            hung, self._hung = self._hung, []
+        for ev in hung:
+            ev.set()
+
+    # ------------------------------------------------------ seam wrapping
+
+    def _wrap(self, method: str, invert, args):
+        with self._lock:
+            self.dispatches += 1
+        kind = self.plan.next_fault()
+        if kind == "raise_dispatch":
+            raise ChaosFault(f"injected dispatch fault on {method}")
+        inner_settle = getattr(self.inner, method)(*args)
+
+        def settle():
+            if kind == "raise_settle":
+                raise ChaosFault(f"injected settle fault on {method}")
+            if kind == "hang":
+                ev = threading.Event()
+                with self._lock:
+                    self._hung.append(ev)
+                ev.wait()
+                raise ChaosFault(f"released injected hang on {method}")
+            if kind == "slow_settle":
+                time.sleep(self.slow_s)
+            value = inner_settle()
+            if kind == "wrong_verdict":
+                return invert(value)
+            return value
+
+        return settle
+
+    def fast_aggregate_verify_batch_async(self, messages, signatures, keys):
+        return self._wrap(
+            "fast_aggregate_verify_batch_async",
+            lambda v: not v,
+            (messages, signatures, keys),
+        )
+
+    def fast_aggregate_verify_batch_indexed_async(self, messages, signatures,
+                                                  indices, registry):
+        return self._wrap(
+            "fast_aggregate_verify_batch_indexed_async",
+            lambda v: not v,
+            (messages, signatures, indices, registry),
+        )
+
+    def g2_subgroup_check_batch_async(self, points):
+        return self._wrap(
+            "g2_subgroup_check_batch_async",
+            lambda arr: ~np.asarray(arr),
+            (points,),
+        )
+
+
+class KnownAnswerBackend:
+    """Truth-table async seam: the batch verdict is the AND of
+    `truth[message_bytes]` over the batch (missing messages are
+    invalid). Subgroup checks always pass — signature geometry is not
+    under test here, verdict plumbing is."""
+
+    def __init__(self, truth: "Optional[dict]" = None) -> None:
+        self.truth = dict(truth or {})
+        self.batches: "list[int]" = []
+
+    def g2_subgroup_check_batch_async(self, points):
+        n = len(points)
+        return lambda: np.ones((n,), dtype=bool)
+
+    def fast_aggregate_verify_batch_async(self, messages, signatures, keys):
+        self.batches.append(len(messages))
+        msgs = [bytes(m) for m in messages]
+        return lambda: all(self.truth.get(m, False) for m in msgs)
+
+
+__all__ = [
+    "FAULT_KINDS",
+    "ChaosBackend",
+    "ChaosFault",
+    "FaultPlan",
+    "KnownAnswerBackend",
+]

@@ -1055,6 +1055,15 @@ class TpuBlsBackend:
     fast_aggregate_verify — same edge-case semantics (empty batch, identity
     pubkeys), differential-tested against the anchor."""
 
+    #: the async verify seam the runtime dispatches through (the first
+    #: two are what runtime/health.py's REQUIRED_SEAM_METHODS detects;
+    #: fault injection wraps exactly these — testing/chaos.ChaosBackend)
+    ASYNC_SEAM = (
+        "fast_aggregate_verify_batch_async",
+        "g2_subgroup_check_batch_async",
+        "fast_aggregate_verify_batch_indexed_async",
+    )
+
     def __init__(self, metrics=None, tracer=None,
                  lane: str = "attestation") -> None:
         #: observability seams (wired by runtime/attestation_verifier):

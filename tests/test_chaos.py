@@ -1,0 +1,307 @@
+"""Seeded chaos soak + breaker lifecycle tests for the verify plane's
+health supervisor (runtime/health.py, testing/chaos.py).
+
+The device is a truth-table stub (`KnownAnswerBackend`) wrapped in a
+seeded `ChaosBackend`, and the host path answers from the same truth
+table — so the fault-free expectation for every ticket is exact, and
+any verdict divergence under injected faults is a supervisor bug, not
+test noise."""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+import pytest
+
+from grandine_tpu.crypto import bls as A
+from grandine_tpu.metrics import Metrics
+from grandine_tpu.runtime import health as _health
+from grandine_tpu.runtime import verify_scheduler as vs
+from grandine_tpu.testing.chaos import (
+    ChaosBackend,
+    FAULT_KINDS,
+    FaultPlan,
+    KnownAnswerBackend,
+)
+from grandine_tpu.transition.genesis import interop_secret_key
+
+# one REAL signature reused everywhere: scheduler host prep decompresses
+# each item's signature bytes (and rejects infinity); verdicts come from
+# the truth table, not the crypto
+_SK = interop_secret_key(0)
+_SIG_BYTES = _SK.sign(b"chaos-test").to_bytes()
+_PK = _SK.public_key()
+
+_GOOD_CANARY = b"canary-good" + b"\x00" * 21
+_BAD_CANARY = b"canary-bad" + b"\x00" * 22
+
+
+def _canary_specimens():
+    sig = A.Signature(A.g2_from_bytes(_SIG_BYTES, subgroup_check=False))
+    return [
+        _health.CanarySpecimen(_GOOD_CANARY, sig, [_PK], expected=True),
+        _health.CanarySpecimen(_BAD_CANARY, sig, [_PK], expected=False),
+    ]
+
+
+def _make_plane(truth, plan, monkeypatch, metrics=None,
+                settle_timeout_s=0.2, backoff_initial_s=0.05,
+                backoff_max_s=0.2, window=16):
+    """ChaosBackend over a truth table + supervisor + scheduler, with
+    the host path answering from the same truth table."""
+    truth = dict(truth)
+    truth[_GOOD_CANARY] = True  # _BAD_CANARY absent -> False
+    chaos = ChaosBackend(KnownAnswerBackend(truth), plan, slow_s=0.02)
+    sup = _health.BackendHealthSupervisor(
+        metrics=metrics,
+        settle_timeout_s=settle_timeout_s,
+        probe=_health.make_canary_probe(
+            chaos, _canary_specimens(), timeout_s=settle_timeout_s
+        ),
+        backoff_initial_s=backoff_initial_s,
+        backoff_max_s=backoff_max_s,
+        window=window,
+        rng=random.Random(3),
+    )
+    sched = vs.VerifyScheduler(
+        backend=chaos, use_device=True, health=sup, metrics=metrics
+    )
+    monkeypatch.setattr(
+        vs, "host_check_item",
+        lambda item: truth.get(bytes(item.message), False),
+    )
+    return chaos, sup, sched
+
+
+def _item(message: bytes) -> vs.VerifyItem:
+    return vs.VerifyItem(message, _SIG_BYTES, public_keys=(_PK,))
+
+
+@pytest.mark.parametrize("seed", [1, 7, 42])
+def test_chaos_soak_verdicts_match_fault_free(monkeypatch, seed):
+    """Under a seeded mix of ALL five fault kinds, every ticket settles
+    with exactly the verdict a fault-free run would produce, within the
+    watchdog+host-pass latency bound, and no scheduler daemon dies.
+
+    The truth table is all-valid: a `wrong_verdict` flip can then only
+    turn valid→invalid, which host bisection corrects. (The converse —
+    a silently-corrupt device validating a truly-invalid batch — is
+    exactly the failure no per-batch check can catch; the canary test
+    below shows the breaker quarantining such a device instead.)"""
+    rng = random.Random(seed)
+    messages = [b"soak-%03d" % i + b"\x00" * 23 for i in range(32)]
+    truth = {m: True for m in messages}
+    plan = FaultPlan(seed=seed, rates={k: 0.06 for k in FAULT_KINDS})
+    chaos, sup, sched = _make_plane(truth, plan, monkeypatch)
+
+    tickets = []
+    try:
+        for _ in range(120):
+            lane = "sync_message" if rng.random() < 0.7 else "block"
+            msgs = [rng.choice(messages)
+                    for _ in range(rng.randrange(1, 4))]
+            expected = all(truth[m] for m in msgs)
+            tickets.append(
+                (sched.submit(lane, [_item(m) for m in msgs]), expected)
+            )
+        sched.flush(60.0)
+        # no daemon thread died along the way
+        assert sched._dispatcher.is_alive()
+        assert sched._completion_thread.is_alive()
+    finally:
+        sched.stop()
+        chaos.release_hangs()
+
+    assert sum(plan.injected.values()) > 0, "soak injected nothing"
+    for tk, expected in tickets:
+        assert tk.done() and not tk.dropped
+        assert tk.ok is expected, (
+            f"verdict diverged from fault-free run (seed={seed})"
+        )
+        # watchdog bound: deadline (0.2s) + retry + host pass + slack —
+        # never the unbounded hang the `hang` fault injects
+        assert tk.settled_at - tk.enqueued_at < 10.0
+
+
+def test_chaos_soak_preserves_rejections_without_verdict_faults(monkeypatch):
+    """With invalid items in the mix and every fault kind EXCEPT
+    wrong_verdict injected, rejections survive degradation exactly:
+    raise/hang/slow faults only reroute to the host path, which shares
+    the truth table."""
+    rng = random.Random(5)
+    truth = {}
+    messages = []
+    for i in range(24):
+        m = b"rej-%03d" % i + b"\x00" * 24
+        truth[m] = rng.random() >= 0.3  # ~30% invalid
+        messages.append(m)
+    plan = FaultPlan(seed=5, rates={
+        "raise_dispatch": 0.08, "raise_settle": 0.08,
+        "hang": 0.06, "slow_settle": 0.08,
+    })
+    chaos, sup, sched = _make_plane(truth, plan, monkeypatch)
+
+    tickets = []
+    try:
+        for _ in range(80):
+            msgs = [rng.choice(messages) for _ in range(rng.randrange(1, 3))]
+            tickets.append((
+                sched.submit("sync_message", [_item(m) for m in msgs]),
+                all(truth[m] for m in msgs),
+            ))
+        sched.flush(60.0)
+    finally:
+        sched.stop()
+        chaos.release_hangs()
+
+    assert sum(plan.injected.values()) > 0
+    assert any(not expected for _, expected in tickets)  # mix has rejects
+    for tk, expected in tickets:
+        assert tk.done() and not tk.dropped and tk.ok is expected
+
+
+def test_breaker_full_traversal_closed_open_half_open_closed(monkeypatch):
+    """Scripted settle faults walk the breaker CLOSED → OPEN; after the
+    backoff a passing canary probe re-promotes HALF_OPEN → CLOSED. The
+    labeled metrics record every transition."""
+    m = Metrics()
+    truth = {b"msg-a" + b"\x00" * 27: True}
+    (msg,) = truth
+    # batch1: dispatch(2 calls) faults, its retry(2 calls) faults;
+    # batch2: dispatch(2 calls) faults -> 3rd consecutive -> OPEN
+    # (its retry is breaker-blocked). Calls past the script are clean.
+    plan = FaultPlan(script=["raise_settle"] * 6)
+    chaos, sup, sched = _make_plane(truth, plan, monkeypatch, metrics=m)
+
+    try:
+        assert sup.state == _health.CLOSED
+        t1 = sched.submit("block", [_item(msg)])
+        sched.flush(30.0)
+        assert t1.ok is True  # degraded to host, not dropped
+        assert sup.state == _health.CLOSED  # 2 faults < threshold 3
+        t2 = sched.submit("block", [_item(msg)])
+        sched.flush(30.0)
+        assert t2.ok is True
+        assert sup.state == _health.OPEN
+        assert sup.breaker.stats["opens"] == 1
+        assert sup.breaker.stats["faults"]["settle"] == 3
+        assert sched.stats["block"]["retries"] == 1  # batch2's was blocked
+
+        # while OPEN (inside backoff): zero device dispatch attempts
+        before = chaos.dispatches
+        t3 = sched.submit("block", [_item(msg)])
+        sched.flush(30.0)
+        assert t3.ok is True
+        assert chaos.dispatches == before
+        assert sched.stats["block"]["breaker_skips"] >= 1
+
+        # past the backoff: HALF_OPEN, canary passes (script exhausted),
+        # breaker re-closes and the batch dispatches on-device again
+        time.sleep(0.3)
+        t4 = sched.submit("block", [_item(msg)])
+        sched.flush(30.0)
+        assert t4.ok is True
+        assert sup.state == _health.CLOSED
+        assert chaos.dispatches > before  # probe + real dispatch
+        br = sup.breaker.stats
+        assert br["closes"] == 1 and br["probes_passed"] == 1
+        assert m.verify_breaker_transitions.value("device", "open") == 1
+        assert m.verify_breaker_transitions.value("device", "half_open") == 1
+        assert m.verify_breaker_transitions.value("device", "closed") == 1
+        assert m.verify_breaker_state.value("device") == 0
+        assert m.verify_canary_probes.value("device", "pass") == 1
+    finally:
+        sched.stop()
+        chaos.release_hangs()
+
+
+def test_wrong_verdict_device_fails_canary_and_stays_open(monkeypatch):
+    """A device that RAISES nothing but inverts verdicts: host bisection
+    contradicts it (verdict faults open the breaker), and at re-promotion
+    time the canary's known answers catch the inversion — the breaker
+    stays OPEN and per-batch dispatch attempts stay at zero."""
+    m = Metrics()
+    truth = {b"msg-b" + b"\x00" * 27: True}
+    (msg,) = truth
+    plan = FaultPlan(seed=0, rates={"wrong_verdict": 1.0})
+    # each batch records one settle SUCCESS (the inverted settle raises
+    # nothing) then one verdict FAULT, so the consecutive counter never
+    # reaches the threshold — the RATE path must open the breaker: with
+    # window=4, two batches fill it at a 0.5 fault rate
+    chaos, sup, sched = _make_plane(
+        truth, plan, monkeypatch, metrics=m, window=4
+    )
+
+    try:
+        # each single-item batch: device says False, host bisection says
+        # True -> one "verdict" breaker fault
+        tickets = [None] * 2
+        for i in range(2):
+            tickets[i] = sched.submit("block", [_item(msg)])
+            sched.flush(30.0)
+        assert all(t.ok is True for t in tickets)  # host verdict wins
+        assert sup.state == _health.OPEN
+        assert sup.breaker.stats["faults"]["verdict"] == 2
+
+        # inside the backoff window: no probe, no dispatch
+        before = chaos.dispatches
+        t = sched.submit("block", [_item(msg)])
+        sched.flush(30.0)
+        assert t.ok is True and chaos.dispatches == before
+        assert sched.stats["block"]["breaker_skips"] >= 1
+
+        # past the backoff: the canary probe runs — the inverted good
+        # specimen fails it, so the device stays quarantined and the
+        # batch itself never dispatches
+        time.sleep(0.3)
+        probe_calls_before = chaos.dispatches
+        t = sched.submit("block", [_item(msg)])
+        sched.flush(30.0)
+        assert t.ok is True
+        assert sup.state == _health.OPEN
+        assert sup.breaker.stats["probes_failed"] >= 1
+        assert m.verify_canary_probes.value("device", "fail") >= 1
+        # only the probe touched the seam (1 specimen call — run_canary
+        # stops at the first wrong answer), never a batch dispatch
+        assert chaos.dispatches - probe_calls_before <= 2
+        assert m.verify_breaker_state.value("device") == 1
+    finally:
+        sched.stop()
+        chaos.release_hangs()
+
+
+def test_fault_plan_is_deterministic():
+    """Same seed, same fault schedule — the soak is reproducible."""
+    a = FaultPlan(seed=9, rates={k: 0.1 for k in FAULT_KINDS})
+    b = FaultPlan(seed=9, rates={k: 0.1 for k in FAULT_KINDS})
+    seq_a = [a.next_fault() for _ in range(200)]
+    seq_b = [b.next_fault() for _ in range(200)]
+    assert seq_a == seq_b
+    assert a.injected == b.injected
+
+
+def test_scripted_plan_and_unknown_rate_validation():
+    plan = FaultPlan(script=["hang", None, "raise_dispatch"])
+    assert [plan.next_fault() for _ in range(5)] == [
+        "hang", None, "raise_dispatch", None, None,
+    ]
+    with pytest.raises(ValueError):
+        FaultPlan(rates={"nonsense": 0.5})
+
+
+def test_watchdog_abandons_hung_settle():
+    """run_with_deadline returns TIMEOUT promptly and leaves the hung
+    settle on an expendable daemon thread."""
+    release = threading.Event()
+
+    def hung():
+        release.wait()
+        return True
+
+    t0 = time.monotonic()
+    outcome = _health.run_with_deadline(hung, 0.1, "test-watchdog")
+    assert outcome.status == _health.TIMEOUT
+    assert time.monotonic() - t0 < 2.0
+    release.set()

@@ -220,6 +220,78 @@ class Network:
 """, "no-inline-gossip-verify") == 1
 
 
+def test_thread_crash_containment_flags_uncontained_loop(tmp_path):
+    assert lint(tmp_path, """
+import threading
+
+class Sched:
+    def __init__(self):
+        self._t = threading.Thread(target=self._dispatch, daemon=True)
+
+    def _dispatch(self):
+        while True:
+            self.step()  # an exception here kills the daemon silently
+""", "thread-crash-containment") == 1
+
+
+def test_thread_crash_containment_narrow_handler_still_flags(tmp_path):
+    """A narrow per-iteration handler is not containment — anything
+    outside (ValueError, KeyError) still kills the thread."""
+    assert lint(tmp_path, """
+import threading
+
+class Sched:
+    def __init__(self):
+        self._t = threading.Thread(target=self._dispatch, daemon=True)
+
+    def _dispatch(self):
+        while True:
+            try:
+                self.step()
+            except (ValueError, KeyError):
+                pass
+""", "thread-crash-containment") == 1
+
+
+def test_thread_crash_containment_allows_contained_loop(tmp_path):
+    """The sanctioned idiom (_dispatch_loop / _collect): a direct-child
+    broad try per iteration."""
+    assert lint(tmp_path, """
+import threading
+
+class Sched:
+    def __init__(self):
+        self._t = threading.Thread(target=self._dispatch, daemon=True)
+
+    def _dispatch(self):
+        while True:
+            try:
+                self.step()
+            except Exception:
+                self.count_failure()
+""", "thread-crash-containment") == 0
+
+
+def test_thread_crash_containment_ignores_for_loops_and_nonthreads(tmp_path):
+    """Bounded for-loops end on their own; a while loop in a plain
+    (non-thread-target) function is not a daemon hazard."""
+    assert lint(tmp_path, """
+import threading
+
+def warm_all(progress=None):
+    for kind in ("a", "b"):
+        compile(kind)
+
+def helper():
+    while True:
+        step()
+
+class W:
+    def __init__(self):
+        self._t = threading.Thread(target=warm_all, daemon=True)
+""", "thread-crash-containment") == 0
+
+
 # ------------------------------------------------ suppression + baseline
 
 

@@ -247,7 +247,14 @@ def run(
                 res.baselined.append(f)
             else:
                 res.new.append(f)
-    res.stale_baseline = sorted(k for k in baseline if k not in seen_keys)
+    # A baseline entry is only stale when its owning rule actually ran
+    # this invocation; restricted-rule runs (e.g. the CI gossip guard)
+    # must not flag other rules' grandfathered findings.
+    active = set(res.checked_rules)
+    res.stale_baseline = sorted(
+        k for k in baseline
+        if k not in seen_keys and k.split(":", 1)[0] in active
+    )
 
     for f in sorted(res.new, key=lambda f: (f.path, f.line)):
         print(f"FAIL: {f.render()}", file=err)

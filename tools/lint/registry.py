@@ -13,6 +13,9 @@ def all_rules():
         NoInlineGossipVerifyRule,
     )
     from tools.lint.rules.no_per_batch_upload import NoPerBatchUploadRule
+    from tools.lint.rules.thread_crash_containment import (
+        ThreadCrashContainmentRule,
+    )
 
     return [
         NoInlineGossipVerifyRule(),
@@ -21,4 +24,5 @@ def all_rules():
         MetricsCardinalityRule(),
         JitPurityRule(),
         NoPerBatchUploadRule(),
+        ThreadCrashContainmentRule(),
     ]

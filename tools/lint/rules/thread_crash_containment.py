@@ -1,0 +1,128 @@
+"""Rule: daemon-thread loops must contain their own crashes.
+
+A `threading.Thread(target=...)` loop that lets an exception escape
+dies SILENTLY — daemon threads take their subsystem down (the
+dispatcher stops dispatching, the collector stops collecting) with no
+traceback on the main thread and no metric. The verify plane's
+containment idiom (verify_scheduler._dispatch_loop,
+attestation_verifier._collect) is:
+
+    while True:
+        try:
+            ... one iteration ...
+        except Exception:
+            account the failure (daemon_loop_failures_total), clean up,
+            keep looping (or return deliberately)
+
+This rule resolves every `threading.Thread(target=f)` target (bound
+method `self.f` or local function `f`) against the file's function
+defs, and flags any `while` loop sitting DIRECTLY in a target's body
+whose own body lacks a DIRECT-child `try` with a broad handler (bare
+`except`, `except Exception`, or `except BaseException`, tuples
+included). Loops nested deeper (already inside a try, or inside a
+`with`) and `for` loops (bounded — they end) are not the hazard this
+rule is about and are not flagged.
+
+Finding keys are line-free (`rule:path:funcname`) so the baseline
+survives unrelated edits.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint.core import Context, Finding, Rule, dotted, walk_functions
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _thread_target_name(call: ast.Call) -> "str | None":
+    """'f' from `threading.Thread(target=self.f|f, ...)`, else None."""
+    name = dotted(call.func)
+    if name is None or name.rsplit(".", 1)[-1] != "Thread":
+        return None
+    for kw in call.keywords:
+        if kw.arg != "target":
+            continue
+        v = kw.value
+        if (
+            isinstance(v, ast.Attribute)
+            and isinstance(v.value, ast.Name)
+            and v.value.id == "self"
+        ):
+            return v.attr
+        if isinstance(v, ast.Name):
+            return v.id
+    return None
+
+
+def _is_broad_handler(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:  # bare except
+        return True
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    for e in elts:
+        name = dotted(e)
+        if name is not None and name.rsplit(".", 1)[-1] in _BROAD:
+            return True
+    return False
+
+
+def _loop_is_contained(loop: ast.While) -> bool:
+    """True when the loop body carries a direct-child try with a broad
+    handler — one poisoned iteration cannot escape the loop."""
+    return any(
+        isinstance(stmt, ast.Try)
+        and any(_is_broad_handler(h) for h in stmt.handlers)
+        for stmt in loop.body
+    )
+
+
+class ThreadCrashContainmentRule(Rule):
+    name = "thread-crash-containment"
+    description = (
+        "threading.Thread target loops must catch broadly per iteration "
+        "— an escaping exception kills the daemon thread silently"
+    )
+    default_paths = (
+        "grandine_tpu/runtime/verify_scheduler.py",
+        "grandine_tpu/runtime/attestation_verifier.py",
+        "grandine_tpu/runtime/thread_pool.py",
+        "grandine_tpu/runtime/controller.py",
+        "grandine_tpu/runtime/health.py",
+        "grandine_tpu/metrics.py",
+    )
+
+    def check(self, ctx: Context, files):
+        out: "list[Finding]" = []
+        for path in files:
+            tree = ctx.tree(path)
+            if tree is None:
+                continue
+            targets: "set[str]" = set()
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Call):
+                    name = _thread_target_name(node)
+                    if name is not None:
+                        targets.add(name)
+            if not targets:
+                continue
+            for _cls, fn in walk_functions(tree):
+                if fn.name not in targets:
+                    continue
+                for stmt in fn.body:
+                    if isinstance(stmt, ast.While) and not (
+                        _loop_is_contained(stmt)
+                    ):
+                        out.append(Finding(
+                            self.name, path, stmt.lineno,
+                            f"thread target {fn.name} loops with no "
+                            f"broad per-iteration try/except — one "
+                            f"uncaught exception kills this daemon "
+                            f"thread silently (wrap the iteration in "
+                            f"try/except Exception and account the "
+                            f"failure on daemon_loop_failures_total)",
+                            key=f"{self.name}:{path}:{fn.name}",
+                        ))
+                        break  # one finding per target function
+        return out
