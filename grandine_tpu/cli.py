@@ -216,12 +216,20 @@ def _node_once(args, cfg) -> int:
         metrics=metrics, tracer=tracer,
     )
     if args.use_device and not getattr(args, "no_warm", False):
-        # precompile the kernel bucket manifest in the background while
+        # precompile the kernel shape manifest in the background while
         # the node syncs — an uncompiled bucket mid-chain stalls
-        # verification for the whole compile (runtime/warmup.py)
+        # verification for the whole compile (runtime/warmup.py). The
+        # shared registry unlocks the indexed-kernel rows, and metrics
+        # wires verify_recompiles_total so a post-warmup compile is
+        # visible; completion seals the shape ledger.
         from grandine_tpu.runtime.warmup import warm_in_background
 
-        warm_in_background(progress=lambda m: print(f"[warmup] {m}"))
+        verifier = getattr(node, "attestation_verifier", None)
+        warm_in_background(
+            progress=lambda m: print(f"[warmup] {m}"),
+            registry=getattr(verifier, "registry", None),
+            metrics=metrics,
+        )
     if getattr(args, "web3signer_url", None):
         # remote-signer registry for a ValidatorService embedding; the
         # list_keys round-trip also fail-fasts on a bad endpoint

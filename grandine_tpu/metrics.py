@@ -530,6 +530,13 @@ class Metrics:
             "contained crashes of long-running daemon loops, by thread",
             ("thread",),
         )
+        self.verify_recompiles = Counter(
+            "verify_recompiles_total",
+            "novel kernel shape signatures dispatched AFTER warmup "
+            "declared completion — each one is an XLA compile stalling "
+            "a live batch; steady state must hold at zero "
+            "(tools/shapes manifest)",
+        )
 
     def collect_system_stats(self, data_dir: "str | None" = None) -> None:
         """Refresh the /proc-sourced gauges (metrics/src/service.rs
@@ -682,10 +689,18 @@ class RemoteMetricsService:
 
         def loop() -> None:
             while not self._stop:
-                self.push_once()
-                deadline = time.monotonic() + self.INTERVAL_S
-                while not self._stop and time.monotonic() < deadline:
-                    time.sleep(0.25)
+                # push_once contains its own network errors, but snapshot
+                # assembly reads live controller/metrics state — contain
+                # every iteration so one bad snapshot can't kill the
+                # push thread for the life of the process
+                try:
+                    self.push_once()
+                    deadline = time.monotonic() + self.INTERVAL_S
+                    while not self._stop and time.monotonic() < deadline:
+                        time.sleep(0.25)
+                except Exception:
+                    self.stats["failures"] += 1
+                    time.sleep(1.0)
 
         self._thread = threading.Thread(
             target=loop, name="metrics-push", daemon=True

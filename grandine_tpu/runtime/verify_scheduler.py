@@ -364,7 +364,15 @@ class VerifyScheduler:
     def _pop_batch(self, lane: LaneConfig) -> "list[_Job]":
         q = self._queues[lane.name]
         jobs, n_items = [], 0
-        while q and n_items < lane.max_batch:
+        # peek before popping: taking a job that would push the batch
+        # past max_batch overflows into the NEXT pow-2 device bucket —
+        # a shape outside the warmed manifest, i.e. a mid-slot XLA
+        # recompile. An oversized single job still goes alone (the
+        # backend chunks it).
+        while q and n_items + len(q[0].items) <= lane.max_batch:
+            jobs.append(q.popleft())
+            n_items += len(jobs[-1].items)
+        if q and not jobs:
             jobs.append(q.popleft())
             n_items += len(jobs[-1].items)
         self._item_counts[lane.name] -= n_items

@@ -59,6 +59,18 @@ from grandine_tpu.tpu import limbs as L
 from grandine_tpu.tpu import msm as M
 from grandine_tpu.tpu import pairing as TP
 
+try:  # jax >= 0.6 exports shard_map at top level (kwarg: check_vma)
+    shard_map = jax.shard_map
+except AttributeError:  # 0.4.x: experimental module, kwarg named check_rep
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return _shard_map_impl(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=check_vma,
+        )
+
+
 # --- module constants (host, Montgomery limb form) -------------------------
 
 _NEG_G1_DEV = C.g1_point_to_dev(-G1)  # (x, y, inf=False)
@@ -727,7 +739,7 @@ def make_sharded_multi_verify(mesh, axis: str = "batch"):
     # check_vma=False: montmul's lax.scan carries start as replicated
     # constants and become device-varying, which the VMA checker rejects
     # (the computation is still correct SPMD — every collective is explicit).
-    fn = jax.shard_map(
+    fn = shard_map(
         local_step, mesh=mesh, in_specs=shardings, out_specs=P(), check_vma=False
     )
     return _no_persistent_cache_first_call(jax.jit(fn))
@@ -905,7 +917,7 @@ def make_sharded_multi_verify_msm(
         plan, plan, plan, plan, plan,   # g1 plan
         plan, plan, plan, plan, plan,   # g2 plan
     )
-    fn = jax.shard_map(
+    fn = shard_map(
         local_step, mesh=mesh, in_specs=in_specs, out_specs=P(),
         check_vma=False,
     )
@@ -992,6 +1004,70 @@ def _jitted_global(name: str, fn):
         f = jax.jit(fn)
         _JITTED[name] = f
     return f
+
+
+# --- shape-signature tracking (tools/shapes contract) -----------------------
+#
+# Process-wide ledger of every (kernel, arg-shapes) signature dispatched
+# through _run_kernel. jax.jit compiles per signature, so after warmup
+# declares the manifest compiled, a NOVEL signature means a live batch is
+# stalling on XLA — counted in `verify_recompiles_total` and asserted
+# zero by bench soaks and tests. Global (not per-backend) because
+# _JITTED is: every TpuBlsBackend shares one compile cache.
+
+_SHAPE_LOCK = threading.Lock()
+_SHAPES_SEEN: set = set()
+_WARMUP_SEALED = [False]
+_POST_WARMUP_COMPILES = [0]
+
+
+def _shape_key(kernel: str, args: tuple):
+    return (kernel, tuple(
+        (tuple(a.shape), str(a.dtype)) if hasattr(a, "shape") else repr(a)
+        for a in args
+    ))
+
+
+def note_dispatch_shapes(kernel: str, args: tuple, metrics=None) -> bool:
+    """Record a dispatch signature; True when it is novel this process.
+
+    Novel-after-seal increments the recompile accounting (and the
+    `verify_recompiles_total` counter when metrics are wired)."""
+    key = _shape_key(kernel, args)
+    with _SHAPE_LOCK:
+        if key in _SHAPES_SEEN:
+            return False
+        _SHAPES_SEEN.add(key)
+        sealed = _WARMUP_SEALED[0]
+        if sealed:
+            _POST_WARMUP_COMPILES[0] += 1
+    if sealed and metrics is not None:
+        metrics.verify_recompiles.inc()
+    return True
+
+
+def declare_warmup_complete() -> None:
+    """Seal the shape ledger: every signature from here on is a recompile."""
+    with _SHAPE_LOCK:
+        _WARMUP_SEALED[0] = True
+
+
+def warmup_declared() -> bool:
+    with _SHAPE_LOCK:
+        return _WARMUP_SEALED[0]
+
+
+def post_warmup_recompiles() -> int:
+    with _SHAPE_LOCK:
+        return _POST_WARMUP_COMPILES[0]
+
+
+def reset_shape_tracking() -> None:
+    """Test seam: forget signatures and unseal (compiles in _JITTED stay)."""
+    with _SHAPE_LOCK:
+        _SHAPES_SEEN.clear()
+        _WARMUP_SEALED[0] = False
+        _POST_WARMUP_COMPILES[0] = 0
 
 
 _ZERO2 = np.zeros((2, L.NLIMBS), np.int32)
@@ -1156,6 +1232,7 @@ class TpuBlsBackend:
         block=False the caller keeps the async seam and settles later
         (see _settle)."""
         self._count_kernel(kernel, sigs)
+        note_dispatch_shapes(kernel, args, self.metrics)
         if not self._observed():
             return fn(*args)
         shapes = tuple(
@@ -1809,4 +1886,9 @@ __all__ = [
     "make_sharded_multi_verify",
     "make_sharded_multi_verify_msm",
     "sharded_msm_plans",
+    "note_dispatch_shapes",
+    "declare_warmup_complete",
+    "warmup_declared",
+    "post_warmup_recompiles",
+    "reset_shape_tracking",
 ]

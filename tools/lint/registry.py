@@ -13,6 +13,7 @@ def all_rules():
         NoInlineGossipVerifyRule,
     )
     from tools.lint.rules.no_per_batch_upload import NoPerBatchUploadRule
+    from tools.lint.rules.shape_contract import ShapeContractRule
     from tools.lint.rules.thread_crash_containment import (
         ThreadCrashContainmentRule,
     )
@@ -25,4 +26,5 @@ def all_rules():
         JitPurityRule(),
         NoPerBatchUploadRule(),
         ThreadCrashContainmentRule(),
+        ShapeContractRule(),
     ]

@@ -9,9 +9,16 @@ pipeline. Readback belongs in settle closures, which the completion
 thread forces OFF the dispatch path.
 
 Scope: functions named `*_async`, `_device_dispatch`, `_dispatch_loop`,
-`_flush`, or `_dispatch*` in the dispatch-plane modules. Allowlist:
-nested `settle*` closures (the sanctioned readback seam) are skipped
-wholesale, as are nested defs listed in ALLOWED_NESTED.
+`_flush`, or `_dispatch*` in the dispatch-plane modules, plus the
+device-registry upload lifecycle (`ensure` / `_append` / `_refresh` /
+`_upload_full` in tpu/registry.py — a forced readback there stalls
+every lane sharing the registry) and the health plane's canary path
+(`run_canary` in runtime/health.py, which runs while live traffic is
+degraded). Allowlist: nested `settle*` closures (the sanctioned
+readback seam) are skipped wholesale, as are nested defs listed in
+ALLOWED_NESTED — `probe*` covers health.py's canary closure, whose
+forcing is deadline-bounded through `run_with_deadline`, the sanctioned
+watchdog seam for the supervisor plane.
 """
 
 from __future__ import annotations
@@ -22,10 +29,11 @@ import re
 from tools.lint.core import Context, Finding, Rule, dotted, walk_functions
 
 DISPATCH_RE = re.compile(
-    r"(_async$|^_device_dispatch$|^_dispatch_loop$|^_flush$|^_dispatch)"
+    r"(_async$|^_device_dispatch$|^_dispatch_loop$|^_flush$|^_dispatch"
+    r"|^ensure$|^_append$|^_refresh$|^_upload_full$|^run_canary$)"
 )
-#: nested closures exempt from the scan (settle/readback seams)
-ALLOWED_NESTED = re.compile(r"^(settle|chunk)")
+#: nested closures exempt from the scan (settle/readback/probe seams)
+ALLOWED_NESTED = re.compile(r"^(settle|chunk|probe)")
 
 #: dotted call names that force a host<->device sync (exact — the
 #: device-side tracer jnp.asarray must NOT match np.asarray)
@@ -44,8 +52,10 @@ class HostSyncRule(Rule):
     )
     default_paths = (
         "grandine_tpu/tpu/bls.py",
+        "grandine_tpu/tpu/registry.py",
         "grandine_tpu/runtime/attestation_verifier.py",
         "grandine_tpu/runtime/verify_scheduler.py",
+        "grandine_tpu/runtime/health.py",
     )
 
     def check(self, ctx: Context, files):

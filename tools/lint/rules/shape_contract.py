@@ -1,0 +1,36 @@
+"""Lint rule: kernel shape contract (delegates to tools/shapes).
+
+Every jit entry point must be statically enumerable, every dispatch-site
+dimension must be proven pow-2-bucketed, and the checked-in kernel
+manifest must match the code.  The analysis itself lives in
+tools/shapes/__init__.py; this adapter runs it under the lint framework
+so suppressions, the baseline, and `python -m tools.lint` selection all
+behave like any other rule.
+
+Restricted runs (explicit fixture targets) skip the manifest-staleness
+and runtime-bound checks — a fixture file has no manifest — while full
+default-path runs enforce them.
+"""
+
+from __future__ import annotations
+
+from tools.lint.core import Context, Rule
+
+from tools import shapes
+
+
+class ShapeContractRule(Rule):
+    name = shapes.RULE
+    description = (
+        "jit kernel entry points are statically enumerable, dispatch "
+        "shapes are pow-2 bucketed, and tools/shapes/manifest.txt "
+        "matches the code"
+    )
+    default_paths = shapes.DEFAULT_FILES
+
+    def check(self, ctx: Context, files):
+        full = sorted(files) == sorted(self.files(ctx, None))
+        findings, _ = shapes.analyze(
+            ctx=ctx, files=list(files), check_manifest=full
+        )
+        return findings

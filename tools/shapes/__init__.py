@@ -1,0 +1,923 @@
+"""Whole-program kernel shape-contract analyzer (rule: shape-contract).
+
+The device plane's central performance invariant is that the set of
+argument shapes that can ever reach a jitted kernel is FINITE and
+statically enumerable: every dispatch path pads its batch into a pow-2
+bucket (`_bucket` in tpu/bls.py, `_next_pow2` in tpu/registry.py) and
+the runtime bounds batch sizes (`MAX_BATCH`, scheduler lane
+`max_batch`).  A shape that escapes this lattice recompiles XLA mid-slot
+— the tail-latency killer the cold-start program exists to prevent.
+
+This package proves the invariant instead of assuming it:
+
+* **entry collection** — every `jax.jit` / `partial(jax.jit, ...)` /
+  `shard_map` kernel entry point in the scanned files, resolved through
+  the same alias machinery as the jit-purity lint rule (module factories
+  `_jitted_global` / `TpuBlsBackend._jitted` / `_jitted_msm`, local
+  `fn = jax.shard_map(...)` aliases, `partial` unwrapping);
+* **dispatch-site shape proof** — for every function that feeds the
+  device (`self._run_kernel` / `self._upload` / `jax.device_put`), each
+  numpy allocation dimension and padding-helper width must derive from a
+  pow-2 bucket call, a module constant, or a value proven safe at every
+  call site (one interprocedural round covers helpers that take the
+  bucket as a parameter);
+* **closed dispatch universe** — every `self._run_kernel("<name>", ...)`
+  literal must name a collected entry point, and the kernel name must be
+  a literal;
+* **bucket sharing** — two sites dispatching the same kernel must use
+  the same bucket floor (`lo`), otherwise they gratuitously split the
+  compile cache;
+* **runtime bounds** — `MAX_BATCH` and every scheduler lane `max_batch`
+  must be literal ints (they bound the warm ladder), and
+  `_device_dispatch` may only cross the device seam through the methods
+  bls.py declares in `ASYNC_SEAM`;
+* **manifest** — the whole lattice is rendered to a deterministic,
+  line-number-free `tools/shapes/manifest.txt` that warmup precompiles
+  at startup; the checked-in copy failing to match the code is itself a
+  finding (stale manifest).
+
+Findings carry the lint framework's stable keys, so `# lint:
+disable=shape-contract` comments and the baseline work unchanged.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+
+from tools.lint.core import Context, Finding, dotted, walk_functions
+from tools.lint.rules.jit_purity import (
+    _ALIAS_FACTORIES,
+    _JIT_NAMES,
+    _jit_target,
+)
+
+RULE = "shape-contract"
+MANIFEST_PATH = "tools/shapes/manifest.txt"
+
+BLS_PATH = "grandine_tpu/tpu/bls.py"
+REGISTRY_PATH = "grandine_tpu/tpu/registry.py"
+VERIFIER_PATH = "grandine_tpu/runtime/attestation_verifier.py"
+SCHEDULER_PATH = "grandine_tpu/runtime/verify_scheduler.py"
+
+TPU_FILES = (
+    BLS_PATH,
+    "grandine_tpu/tpu/msm.py",
+    "grandine_tpu/tpu/pairing.py",
+    REGISTRY_PATH,
+)
+RUNTIME_FILES = (VERIFIER_PATH, SCHEDULER_PATH)
+DEFAULT_FILES = TPU_FILES + RUNTIME_FILES
+
+#: named jit factories: call sites register a kernel under a literal name
+_FACTORY_JIT = {"_jitted_global", "_jitted"}
+_FACTORY_JIT_PARTIAL = {"_jitted_msm"}
+#: functions whose bodies ARE the factories — bare jax.jit inside them is
+#: the implementation of registration, not a second entry point
+_FACTORY_IMPLS = _FACTORY_JIT | _FACTORY_JIT_PARTIAL
+
+#: pow-2 padders: assignment from one of these proves the name bucketed.
+#: value = default bucket floor when no explicit `lo` is passed.
+_BUCKET_FNS = {"_bucket": 4, "_next_pow2": 16}
+
+#: numpy allocators whose first argument is the (shape) that reaches jit
+_ALLOC_NAMES = {"zeros", "ones", "empty", "full", "arange"}
+_NP_MODULES = {"np", "numpy"}
+
+#: padding helpers: (callee suffix) -> index of the argument that must be
+#: a proven bucket width (the helper allocates to that width internally)
+_PAD_HELPERS = {
+    "rlc_bits_host": 1,
+    "sign_bits_host": 1,
+    "_g2_plan": 1,
+    "scalars_to_bits_msb": 0,
+}
+
+#: calls that produce device MSM plans (shape-static per bucket: msm.py
+#: derives S/T from the UNPRUNED total and J from a data-independent
+#: tail bound) — counted per dispatch site for the manifest
+_PLAN_SUFFIXES = ("plan_msm", "_g2_plan")
+
+_CONST_NAME_RE = re.compile(r"[A-Z_][A-Z0-9_]*\Z")
+
+
+def _qual(cls: "str | None", fn: "str | None") -> str:
+    name = fn or "<module>"
+    return f"{cls}.{name}" if cls else name
+
+
+def _suffix(name: "str | None") -> "str | None":
+    return None if name is None else name.rsplit(".", 1)[-1]
+
+
+@dataclass
+class KernelEntry:
+    kernel: str
+    qualname: str  # Class.method (or function) that registers it
+    path: str
+    factory: str  # "jit" | "jit+partial" | "shard_map"
+    static: "tuple[str, ...]" = ()
+    sharding: str = "single"
+    line: int = 0
+
+
+@dataclass
+class DispatchSite:
+    kernel: str
+    qualname: str
+    path: str
+    line: int
+    #: rendered "(dims):dtype" allocation descriptors fed to the kernel
+    shapes: "set[str]" = field(default_factory=set)
+    plans: int = 0
+    #: bucket floors (`lo`) of the pow-2 pads feeding this site
+    bucket_los: "set[int]" = field(default_factory=set)
+    registry_arrays: bool = False
+
+
+@dataclass
+class Analysis:
+    entries: "list[KernelEntry]" = field(default_factory=list)
+    sites: "list[DispatchSite]" = field(default_factory=list)
+    #: "<module>.<NAME>" -> int (MAX_BATCH, MAX_BUCKET, lane max_batch...)
+    bounds: "dict[str, int]" = field(default_factory=dict)
+
+    def manifest_text(self) -> str:
+        lines = [
+            "# grandine-tpu kernel shape-contract manifest",
+            "# generated: python -m tools.shapes --write-manifest",
+            "# verified:  python -m tools.shapes   (lint rule: shape-contract)",
+            "# Rows are line-number-free; regenerate after changing any",
+            "# dispatch path, kernel registration, or runtime batch bound.",
+        ]
+        for name in sorted(self.bounds):
+            lines.append(f"bound {name} = {self.bounds[name]}")
+        by_kernel: "dict[str, list[DispatchSite]]" = {}
+        for s in self.sites:
+            by_kernel.setdefault(s.kernel, []).append(s)
+        for e in sorted(self.entries, key=lambda e: (e.kernel, e.qualname)):
+            shapes: "set[str]" = set()
+            plans = 0
+            registry = False
+            for s in by_kernel.get(e.kernel, ()):
+                shapes |= s.shapes
+                plans += s.plans
+                registry = registry or s.registry_arrays
+            cols = [
+                f"contract {e.kernel}",
+                f"entry {e.qualname}",
+                f"file {e.path}",
+                f"factory {e.factory}",
+                "static " + (",".join(e.static) if e.static else "-"),
+                f"sharding {e.sharding}",
+                "shapes " + (" ".join(sorted(shapes)) if shapes else "-"),
+                f"plans {plans}",
+            ]
+            if registry:
+                cols.append("registry device-resident")
+            lines.append(" | ".join(cols))
+        for kind, buckets, source in self.warm_rows():
+            lines.append(
+                f"warm {kind} | buckets {','.join(str(b) for b in buckets)}"
+                f" | source {source}"
+            )
+        return "\n".join(lines) + "\n"
+
+    def warm_rows(self):
+        """(kind, bucket-ladder, provenance) rows driving runtime/warmup.
+
+        The firehose kinds (aggregate / aggregate_idx / subgroup) are
+        DERIVED: their bucket ladder is every pow-2 from the device floor
+        up to the bucket covering the largest runtime batch bound.  The
+        bulk kinds (multi_verify for block replay, sign for the signer)
+        are policy ladders — their batch size is caller-chosen up to
+        MAX_BUCKET, so warming the full pow-2 range would waste minutes
+        compiling shapes replay never dispatches.
+        """
+        agg_bound = max(
+            [v for k, v in self.bounds.items()
+             if k.endswith(".MAX_BATCH") or ".lane." in k] or [128]
+        )
+        ladder, b = [], 4
+        while b < agg_bound:
+            ladder.append(b)
+            b <<= 1
+        ladder.append(b)
+        derived = "derived:max(attestation.MAX_BATCH,scheduler.lane.max_batch)"
+        rows = [
+            ("aggregate", tuple(ladder), derived),
+            ("aggregate_idx", tuple(ladder), derived),
+            ("multi_verify", (64, 256, 1024, 4096), "policy:block-replay"),
+            ("sign", (64, 512), "policy:signer"),
+            ("subgroup", tuple(ladder), derived),
+        ]
+        return rows
+
+
+# ------------------------------------------------------------ file scan
+
+
+class _FileScan:
+    """All per-file AST extraction, shared by every pass."""
+
+    def __init__(self, path: str, tree: ast.AST) -> None:
+        self.path = path
+        self.tree = tree
+        #: (classname, FunctionDef) including nested defs
+        self.functions = list(walk_functions(tree))
+        self._own: "dict[ast.AST, ast.FunctionDef]" = {}
+        for _, fn in self.functions:
+            for node in self._body_nodes(fn):
+                self._own.setdefault(node, fn)
+
+    @staticmethod
+    def _body_nodes(fn: ast.AST):
+        """Every node in fn's body EXCLUDING nested function bodies."""
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            yield node
+            if not isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                stack.extend(ast.iter_child_nodes(node))
+
+    def owner(self, node: ast.AST) -> "ast.FunctionDef | None":
+        """Nearest enclosing def, None for module scope."""
+        return self._own.get(node)
+
+    def qualname(self, node: ast.AST) -> str:
+        fn = self.owner(node)
+        if fn is None:
+            return "<module>"
+        cls = next(c for c, f in self.functions if f is fn)
+        return _qual(cls, fn.name)
+
+    def scope_statements(self, fn: "ast.FunctionDef | None"):
+        """Direct (non-nested-def) statements of fn, or of the module."""
+        if fn is None:
+            stack = list(ast.iter_child_nodes(self.tree))
+            out = []
+            while stack:
+                node = stack.pop()
+                if isinstance(
+                    node,
+                    (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+                ):
+                    continue
+                out.append(node)
+                stack.extend(ast.iter_child_nodes(node))
+            return out
+        return list(self._body_nodes(fn))
+
+
+# ------------------------------------------------------- shape safety
+
+
+class _SafetyScope:
+    """Names proven shape-safe inside one function scope."""
+
+    def __init__(self) -> None:
+        self.safe: "set[str]" = set()
+        #: name -> bucket floor (lo) for names assigned from _bucket/...
+        self.bucket_lo: "dict[str, int]" = {}
+        self.registry_names: "set[str]" = set()
+
+    def is_safe(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Constant):
+            return isinstance(node.value, int) and not isinstance(
+                node.value, bool
+            )
+        if isinstance(node, ast.Name):
+            return (
+                node.id in self.safe
+                or _CONST_NAME_RE.match(node.id) is not None
+            )
+        if isinstance(node, ast.Attribute):
+            # module-constant convention: L.NLIMBS, bls.MAX_BUCKET
+            return _CONST_NAME_RE.match(node.attr) is not None
+        if isinstance(node, ast.UnaryOp):
+            return self.is_safe(node.operand)
+        if isinstance(node, ast.BinOp):
+            # `[x] * b` list-repeat padding is safe when the count is —
+            # the literal side contributes no data-dependent extent
+            left_lit = isinstance(node.left, (ast.List, ast.ListComp))
+            right_lit = isinstance(node.right, (ast.List, ast.ListComp))
+            if left_lit or right_lit:
+                return isinstance(node.op, ast.Mult) and self.is_safe(
+                    node.right if left_lit else node.left
+                )
+            return self.is_safe(node.left) and self.is_safe(node.right)
+        if isinstance(node, ast.Call):
+            return _bucket_call_lo(node) is not None
+        return False
+
+
+def _bucket_call_lo(call: ast.AST) -> "int | None":
+    """Bucket floor when `call` invokes a pow-2 padder, else None."""
+    if not isinstance(call, ast.Call):
+        return None
+    name = _suffix(dotted(call.func))
+    if name not in _BUCKET_FNS:
+        return None
+    lo = _BUCKET_FNS[name]
+    for kw in call.keywords:
+        if kw.arg == "lo" and isinstance(kw.value, ast.Constant):
+            lo = int(kw.value.value)
+    if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant):
+        lo = int(call.args[1].value)
+    return lo
+
+
+def _build_scope(scan: _FileScan, fn: "ast.FunctionDef | None") -> _SafetyScope:
+    scope = _SafetyScope()
+    for node in scan.scope_statements(fn):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        target = node.targets[0]
+        if isinstance(target, ast.Tuple):
+            # `reg_x, reg_y, reg_n = registry.arrays()` — device-resident
+            # registry arrays; extents proven by the registry pass
+            if (
+                isinstance(node.value, ast.Call)
+                and isinstance(node.value.func, ast.Attribute)
+                and node.value.func.attr == "arrays"
+            ):
+                for elt in target.elts:
+                    if isinstance(elt, ast.Name):
+                        scope.safe.add(elt.id)
+                        scope.registry_names.add(elt.id)
+            continue
+        if not isinstance(target, ast.Name):
+            continue
+        lo = _bucket_call_lo(node.value)
+        if lo is not None:
+            scope.safe.add(target.id)
+            scope.bucket_lo[target.id] = lo
+        elif scope.is_safe(node.value):
+            scope.safe.add(target.id)
+    return scope
+
+
+def _fn_params(fn: ast.FunctionDef) -> "list[str]":
+    names = [a.arg for a in fn.args.args]
+    return names[1:] if names and names[0] == "self" else names
+
+
+def _alloc_shape_arg(call: ast.Call) -> "ast.AST | None":
+    name = dotted(call.func)
+    if name is None:
+        return None
+    mod, _, attr = name.rpartition(".")
+    if attr in _ALLOC_NAMES and (mod in _NP_MODULES or mod == ""):
+        # bare zeros()/arange() only counts when imported from numpy —
+        # outside bls/registry that heuristic is too grabby, so require
+        # the module prefix except for the arange idiom
+        if mod == "":
+            return None
+        return call.args[0] if call.args else None
+    return None
+
+
+def _alloc_dtype(call: ast.Call) -> str:
+    node = None
+    if len(call.args) >= 2:
+        node = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "dtype":
+            node = kw.value
+    if node is None:
+        return "int" if _suffix(dotted(call.func)) == "arange" else "f32"
+    txt = ast.unparse(node)
+    for mod in _NP_MODULES:
+        if txt.startswith(mod + "."):
+            txt = txt[len(mod) + 1:]
+    return txt
+
+
+def _render_dims(shape_arg: ast.AST) -> str:
+    dims = (
+        list(shape_arg.elts)
+        if isinstance(shape_arg, ast.Tuple)
+        else [shape_arg]
+    )
+    rendered = []
+    for d in dims:
+        txt = ast.unparse(d)
+        txt = txt.replace("L.NLIMBS", "NLIMBS").replace(" ", "")
+        rendered.append(txt)
+    return "(" + ",".join(rendered) + ")"
+
+
+# ----------------------------------------------------------- the passes
+
+
+def _collect_entries(scan: _FileScan, findings: "list[Finding]"):
+    entries: "list[KernelEntry]" = []
+    fn_names = {f.name for _, f in scan.functions}
+    for cls, fn in scan.functions:
+        for dec in fn.decorator_list:
+            if dotted(dec) in _JIT_NAMES:
+                entries.append(KernelEntry(
+                    kernel=fn.name, qualname=_qual(cls, fn.name),
+                    path=scan.path, factory="jit", line=fn.lineno,
+                ))
+            elif isinstance(dec, ast.Call):
+                if dotted(dec.func) in _JIT_NAMES or (
+                    dotted(dec.func) in _ALIAS_FACTORIES
+                    and dec.args
+                    and dotted(dec.args[0]) in _JIT_NAMES
+                ):
+                    static = tuple(sorted(
+                        kw.arg for kw in dec.keywords if kw.arg is not None
+                    ))
+                    entries.append(KernelEntry(
+                        kernel=fn.name, qualname=_qual(cls, fn.name),
+                        path=scan.path, factory="jit", static=static,
+                        line=fn.lineno,
+                    ))
+    for node in ast.walk(scan.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        owner = scan.owner(node)
+        owner_name = owner.name if owner is not None else None
+        callee = _suffix(dotted(node.func))
+        if callee in _FACTORY_IMPLS and owner_name in _FACTORY_IMPLS:
+            continue  # the factory's own delegation, not a registration
+        if callee in _FACTORY_JIT or callee in _FACTORY_JIT_PARTIAL:
+            if not node.args or not (
+                isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                findings.append(Finding(
+                    RULE, scan.path, node.lineno,
+                    f"kernel registered through {callee} with a "
+                    "non-literal name: the dispatch universe cannot be "
+                    "enumerated statically",
+                    key=f"{RULE}:{scan.path}:{scan.qualname(node)}:"
+                        "nonliteral-kernel-name",
+                ))
+                continue
+            kernel = node.args[0].value
+            static = tuple(sorted(
+                kw.arg for kw in node.keywords if kw.arg is not None
+            ))
+            entries.append(KernelEntry(
+                kernel=kernel,
+                qualname=scan.qualname(node),
+                path=scan.path,
+                factory=(
+                    "jit+partial" if callee in _FACTORY_JIT_PARTIAL
+                    else "jit"
+                ),
+                static=static,
+                line=node.lineno,
+            ))
+            continue
+        if dotted(node.func) in _JIT_NAMES:
+            if owner_name in _FACTORY_IMPLS:
+                continue  # jax.jit inside the registration factory body
+            target = _jit_target(node)
+            entry = _resolve_bare_jit(scan, node, target, fn_names)
+            if entry is not None:
+                entries.append(entry)
+            else:
+                findings.append(Finding(
+                    RULE, scan.path, node.lineno,
+                    "jax.jit target does not resolve to a named kernel "
+                    "or shard_map alias: unenumerable entry point",
+                    key=f"{RULE}:{scan.path}:{scan.qualname(node)}:"
+                        "unresolvable-jit-target",
+                ))
+    return entries
+
+
+def _resolve_bare_jit(scan, call, target, fn_names) -> "KernelEntry | None":
+    if target is None:
+        return None
+    owner = scan.owner(call)
+    if isinstance(target, ast.Name):
+        # chase local aliases: fn = jax.shard_map(local_step, mesh=...)
+        for node in scan.scope_statements(owner):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == target.id
+                and isinstance(node.value, ast.Call)
+            ):
+                src = dotted(node.value.func)
+                if src in _ALIAS_FACTORIES:
+                    if _suffix(src) == "shard_map":
+                        axis = "batch"
+                        if owner is not None:
+                            args = owner.args
+                            defaults = args.defaults
+                            names = [a.arg for a in args.args]
+                            for name, d in zip(
+                                names[len(names) - len(defaults):], defaults
+                            ):
+                                if name == "axis" and isinstance(
+                                    d, ast.Constant
+                                ):
+                                    axis = str(d.value)
+                        return KernelEntry(
+                            kernel=owner.name if owner else target.id,
+                            qualname=scan.qualname(call),
+                            path=scan.path,
+                            factory="shard_map",
+                            sharding=f"mesh({axis})",
+                            line=call.lineno,
+                        )
+                    inner = node.value.args[0] if node.value.args else None
+                    if isinstance(inner, ast.Name):
+                        target = inner
+                        break
+        if isinstance(target, ast.Name) and target.id in fn_names:
+            return KernelEntry(
+                kernel=target.id,
+                qualname=scan.qualname(call),
+                path=scan.path,
+                factory="jit",
+                line=call.lineno,
+            )
+        return None
+    if isinstance(target, (ast.Attribute,)) and dotted(target):
+        return KernelEntry(
+            kernel=dotted(target),
+            qualname=scan.qualname(call),
+            path=scan.path,
+            factory="jit",
+            line=call.lineno,
+        )
+    return None
+
+
+def _is_device_feeding(scan: _FileScan, fn: ast.FunctionDef) -> bool:
+    for node in scan.scope_statements(fn):
+        if isinstance(node, ast.Call):
+            name = dotted(node.func)
+            if name in (
+                "self._run_kernel", "self._upload", "jax.device_put"
+            ):
+                return True
+    return False
+
+
+def _check_dispatch_fn(
+    scan: _FileScan,
+    cls: "str | None",
+    fn: ast.FunctionDef,
+    scope: _SafetyScope,
+    findings: "list[Finding]",
+) -> "list[DispatchSite]":
+    qual = _qual(cls, fn.name)
+    shapes: "set[str]" = set()
+    plans = 0
+    kernels: "list[tuple[str, int]]" = []
+    uses_registry = bool(scope.registry_names)
+    used_los: "set[int]" = set()
+
+    def note_dim_lo(node: ast.AST) -> None:
+        for sub in ast.walk(node):
+            if (
+                isinstance(sub, ast.Name)
+                and sub.id in scope.bucket_lo
+            ):
+                used_los.add(scope.bucket_lo[sub.id])
+
+    for node in scan.scope_statements(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = dotted(node.func)
+        shape_arg = _alloc_shape_arg(node)
+        if shape_arg is not None:
+            dims = (
+                list(shape_arg.elts)
+                if isinstance(shape_arg, ast.Tuple)
+                else [shape_arg]
+            )
+            for d in dims:
+                if not scope.is_safe(d):
+                    findings.append(Finding(
+                        RULE, scan.path, node.lineno,
+                        f"{qual} allocates device input with "
+                        f"unprovable dimension `{ast.unparse(d)}` — a "
+                        "dynamic shape reaching jit recompiles XLA; pad "
+                        "through _bucket()/_next_pow2() first",
+                        key=f"{RULE}:{scan.path}:{qual}:alloc:"
+                            f"{ast.unparse(d)}",
+                    ))
+                else:
+                    note_dim_lo(d)
+            shapes.add(f"{_render_dims(shape_arg)}:{_alloc_dtype(node)}")
+        suffix = _suffix(callee)
+        if suffix in _PAD_HELPERS:
+            idx = _PAD_HELPERS[suffix]
+            arg = node.args[idx] if len(node.args) > idx else None
+            if arg is not None and not scope.is_safe(arg):
+                findings.append(Finding(
+                    RULE, scan.path, node.lineno,
+                    f"{qual} passes unprovable width "
+                    f"`{ast.unparse(arg)}` to padding helper {suffix}",
+                    key=f"{RULE}:{scan.path}:{qual}:pad:{suffix}",
+                ))
+            elif arg is not None:
+                note_dim_lo(arg)
+        if suffix is not None and suffix.endswith(_PLAN_SUFFIXES):
+            plans += 1
+        if callee == "self._run_kernel":
+            if node.args and isinstance(node.args[0], ast.Constant):
+                kernels.append((str(node.args[0].value), node.lineno))
+            else:
+                findings.append(Finding(
+                    RULE, scan.path, node.lineno,
+                    f"{qual} dispatches through _run_kernel with a "
+                    "non-literal kernel name",
+                    key=f"{RULE}:{scan.path}:{qual}:"
+                        "nonliteral-dispatch-name",
+                ))
+    return [
+        DispatchSite(
+            kernel=k,
+            qualname=qual,
+            path=scan.path,
+            line=line,
+            shapes=set(shapes),
+            plans=plans,
+            bucket_los=set(used_los),
+            registry_arrays=uses_registry,
+        )
+        for k, line in kernels
+    ] or (
+        # device-feeding helpers that never _run_kernel (e.g. the
+        # registry's _upload_full) still get their allocs checked above
+        []
+    )
+
+
+def _interprocedural_params(
+    scan: _FileScan,
+    scopes: "dict[ast.FunctionDef, _SafetyScope]",
+) -> None:
+    """One round: a dispatch fn's parameter is safe when EVERY intra-file
+    call site passes a provably-safe argument at that position (covers
+    `_grouped_multi_verify_async(self, ..., bm, bk, ...)`)."""
+    by_name = {fn.name: fn for _, fn in scan.functions}
+    callers: "dict[str, list[tuple[ast.Call, _SafetyScope]]]" = {}
+    for node in ast.walk(scan.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = dotted(node.func)
+        if callee is None or not callee.startswith("self."):
+            continue
+        name = callee[len("self."):]
+        if name not in by_name:
+            continue
+        owner = scan.owner(node)
+        if owner is None or owner not in scopes:
+            continue
+        callers.setdefault(name, []).append((node, scopes[owner]))
+    for name, sites in callers.items():
+        fn = by_name[name]
+        params = _fn_params(fn)
+        target_scope = scopes.get(fn)
+        if target_scope is None:
+            continue
+        for i, param in enumerate(params):
+            vals = []
+            for call, caller_scope in sites:
+                if i < len(call.args):
+                    vals.append((call.args[i], caller_scope))
+            if vals and all(s.is_safe(a) for a, s in vals):
+                target_scope.safe.add(param)
+                for a, s in vals:
+                    if isinstance(a, ast.Name) and a.id in s.bucket_lo:
+                        target_scope.bucket_lo.setdefault(
+                            param, s.bucket_lo[a.id]
+                        )
+
+
+def _parse_bounds(ctx: Context, files, analysis, findings) -> None:
+    if VERIFIER_PATH in files:
+        tree = ctx.tree(VERIFIER_PATH)
+        val = _module_int(tree, "MAX_BATCH") if tree else None
+        if val is None:
+            findings.append(Finding(
+                RULE, VERIFIER_PATH, 1,
+                "MAX_BATCH is not a literal int: the firehose batch "
+                "bound (and the warm ladder) cannot be derived",
+                key=f"{RULE}:{VERIFIER_PATH}:MAX_BATCH-unprovable",
+            ))
+        else:
+            analysis.bounds["attestation_verifier.MAX_BATCH"] = val
+    if BLS_PATH in files:
+        tree = ctx.tree(BLS_PATH)
+        val = _module_int(tree, "MAX_BUCKET") if tree else None
+        if val is not None:
+            analysis.bounds["bls.MAX_BUCKET"] = val
+    if REGISTRY_PATH in files:
+        tree = ctx.tree(REGISTRY_PATH)
+        val = _module_int(tree, "MIN_CAPACITY") if tree else None
+        if val is not None:
+            analysis.bounds["registry.MIN_CAPACITY"] = val
+    if SCHEDULER_PATH in files:
+        tree = ctx.tree(SCHEDULER_PATH)
+        lanes = _parse_lanes(tree) if tree else None
+        if not lanes:
+            findings.append(Finding(
+                RULE, SCHEDULER_PATH, 1,
+                "DEFAULT_LANES max_batch values are not literal ints: "
+                "scheduler batch bounds cannot be derived",
+                key=f"{RULE}:{SCHEDULER_PATH}:lanes-unprovable",
+            ))
+        else:
+            for name, mb in lanes:
+                analysis.bounds[f"scheduler.lane.{name}.max_batch"] = mb
+
+
+def _module_int(tree: ast.AST, name: str) -> "int | None":
+    for node in ast.iter_child_nodes(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            if isinstance(t, ast.Name) and t.id == name:
+                v = node.value
+                if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                    return int(v.value)
+                if (
+                    isinstance(v, ast.BinOp)
+                    and isinstance(v.op, ast.LShift)
+                    and isinstance(v.left, ast.Constant)
+                    and isinstance(v.right, ast.Constant)
+                ):
+                    return int(v.left.value) << int(v.right.value)
+    return None
+
+
+def _parse_lanes(tree: ast.AST):
+    for node in ast.iter_child_nodes(tree):
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == "DEFAULT_LANES"
+            and isinstance(node.value, (ast.Tuple, ast.List))
+        ):
+            lanes = []
+            for elt in node.value.elts:
+                if not (
+                    isinstance(elt, ast.Call)
+                    and len(elt.args) >= 3
+                    and isinstance(elt.args[0], ast.Constant)
+                    and isinstance(elt.args[2], ast.Constant)
+                    and isinstance(elt.args[2].value, int)
+                ):
+                    return None
+                lanes.append((str(elt.args[0].value), int(elt.args[2].value)))
+            return lanes
+    return None
+
+
+def _parse_async_seam(ctx: Context) -> "set[str] | None":
+    tree = ctx.tree(BLS_PATH)
+    if tree is None:
+        return None
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == "ASYNC_SEAM"
+            and isinstance(node.value, (ast.Tuple, ast.List))
+        ):
+            return {
+                str(e.value)
+                for e in node.value.elts
+                if isinstance(e, ast.Constant)
+            }
+    return None
+
+
+def _check_seam(ctx, scan: _FileScan, findings: "list[Finding]") -> None:
+    seam = _parse_async_seam(ctx)
+    if seam is None:
+        return
+    for cls, fn in scan.functions:
+        if fn.name != "_device_dispatch":
+            continue
+        for node in scan.scope_statements(fn):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr.endswith("_async")
+                and node.func.attr not in seam
+            ):
+                qual = _qual(cls, fn.name)
+                findings.append(Finding(
+                    RULE, scan.path, node.lineno,
+                    f"{qual} crosses the device seam through "
+                    f"{node.func.attr}, which bls.py does not declare "
+                    "in ASYNC_SEAM — fault injection and shape warmup "
+                    "cannot see it",
+                    key=f"{RULE}:{scan.path}:{qual}:"
+                        f"off-seam:{node.func.attr}",
+                ))
+
+
+# -------------------------------------------------------------- driver
+
+
+def analyze(
+    root: "str | None" = None,
+    ctx: "Context | None" = None,
+    files: "list[str] | None" = None,
+    check_manifest: bool = True,
+    manifest_path: str = MANIFEST_PATH,
+) -> "tuple[list[Finding], Analysis]":
+    if ctx is None:
+        ctx = Context(root or ".")
+    if files is None:
+        files = [p for p in DEFAULT_FILES if ctx.source(p) is not None]
+    findings: "list[Finding]" = []
+    analysis = Analysis()
+    scans: "list[_FileScan]" = []
+    for path in files:
+        tree = ctx.tree(path)
+        if tree is None:
+            continue
+        scan = _FileScan(path, tree)
+        scans.append(scan)
+        analysis.entries.extend(_collect_entries(scan, findings))
+        scopes = {fn: _build_scope(scan, fn) for _, fn in scan.functions}
+        _interprocedural_params(scan, scopes)
+        for cls, fn in scan.functions:
+            if not _is_device_feeding(scan, fn):
+                continue
+            analysis.sites.extend(
+                _check_dispatch_fn(scan, cls, fn, scopes[fn], findings)
+            )
+        if path in RUNTIME_FILES:
+            _check_seam(ctx, scan, findings)
+
+    registered = {e.kernel for e in analysis.entries}
+    for site in analysis.sites:
+        if site.kernel not in registered:
+            findings.append(Finding(
+                RULE, site.path, site.line,
+                f"{site.qualname} dispatches kernel "
+                f"{site.kernel!r} that no jit entry point registers",
+                key=f"{RULE}:{site.path}:{site.qualname}:"
+                    f"unregistered:{site.kernel}",
+            ))
+
+    by_kernel: "dict[str, set[int]]" = {}
+    first_site: "dict[str, DispatchSite]" = {}
+    for site in analysis.sites:
+        by_kernel.setdefault(site.kernel, set()).update(site.bucket_los)
+        first_site.setdefault(site.kernel, site)
+    for kernel, los in sorted(by_kernel.items()):
+        if len(los) > 1:
+            site = first_site[kernel]
+            findings.append(Finding(
+                RULE, site.path, site.line,
+                f"kernel {kernel!r} is dispatched with bucket floors "
+                f"{sorted(los)} from different sites — gratuitously "
+                "distinct shapes splitting the compile cache; share one "
+                "`lo`",
+                key=f"{RULE}:{site.path}:bucket-floor:{kernel}",
+            ))
+
+    _parse_bounds(ctx, files, analysis, findings)
+
+    if check_manifest:
+        want = analysis.manifest_text()
+        have = ctx.source(manifest_path)
+        if have is None:
+            findings.append(Finding(
+                RULE, manifest_path, 1,
+                "kernel manifest missing — run "
+                "`python -m tools.shapes --write-manifest`",
+                key=f"{RULE}:{manifest_path}:missing",
+            ))
+        elif have != want:
+            findings.append(Finding(
+                RULE, manifest_path, 1,
+                "kernel manifest is stale vs. the code — run "
+                "`python -m tools.shapes --write-manifest`",
+                key=f"{RULE}:{manifest_path}:stale",
+            ))
+    return findings, analysis
+
+
+__all__ = [
+    "analyze",
+    "Analysis",
+    "KernelEntry",
+    "DispatchSite",
+    "RULE",
+    "MANIFEST_PATH",
+    "DEFAULT_FILES",
+    "TPU_FILES",
+    "RUNTIME_FILES",
+]

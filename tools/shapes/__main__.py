@@ -1,0 +1,78 @@
+"""CLI: `python -m tools.shapes` checks the shape contract, exit 1 on
+any finding; `--write-manifest` regenerates tools/shapes/manifest.txt.
+
+Suppressions use the lint framework's comments (`# lint:
+disable=shape-contract`), so a deliberately dynamic site is silenced at
+the site, visibly, not by editing the analyzer.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from tools.lint.core import Context
+from tools.shapes import MANIFEST_PATH, analyze
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    parser = argparse.ArgumentParser(prog="python -m tools.shapes")
+    parser.add_argument(
+        "--root", default=None,
+        help="repo root (default: two levels above this package)",
+    )
+    parser.add_argument(
+        "--write-manifest", action="store_true",
+        help="regenerate the kernel manifest instead of checking it",
+    )
+    parser.add_argument(
+        "--out", default=None,
+        help="with --write-manifest: write to this path instead of "
+             "the checked-in manifest",
+    )
+    parser.add_argument(
+        "--manifest", default=MANIFEST_PATH,
+        help="manifest path to check against (repo-relative)",
+    )
+    parser.add_argument(
+        "--list", action="store_true", dest="list_manifest",
+        help="print the derived manifest text and exit",
+    )
+    args = parser.parse_args(argv)
+
+    root = args.root or os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    ctx = Context(root)
+    findings, analysis = analyze(
+        ctx=ctx,
+        check_manifest=not (args.write_manifest or args.list_manifest),
+        manifest_path=args.manifest,
+    )
+    findings = [f for f in findings if not ctx.suppressed(f)]
+
+    if args.list_manifest:
+        sys.stdout.write(analysis.manifest_text())
+        return 0
+    if args.write_manifest:
+        out = args.out or ctx.abspath(MANIFEST_PATH)
+        with open(out, "w", encoding="utf-8") as fh:
+            fh.write(analysis.manifest_text())
+        print(f"wrote {out}")
+
+    for f in sorted(findings, key=lambda f: (f.path, f.line)):
+        print(f"FAIL: {f.render()}", file=sys.stderr)
+    n_entries = len(analysis.entries)
+    n_sites = len(analysis.sites)
+    status = "FAIL" if findings else "OK"
+    print(
+        f"{status}: shape-contract entries={n_entries} "
+        f"dispatch_sites={n_sites} bounds={len(analysis.bounds)} "
+        f"findings={len(findings)}"
+    )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
