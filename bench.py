@@ -857,6 +857,119 @@ def bench_coldstart() -> None:
         raise SystemExit(1)
 
 
+def _build_replay_chain(n_blocks: int, n_validators: int):
+    """Signature-dense minimal-preset chain plus the per-block signature
+    sets it generates, collected ONCE with a CollectingVerifier — the
+    state transition is identical work on both sides of the comparison,
+    so it runs off the verify clock."""
+    from grandine_tpu.consensus.verifier import CollectingVerifier
+    from grandine_tpu.runtime.replay import _WindowSink
+    from grandine_tpu.transition.combined import custom_state_transition
+    from grandine_tpu.transition.genesis import interop_genesis_state
+    from grandine_tpu.types.config import Config
+    from grandine_tpu.validator.duties import produce_attestations, produce_block
+
+    cfg = Config.minimal()
+    genesis = interop_genesis_state(n_validators, cfg)
+    state, chain, atts = genesis, [], []
+    for slot in range(1, n_blocks + 1):
+        blk, state = produce_block(
+            state, slot, cfg, attestations=atts,
+            full_sync_participation=True,
+        )
+        chain.append(blk)
+        atts = produce_attestations(state, cfg, slot=slot)
+    sink = _WindowSink()
+    verifier = CollectingVerifier(sink)
+    slices, cur = [], genesis
+    for blk in chain:
+        lo = len(sink.items)
+        cur = custom_state_transition(cur, blk, cfg, verifier)
+        slices.append((lo, len(sink.items)))
+    return cfg, sink.items, slices
+
+
+def bench_replay() -> None:
+    """`--replay`: cross-block bulk signature verification (ONE device
+    batch per window, the BulkReplayPipeline dispatch shape) vs the
+    legacy per-block `verify_block_batch` shape (a FRESH verifier and
+    one dispatch per block) over one identical pre-collected signature
+    workload. Prints one parseable JSON line
+    (metric `replay_bulk_vs_perblock`)."""
+    _lint_preflight()
+    # Default 44 blocks ≈ 218 sig-sets → 0.85 fill of the 256-lane
+    # multi_verify bucket.  At exactly 32 blocks (158 sig-sets) the pow-2
+    # padding drops fill to 0.62 and the bulk rate with it — the reported
+    # window/sigsets fields make the fill visible.
+    n_blocks = int(os.environ.get("BENCH_REPLAY_BLOCKS", "44"))
+    n_validators = int(os.environ.get("BENCH_REPLAY_VALIDATORS", "64"))
+    window = int(os.environ.get("BENCH_REPLAY_WINDOW", str(n_blocks)))
+    use_device = os.environ.get("BENCH_REPLAY_DEVICE", "1") != "0"
+    reps = int(os.environ.get("BENCH_REPLAY_REPS", "3"))
+    if use_device:
+        _enable_compilation_cache()
+
+    t_prep = time.time()
+    cfg, items, slices = _build_replay_chain(n_blocks, n_validators)
+    prep_s = time.time() - t_prep
+
+    from grandine_tpu.consensus.verifier import MultiVerifier, TpuVerifier
+    from grandine_tpu.runtime.replay import BulkReplayPipeline
+
+    pipe = BulkReplayPipeline(cfg, use_device=use_device, window_size=window)
+
+    def run_bulk() -> None:
+        for b_lo in range(0, len(slices), window):
+            b_hi = min(b_lo + window, len(slices))
+            i_lo, i_hi = slices[b_lo][0], slices[b_hi - 1][1]
+            if not pipe._dispatch_batch(items[i_lo:i_hi])():
+                raise SystemExit("bulk replay batch rejected valid blocks")
+
+    def run_per_block() -> None:
+        for i_lo, i_hi in slices:
+            v = TpuVerifier() if use_device else MultiVerifier()
+            for it in items[i_lo:i_hi]:
+                v.verify_aggregate(it.message, it.signature, it.resolve_keys())
+            v.finish()
+
+    def timed(fn) -> float:
+        fn()  # warm pass: compiles + caches off the clock
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.time()
+            fn()
+            best = min(best, time.time() - t0)
+        return best
+
+    bulk_s = timed(run_bulk)
+    base_s = timed(run_per_block)
+    bulk_rate = len(items) / bulk_s if bulk_s else 0.0
+    base_rate = len(items) / base_s if base_s else 0.0
+    speedup = bulk_rate / base_rate if base_rate else 0.0
+    target_met = window < 32 or speedup >= 5.0
+    print(json.dumps({
+        "metric": "replay_bulk_vs_perblock",
+        "unit": "sigsets/s",
+        "value": round(bulk_rate, 1),
+        "per_block": round(base_rate, 1),
+        "speedup": round(speedup, 2),
+        "blocks": n_blocks,
+        "window": window,
+        "sigsets": len(items),
+        "device": use_device,
+        "prep_s": round(prep_s, 1),
+        "target_met": target_met,
+    }))
+    print(
+        f"# replay: bulk {bulk_rate:.1f} vs per-block {base_rate:.1f} "
+        f"sigsets/s ({speedup:.2f}x) over {n_blocks} blocks, "
+        f"window {window}, device={use_device}",
+        file=sys.stderr,
+    )
+    if os.environ.get("BENCH_REPLAY_STRICT") == "1" and not target_met:
+        raise SystemExit(1)
+
+
 if __name__ == "__main__":
     if "--coldstart-child" in sys.argv:
         bench_coldstart_child(
@@ -866,6 +979,8 @@ if __name__ == "__main__":
         bench_coldstart()
     elif "--chaos" in sys.argv or os.environ.get("BENCH_CHAOS") == "1":
         bench_chaos()
+    elif "--replay" in sys.argv or os.environ.get("BENCH_REPLAY") == "1":
+        bench_replay()
     elif os.environ.get("BENCH_SCHED_ONLY") == "1":
         bench_verify_scheduler()
     else:

@@ -115,6 +115,12 @@ def build_parser() -> argparse.ArgumentParser:
 
     rep = sub.add_parser("replay",
                          help="re-validate the stored finalized chain")
+    rep.add_argument("--window", type=int, default=None,
+                     help="blocks per cross-block verification batch")
+    rep.add_argument("--per-block", action="store_true",
+                     help="legacy one-dispatch-per-block replay (baseline)")
+    rep.add_argument("--no-slasher", action="store_true",
+                     help="skip historical slashing surveillance")
     return parser
 
 
@@ -492,8 +498,15 @@ def cmd_import_interchange(args) -> int:
 
 def cmd_replay(args) -> int:
     """Re-validate the stored finalized chain from its first anchor with
-    full batch signature verification (the ad_hoc_bench shape)."""
+    cross-block batched signature verification, feeding every replayed
+    attestation through the slasher (historical surveillance)."""
     from grandine_tpu.consensus.verifier import MultiVerifier, TpuVerifier
+    from grandine_tpu.runtime.replay import (
+        DEFAULT_WINDOW_BLOCKS,
+        BulkReplayPipeline,
+        ReplayInvalidBlock,
+    )
+    from grandine_tpu.slasher import Slasher
     from grandine_tpu.storage import Database, Storage
     from grandine_tpu.transition.combined import custom_state_transition
 
@@ -505,20 +518,38 @@ def cmd_replay(args) -> int:
         print("no stored chain", file=sys.stderr)
         return 1
     latest = storage.latest_persisted_slot()
-    n = 0
-    t0 = time.time()
-    cur = start_state
+    blocks = []
     for slot in range(int(start_state.slot) + 1, latest + 1):
         root = storage.finalized_root_by_slot(slot)
         if root is None:
             continue  # empty slot
-        blk = storage.finalized_block_by_root(root)
-        verifier = TpuVerifier() if args.use_device else MultiVerifier()
-        cur = custom_state_transition(cur, blk, cfg, verifier)
-        n += 1
+        blocks.append(storage.finalized_block_by_root(root))
+    t0 = time.time()
+    if getattr(args, "per_block", False):
+        cur = start_state
+        for blk in blocks:
+            verifier = TpuVerifier() if args.use_device else MultiVerifier()
+            cur = custom_state_transition(cur, blk, cfg, verifier)
+        n, sigsets, hits = len(blocks), 0, 0
+    else:
+        slasher = None if getattr(args, "no_slasher", False) else Slasher()
+        pipeline = BulkReplayPipeline(
+            cfg, use_device=args.use_device,
+            window_size=getattr(args, "window", None) or DEFAULT_WINDOW_BLOCKS,
+            slasher=slasher,
+        )
+        try:
+            pipeline.replay(start_state, blocks)
+        except ReplayInvalidBlock as e:
+            print(f"stored chain INVALID: {e}", file=sys.stderr)
+            return 1
+        n = pipeline.stats["blocks"]
+        sigsets = pipeline.stats["sigsets"]
+        hits = pipeline.stats["slasher_hits"]
     dt = time.time() - t0
     if n:
-        print(f"replayed {n} blocks in {dt:.1f}s ({n / dt:.1f} blocks/s)")
+        print(f"replayed {n} blocks in {dt:.1f}s ({n / dt:.1f} blocks/s, "
+              f"{sigsets} signature sets, {hits} slashing hit(s))")
     else:
         print("nothing to replay")
     return 0

@@ -95,13 +95,22 @@ def attestation_signing_root(state, data, cfg) -> bytes:
 
 
 def extend_with_indexed_attestation(v: Verifier, state, indexed, cfg) -> None:
-    """fast_aggregate_verify shape: aggregate the attesting keys host-side,
-    one triple (verifier.rs Triple aggregation :367-405)."""
+    """fast_aggregate_verify shape, handed to the verifier in INDEXED form
+    (registry rows + the state's compressed pubkey columns) so device
+    verifiers can gather the keys from the resident registry; host
+    verifiers decompress-and-delegate in the base class, preserving the
+    old aggregate-the-keys semantics (verifier.rs Triple aggregation
+    :367-405)."""
     if v.is_null():
         return
     root = attestation_signing_root(state, indexed.data, cfg)
-    pks = [_pubkey(state, int(i)) for i in indexed.attesting_indices]
-    v.verify_aggregate(root, bytes(indexed.signature), pks)
+    cols = accessors.registry_columns(state)
+    v.verify_aggregate_indexed(
+        root,
+        bytes(indexed.signature),
+        [int(i) for i in indexed.attesting_indices],
+        cols.pubkeys,
+    )
 
 
 # --- voluntary exits -------------------------------------------------------

@@ -11,6 +11,8 @@ This is the ONE place that knows which BLS backend runs a batch:
   MultiVerifier  — accumulate `Triple`s, one anchor RLC batch in finish()
   TpuVerifier    — accumulate `Triple`s, ship ONE padded batch to
                    `TpuBlsBackend.multi_verify` (the accelerator plane)
+  CollectingVerifier — defer everything into an external sink spanning
+                   MANY blocks (the bulk replay pipeline's window mode)
 
 Transition/fork-choice code takes a `Verifier` argument and never sees the
 backend choice, exactly like the reference.
@@ -67,6 +69,34 @@ class Verifier:
             raise SignatureInvalid("aggregate with no public keys")
         self.verify_singular(message, signature, A.PublicKey.aggregate(public_keys))
 
+    def verify_aggregate_indexed(
+        self,
+        message: bytes,
+        signature: bytes,
+        member_indices: "Sequence[int]",
+        pubkey_columns,
+    ) -> None:
+        """fast_aggregate_verify with the signer set named by registry row
+        indices into the state's compressed pubkey columns — the geometry
+        device backends need to gather keys from the resident registry
+        (tpu/registry.py) without the host decompressing them. Default:
+        decompress and delegate, so host verifiers keep their exact
+        semantics."""
+        if not member_indices:
+            raise SignatureInvalid("aggregate with no public keys")
+        from grandine_tpu.consensus import keys
+
+        try:
+            pks = [
+                keys.decompress_pubkey(
+                    bytes(pubkey_columns[int(i)]), trusted=True
+                )
+                for i in member_indices
+            ]
+        except Exception as e:
+            raise SignatureInvalid(f"invalid registry pubkey: {e}") from e
+        self.verify_aggregate(message, signature, pks)
+
     def extend(self, triples: "Sequence[Triple]") -> None:
         for t in triples:
             self.verify_singular(t.message, t.signature, t.public_key)
@@ -98,6 +128,11 @@ class NullVerifier(Verifier):
     def verify_aggregate(self, message, signature, public_keys) -> None:
         pass
 
+    def verify_aggregate_indexed(
+        self, message, signature, member_indices, pubkey_columns
+    ) -> None:
+        pass
+
     def extend(self, triples) -> None:
         pass
 
@@ -117,6 +152,47 @@ class SingleVerifier(Verifier):
             raise SignatureInvalid(f"malformed signature: {e}") from e
         if not sig.verify(bytes(message), public_key):
             raise SignatureInvalid(f"invalid signature over {bytes(message).hex()}")
+
+
+class CollectingVerifier(Verifier):
+    """Defer every check into an external cross-block sink; finish() is a
+    no-op. The bulk replay pipeline (runtime/replay.py) runs
+    `custom_state_transition` over a WINDOW of blocks with one of these,
+    so the signatures of all blocks in the window accumulate into shared
+    device batches instead of one dispatch per block.
+
+    `sink` is duck-typed: `sink.add(message, signature, public_keys=...)`
+    or `sink.add(message, signature, member_indices=..., pubkey_columns=
+    ...)`. Structural rejections that the host verifiers raise at collect
+    time (empty aggregates) still raise here — they are properties of the
+    block, not of any signature batch."""
+
+    def __init__(self, sink) -> None:
+        self.sink = sink
+
+    def verify_singular(self, message, signature, public_key) -> None:
+        self.sink.add(message, signature, public_keys=(public_key,))
+
+    def verify_aggregate(self, message, signature, public_keys) -> None:
+        if not public_keys:
+            raise SignatureInvalid("aggregate with no public keys")
+        self.sink.add(message, signature, public_keys=tuple(public_keys))
+
+    def verify_aggregate_indexed(
+        self, message, signature, member_indices, pubkey_columns
+    ) -> None:
+        if not member_indices:
+            raise SignatureInvalid("aggregate with no public keys")
+        self.sink.add(
+            message,
+            signature,
+            member_indices=tuple(int(i) for i in member_indices),
+            pubkey_columns=pubkey_columns,
+        )
+
+    def extend(self, triples) -> None:
+        for t in triples:
+            self.sink.add(t.message, t.signature, public_keys=(t.public_key,))
 
 
 class MultiVerifier(Verifier):
@@ -192,6 +268,7 @@ __all__ = [
     "Triple",
     "Verifier",
     "NullVerifier",
+    "CollectingVerifier",
     "SingleVerifier",
     "MultiVerifier",
     "TpuVerifier",

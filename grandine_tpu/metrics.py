@@ -537,6 +537,31 @@ class Metrics:
             "a live batch; steady state must hold at zero "
             "(tools/shapes manifest)",
         )
+        # bulk replay pipeline (runtime/replay.py): whole-window wall
+        # time (transition+collect through settle), cross-block
+        # signature sets and blocks verified, and how many windows are
+        # in flight (dispatched, not settled — 0..pipeline_depth)
+        self.replay_window_seconds = Histogram(
+            "replay_window_seconds",
+            "bulk replay window wall time, transition through settle",
+            buckets=(
+                0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                25.0, 60.0,
+            ),
+        )
+        self.replay_sigsets = Counter(
+            "replay_sigsets_total",
+            "signature sets verified by the bulk replay pipeline",
+        )
+        self.replay_blocks = Counter(
+            "replay_blocks_total",
+            "blocks whose window batch settled valid in the bulk "
+            "replay pipeline",
+        )
+        self.replay_pipeline_depth = Gauge(
+            "replay_pipeline_depth",
+            "replay windows in flight (dispatched, not settled)",
+        )
 
     def collect_system_stats(self, data_dir: "str | None" = None) -> None:
         """Refresh the /proc-sourced gauges (metrics/src/service.rs

@@ -6,10 +6,13 @@ batches verified against one head state).
 
 from __future__ import annotations
 
+import logging
 from typing import Optional
 
 from grandine_tpu.consensus.verifier import MultiVerifier, NullVerifier
 from grandine_tpu.types.combined import decode_signed_block
+
+logger = logging.getLogger("grandine.sync")
 
 
 class SyncManager:
@@ -47,15 +50,24 @@ class BlockSyncService:
     out-of-order arrival)."""
 
     def __init__(self, transport, controller, cfg,
-                 batch_size: "Optional[int]" = None) -> None:
+                 batch_size: "Optional[int]" = None,
+                 bulk_verify: bool = False,
+                 replay_pipeline=None) -> None:
         self.transport = transport
         self.controller = controller
         self.cfg = cfg
         self.sync_manager = SyncManager(transport)
         # two epochs per round, like the reference's verification pool
         self.batch_size = batch_size or 2 * cfg.preset.SLOTS_PER_EPOCH
+        #: bulk mode: verify a fetched range as ONE cross-block batch
+        #: through the replay pipeline, then import trusted — any
+        #: pipeline failure degrades to the per-block path, which stays
+        #: the arbiter of validity
+        self.bulk_verify = bulk_verify
+        self._pipeline = replay_pipeline
         self.stats = {"requested": 0, "applied_batches": 0,
-                      "root_requests": 0, "blob_requests": 0}
+                      "root_requests": 0, "blob_requests": 0,
+                      "bulk_blocks": 0, "bulk_fallbacks": 0}
         # resolve delayed-by-parent blocks via BlocksByRoot instead of
         # waiting for the next range round (p2p/src/network.rs:911-912)
         if hasattr(controller, "on_unknown_parent"):
@@ -145,12 +157,44 @@ class BlockSyncService:
             max_received = max(int(b.message.slot) for b in blocks)
             self.controller.on_tick(Tick(max_received, TickKind.AGGREGATE))
             self._fetch_blobs(peer, blocks)
-        for block in blocks:
-            self.controller.on_requested_block(block)
+        if not (self.bulk_verify and self._bulk_import(snap, blocks)):
+            for block in blocks:
+                self.controller.on_requested_block(block)
         self.controller.wait()
         self.stats["applied_batches"] += 1
         head = int(self.controller.snapshot().head_state.slot)
         return bool(blocks) and head < target
+
+    def _bulk_import(self, snap, blocks) -> bool:
+        """Verify a fetched range as ONE cross-block pipeline batch against
+        the head state, then import trusted. Returns False (per-block
+        fallback) when the range is not a contiguous chain off the head,
+        or when the pipeline rejects anything — the per-block path stays
+        the arbiter of validity and will name the bad block."""
+        if not blocks:
+            return False
+        ordered = sorted(blocks, key=lambda b: int(b.message.slot))
+        parent = bytes(snap.head_root)
+        for b in ordered:
+            if bytes(b.message.parent_root) != parent:
+                self.stats["bulk_fallbacks"] += 1
+                return False
+            parent = bytes(b.message.hash_tree_root())
+        if self._pipeline is None:
+            from grandine_tpu.runtime.replay import BulkReplayPipeline
+
+            self._pipeline = BulkReplayPipeline(self.cfg)
+        try:
+            self._pipeline.replay(snap.head_state, ordered)
+        except Exception as e:
+            logger.warning("bulk range verification failed (%s); "
+                           "falling back to per-block import", e)
+            self.stats["bulk_fallbacks"] += 1
+            return False
+        for b in ordered:
+            self.controller.on_verified_block(b)
+        self.stats["bulk_blocks"] += len(ordered)
+        return True
 
     def sync_to_head(self, max_rounds: int = 1000) -> None:
         for _ in range(max_rounds):
@@ -161,29 +205,36 @@ class BlockSyncService:
 
 def back_sync(storage, transport, cfg, anchor_slot: int,
               peer: "Optional[str]" = None, batch_size: int = 64,
-              verify: bool = True) -> int:
+              verify: bool = True, use_device: bool = False,
+              window_size: "Optional[int]" = None,
+              slasher=None) -> dict:
     """Reverse-fill history below a checkpoint anchor down to genesis
     (back_sync.rs): request ranges below `anchor_slot`, check hash-chain
-    linkage child->parent, persist to the finalized schema. Returns the
-    number of blocks stored.
+    linkage child->parent, persist to the finalized schema. Returns a
+    stats dict: ``stored`` blocks persisted, ``off_chain`` blocks dropped
+    for not being on the anchored chain, ``reverified`` blocks whose
+    signatures were re-checked.
 
     With verify=True the linkage to the trusted anchor root guards
-    integrity (the reference trusts back-synced signature batches behind
-    `TrustBackSyncBlocks`; full signature re-verification would need the
-    historical states)."""
+    integrity during the fill; once the fill reaches a stored genesis
+    state the whole history is additionally replayed through the bulk
+    pipeline for FULL signature re-verification (closing the reference's
+    `TrustBackSyncBlocks` escape hatch). Checkpoint-sync nodes whose
+    first anchor IS the checkpoint have no pre-anchor state to replay
+    from; they keep linkage-only verification (logged once)."""
     from grandine_tpu.storage.storage import (
         PREFIX_BLOCK,
         PREFIX_SLOT_INDEX,
         _slot_key,
     )
 
+    stats = {"stored": 0, "off_chain": 0, "reverified": 0}
     if peer is None:
         peers = transport.peers()
         if not peers:
-            return 0
+            return stats
         peer = peers[0]
 
-    stored = 0
     # expected root of the next (lower) block comes from the anchor chain
     anchor_root = storage.finalized_root_by_slot(anchor_slot)
     expected_parent = None
@@ -207,30 +258,109 @@ def back_sync(storage, transport, cfg, anchor_slot: int,
         blocks = [decode_signed_block(r, cfg) for r in raws]
         blocks.sort(key=lambda b: -int(b.message.slot))  # high -> low
         items = []
+        off_chain = 0
         for block in blocks:
             root = block.message.hash_tree_root()
             if verify and expected_parent is not None and root != expected_parent:
+                off_chain += 1
                 continue  # not on the anchored chain
             items.append((PREFIX_BLOCK + root, block.serialize()))
             items.append(
                 (_slot_key(PREFIX_SLOT_INDEX, int(block.message.slot)), root)
             )
             expected_parent = bytes(block.message.parent_root)
-            stored += 1
+            stats["stored"] += 1
+        if off_chain:
+            stats["off_chain"] += off_chain
+            logger.warning(
+                "back_sync: dropped %d off-anchor-chain block(s) in "
+                "slots [%d, %d] from peer %s", off_chain, start, slot_hi,
+                peer,
+            )
         storage.db.put_batch(items)
         # an empty window just moves the cursor down (long empty stretches
         # are normal); the loop ends when the window reaches genesis
         slot_hi = start - 1
         if start == 0:
             break
-    return stored
+
+    if verify and stats["stored"]:
+        stats["reverified"] = _reverify_back_synced(
+            storage, cfg, anchor_slot, use_device=use_device,
+            window_size=window_size, slasher=slasher,
+        )
+    return stats
 
 
-def verify_block_batch(anchor_state, blocks, cfg, use_device: bool = False):
-    """Two-epoch batch verification against one base state
-    (block_verification_pool.rs:76-129): replay each block with a fresh
-    MultiVerifier (one RLC batch per block), returning the post states.
-    Raises on the first invalid block."""
+def _reverify_back_synced(storage, cfg, anchor_slot: int, *,
+                          use_device: bool = False,
+                          window_size: "Optional[int]" = None,
+                          slasher=None) -> int:
+    """Full signature re-verification of the back-synced range through
+    the bulk replay pipeline, anchored at the stored genesis state.
+    Raises ReplayInvalidBlock on a bad signature; returns the number of
+    blocks re-verified (0 when no pre-anchor state exists to replay
+    from — the checkpoint-sync case)."""
+    genesis = storage.load_genesis_state()
+    if genesis is None or int(genesis.slot) >= anchor_slot:
+        logger.warning(
+            "back_sync: no pre-anchor state available; back-synced "
+            "history below slot %d keeps linkage-only verification",
+            anchor_slot,
+        )
+        return 0
+    blocks = []
+    for slot in range(int(genesis.slot) + 1, anchor_slot):
+        root = storage.finalized_root_by_slot(slot)
+        if root is None:
+            continue  # empty slot
+        block = storage.finalized_block_by_root(root)
+        if block is not None:
+            blocks.append(block)
+    if not blocks:
+        return 0
+    from grandine_tpu.runtime.replay import (
+        DEFAULT_WINDOW_BLOCKS,
+        BulkReplayPipeline,
+    )
+
+    pipeline = BulkReplayPipeline(
+        cfg, use_device=use_device,
+        window_size=window_size or DEFAULT_WINDOW_BLOCKS,
+        slasher=slasher,
+    )
+    pipeline.replay(genesis, blocks)
+    logger.info("back_sync: re-verified %d block(s) of back-synced "
+                "history (%d signature sets)", len(blocks),
+                pipeline.stats["sigsets"])
+    return len(blocks)
+
+
+def verify_block_batch(anchor_state, blocks, cfg, use_device: bool = False,
+                       bulk: bool = True,
+                       window_size: "Optional[int]" = None,
+                       slasher=None):
+    """Batch verification against one base state
+    (block_verification_pool.rs:76-129), returning the post states and
+    raising on the first invalid block.
+
+    bulk=True (default) routes through the BulkReplayPipeline: ONE
+    cross-block batch per window instead of one dispatch per block.
+    bulk=False keeps the legacy shape — a fresh verifier and one RLC
+    batch PER BLOCK — as the per-block baseline (`bench.py --replay`
+    measures the two against each other)."""
+    if bulk:
+        from grandine_tpu.runtime.replay import (
+            DEFAULT_WINDOW_BLOCKS,
+            BulkReplayPipeline,
+        )
+
+        pipeline = BulkReplayPipeline(
+            cfg, use_device=use_device,
+            window_size=window_size or DEFAULT_WINDOW_BLOCKS,
+            slasher=slasher,
+        )
+        return pipeline.replay(anchor_state, blocks)
     from grandine_tpu.consensus.verifier import TpuVerifier
     from grandine_tpu.transition.combined import custom_state_transition
 

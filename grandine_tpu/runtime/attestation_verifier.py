@@ -657,7 +657,7 @@ class AttestationVerifier:
             prior_root = bytes.fromhex(hit.evidence["roots"][0])
         elif hit.kind in ("surround_vote", "surrounded_vote"):
             prior_target = int(hit.evidence["existing"][1])
-            rec = self.slasher._record(hit.validator_index, prior_target)
+            rec = self.slasher.record_for(hit.validator_index, prior_target)
             if rec is None:
                 return None  # evidence pruned
             prior_root = rec[1]

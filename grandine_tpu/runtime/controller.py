@@ -313,6 +313,13 @@ class Controller:
         root is still checked (tasks.rs:103-118 TrustOwnBlockSignatures)."""
         self._spawn_block_task(signed_block, trusted=True)
 
+    def on_verified_block(self, signed_block) -> None:
+        """Block whose signatures were already verified out-of-band (the
+        bulk replay pipeline re-ran the full transition with batch
+        verification): skip the per-block verifier, keep the state-root
+        check."""
+        self._spawn_block_task(signed_block, trusted=True)
+
     def on_valid_attestation_batch(
         self, valids: "Sequence[ValidAttestation]"
     ) -> None:

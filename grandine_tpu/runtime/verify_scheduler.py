@@ -852,6 +852,16 @@ class DeferredVerifier(Verifier):
             VerifyItem(message, signature, public_keys=public_keys)
         )
 
+    def verify_aggregate_indexed(
+        self, message, signature, member_indices, pubkey_columns
+    ) -> None:
+        if not member_indices:
+            raise SignatureInvalid("aggregate with no public keys")
+        self.items.append(
+            VerifyItem(message, signature, member_indices=member_indices,
+                       pubkey_columns=pubkey_columns)
+        )
+
     def extend(self, triples) -> None:
         for t in triples:
             self.verify_singular(t.message, t.signature, t.public_key)

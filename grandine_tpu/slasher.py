@@ -122,6 +122,14 @@ class Slasher:
         raw = bytes(raw)
         return int.from_bytes(raw[:8], "big"), raw[8:40]
 
+    def record_for(self, validator_index: int, target: int):
+        """Recorded vote of `validator_index` at `target`, as
+        (source_epoch, data_root) — or None when the validator has no
+        recorded attestation for that target epoch. The public read used
+        by the firehose and replay feeds to assemble double-vote
+        evidence."""
+        return self._record(int(validator_index), int(target))
+
     # -------------------------------------------------------- attestations
 
     def on_attestation(

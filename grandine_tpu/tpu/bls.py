@@ -1138,6 +1138,7 @@ class TpuBlsBackend:
         "fast_aggregate_verify_batch_async",
         "g2_subgroup_check_batch_async",
         "fast_aggregate_verify_batch_indexed_async",
+        "multi_verify_async",
     )
 
     def __init__(self, metrics=None, tracer=None,

@@ -56,6 +56,7 @@ class HostSyncRule(Rule):
         "grandine_tpu/runtime/attestation_verifier.py",
         "grandine_tpu/runtime/verify_scheduler.py",
         "grandine_tpu/runtime/health.py",
+        "grandine_tpu/runtime/replay.py",
     )
 
     def check(self, ctx: Context, files):

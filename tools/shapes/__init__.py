@@ -60,6 +60,7 @@ BLS_PATH = "grandine_tpu/tpu/bls.py"
 REGISTRY_PATH = "grandine_tpu/tpu/registry.py"
 VERIFIER_PATH = "grandine_tpu/runtime/attestation_verifier.py"
 SCHEDULER_PATH = "grandine_tpu/runtime/verify_scheduler.py"
+REPLAY_PATH = "grandine_tpu/runtime/replay.py"
 
 TPU_FILES = (
     BLS_PATH,
@@ -67,7 +68,7 @@ TPU_FILES = (
     "grandine_tpu/tpu/pairing.py",
     REGISTRY_PATH,
 )
-RUNTIME_FILES = (VERIFIER_PATH, SCHEDULER_PATH)
+RUNTIME_FILES = (VERIFIER_PATH, SCHEDULER_PATH, REPLAY_PATH)
 DEFAULT_FILES = TPU_FILES + RUNTIME_FILES
 
 #: named jit factories: call sites register a kernel under a literal name
@@ -212,6 +213,24 @@ class Analysis:
             ("sign", (64, 512), "policy:signer"),
             ("subgroup", tuple(ladder), derived),
         ]
+        # bulk replay stacks a WINDOW of blocks into one multi_verify
+        # dispatch (the multi_verify policy ladder above already covers
+        # it) plus one subgroup-check batch of the same width, which runs
+        # past the firehose subgroup ladder; a sparse pow-2 policy ladder
+        # (every other rung) up to the device cap keeps those shapes warm
+        # without compiling every rung
+        window = self.bounds.get("replay.window_blocks")
+        if window:
+            cap = min(self.bounds.get("bls.MAX_BUCKET", 4096), 128 * window)
+            bulk, b = [], ladder[-1] * 2
+            while b <= cap:
+                bulk.append(b)
+                b <<= 2
+            if bulk:
+                rows.append((
+                    "subgroup", tuple(bulk),
+                    "policy:bulk-replay(window_blocks)",
+                ))
         return rows
 
 
@@ -712,6 +731,18 @@ def _parse_bounds(ctx: Context, files, analysis, findings) -> None:
             ))
         else:
             analysis.bounds["attestation_verifier.MAX_BATCH"] = val
+    if REPLAY_PATH in files:
+        tree = ctx.tree(REPLAY_PATH)
+        val = _module_int(tree, "DEFAULT_WINDOW_BLOCKS") if tree else None
+        if val is None:
+            findings.append(Finding(
+                RULE, REPLAY_PATH, 1,
+                "DEFAULT_WINDOW_BLOCKS is not a literal int: the bulk "
+                "replay warm ladder cannot be derived",
+                key=f"{RULE}:{REPLAY_PATH}:window-unprovable",
+            ))
+        else:
+            analysis.bounds["replay.window_blocks"] = val
     if BLS_PATH in files:
         tree = ctx.tree(BLS_PATH)
         val = _module_int(tree, "MAX_BUCKET") if tree else None
