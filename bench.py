@@ -555,6 +555,14 @@ def bench_verify_scheduler() -> None:
         f"measures lane scheduling, not crypto",
         file=sys.stderr,
     )
+    # the scheduler's own flight recorder saw every batch above
+    print(
+        json.dumps({
+            "metric": "verify_flight_summary",
+            "value": sched.flight.summary(),
+        }),
+        file=sys.stderr,
+    )
 
 
 def bench_chaos() -> None:
@@ -567,6 +575,12 @@ def bench_chaos() -> None:
     breaker demonstrably opens/probes/re-closes. No accelerator needed —
     the device is a truth-table stub; this soaks the SUPERVISOR.
 
+    The soak also audits the flight recorder's TIMELINE: every injected
+    fault kind must leave a matching fault record (batch or canary),
+    every SLO miss must carry a cause that an independent copy of the
+    attribution rule agrees with, and the breaker records must trace a
+    legal CLOSED→OPEN→HALF_OPEN→CLOSED walk.
+
     Knobs: BENCH_CHAOS_SEED, BENCH_CHAOS_JOBS, BENCH_CHAOS_RATE (total
     fault probability split evenly over the five kinds)."""
     import threading
@@ -574,6 +588,12 @@ def bench_chaos() -> None:
     from grandine_tpu.crypto import bls as A
     from grandine_tpu.runtime import health as _health
     from grandine_tpu.runtime import verify_scheduler as vs
+    from grandine_tpu.runtime.flight import (
+        BATCH,
+        BREAKER,
+        FlightRecorder,
+        SLO_CAUSES,
+    )
     from grandine_tpu.testing.chaos import (
         ChaosBackend,
         FAULT_KINDS,
@@ -611,15 +631,23 @@ def bench_chaos() -> None:
 
     plan = FaultPlan(seed=seed, rates={k: rate / 5.0 for k in FAULT_KINDS})
     chaos = ChaosBackend(KnownAnswerBackend(truth), plan, slow_s=0.02)
+    # SLO budgets tightened to 5ms so every fault-lengthened batch trips
+    # a miss with an attributable cause (production budgets would
+    # swallow a 20ms slow-settle without a trace)
+    flight = FlightRecorder(
+        capacity=8192,
+        slo_budgets={"sync_message": 0.005, "block": 0.005},
+    )
     supervisor = _health.BackendHealthSupervisor(
         settle_timeout_s=0.2,  # hangs cost 200ms, not the 5s default
         probe=_health.make_canary_probe(chaos, specimens, timeout_s=0.2),
         backoff_initial_s=0.05,
         backoff_max_s=0.4,
+        flight=flight,
         rng=__import__("random").Random(seed),
     )
     sched = vs.VerifyScheduler(
-        backend=chaos, use_device=True, health=supervisor
+        backend=chaos, use_device=True, health=supervisor, flight=flight
     )
     # the host path (degradation target + bisection leaf) answers from
     # the same truth table -- the fault-free expectation is exact
@@ -686,9 +714,127 @@ def bench_chaos() -> None:
         k: sum(st[k] for st in sched.stats.values())
         for k in ("batches", "device_faults", "breaker_skips", "retries")
     }
+    # ---- deterministic fault→record probes: one scripted single-job
+    # plane per fault kind, the fault landed on the batch's VERIFY seam
+    # call (call 0 is the subgroup check), asserting the matching flight
+    # entry and — for slow_settle — the SLO-miss cause. The random soak
+    # above cannot carry this mapping: an injection landing on a retry
+    # of an already-faulted batch or inside bisection descent leaves
+    # only aggregate (or by-design zero) evidence.
+    problems: "list[str]" = []
+    probe_fault_of = {
+        "raise_dispatch": "dispatch",
+        "raise_settle": "settle",
+        "hang": "watchdog",
+        "wrong_verdict": "verdict",
+        "slow_settle": None,
+    }
+
+    def probe_kind(kind: str) -> None:
+        plan_k = FaultPlan(script=[None, kind])
+        chaos_k = ChaosBackend(KnownAnswerBackend(truth), plan_k,
+                               slow_s=0.02)
+        fl_k = FlightRecorder(slo_budgets={"block": 0.0005})
+        sup_k = _health.BackendHealthSupervisor(
+            settle_timeout_s=0.2,
+            probe=_health.make_canary_probe(chaos_k, specimens,
+                                            timeout_s=0.2),
+            backoff_initial_s=0.01,
+            backoff_max_s=0.05,
+            flight=fl_k,
+            rng=__import__("random").Random(seed),
+        )
+        s_k = vs.VerifyScheduler(
+            backend=chaos_k, use_device=True, health=sup_k, flight=fl_k
+        )
+        try:
+            tk = s_k.submit("block", [
+                vs.VerifyItem(messages[0], sig_bytes, public_keys=(pk,))
+            ])
+            s_k.flush(30.0)
+        finally:
+            s_k.stop()
+            chaos_k.release_hangs()
+        recs = fl_k.snapshot(kind=BATCH)
+        if not tk.done() or tk.ok is not True:
+            problems.append(f"{kind}: probe ticket did not settle True")
+            return
+        want = probe_fault_of[kind]
+        if want is not None:
+            if not any(r.fault == want for r in recs):
+                problems.append(
+                    f"{kind}: no batch record with fault {want!r}"
+                )
+            return
+        slowed = [
+            r for r in recs
+            if r.device_s >= 0.02 * 0.9 and r.fault is None
+        ]
+        if not slowed:
+            problems.append("slow_settle: no fault-free slowed record")
+        elif not any(
+            r.slo_miss and r.slo_cause == "device" for r in slowed
+        ):
+            problems.append(
+                "slow_settle: slowed batch did not miss SLO as 'device'"
+            )
+
+    for fault_kind in FAULT_KINDS:
+        probe_kind(fault_kind)
+
     vs.host_check_item = real_host_check
     recompiles = B.post_warmup_recompiles()
-    soak_ok = unsettled == 0 and mismatches == 0 and recompiles == 0
+
+    # ---- soak flight audit: the recorder must EXPLAIN the random soak
+    batches = flight.snapshot(kind=BATCH)
+    breaker_walk = [r.breaker_state for r in flight.snapshot(kind=BREAKER)]
+    # every SLO miss carries a cause the attribution rule (re-derived
+    # here as an independent oracle) agrees with
+    slo_missed = [r for r in batches if r.slo_miss]
+    if not slo_missed:
+        problems.append("5ms budgets produced zero SLO misses")
+    for r in slo_missed:
+        exec_s = r.device_s + r.host_s
+        if r.breaker_state == "open" and r.device_s == 0.0:
+            want = "breaker_open"
+        elif r.bisect_s > exec_s and r.bisect_s > r.queue_wait_s:
+            want = "bisection"
+        elif exec_s >= r.queue_wait_s:
+            want = "device"
+        else:
+            want = "queue_wait"
+        if r.slo_cause not in SLO_CAUSES:
+            problems.append(f"slo cause {r.slo_cause!r} outside enum")
+            break
+        if r.slo_cause != want:
+            problems.append(
+                f"slo cause {r.slo_cause!r} != expected {want!r}"
+            )
+            break
+    # breaker transitions in the timeline must be a legal walk from
+    # CLOSED, and must cover the traversal the stats counters claim
+    legal = {
+        "closed": {"open"},
+        "open": {"half_open"},
+        "half_open": {"closed", "open"},
+    }
+    prev = "closed"
+    for s in breaker_walk:
+        if s not in legal.get(prev, ()):
+            problems.append(f"illegal breaker transition {prev}->{s}")
+            break
+        prev = s
+    if br["opens"] > 0 and "open" not in breaker_walk:
+        problems.append("breaker opened but no OPEN flight record")
+    if br["closes"] > 0 and not (
+        "half_open" in breaker_walk and "closed" in breaker_walk
+    ):
+        problems.append("breaker re-closed but walk lacks half_open/closed")
+    flight_ok = not problems
+
+    soak_ok = (
+        unsettled == 0 and mismatches == 0 and recompiles == 0 and flight_ok
+    )
     print(
         json.dumps({
             "metric": "verify_chaos_soak",
@@ -710,16 +856,25 @@ def bench_chaos() -> None:
             "unsettled": unsettled,
             "verdict_mismatches": mismatches,
             "verify_recompiles_total": recompiles,
+            "flight_ok": flight_ok,
+            "flight_problems": problems,
             "soak_ok": soak_ok,
+        })
+    )
+    print(
+        json.dumps({
+            "metric": "verify_flight_summary",
+            "value": flight.summary(),
         })
     )
     print(
         f"# chaos soak: {sum(plan.injected.values())} faults over "
         f"{plan.calls} seam calls; breaker opened {br['opens']}x, "
         f"re-closed {br['closes']}x; {recompiles} steady-state "
-        f"recompiles; "
-        + ("OK" if soak_ok else
-           "FAILED (see verdict_mismatches / verify_recompiles_total)"),
+        f"recompiles; flight timeline "
+        + ("consistent; OK" if soak_ok else
+           f"problems={problems}; FAILED (see verdict_mismatches / "
+           "verify_recompiles_total / flight_problems)"),
         file=sys.stderr,
     )
     if not soak_ok:
@@ -919,10 +1074,22 @@ def bench_replay() -> None:
     pipe = BulkReplayPipeline(cfg, use_device=use_device, window_size=window)
 
     def run_bulk() -> None:
+        # flight-instrumented like BulkReplayPipeline.replay: the bench
+        # drives _dispatch_batch directly, so it files its own records
         for b_lo in range(0, len(slices), window):
             b_hi = min(b_lo + window, len(slices))
             i_lo, i_hi = slices[b_lo][0], slices[b_hi - 1][1]
-            if not pipe._dispatch_batch(items[i_lo:i_hi])():
+            fl = pipe.flight.begin_batch(
+                "replay", "multi_verify" if use_device else "host",
+                i_hi - i_lo,
+            )
+            t_d = time.time()
+            ok = pipe._dispatch_batch(items[i_lo:i_hi])()
+            (fl.note_device if use_device else fl.note_host)(
+                time.time() - t_d
+            )
+            fl.finish(ok)
+            if not ok:
                 raise SystemExit("bulk replay batch rejected valid blocks")
 
     def run_per_block() -> None:
@@ -964,6 +1131,13 @@ def bench_replay() -> None:
         f"# replay: bulk {bulk_rate:.1f} vs per-block {base_rate:.1f} "
         f"sigsets/s ({speedup:.2f}x) over {n_blocks} blocks, "
         f"window {window}, device={use_device}",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps({
+            "metric": "verify_flight_summary",
+            "value": pipe.flight.summary(),
+        }),
         file=sys.stderr,
     )
     if os.environ.get("BENCH_REPLAY_STRICT") == "1" and not target_met:

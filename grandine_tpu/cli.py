@@ -378,6 +378,7 @@ def _node_once(args, cfg) -> int:
             keymanager_token=km_token,
             data_dir=args.data_dir,
             tracer=tracer,
+            flight=node.flight,
         )
         server, _thread = serve(ctx, port=args.http_port)
         print(f"Beacon API on http://127.0.0.1:{args.http_port}")

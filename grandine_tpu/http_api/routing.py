@@ -48,6 +48,7 @@ class ApiContext:
         keymanager_token: "Optional[str]" = None,
         data_dir: "Optional[str]" = None,
         tracer=None,
+        flight=None,
     ) -> None:
         self.controller = controller
         self.cfg = cfg
@@ -69,6 +70,9 @@ class ApiContext:
         self.data_dir = data_dir
         #: grandine_tpu.tracing.Tracer backing /eth/v1/debug/grandine/trace
         self.tracer = tracer
+        #: runtime.flight.FlightRecorder backing
+        #: /eth/v1/debug/grandine/flight (verify-plane batch timeline)
+        self.flight = flight
         #: pubkey-hex -> SignedValidatorRegistrationV1 JSON (builder flow)
         self.validator_registrations: "dict[str, dict]" = {}
         #: validator index -> fee recipient (prepare_beacon_proposer)
@@ -607,6 +611,32 @@ def get_debug_trace(ctx, params, query, body):
     if str(query.get("clear", "")).lower() in ("1", "true", "yes"):
         ctx.tracer.clear()
     return payload
+
+
+def get_debug_flight(ctx, params, query, body):
+    """Verify-plane flight-recorder dump: the newest batch/canary/breaker
+    records plus the aggregate summary (SLO misses by lane+cause, bucket
+    fill, duty cycle, top failing origins). `?lane=` filters to one lane,
+    `?kind=` to one record kind, `?n=` bounds the record count."""
+    if ctx.flight is None:
+        raise ApiError(503, "flight recorder not wired")
+    lane = query.get("lane") or None
+    kind = query.get("kind") or None
+    try:
+        n = int(query.get("n", 256))
+    except ValueError:
+        raise ApiError(400, "n must be an integer") from None
+    if n < 0:
+        raise ApiError(400, "n must be non-negative")
+    records = ctx.flight.snapshot(lane=lane, n=n, kind=kind)
+    return {
+        "data": {
+            "records": [r.as_dict() for r in records],
+            "summary": ctx.flight.summary(),
+            "slo": ctx.flight.slo_misses(),
+            "origins": ctx.flight.origins.snapshot(),
+        }
+    }
 
 
 # ------------------------------------------- JSON <-> container codecs
@@ -1555,6 +1585,7 @@ def build_router() -> Router:
     r.add("POST", "/eth/v1/validator/duties/attester/{epoch}", post_attester_duties)
     r.add("GET", "/metrics", get_metrics)
     r.add("GET", "/eth/v1/debug/grandine/trace", get_debug_trace)
+    r.add("GET", "/eth/v1/debug/grandine/flight", get_debug_flight)
     # state breadth (routing.rs:341-369)
     r.add(
         "GET", "/eth/v1/beacon/states/{state_id}/committees",

@@ -537,6 +537,43 @@ class Metrics:
             "a live batch; steady state must hold at zero "
             "(tools/shapes manifest)",
         )
+        # flight recorder (runtime/flight.py): per-lane SLO misses with
+        # a CLOSED cause enum (flight.SLO_CAUSES — the lint rule
+        # rejects values outside it), bucket-fill/padding-waste per
+        # kernel (multi-chip capacity planning), and the duty-cycle /
+        # occupancy gauges measuring the two-deep overlap. Origins are
+        # NEVER labels here — they live only in the bounded flight
+        # top-K table.
+        self.verify_slo_miss = LabeledCounter(
+            "verify_slo_miss_total",
+            "verify batches that blew their lane's deadline budget, by "
+            "lane and dominant cause "
+            "(queue_wait/device/bisection/breaker_open)",
+            ("lane", "cause"),
+        )
+        self.verify_bucket_fill = LabeledHistogram(
+            "verify_bucket_fill_ratio",
+            "items over the pow-2 device bucket actually dispatched, "
+            "by kernel",
+            ("kernel",),
+            buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0),
+        )
+        self.verify_padding_waste = LabeledCounter(
+            "verify_padding_waste_total",
+            "padded-out device batch slots (bucket minus items), by "
+            "kernel",
+            ("kernel",),
+        )
+        self.verify_device_duty_cycle = Gauge(
+            "verify_device_duty_cycle",
+            "fraction of wall time with at least one verify batch on "
+            "the device",
+        )
+        self.verify_pipeline_occupancy = Gauge(
+            "verify_pipeline_occupancy",
+            "time-weighted mean verify batches in flight (the two-deep "
+            "overlap's real depth)",
+        )
         # bulk replay pipeline (runtime/replay.py): whole-window wall
         # time (transition+collect through settle), cross-block
         # signature sets and blocks verified, and how many windows are

@@ -9,6 +9,7 @@ ssz_snappy (the real encoding), topics carry the fork digest.
 
 from __future__ import annotations
 
+import inspect
 import threading
 from collections import defaultdict
 from typing import Callable, Optional
@@ -80,6 +81,9 @@ class Transport:
         raise NotImplementedError
 
     def subscribe(self, topic: str, handler: "Callable[[str, bytes], None]") -> None:
+        """Handlers taking a third positional argument additionally
+        receive the sending peer's id (failure-attribution feed for the
+        flight recorder); two-argument handlers keep working unchanged."""
         raise NotImplementedError
 
     def peers(self) -> "list[str]":
@@ -116,6 +120,25 @@ class Transport:
         raise NotImplementedError
 
 
+def _handler_accepts_sender(handler) -> bool:
+    """Arity probe done ONCE at subscribe time: a handler whose bound
+    signature takes a third positional parameter (topic, payload, sender)
+    gets the sending peer id on every publish; legacy two-argument
+    handlers never see it. Unintrospectable callables (C builtins, some
+    mocks) fall back to the legacy shape."""
+    try:
+        params = list(inspect.signature(handler).parameters.values())
+    except (TypeError, ValueError):
+        return False
+    if any(p.kind == p.VAR_POSITIONAL for p in params):
+        return True
+    positional = [
+        p for p in params
+        if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+    ]
+    return len(positional) >= 3
+
+
 class InMemoryHub:
     """Process-local gossip mesh + req/resp: every joined transport sees
     every publish (except its own); range/status requests are served by
@@ -149,13 +172,17 @@ class InMemoryHub:
     def _publish(self, sender: str, topic: str, payload: bytes) -> None:
         with self._lock:
             handlers = list(self._subs.get(topic, ()))
-        for peer_id, handler in handlers:
+        for peer_id, handler, wants_sender in handlers:
             if peer_id != sender:
-                handler(topic, payload)
+                if wants_sender:
+                    handler(topic, payload, sender)
+                else:
+                    handler(topic, payload)
 
     def _subscribe(self, peer_id: str, topic: str, handler) -> None:
+        wants_sender = _handler_accepts_sender(handler)
         with self._lock:
-            self._subs[topic].append((peer_id, handler))
+            self._subs[topic].append((peer_id, handler, wants_sender))
 
     def _peers(self, excluding: str) -> "list[str]":
         with self._lock:
@@ -356,8 +383,18 @@ class Network:
 
         return all(host_check_item(it) for it in items)
 
+    @staticmethod
+    def _origin_of(sender: "Optional[str]") -> "Optional[str]":
+        """Gossip sender → failure-attribution origin string. The
+        `peer:` prefix namespaces the id so the flight recorder's top-K
+        table can mix peer origins with future validator origins; the
+        string NEVER becomes a Prometheus label (unbounded cardinality —
+        tools/lint metrics_cardinality enforces this)."""
+        return f"peer:{sender}" if sender else None
+
     def _dispatch_verify(
-        self, lane: str, items, topic: str, reject_key: str, on_accept
+        self, lane: str, items, topic: str, reject_key: str, on_accept,
+        origin: "Optional[str]" = None,
     ) -> None:
         """Route one handler's deferred signature checks: submit to the
         scheduler lane (effects run from the ticket callback) or fall
@@ -391,6 +428,7 @@ class Network:
             sched.submit(
                 lane, items,
                 callback=lambda t: deliver(t.ok, t.dropped),
+                origin=origin,
             )
             return
         deliver(self._eager_verify_items(items))
@@ -472,7 +510,9 @@ class Network:
         except ValueError:
             return None
 
-    def _on_gossip_attestation(self, topic: str, payload: bytes) -> None:
+    def _on_gossip_attestation(
+        self, topic: str, payload: bytes, sender: "Optional[str]" = None
+    ) -> None:
         from grandine_tpu.types.combined import decode_attestation
 
         subnet = self._subnet_of_topic(topic)
@@ -496,9 +536,11 @@ class Network:
             self._count_gossip(topic, "reject")
             return
         self._count_gossip(topic, "accept")
-        self.attestation_verifier.submit(att)
+        self.attestation_verifier.submit(att, origin=self._origin_of(sender))
 
-    def _on_gossip_aggregate(self, topic: str, payload: bytes) -> None:
+    def _on_gossip_aggregate(
+        self, topic: str, payload: bytes, sender: "Optional[str]" = None
+    ) -> None:
         from grandine_tpu.types.combined import decode_signed_aggregate
 
         self.stats["aggregates_in"] += 1
@@ -515,7 +557,9 @@ class Network:
             self._count_gossip(topic, "reject")
             return
         self._count_gossip(topic, "accept")
-        self.attestation_verifier.submit(signed.message.aggregate)
+        self.attestation_verifier.submit(
+            signed.message.aggregate, origin=self._origin_of(sender)
+        )
 
     def _deneb_ns(self):
         from grandine_tpu.types.containers import spec_types
@@ -536,7 +580,7 @@ class Network:
         self.controller.on_gossip_blob_sidecar(sidecar)
 
     def _on_gossip_sync_committee_message(
-        self, topic: str, payload: bytes
+        self, topic: str, payload: bytes, sender: "Optional[str]" = None
     ) -> None:
         self.stats["sync_messages_in"] += 1
         if self.sync_pool is None:
@@ -595,9 +639,12 @@ class Network:
             [VerifyItem(root, signature, member_indices=(vidx,),
                         pubkey_columns=cols.pubkeys)],
             topic, "sync_messages_rejected", insert,
+            origin=self._origin_of(sender),
         )
 
-    def _on_gossip_sync_contribution(self, topic: str, payload: bytes) -> None:
+    def _on_gossip_sync_contribution(
+        self, topic: str, payload: bytes, sender: "Optional[str]" = None
+    ) -> None:
         self.stats["sync_contributions_in"] += 1
         if self.sync_pool is None:
             self._count_gossip(topic, "ignore")
@@ -691,9 +738,12 @@ class Network:
             ],
             topic, "sync_contributions_rejected",
             lambda: self.sync_pool.insert_contribution(contribution),
+            origin=self._origin_of(sender),
         )
 
-    def _on_gossip_proposer_slashing(self, topic: str, payload: bytes) -> None:
+    def _on_gossip_proposer_slashing(
+        self, topic: str, payload: bytes, sender: "Optional[str]" = None
+    ) -> None:
         self.stats["proposer_slashings_in"] += 1
         if self.operation_pool is None:
             self._count_gossip(topic, "ignore")
@@ -755,9 +805,12 @@ class Network:
         self._dispatch_verify(
             "slashing", items, topic, "proposer_slashings_rejected",
             lambda: self.operation_pool.insert_proposer_slashing(slashing),
+            origin=self._origin_of(sender),
         )
 
-    def _on_gossip_attester_slashing(self, topic: str, payload: bytes) -> None:
+    def _on_gossip_attester_slashing(
+        self, topic: str, payload: bytes, sender: "Optional[str]" = None
+    ) -> None:
         self.stats["attester_slashings_in"] += 1
         try:
             slashing = self._deneb_ns().AttesterSlashing.deserialize(
@@ -812,9 +865,12 @@ class Network:
 
         self._dispatch_verify(
             "slashing", items, topic, "attester_slashings_rejected", apply,
+            origin=self._origin_of(sender),
         )
 
-    def _on_gossip_bls_change(self, topic: str, payload: bytes) -> None:
+    def _on_gossip_bls_change(
+        self, topic: str, payload: bytes, sender: "Optional[str]" = None
+    ) -> None:
         self.stats["bls_changes_in"] += 1
         if self.operation_pool is None:
             self._count_gossip(topic, "ignore")
@@ -857,9 +913,12 @@ class Network:
             lambda: self.operation_pool.insert_bls_to_execution_change(
                 signed
             ),
+            origin=self._origin_of(sender),
         )
 
-    def _on_gossip_voluntary_exit(self, topic: str, payload: bytes) -> None:
+    def _on_gossip_voluntary_exit(
+        self, topic: str, payload: bytes, sender: "Optional[str]" = None
+    ) -> None:
         self.stats["voluntary_exits_in"] += 1
         if self.operation_pool is None:
             self._count_gossip(topic, "ignore")
@@ -900,6 +959,7 @@ class Network:
         self._dispatch_verify(
             "exit", items, topic, "voluntary_exits_rejected",
             lambda: self.operation_pool.insert_voluntary_exit(signed),
+            origin=self._origin_of(sender),
         )
 
     # ----------------------------------------------------------- outbound

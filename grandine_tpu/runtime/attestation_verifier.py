@@ -25,6 +25,7 @@ from grandine_tpu.consensus import accessors, keys, signing
 from grandine_tpu.consensus.verifier import SignatureInvalid
 from grandine_tpu.crypto import bls as A
 from grandine_tpu.fork_choice.store import ForkChoiceError, ValidAttestation
+from grandine_tpu.runtime import flight as _flight
 from grandine_tpu.runtime import health as _health
 from grandine_tpu.runtime.thread_pool import Priority
 from grandine_tpu.tracing import NULL_TRACER
@@ -33,13 +34,17 @@ MAX_BATCH = 64  # attestation_verifier.rs:37
 
 
 class GossipAttestation:
-    """One attestation off the wire, pre-verification."""
+    """One attestation off the wire, pre-verification. `origin` is the
+    gossip peer attribution ("peer:<id>") for the flight recorder's
+    failing-origin table — never a metrics label."""
 
-    __slots__ = ("attestation", "received_at")
+    __slots__ = ("attestation", "received_at", "origin")
 
-    def __init__(self, attestation, received_at: "Optional[float]" = None) -> None:
+    def __init__(self, attestation, received_at: "Optional[float]" = None,
+                 origin: "Optional[str]" = None) -> None:
         self.attestation = attestation
         self.received_at = received_at if received_at is not None else time.time()
+        self.origin = origin
 
 
 class AttestationVerifier:
@@ -66,6 +71,7 @@ class AttestationVerifier:
         tracer=None,
         health: "Optional[_health.BackendHealthSupervisor]" = None,
         settle_timeout_s: float = 5.0,
+        flight: "Optional[_flight.FlightRecorder]" = None,
     ) -> None:
         self.controller = controller
         self.cfg = controller.cfg
@@ -102,12 +108,24 @@ class AttestationVerifier:
         #: breaker + settle watchdog + canary gating; node.py passes the
         #: scheduler's supervisor so both verify planes quarantine the
         #: device together
+        #: flight recorder — always-on (a private ring when none is
+        #: injected; node.py shares one across the whole verify plane)
+        self.flight = (
+            flight if flight is not None
+            else _flight.FlightRecorder(metrics=self.metrics)
+        )
         self.health = (
             health if health is not None
             else _health.BackendHealthSupervisor(
-                metrics=self.metrics, settle_timeout_s=settle_timeout_s
+                metrics=self.metrics, settle_timeout_s=settle_timeout_s,
+                flight=self.flight,
             )
         )
+        if self.health.flight is None:
+            # an injected supervisor without its own recorder joins this
+            # pipeline's timeline
+            self.health.flight = self.flight
+            self.health.breaker.flight = self.flight
         self._queue: "deque[GossipAttestation]" = deque()
         self._cond = threading.Condition()
         self._active = 0
@@ -156,14 +174,17 @@ class AttestationVerifier:
 
     # ----------------------------------------------------------- ingestion
 
-    def submit(self, attestation) -> None:
+    def submit(self, attestation, origin: "Optional[str]" = None) -> None:
         with self._cond:
-            self._queue.append(GossipAttestation(attestation))
+            self._queue.append(GossipAttestation(attestation, origin=origin))
             self._cond.notify()
 
-    def submit_many(self, attestations: "Sequence") -> None:
+    def submit_many(self, attestations: "Sequence",
+                    origin: "Optional[str]" = None) -> None:
         with self._cond:
-            self._queue.extend(GossipAttestation(a) for a in attestations)
+            self._queue.extend(
+                GossipAttestation(a, origin=origin) for a in attestations
+            )
             self._cond.notify()
 
     # ----------------------------------------------------------- collector
@@ -269,42 +290,69 @@ class AttestationVerifier:
         with self._stage("host_prep", items=len(batch)):
             for item in batch:
                 try:
-                    prepared.append(self._prevalidate(state, item.attestation))
+                    prepared.append(
+                        self._prevalidate(state, item.attestation)
+                        + (item.origin,)
+                    )
                 except (ForkChoiceError, ValueError, KeyError):
                     # KeyError: raced the mutator's finalization prune (the
                     # same race the block task path catches)
                     self.stats["rejected"] += 1
         if not prepared:
             return
+        # accumulate-wait of the OLDEST attestation in the batch is its
+        # queue_wait component for flight SLO attribution
+        fl = self.flight.begin_batch(
+            self.lane, "", len(prepared),
+            queue_wait_s=max(
+                0.0, time.time() - min(it.received_at for it in batch)
+            ),
+            breaker_state=self.health.state if self.use_device else "",
+        )
+        skipped = False
         if self.use_device and self._completion is not None:
             if not self.health.allow_device():
                 # breaker OPEN: zero device dispatch attempts — straight
                 # to the host anchor below, no per-batch fault tax
                 self.stats["breaker_skips"] += 1
+                skipped = True
             else:
+                t0 = time.perf_counter()
                 try:
                     settle = self._device_dispatch(prepared)
+                    fl.note_device(time.perf_counter() - t0)
                 except Exception:
+                    fl.note_device(time.perf_counter() - t0)
+                    fl.note_fault("dispatch")
                     self.health.record_fault("dispatch")
                     # bounded transient retry: one immediate re-dispatch
-                    settle = self._retry_dispatch(prepared)
+                    settle = self._retry_dispatch(prepared, fl)
                 if settle is not None:
                     # pipelined path: readback is deferred to the
                     # completion thread so this pool thread (and the
                     # collector behind it) can start the NEXT batch's
                     # host_prep while the device executes this one
-                    self._enqueue_settle(settle, prepared)
+                    fl.record.kernel = "fast_aggregate"
+                    self._enqueue_settle(settle, prepared, fl)
                     return
         messages = [p[0] for p in prepared]
         signatures = [p[1] for p in prepared]
         members = [p[2] for p in prepared]
+        t0 = time.perf_counter()
         ok = self._batch_check(messages, signatures, members)
-        self._resolve_batch(prepared, ok)
+        dt = time.perf_counter() - t0
+        if self.use_device and not skipped:
+            fl.note_device(dt)
+        else:
+            fl.note_host(dt)
+        self._resolve_batch(prepared, ok, fl)
 
-    def _resolve_batch(self, prepared, ok: bool) -> None:
+    def _resolve_batch(self, prepared, ok: bool, fl=None) -> None:
         """Deliver a settled batch verdict: feedback on success, bisection
         on failure. Runs on the pool thread (sync path) or the completion
         thread (pipelined path)."""
+        if fl is None:
+            fl = self.flight.begin_batch(self.lane, "", len(prepared))
         if ok:
             self.stats["accepted"] += len(prepared)
             with self._stage("feedback", items=len(prepared)):
@@ -314,6 +362,7 @@ class AttestationVerifier:
                 # AFTER delivery: a slasher problem must never cost fork
                 # choice its verified votes
                 self._feed_slasher([(p[4], p[3]) for p in prepared])
+            fl.finish(True)
             return
         # batch failed: BISECT to the bad items with batch checks —
         # O(k·log n) verifies for k bad signatures instead of n
@@ -327,12 +376,25 @@ class AttestationVerifier:
         if self.metrics is not None:
             self.metrics.att_fallbacks.inc()
         with self._stage("fallback", items=len(prepared)):
+            t0 = time.perf_counter()
             good_items, bad_count = self._isolate(prepared)
+            fl.note_bisect(
+                time.perf_counter() - t0,
+                depth=max(1, len(prepared).bit_length()),
+            )
         if bad_count == 0:
             # the batch verdict said "invalid" yet bisection cleared
             # every item: a wrong-verdict device — file the fault kind
             # only canary probes catch at re-promotion
             self.health.record_fault("verdict")
+            fl.note_fault("verdict")
+        else:
+            # attribute each bisection-named bad item to its gossip
+            # origin (bounded top-K — the quarantine lane's feed)
+            good_ids = {id(p) for p in good_items}
+            for p in prepared:
+                if id(p) not in good_ids:
+                    fl.note_origin_failure(p[7])
         self.stats["accepted"] += len(good_items)
         self.stats["rejected"] += bad_count
         if good_items:
@@ -341,6 +403,7 @@ class AttestationVerifier:
                     [p[3] for p in good_items]
                 )
                 self._feed_slasher([(p[4], p[3]) for p in good_items])
+        fl.finish(bad_count == 0)
 
     # ------------------------------------------------------------ pipeline
 
@@ -408,7 +471,7 @@ class AttestationVerifier:
             ))
         return backend
 
-    def _retry_dispatch(self, prepared):
+    def _retry_dispatch(self, prepared, fl=None):
         """Bounded transient retry: ONE immediate re-dispatch after a
         dispatch fault, breaker permitting."""
         if not self.health.allow_device():
@@ -416,11 +479,19 @@ class AttestationVerifier:
         self.stats["retries"] += 1
         if self.metrics is not None:
             self.metrics.verify_retry.inc(self.lane)
+        if fl is not None:
+            fl.note_retry()
+        t0 = time.perf_counter()
         try:
             return self._device_dispatch(prepared)
         except Exception:
             self.health.record_fault("dispatch")
+            if fl is not None:
+                fl.note_fault("dispatch")
             return None
+        finally:
+            if fl is not None:
+                fl.note_device(time.perf_counter() - t0)
 
     def _count_daemon_failure(self, thread: str) -> None:
         if self.metrics is not None:
@@ -443,7 +514,7 @@ class AttestationVerifier:
             pass
         return None
 
-    def _enqueue_settle(self, settle, prepared) -> None:
+    def _enqueue_settle(self, settle, prepared, fl=None) -> None:
         """Hand a dispatched batch to the completion thread. Blocks when
         `pipeline_depth` batches are already in flight — backpressure that
         bounds device residency."""
@@ -453,7 +524,8 @@ class AttestationVerifier:
             depth = self._inflight
         if self.metrics is not None:
             self.metrics.verify_pipeline_depth.set(depth)
-        self._completion.put((settle, prepared, self.tracer.capture()))
+        self.flight.device_enter()
+        self._completion.put((settle, prepared, self.tracer.capture(), fl))
 
     def _complete(self) -> None:
         """Completion thread: force settled batch verdicts in dispatch
@@ -463,17 +535,20 @@ class AttestationVerifier:
             item = self._completion.get()
             if item is None:
                 return
-            settle, prepared, span_ctx = item
+            settle, prepared, span_ctx, fl = item
             try:
                 with self.tracer.attach(span_ctx):
-                    self._settle_one(settle, prepared)
+                    self._settle_one(settle, prepared, fl)
             except Exception:
                 # the completion thread must survive backend faults; the
                 # batch is dropped (counted), not silently accepted
                 self.stats["settle_errors"] = (
                     self.stats.get("settle_errors", 0) + 1
                 )
+                if fl is not None:
+                    fl.finish(None)
             finally:
+                self.flight.device_exit()
                 self._dispatch_sem.release()
                 with self._cond:
                     self._inflight -= 1
@@ -482,17 +557,20 @@ class AttestationVerifier:
                 if self.metrics is not None:
                     self.metrics.verify_pipeline_depth.set(depth)
 
-    def _settle_one(self, settle, prepared) -> None:
+    def _settle_one(self, settle, prepared, fl=None) -> None:
         """Force one batch verdict under the settle watchdog. A fault or
         watchdog expiry files a breaker fault and DEGRADES the batch to a
         fresh (breaker-gated device or host) re-check — honest votes are
         never dropped on a backend hiccup."""
+        t0 = time.perf_counter()
         outcome = self.health.guard_settle(
             settle, thread_name="attestation-settle-watchdog"
         )
+        if fl is not None:
+            fl.note_device(time.perf_counter() - t0)
         if outcome.status == _health.OK:
             self.health.record_success()
-            self._resolve_batch(prepared, bool(outcome.value))
+            self._resolve_batch(prepared, bool(outcome.value), fl)
             return
         if outcome.status == _health.TIMEOUT:
             # abandon the hung settle (its thread is an expendable
@@ -501,15 +579,22 @@ class AttestationVerifier:
             if self.metrics is not None:
                 self.metrics.verify_watchdog_fired.inc(self.lane)
             self.health.record_fault("watchdog")
+            if fl is not None:
+                fl.note_fault("watchdog")
         else:
             self.health.record_fault("settle")
+            if fl is not None:
+                fl.note_fault("settle")
         self.stats["settle_errors"] = self.stats.get("settle_errors", 0) + 1
+        t0 = time.perf_counter()
         ok = self._batch_check(
             [p[0] for p in prepared],
             [p[1] for p in prepared],
             [p[2] for p in prepared],
         )
-        self._resolve_batch(prepared, ok)
+        if fl is not None:
+            fl.note_host(time.perf_counter() - t0)
+        self._resolve_batch(prepared, ok, fl)
 
     def _isolate(self, prepared):
         """Recursive bisection over a FAILED batch: re-check halves as

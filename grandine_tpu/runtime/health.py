@@ -172,40 +172,59 @@ def default_specimens() -> "list[CanarySpecimen]":
     ]
 
 
-def run_canary(backend, specimens: "Sequence[CanarySpecimen]",
-               timeout_s: float = 5.0) -> bool:
-    """Dispatch each specimen through the backend's async seam and
-    require the exact expected verdict within the deadline. Any dispatch
-    exception, settle fault, timeout, or wrong verdict fails the probe."""
+def run_canary_detail(backend, specimens: "Sequence[CanarySpecimen]",
+                      timeout_s: float = 5.0) -> "tuple[bool, Optional[str]]":
+    """`run_canary` plus the FAULT_KINDS attribution of the first
+    failure: (passed, None) on success, else (False, kind) where kind
+    names what broke — dispatch exception, settle fault, watchdog
+    expiry, or a wrong verdict. The flight recorder files the kind so a
+    failed probe reads like the batch faults that provoked it."""
     if not has_async_seam(backend):
-        return False
+        return False, "dispatch"
     for spec in specimens:
         try:
             settle = backend.fast_aggregate_verify_batch_async(
                 [spec.message], [spec.signature], [spec.public_keys]
             )
         except Exception:
-            return False
+            return False, "dispatch"
         outcome = run_with_deadline(settle, timeout_s, "canary-probe")
+        if outcome.status == TIMEOUT:
+            return False, "watchdog"
         if outcome.status != OK:
-            return False
+            return False, "settle"
         if bool(outcome.value) != spec.expected:
-            return False
-    return True
+            return False, "verdict"
+    return True, None
+
+
+def run_canary(backend, specimens: "Sequence[CanarySpecimen]",
+               timeout_s: float = 5.0) -> bool:
+    """Dispatch each specimen through the backend's async seam and
+    require the exact expected verdict within the deadline. Any dispatch
+    exception, settle fault, timeout, or wrong verdict fails the probe."""
+    return run_canary_detail(backend, specimens, timeout_s=timeout_s)[0]
 
 
 def make_canary_probe(backend, specimens=None,
                       timeout_s: float = 5.0) -> Callable[[], bool]:
     """A zero-arg probe closure for CircuitBreaker(probe=...). Specimen
     construction is deferred to first probe so wiring a probe at
-    scheduler construction costs nothing until the breaker half-opens."""
+    scheduler construction costs nothing until the breaker half-opens.
+    The closure exposes `last_fault` (a FAULT_KINDS member or None) so
+    the breaker can attribute a failed probe in the flight timeline."""
     state: dict = {"specimens": specimens}
 
     def probe() -> bool:
         if state["specimens"] is None:
             state["specimens"] = default_specimens()
-        return run_canary(backend, state["specimens"], timeout_s=timeout_s)
+        passed, fault = run_canary_detail(
+            backend, state["specimens"], timeout_s=timeout_s
+        )
+        probe.last_fault = fault
+        return passed
 
+    probe.last_fault = None
     return probe
 
 
@@ -239,6 +258,7 @@ class CircuitBreaker:
         jitter_frac: float = 0.1,
         probe: "Optional[Callable[[], bool]]" = None,
         metrics=None,
+        flight=None,
         clock: Callable[[], float] = time.monotonic,
         rng: "Optional[random.Random]" = None,
     ) -> None:
@@ -251,6 +271,9 @@ class CircuitBreaker:
         self.jitter_frac = float(jitter_frac)
         self.probe = probe
         self.metrics = metrics
+        #: optional FlightRecorder: breaker transitions and canary
+        #: probes land in the same timeline as the batches around them
+        self.flight = flight
         self.clock = clock
         self.rng = rng if rng is not None else random.Random()
         self._lock = threading.Lock()
@@ -294,10 +317,19 @@ class CircuitBreaker:
                 return False
             self._probing = True
             probe = self.probe
+        t_probe = time.perf_counter()
         try:
             passed = bool(probe())
         except Exception:
             passed = False
+        if self.flight is not None:
+            self.flight.record_canary(
+                self.name, passed,
+                duration_s=time.perf_counter() - t_probe,
+                fault=None if passed else getattr(
+                    probe, "last_fault", None
+                ),
+            )
         with self._lock:
             self._probing = False
             if self._state != HALF_OPEN:
@@ -368,6 +400,8 @@ class CircuitBreaker:
             return
         self._state = state
         self._publish_state(state, transition=True)
+        if self.flight is not None:
+            self.flight.record_breaker(self.name, state)
 
     def _publish_state(self, state: str, transition: bool) -> None:
         if self.metrics is None:
@@ -405,10 +439,12 @@ class BackendHealthSupervisor:
         backoff_initial_s: float = 1.0,
         backoff_max_s: float = 60.0,
         jitter_frac: float = 0.1,
+        flight=None,
         clock: Callable[[], float] = time.monotonic,
         rng: "Optional[random.Random]" = None,
     ) -> None:
         self.metrics = metrics
+        self.flight = flight
         self.settle_timeout_s = float(settle_timeout_s)
         self.breaker = CircuitBreaker(
             name=name,
@@ -420,6 +456,7 @@ class BackendHealthSupervisor:
             jitter_frac=jitter_frac,
             probe=probe,
             metrics=metrics,
+            flight=flight,
             clock=clock,
             rng=rng,
         )
@@ -472,5 +509,6 @@ __all__ = [
     "has_async_seam",
     "make_canary_probe",
     "run_canary",
+    "run_canary_detail",
     "run_with_deadline",
 ]

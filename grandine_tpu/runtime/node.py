@@ -39,15 +39,23 @@ class InProcessNode:
     ) -> None:
         from grandine_tpu.consensus.verifier import MultiVerifier
 
+        from grandine_tpu.runtime.flight import FlightRecorder
         from grandine_tpu.runtime.health import BackendHealthSupervisor
 
         self.cfg = cfg
         self.metrics = metrics
         self.tracer = tracer
+        #: ONE flight recorder for the whole verify plane: scheduler
+        #: batches, firehose batches, canary probes, and breaker
+        #: transitions share a single ordered timeline (the debug
+        #: endpoint GET /eth/v1/debug/grandine/flight serves it)
+        self.flight = FlightRecorder(metrics=metrics)
         #: ONE health supervisor for the whole device verify plane: a
         #: breaker fault observed by either the scheduler or the
         #: attestation firehose quarantines the device for both
-        self.health = BackendHealthSupervisor(metrics=metrics)
+        self.health = BackendHealthSupervisor(
+            metrics=metrics, flight=self.flight
+        )
         self.verify_scheduler = None
         if use_verify_scheduler:
             from grandine_tpu.runtime.verify_scheduler import VerifyScheduler
@@ -57,6 +65,7 @@ class InProcessNode:
                 metrics=metrics,
                 tracer=tracer,
                 health=self.health,
+                flight=self.flight,
             )
             if verifier_factory is None:
                 # block proposer-signature batches ride the HIGH lane
@@ -80,6 +89,7 @@ class InProcessNode:
             metrics=metrics,
             tracer=tracer,
             health=self.health,
+            flight=self.flight,
         )
         if (
             self.verify_scheduler is not None

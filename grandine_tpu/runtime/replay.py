@@ -47,6 +47,7 @@ from grandine_tpu.consensus.verifier import (
     SignatureInvalid,
 )
 from grandine_tpu.crypto import bls as A
+from grandine_tpu.runtime import flight as _flight
 from grandine_tpu.runtime.verify_scheduler import VerifyItem, host_check_item
 from grandine_tpu.tracing import NULL_TRACER
 
@@ -135,6 +136,7 @@ class BulkReplayPipeline:
         slasher=None,
         metrics=None,
         tracer=None,
+        flight=None,
         state_root_policy: str = "verify",
     ) -> None:
         self.cfg = cfg
@@ -145,6 +147,11 @@ class BulkReplayPipeline:
             backend = TpuBlsBackend(metrics=metrics, tracer=tracer,
                                     lane="replay")
         self.backend = backend
+        #: flight recorder: one record per window in the "replay" lane
+        self.flight = (
+            flight if flight is not None
+            else _flight.FlightRecorder(metrics=metrics)
+        )
         self.window_size = max(1, int(window_size))
         self.pipeline_depth = max(1, int(pipeline_depth))
         self.slasher = slasher
@@ -164,16 +171,27 @@ class BulkReplayPipeline:
         through windowed batch verification; returns all post-states."""
         blocks = list(blocks)
         posts: list = []
-        pending: "deque[tuple[_Window, object]]" = deque()
+        pending: "deque[tuple[_Window, object, object]]" = deque()
         state = anchor_state
+        device = self.use_device and self.backend is not None
+        kernel = "multi_verify" if device else "host"
         try:
             for w0 in range(0, len(blocks), self.window_size):
                 chunk = blocks[w0 : w0 + self.window_size]
                 window, state = self._transition_and_collect(
                     state, chunk, w0
                 )
+                fl = self.flight.begin_batch(
+                    "replay", kernel, len(window.items)
+                )
+                t0 = time.perf_counter()
                 settle = self._dispatch_batch(window.items)
-                pending.append((window, settle))
+                (fl.note_device if device else fl.note_host)(
+                    time.perf_counter() - t0
+                )
+                if device:
+                    self.flight.device_enter()
+                pending.append((window, settle, fl))
                 self._note_depth(len(pending))
                 while len(pending) > self.pipeline_depth:
                     self._settle_window(*pending.popleft(), posts=posts)
@@ -343,12 +361,28 @@ class BulkReplayPipeline:
 
     # ------------------------------------------------------------- settle
 
-    def _settle_window(self, window: _Window, settle, posts: list) -> None:
+    def _settle_window(self, window: _Window, settle, fl,
+                       posts: list) -> None:
+        device = self.use_device and self.backend is not None
         with self._stage("settle", blocks=len(window.blocks)):
-            ok = bool(settle())
+            t0 = time.perf_counter()
+            try:
+                ok = bool(settle())
+            finally:
+                (fl.note_device if device else fl.note_host)(
+                    time.perf_counter() - t0
+                )
+                if device:
+                    self.flight.device_exit()
         if not ok:
             self.stats["localizations"] += 1
+            t0 = time.perf_counter()
             k, reason = self._localize(window)
+            fl.note_bisect(
+                time.perf_counter() - t0,
+                depth=max(1, len(window.blocks).bit_length()),
+            )
+            fl.finish(False)
             posts.extend(window.posts[:k])
             self._commit(window, upto=k)
             blk = window.blocks[k]
@@ -359,6 +393,7 @@ class BulkReplayPipeline:
                 reason,
                 posts,
             )
+        fl.finish(True)
         self._commit(window, upto=len(window.blocks))
         posts.extend(window.posts)
         self.stats["windows"] += 1

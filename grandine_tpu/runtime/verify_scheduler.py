@@ -50,6 +50,7 @@ from grandine_tpu.consensus.verifier import (
     Verifier,
 )
 from grandine_tpu.crypto import bls as A
+from grandine_tpu.runtime import flight as _flight
 from grandine_tpu.runtime import health as _health
 from grandine_tpu.runtime.thread_pool import Priority
 from grandine_tpu.tracing import NULL_TRACER
@@ -154,11 +155,16 @@ class VerifyTicket:
     was shed under overload / at shutdown, so callers can count an
     "ignore" rather than a "reject")."""
 
-    __slots__ = ("lane", "enqueued_at", "settled_at", "dropped",
+    __slots__ = ("lane", "origin", "enqueued_at", "settled_at", "dropped",
                  "_ok", "_event", "_callbacks", "_lock")
 
-    def __init__(self, lane: str) -> None:
+    def __init__(self, lane: str, origin: "Optional[str]" = None) -> None:
         self.lane = lane
+        #: gossip peer / validator attribution ("peer:<id>",
+        #: "validator:<index>", …) — a rejected job files it into the
+        #: flight recorder's bounded top-K failing-origin table (the
+        #: quarantine lane's feed); NEVER a Prometheus label value
+        self.origin = origin
         self.enqueued_at = time.monotonic()
         self.settled_at: "Optional[float]" = None
         self.dropped = False
@@ -232,19 +238,33 @@ class VerifyScheduler:
         tracer=None,
         health: "Optional[_health.BackendHealthSupervisor]" = None,
         settle_timeout_s: float = 5.0,
+        flight: "Optional[_flight.FlightRecorder]" = None,
     ) -> None:
         self.metrics = metrics
         self.tracer = tracer or NULL_TRACER
         self.use_device = use_device
+        #: flight recorder — always-on (a private ring when none is
+        #: injected; node.py shares one across the whole verify plane)
+        self.flight = (
+            flight if flight is not None
+            else _flight.FlightRecorder(metrics=metrics)
+        )
         #: breaker + settle watchdog + canary gating; node.py shares one
         #: supervisor with the attestation pipeline so a fault on either
         #: plane quarantines the device for both
         self.health = (
             health if health is not None
             else _health.BackendHealthSupervisor(
-                metrics=metrics, settle_timeout_s=settle_timeout_s
+                metrics=metrics, settle_timeout_s=settle_timeout_s,
+                flight=self.flight,
             )
         )
+        if self.health.flight is None:
+            # an injected supervisor without its own recorder joins this
+            # scheduler's timeline (breaker + canary events interleave
+            # with the batches that provoked them)
+            self.health.flight = self.flight
+            self.health.breaker.flight = self.flight
         #: a shared injected backend (tests: fault injection) or one
         #: lazily-built TpuBlsBackend per lane, so device stage spans
         #: attribute to the dispatching lane (kernels stay shared via
@@ -283,12 +303,14 @@ class VerifyScheduler:
     # ------------------------------------------------------------ submit
 
     def submit(self, lane_name: str, items: "Sequence[VerifyItem]",
-               callback=None) -> VerifyTicket:
+               callback=None, origin: "Optional[str]" = None) -> VerifyTicket:
         """Queue one job (all `items` must verify for the ticket to
         resolve True). Returns immediately; LOW lanes shed oldest-first
-        at capacity, HIGH lanes block the caller until there is room."""
+        at capacity, HIGH lanes block the caller until there is room.
+        `origin` attributes a rejected job to its gossip peer/validator
+        in the flight recorder's failing-origin table."""
         lane = self.lanes[lane_name]
-        ticket = VerifyTicket(lane_name)
+        ticket = VerifyTicket(lane_name, origin=origin)
         if callback is not None:
             ticket.add_callback(callback)
         job = _Job(items, ticket)
@@ -501,7 +523,7 @@ class VerifyScheduler:
             ))
         return backend
 
-    def _retry_dispatch(self, lane: LaneConfig, items):
+    def _retry_dispatch(self, lane: LaneConfig, items, fl=None):
         """Bounded transient retry: ONE immediate re-dispatch after a
         dispatch/settle fault, breaker permitting. The retry's faults
         feed the breaker but not the per-lane `device_faults` stat (the
@@ -510,11 +532,19 @@ class VerifyScheduler:
             return None
         self.stats[lane.name]["retries"] += 1
         self._count_retry(lane.name)
+        if fl is not None:
+            fl.note_retry()
+        t0 = time.perf_counter()
         try:
             return self._device_dispatch(lane, items)
         except Exception:
             self.health.record_fault("dispatch")
+            if fl is not None:
+                fl.note_fault("dispatch")
             return None
+        finally:
+            if fl is not None:
+                fl.note_device(time.perf_counter() - t0)
 
     def _flush(self, lane: LaneConfig, jobs: "list[_Job]") -> None:
         items = [it for j in jobs for it in j.items]
@@ -526,6 +556,13 @@ class VerifyScheduler:
         st = self.stats[lane.name]
         st["batches"] += 1
         st["max_batch_items"] = max(st["max_batch_items"], len(items))
+        # jobs pop FIFO, so jobs[0] is the oldest: its wait is the
+        # batch's queue_wait component for SLO attribution
+        fl = self.flight.begin_batch(
+            lane.name, "", len(items),
+            queue_wait_s=now - jobs[0].ticket.enqueued_at,
+            breaker_state=self.health.state if self.use_device else "",
+        )
         settle = None
         device_allowed = False
         with self.tracer.span(
@@ -539,14 +576,18 @@ class VerifyScheduler:
                     # straight to the host path, zero dispatch attempts
                     st["breaker_skips"] += 1
                 else:
+                    t0 = time.perf_counter()
                     try:
                         settle = self._device_dispatch(lane, items)
+                        fl.note_device(time.perf_counter() - t0)
                     except Exception:
+                        fl.note_device(time.perf_counter() - t0)
                         st["device_faults"] += 1
+                        fl.note_fault("dispatch")
                         self.health.record_fault("dispatch")
                         # bounded transient retry: one immediate
                         # re-dispatch before paying a full host pass
-                        settle = self._retry_dispatch(lane, items)
+                        settle = self._retry_dispatch(lane, items, fl)
             if settle is None:
                 # graceful degradation: breaker-open, no device/async
                 # seam, or a faulted dispatch → the eager host path
@@ -555,17 +596,22 @@ class VerifyScheduler:
                         lane,
                         "degraded" if device_allowed else "breaker_open",
                     )
+                t0 = time.perf_counter()
                 verdicts = self._host_check_all(lane, items)
+                fl.note_host(time.perf_counter() - t0)
                 if not self.use_device:
                     self._count_batch(
                         lane, "ok" if all(verdicts) else "invalid"
                     )
                 self._deliver(lane, jobs, verdicts)
+                fl.finish(all(verdicts))
                 return
             ctx = self.tracer.capture()
+        fl.record.kernel = "fast_aggregate"
         # two-deep pipelined handoff (backpressure bounds device residency)
         self._sem.acquire()
-        self._completion.put((lane, jobs, items, settle, ctx))
+        self.flight.device_enter()
+        self._completion.put((lane, jobs, items, settle, ctx, fl))
 
     def _device_dispatch(self, lane: LaneConfig, items):
         """Host prep + async device dispatch of one coalesced batch;
@@ -660,10 +706,10 @@ class VerifyScheduler:
             entry = self._completion.get()
             if entry is None:
                 return
-            lane, jobs, items, settle, ctx = entry
+            lane, jobs, items, settle, ctx, fl = entry
             try:
                 with self.tracer.attach(ctx):
-                    self._settle_batch(lane, jobs, items, settle)
+                    self._settle_batch(lane, jobs, items, settle, fl)
             except Exception:
                 # the settle thread must survive anything; no ticket may
                 # hang — degrade the whole batch to the host path
@@ -674,15 +720,20 @@ class VerifyScheduler:
                 except Exception:
                     for j in jobs:
                         j.ticket._resolve(False, dropped=True)
+                fl.finish(None)
             finally:
+                self.flight.device_exit()
                 self._sem.release()
 
-    def _guarded_settle(self, lane: LaneConfig, settle,
+    def _guarded_settle(self, lane: LaneConfig, settle, fl=None,
                         count_stats: bool = True) -> "_health.SettleOutcome":
         """One watchdog-bounded settle with breaker accounting: OK
         records a success; a fault or watchdog expiry files the breaker
         fault (and, for the batch's FIRST failure, the per-lane stat)."""
+        t0 = time.perf_counter()
         outcome = self.health.guard_settle(settle)
+        if fl is not None:
+            fl.note_device(time.perf_counter() - t0)
         if outcome.status == _health.OK:
             self.health.record_success()
             return outcome
@@ -691,48 +742,66 @@ class VerifyScheduler:
             # the pipeline slot is released by _complete's finally
             self._count_watchdog(lane.name)
             self.health.record_fault("watchdog")
+            if fl is not None:
+                fl.note_fault("watchdog")
         else:
             self.health.record_fault("settle")
+            if fl is not None:
+                fl.note_fault("settle")
         if count_stats:
             self.stats[lane.name]["device_faults"] += 1
         return outcome
 
-    def _settle_batch(self, lane, jobs, items, settle) -> None:
-        outcome = self._guarded_settle(lane, settle)
+    def _settle_batch(self, lane, jobs, items, settle, fl=None) -> None:
+        if fl is None:
+            fl = self.flight.begin_batch(lane.name, "", len(items))
+        outcome = self._guarded_settle(lane, settle, fl)
         if outcome.status == _health.FAULT:
             # fast fault: one bounded re-dispatch before degrading. A
             # TIMEOUT never retries — the ticket already spent its
             # watchdog budget, the host pass must start now.
-            retry = self._retry_dispatch(lane, items)
+            retry = self._retry_dispatch(lane, items, fl)
             if retry is not None:
-                outcome = self._guarded_settle(lane, retry,
+                outcome = self._guarded_settle(lane, retry, fl,
                                                count_stats=False)
         if outcome.status != _health.OK:
             self._count_batch(lane, "degraded")
-            self._deliver(lane, jobs, self._host_check_all(lane, items))
+            t0 = time.perf_counter()
+            verdicts = self._host_check_all(lane, items)
+            fl.note_host(time.perf_counter() - t0)
+            self._deliver(lane, jobs, verdicts)
+            fl.finish(all(verdicts))
             return
         if bool(outcome.value):
             self._count_batch(lane, "ok")
             self._deliver(lane, jobs, [True] * len(items))
+            fl.finish(True)
             return
         with self._stage(lane, "fallback", items=len(items)):
             # the bisection shares ONE watchdog budget so a failed
             # batch still meets the deadline + one-host-pass bound
             deadline = time.monotonic() + self.health.settle_timeout_s
-            verdicts = self._isolate(lane, list(items), deadline)
+            t0 = time.perf_counter()
+            verdicts = self._isolate(lane, list(items), deadline, fl)
+            fl.note_bisect(time.perf_counter() - t0)
         if verdicts and all(verdicts):
             # device said "invalid", host verified every item: a
             # wrong-verdict device — the fault kind only canary probes
             # catch at re-promotion time
             self.health.record_fault("verdict")
+            fl.note_fault("verdict")
         self._count_batch(lane, "ok" if all(verdicts) else "invalid")
         self._deliver(lane, jobs, verdicts)
+        fl.finish(all(verdicts))
 
     def _isolate(self, lane: LaneConfig, items,
-                 deadline: "Optional[float]" = None) -> "list[bool]":
+                 deadline: "Optional[float]" = None, fl=None,
+                 depth: int = 1) -> "list[bool]":
         """Recursive bisection of a failed batch — batch-check halves,
         descend only into failing halves, SingleVerifier at the leaf —
         so k bad items cost O(k·log n) checks, not n."""
+        if fl is not None:
+            fl.note_bisect(0.0, depth)
         if len(items) == 1:
             return [host_check_item(items[0])]
         mid = len(items) // 2
@@ -745,7 +814,7 @@ class VerifyScheduler:
                 ok = False  # descend; leaves verify on the host
             out.extend(
                 [True] * len(half)
-                if ok else self._isolate(lane, half, deadline)
+                if ok else self._isolate(lane, half, deadline, fl, depth + 1)
             )
         return out
 
@@ -790,6 +859,10 @@ class VerifyScheduler:
             ok = all(verdicts[i:i + n])
             i += n
             st["accepted" if ok else "rejected"] += 1
+            if not ok and job.ticket.origin is not None:
+                # bisection named this job's items bad: attribute the
+                # failure to its gossip origin (bounded top-K table)
+                self.flight.note_origin_failure(job.ticket.origin)
             job.ticket._resolve(ok)
         with self._cond:
             self._pending -= len(jobs)

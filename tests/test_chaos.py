@@ -48,7 +48,7 @@ def _canary_specimens():
 
 def _make_plane(truth, plan, monkeypatch, metrics=None,
                 settle_timeout_s=0.2, backoff_initial_s=0.05,
-                backoff_max_s=0.2, window=16):
+                backoff_max_s=0.2, window=16, flight=None):
     """ChaosBackend over a truth table + supervisor + scheduler, with
     the host path answering from the same truth table."""
     truth = dict(truth)
@@ -64,9 +64,11 @@ def _make_plane(truth, plan, monkeypatch, metrics=None,
         backoff_max_s=backoff_max_s,
         window=window,
         rng=random.Random(3),
+        flight=flight,
     )
     sched = vs.VerifyScheduler(
-        backend=chaos, use_device=True, health=sup, metrics=metrics
+        backend=chaos, use_device=True, health=sup, metrics=metrics,
+        flight=flight,
     )
     monkeypatch.setattr(
         vs, "host_check_item",
@@ -270,6 +272,149 @@ def test_wrong_verdict_device_fails_canary_and_stays_open(monkeypatch):
     finally:
         sched.stop()
         chaos.release_hangs()
+
+
+# ------------------------------------------------------ flight timeline
+
+
+#: what each injected fault kind must leave in the flight timeline;
+#: slow_settle files no fault — it shows up as device time + SLO miss
+_FLIGHT_FAULT_OF = {
+    "raise_dispatch": "dispatch",
+    "raise_settle": "settle",
+    "hang": "watchdog",
+    "wrong_verdict": "verdict",
+    "slow_settle": None,
+}
+
+
+@pytest.mark.parametrize("kind", sorted(FAULT_KINDS))
+def test_flight_timeline_attributes_each_fault_kind(monkeypatch, kind):
+    """One scripted injection per fault kind, on a fresh plane each
+    time: the batch still settles correctly AND the flight timeline
+    carries a record attributing exactly that fault (or, for
+    slow_settle, a fault-free record whose device time blew the lane
+    budget with cause \"device\"). The script's leading None spends the
+    subgroup-check seam call so the fault lands on the verify call."""
+    from grandine_tpu.runtime.flight import BATCH, FlightRecorder
+
+    msg = b"flight-probe" + b"\x00" * 20
+    truth = {msg: True}
+    plan = FaultPlan(script=[None, kind])
+    fl = FlightRecorder(slo_budgets={"block": 0.0005})
+    chaos, sup, sched = _make_plane(truth, plan, monkeypatch, flight=fl)
+    try:
+        tk = sched.submit("block", [_item(msg)])
+        sched.flush(30.0)
+        assert tk.ok is True, f"{kind}: verdict diverged"
+    finally:
+        sched.stop()
+        chaos.release_hangs()
+
+    assert plan.injected.get(kind, 0) == 1, f"{kind} was not injected"
+    recs = fl.snapshot(kind=BATCH)
+    assert recs, "no batch record reached the flight ring"
+    want = _FLIGHT_FAULT_OF[kind]
+    if want is not None:
+        faulted = [r for r in recs if r.fault == want]
+        assert faulted, (
+            f"{kind}: no flight record with fault={want!r} "
+            f"(got {[r.fault for r in recs]})"
+        )
+        assert fl.summary()["faults"].get(want, 0) >= 1
+    else:
+        (rec,) = recs
+        assert rec.fault is None
+        assert rec.device_s >= 0.018  # the injected slow settle
+        assert rec.slo_miss and rec.slo_cause == "device"
+
+
+def test_flight_breaker_walk_and_canary_share_timeline(monkeypatch):
+    """The scripted CLOSED→OPEN→HALF_OPEN→CLOSED traversal leaves an
+    ordered breaker walk in the flight ring, with the provoking batch
+    faults BEFORE the open and the passing canary probe BETWEEN
+    half_open and re-close — one timeline tells the whole story."""
+    from grandine_tpu.runtime.flight import (
+        BATCH, BREAKER, CANARY, FlightRecorder,
+    )
+
+    msg = b"flight-brk" + b"\x00" * 22
+    truth = {msg: True}
+    plan = FaultPlan(script=["raise_settle"] * 6)
+    fl = FlightRecorder()
+    chaos, sup, sched = _make_plane(truth, plan, monkeypatch, flight=fl)
+    try:
+        for _ in range(2):
+            t = sched.submit("block", [_item(msg)])
+            sched.flush(30.0)
+            assert t.ok is True
+        assert sup.state == _health.OPEN
+        time.sleep(0.3)  # past the backoff: probe re-promotes
+        t = sched.submit("block", [_item(msg)])
+        sched.flush(30.0)
+        assert t.ok is True and sup.state == _health.CLOSED
+    finally:
+        sched.stop()
+        chaos.release_hangs()
+
+    walk = [r.breaker_state for r in fl.snapshot(kind=BREAKER)]
+    assert walk == ["open", "half_open", "closed"]
+    probes = fl.snapshot(kind=CANARY)
+    assert len(probes) == 1 and probes[0].verdict is True
+    assert probes[0].note == "probe_pass"
+    # ordering: the first faulted batch precedes the open (the SECOND
+    # faulted batch's record commits at finish — after the open its
+    # third fault triggered mid-batch), then open < half_open < probe
+    # < re-close
+    seq_of = {r.note: r.seq for r in fl.snapshot(kind=BREAKER)}
+    fault_seqs = [r.seq for r in fl.snapshot(kind=BATCH)
+                  if r.fault == "settle"]
+    assert len(fault_seqs) == 2  # both batches carry a settle fault
+    assert min(fault_seqs) < seq_of["breaker_open"]
+    assert (seq_of["breaker_open"] < seq_of["breaker_half_open"]
+            < probes[0].seq < seq_of["breaker_closed"])
+    assert fl.summary()["faults"]["settle"] == 3
+
+
+def test_flight_soak_causes_stay_in_enum(monkeypatch):
+    """Under a seeded all-kinds soak every recorded SLO cause is a
+    member of the closed enum and the recorder's aggregate counts match
+    a walk of the ring it retains."""
+    from grandine_tpu.runtime.flight import BATCH, FlightRecorder, SLO_CAUSES
+
+    rng = random.Random(11)
+    messages = [b"enum-%03d" % i + b"\x00" * 23 for i in range(16)]
+    truth = {m: True for m in messages}
+    plan = FaultPlan(seed=11, rates={k: 0.08 for k in FAULT_KINDS})
+    fl = FlightRecorder(capacity=4096,
+                        slo_budgets={"sync_message": 0.0005,
+                                     "block": 0.0005})
+    chaos, sup, sched = _make_plane(truth, plan, monkeypatch, flight=fl)
+    try:
+        for i in range(60):
+            lane = "sync_message" if rng.random() < 0.7 else "block"
+            msgs = [rng.choice(messages) for _ in range(rng.randrange(1, 4))]
+            sched.submit(lane, [_item(m) for m in msgs])
+            if i % 3 == 2:  # cut batches: a burst this fast would
+                sched.flush(30.0)  # otherwise coalesce into one batch
+        sched.flush(60.0)
+    finally:
+        sched.stop()
+        chaos.release_hangs()
+
+    assert sum(plan.injected.values()) > 0
+    recs = fl.snapshot(kind=BATCH)
+    assert recs
+    missed = [r for r in recs if r.slo_miss]
+    assert missed, "a 5ms budget under chaos must record misses"
+    assert all(r.slo_cause in SLO_CAUSES for r in missed)
+    assert all(r.slo_cause is None for r in recs if not r.slo_miss)
+    # aggregate == ring walk (nothing wrapped at this capacity)
+    walked: dict = {}
+    for r in missed:
+        walked.setdefault(r.lane, {}).setdefault(r.slo_cause, 0)
+        walked[r.lane][r.slo_cause] += 1
+    assert fl.slo_misses() == walked
 
 
 def test_fault_plan_is_deterministic():

@@ -173,6 +173,66 @@ class U:
 """, "metrics-cardinality") == 0
 
 
+def test_metrics_cardinality_flags_identity_labels(tmp_path):
+    """Declaring a per-actor label mints one series per peer — the
+    bounded home for that attribution is the flight recorder's
+    OriginTable, never a Prometheus label."""
+    code = lint(tmp_path, """
+from grandine_tpu.metrics import LabeledCounter
+
+class M:
+    def __init__(self):
+        self.rejects = LabeledCounter(
+            "gossip_rejects_total", "h", ("topic", "peer_id"),
+        )
+""", "metrics-cardinality")
+    assert code == 1
+
+
+def test_metrics_cardinality_flags_slo_cause_outside_enum(tmp_path):
+    """Literal `cause` values on verify_slo_miss must be members of
+    the SLO_CAUSES tuple (parsed from source, here the fixture's own
+    module-level constant)."""
+    code = lint(tmp_path, """
+from grandine_tpu.metrics import LabeledCounter
+
+SLO_CAUSES = ("queue_wait", "device", "bisection", "breaker_open")
+
+class M:
+    def __init__(self):
+        self.verify_slo_miss = LabeledCounter(
+            "verify_slo_miss_total", "h", ("lane", "cause"),
+        )
+
+class U:
+    def use(self, m):
+        m.verify_slo_miss.inc("block", "coffee_break")
+""", "metrics-cardinality")
+    assert code == 1
+
+
+def test_metrics_cardinality_allows_enum_members_and_variables(tmp_path):
+    """In-enum literals, variable cause values (the flight recorder's
+    own idiom), and kwarg labels() spellings all stay quiet."""
+    assert lint(tmp_path, """
+from grandine_tpu.metrics import LabeledCounter
+
+SLO_CAUSES = ("queue_wait", "device", "bisection", "breaker_open")
+
+class M:
+    def __init__(self):
+        self.verify_slo_miss = LabeledCounter(
+            "verify_slo_miss_total", "h", ("lane", "cause"),
+        )
+
+class U:
+    def use(self, m, rec):
+        m.verify_slo_miss.inc("block", "device")
+        m.verify_slo_miss.inc(rec.lane, rec.slo_cause)
+        m.verify_slo_miss.labels(lane="block", cause="queue_wait")
+""", "metrics-cardinality") == 0
+
+
 def test_jit_purity_flags_clock_global_and_config_update(tmp_path):
     assert lint(tmp_path, """
 import time

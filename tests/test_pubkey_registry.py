@@ -341,7 +341,32 @@ def test_indexed_flat_verify_agrees_with_upload_path(
     assert backend.multi_verify_indexed(msgs, sigs, [0, 1, 2], reg, rng=rng)
 
 
+def test_indexed_aggregate_edge_policies_without_device(backend, keyring):
+    """Host-side edge policies of the indexed aggregate path — the
+    fast tier-1 witness for the full differential below (slow tier):
+    length mismatch and an empty committee are verification failures,
+    the empty batch is vacuously true, all decided before any device
+    work."""
+    sks, pkb = keyring
+    reg = DevicePubkeyRegistry()
+    msg = b"edge"
+    sig = A.Signature.aggregate([sks[0].sign(msg)])
+    settle = backend.fast_aggregate_verify_batch_indexed_async(
+        [msg], [sig], [[0], [1]], reg
+    )
+    assert settle() is False  # committees/messages length mismatch
+    settle = backend.fast_aggregate_verify_batch_indexed_async(
+        [msg], [sig], [[]], reg
+    )
+    assert settle() is False  # empty committee can't have signed
+    settle = backend.fast_aggregate_verify_batch_indexed_async(
+        [], [], [], reg
+    )
+    assert settle() is True  # vacuous batch
+
+
 @kernel
+@pytest.mark.slow
 def test_indexed_aggregate_verify_agrees_and_skips_pubkey_upload(
     backend, metrics, keyring
 ):

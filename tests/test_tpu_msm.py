@@ -136,9 +136,15 @@ def test_msm_g2_single_group():
     assert got[0] == want[0]
 
 
+@pytest.mark.slow
 def test_grouped_msm_kernel_matches_ladder_kernel():
     """End-to-end: the MSM-backed grouped verify kernel accepts a valid
-    batch and rejects a corrupted one, agreeing with the ladder kernel."""
+    batch and rejects a corrupted one, agreeing with the ladder kernel.
+
+    Slow tier: the full grouped-verify compile dominates. The grouped
+    MSM scan itself keeps fast differential coverage above
+    (test_msm_g1_grouped / test_msm_g2_single_group), and the grouped
+    verify path stays covered by test_tpu_bls_grouped."""
     rng = random.Random(17)
     m, k = 4, 8
     n = m * k

@@ -385,6 +385,31 @@ def test_no_attestations_no_finality():
 # ------------------------------------------------------------ fork upgrade
 
 
+def test_fork_upgrade_boundary_smoke():
+    """Fast witness for the full three-fork traversal below (slow
+    tier): cross the single phase0→altair boundary with a small
+    validator set and keep producing valid blocks on the far side."""
+    cfg = Config(
+        config_name="upgrade-smoke",
+        preset_base="minimal",
+        altair_fork_epoch=1,
+        bellatrix_fork_epoch=FAR_FUTURE_EPOCH,
+        capella_fork_epoch=FAR_FUTURE_EPOCH,
+        deneb_fork_epoch=FAR_FUTURE_EPOCH,
+        genesis_fork_version=bytes.fromhex("00000002"),
+        altair_fork_version=bytes.fromhex("01000002"),
+    )
+    slots_per_epoch = cfg.preset.SLOTS_PER_EPOCH
+    prev = interop_genesis_state(16, cfg)
+    assert state_phase(prev, cfg) == Phase.PHASE0
+    for slot in range(1, slots_per_epoch + 2):
+        atts = produce_attestations(prev, cfg, slot=slot - 1) if slot > 1 else []
+        _, prev = produce_block(prev, slot, cfg, attestations=atts)
+        assert state_phase(prev, cfg) == cfg.phase_at_slot(slot)
+    assert state_phase(prev, cfg) == Phase.ALTAIR
+
+
+@pytest.mark.slow
 def test_fork_upgrade_phase0_to_altair():
     cfg = Config(
         config_name="upgrade-test",

@@ -14,6 +14,17 @@ are self-contained, from each scanned file. Checked operations:
 `.labels(...)` plus the family-level shorthands `.inc/.set/.observe/
 .time/.value(*label_values, ...)`. Plain (unlabeled) families are also
 tracked so a `.labels(...)` call on one is flagged.
+
+Two further checks ride on the same parse:
+
+- identity label NAMES (peer_id, origin, validator_index, ...) are
+  banned at the declaration site — one series per network actor is
+  unbounded by construction. Per-origin failure attribution belongs in
+  the flight recorder's bounded top-K OriginTable, not in a label.
+- families listed in _ENUM_LABELS must pass the named label from a
+  CLOSED enum: literal values at call sites are checked against the
+  tuple constant (e.g. flight.SLO_CAUSES) parsed from source, so a
+  typo'd or ad-hoc `cause` can never mint a new series.
 """
 
 from __future__ import annotations
@@ -39,6 +50,21 @@ _OPS = {
 }
 #: conversions that turn protocol data into unbounded label values
 _FORBIDDEN_CONVERSIONS = {"str", "repr", "hex", "format", "bin", "oct"}
+#: label names that identify an individual network actor; declaring one
+#: makes series count scale with peer/validator population
+_IDENTITY_LABELS = {
+    "peer", "peer_id", "origin", "sender", "remote",
+    "validator", "validator_index", "pubkey", "node_id",
+}
+#: family attr -> (label name, canonical module, enum constant name):
+#: literal values of that label must be members of the tuple constant.
+#: The constant is parsed from the canonical module and, so fixtures
+#: are self-contained, from each scanned file (last parse wins).
+_ENUM_LABELS = {
+    "verify_slo_miss": (
+        "cause", "grandine_tpu/runtime/flight.py", "SLO_CAUSES"
+    ),
+}
 
 
 class _Family:
@@ -113,6 +139,55 @@ def _parse_declarations(tree: ast.AST) -> "dict[str, _Family | None]":
     return out
 
 
+def _declared_labelnames(tree: ast.AST):
+    """(lineno, attr, labelnames) per labeled-family declaration —
+    the positional walk _parse_declarations does, kept separate because
+    this one needs source positions for declaration-site findings."""
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            continue
+        target = node.targets[0]
+        if not (
+            isinstance(target, ast.Attribute)
+            and isinstance(target.value, ast.Name)
+            and target.value.id == "self"
+        ):
+            continue
+        call = node.value
+        if not isinstance(call, ast.Call):
+            continue
+        factory = dotted(call.func)
+        factory = factory.rsplit(".", 1)[-1] if factory else None
+        if factory not in _LABELED_FACTORIES:
+            continue
+        labelnames = None
+        if len(call.args) >= 3:
+            labelnames = _const_str_tuple(call.args[2])
+        for kw in call.keywords:
+            if kw.arg == "labelnames":
+                labelnames = _const_str_tuple(kw.value)
+        if labelnames:
+            yield node.lineno, target.attr, labelnames
+
+
+def _parse_enum_consts(
+    tree: ast.AST, wanted: "set[str]"
+) -> "dict[str, frozenset[str]]":
+    """Module-level `NAME = ("a", "b", ...)` string-tuple assignments
+    for the constant names in `wanted`."""
+    out: "dict[str, frozenset[str]]" = {}
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            continue
+        target = node.targets[0]
+        if not (isinstance(target, ast.Name) and target.id in wanted):
+            continue
+        vals = _const_str_tuple(node.value)
+        if vals is not None:
+            out[target.id] = frozenset(vals)
+    return out
+
+
 def _bad_value(node: ast.AST) -> "str | None":
     """Why this label-value expression is unbounded, or None if OK."""
     if isinstance(node, ast.JoinedStr):
@@ -135,7 +210,8 @@ class MetricsCardinalityRule(Rule):
     description = (
         "labeled-metric call sites pass exactly the declared label "
         "names/arity, with values from bounded sets (no f-strings or "
-        "str()-of-protocol-data)"
+        "str()-of-protocol-data); no identity labels (peer_id, "
+        "validator_index, ...); enum-bounded labels stay in their enum"
     )
 
     def files(self, ctx: Context, targets):
@@ -164,17 +240,56 @@ class MetricsCardinalityRule(Rule):
             if tree is not None:
                 families.update(_parse_declarations(tree))
 
+        # closed-enum members for _ENUM_LABELS: canonical modules
+        # first, then scanned files so fixtures stay self-contained
+        wanted = {const for _lbl, _src, const in _ENUM_LABELS.values()}
+        enum_consts: "dict[str, frozenset[str]]" = {}
+        sources = sorted({src for _lbl, src, _c in _ENUM_LABELS.values()})
+        for src in sources:
+            tree = ctx.tree(src)
+            if tree is not None:
+                enum_consts.update(_parse_enum_consts(tree, wanted))
+        for path in files:
+            tree = ctx.tree(path)
+            if tree is not None:
+                enum_consts.update(_parse_enum_consts(tree, wanted))
+        enums: "dict[str, tuple[str, frozenset[str]]]" = {}
+        for attr, (label, _src, const) in _ENUM_LABELS.items():
+            allowed = enum_consts.get(const)
+            if allowed:
+                enums[attr] = (label, allowed)
+
         out: "list[Finding]" = []
+        decl_paths = [DECLARATIONS] + [p for p in files
+                                       if p != DECLARATIONS]
+        for path in decl_paths:
+            tree = ctx.tree(path)
+            if tree is None:
+                continue
+            for lineno, attr, labelnames in _declared_labelnames(tree):
+                bad = [n for n in labelnames if n in _IDENTITY_LABELS]
+                if bad:
+                    out.append(Finding(
+                        self.name, path, lineno,
+                        f"{attr} declares identity label(s) {bad} — "
+                        f"one series per peer/validator is unbounded; "
+                        f"attribute per-origin data through the flight "
+                        f"recorder's bounded top-K table instead",
+                        key=(f"{self.name}:{path}:{attr}:identity:"
+                             f"{','.join(bad)}"),
+                    ))
         for path in files:
             tree = ctx.tree(path)
             if tree is None:
                 continue
             for node in ast.walk(tree):
                 if isinstance(node, ast.Call):
-                    out.extend(self._check_call(path, node, families))
+                    out.extend(
+                        self._check_call(path, node, families, enums)
+                    )
         return out
 
-    def _check_call(self, path, call: ast.Call, families):
+    def _check_call(self, path, call: ast.Call, families, enums):
         fn = call.func
         if not (isinstance(fn, ast.Attribute) and fn.attr in _OPS):
             return
@@ -269,4 +384,31 @@ class MetricsCardinalityRule(Rule):
                     f"enum value",
                     key=(f"{self.name}:{path}:{fam.name}:{op}:"
                          f"unbounded:{why}"),
+                )
+
+        # ---- closed-enum labels: literal values must be members
+        enum = enums.get(owner.attr)
+        if enum is not None:
+            label, allowed = enum
+            value_node = None
+            if op == "labels" and label_kwargs:
+                for kw in label_kwargs:
+                    if kw.arg == label:
+                        value_node = kw.value
+            elif label in fam.labelnames:
+                i = fam.labelnames.index(label)
+                if i < len(label_args):
+                    value_node = label_args[i]
+            if (
+                isinstance(value_node, ast.Constant)
+                and isinstance(value_node.value, str)
+                and value_node.value not in allowed
+            ):
+                yield Finding(
+                    self.name, path, value_node.lineno,
+                    f"{fam.name}.{op}() passes "
+                    f"{label}={value_node.value!r} — not a member of "
+                    f"the closed enum {sorted(allowed)}",
+                    key=(f"{self.name}:{path}:{fam.name}:enum:"
+                         f"{value_node.value}"),
                 )
