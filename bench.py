@@ -1144,11 +1144,265 @@ def bench_replay() -> None:
         raise SystemExit(1)
 
 
+def bench_multichip_child(n_devices: int) -> None:
+    """One `--devices` sweep point, run by bench_multichip in a FRESH
+    process: on the CPU platform the virtual device count comes from
+    XLA_FLAGS=--xla_force_host_platform_device_count, which XLA parses
+    once per process before the first backend call, so every count needs
+    its own interpreter. Prints one JSON line with this count's raw
+    multi_verify and firehose throughput (or a {"skipped": ...} line
+    when the platform can't supply the devices)."""
+    import re
+
+    platform = os.environ.get("BENCH_MC_PLATFORM", "cpu")
+    if platform == "cpu":
+        flags = os.environ.get("XLA_FLAGS", "")
+        found = re.findall(
+            r"xla_force_host_platform_device_count=(\d+)", flags
+        )
+        if not found or int(found[-1]) < n_devices:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n_devices}"
+            ).strip()
+
+    import jax
+
+    if platform == "cpu":
+        # sitecustomize force-registers the TPU platform; the CPU switch
+        # must precede the first backend call (same contract as
+        # __graft_entry__.dryrun_multichip)
+        jax.config.update("jax_platforms", "cpu")
+        try:
+            from jax.extend.backend import clear_backends
+        except ImportError:
+            clear_backends = getattr(jax, "clear_backends", None)
+        if clear_backends is not None:
+            clear_backends()
+    if n_devices == 1:
+        # single-device executables are persistent-cache-safe;
+        # multi-device executables are not (serialize/deserialize is
+        # unsound for them — tpu/bls.py _cache_bypassed_call), so N>1
+        # children run cacheless rather than bypass per-dispatch
+        _enable_compilation_cache()
+
+    from grandine_tpu.tpu.mesh import VerifyMesh
+
+    try:
+        vmesh = VerifyMesh.build(n_devices, platform=platform)
+    except ValueError as exc:
+        print(json.dumps({"devices": n_devices, "skipped": str(exc)}))
+        return
+
+    from grandine_tpu.crypto import bls as A
+    from grandine_tpu.tpu.bls import (
+        TpuBlsBackend,
+        multi_verify_kernel,
+        rlc_bits_host,
+        sharded_multi_verify,
+    )
+    from grandine_tpu.tpu.registry import DevicePubkeyRegistry
+
+    n = int(os.environ.get("BENCH_MC_N", "256"))
+    iters = int(os.environ.get("BENCH_MC_ITERS", "3"))
+    report = {
+        "devices": n_devices,
+        "mesh": vmesh.describe(),
+        "platform": platform,
+        "n": n,
+    }
+
+    # ---- raw multi_verify: the flat RLC kernel, batch axis sharded.
+    # Identical 9-array + r_bits signature at every count — N=1 runs the
+    # plain jitted kernel, N>1 the registered shard_map factory; same
+    # math, the sharding is the only delta (the apples-to-apples pair).
+    args = build_batch(n, n_msgs=8)
+    if vmesh.is_single:
+        fn = jax.jit(multi_verify_kernel)
+        dev_args = tuple(jax.device_put(a) for a in args)
+        put = jax.device_put
+    else:
+        sharding = vmesh.batch_sharding()
+        fn = sharded_multi_verify(vmesh.mesh)
+        dev_args = tuple(jax.device_put(a, sharding) for a in args)
+        put = lambda a: jax.device_put(a, sharding)  # noqa: E731
+
+    def one_iter(seed: int) -> float:
+        # fresh RLC bits per iteration (the axon runtime dedupes repeated
+        # identical executions), staged OFF the clock: the timed phase is
+        # dispatch + verdict force — the device phase whose scaling the
+        # sweep exists to measure (host plan cost is count-invariant)
+        r_lo, r_hi = draw_rlc(n, seed)
+        bits = put(rlc_bits_host(list(zip(r_lo.tolist(), r_hi.tolist())), n))
+        bits.block_until_ready()
+        t0 = time.time()
+        ok = bool(fn(*dev_args, bits))
+        dt = time.time() - t0
+        if not ok:
+            raise SystemExit("multichip flat kernel rejected a valid batch")
+        return dt
+
+    t0 = time.time()
+    one_iter(0)  # compile + first run
+    report["mv_compile_s"] = round(time.time() - t0, 1)
+    lat = sorted(one_iter(i + 1) for i in range(iters))
+    p50 = lat[len(lat) // 2]
+    report["multi_verify_p50_s"] = round(p50, 4)
+    report["multi_verify_sigs_per_s"] = round(n / p50, 1)
+
+    # ---- firehose: indexed aggregate verify through the backend against
+    # the row-sharded device registry (the gossip-lane production path:
+    # host hashing + committee gather + sharded MSM verify, end to end)
+    b = int(os.environ.get("BENCH_MC_FIREHOSE_B", "64"))
+    sks = [
+        A.SecretKey.keygen(bytes([9, i % 256, i >> 8]) + b"\x29" * 29)
+        for i in range(b)
+    ]
+    registry = DevicePubkeyRegistry(mesh=vmesh)
+    registry.ensure([sk.public_key().to_bytes() for sk in sks])
+    backend = TpuBlsBackend(mesh=vmesh)
+    msgs = [b"mc-firehose-%d" % i for i in range(b)]
+    sigs = [sk.sign(m) for sk, m in zip(sks, msgs)]
+    committees = [[i] for i in range(b)]
+
+    def fire() -> float:
+        # messages/signatures fixed across iterations; the RLC
+        # randomizers are drawn fresh inside every call, so no two
+        # executions are identical
+        t0 = time.time()
+        ok = backend.fast_aggregate_verify_batch_indexed(
+            msgs, sigs, committees, registry
+        )
+        dt = time.time() - t0
+        if not ok:
+            raise SystemExit("multichip firehose rejected a valid batch")
+        return dt
+
+    t0 = time.time()
+    fire()  # compile + first run
+    report["fh_compile_s"] = round(time.time() - t0, 1)
+    flat = sorted(fire() for _ in range(iters))
+    p50 = flat[len(flat) // 2]
+    report["firehose_b"] = b
+    report["firehose_p50_s"] = round(p50, 4)
+    report["firehose_sigs_per_s"] = round(b / p50, 1)
+    print(json.dumps(report))
+
+
+def bench_multichip() -> None:
+    """`--devices`: per-device-count scaling sweep over {1, 2, 4, 8}
+    (BENCH_MC_DEVICES overrides), one fresh child process per count,
+    covering the raw flat multi_verify kernel and the indexed firehose.
+    Prints one parseable `multichip_scaling` JSON line with per-count
+    sigs/s and parallel efficiency vs the single-device number.
+
+    Honesty note: on the default CPU mesh the "devices" are XLA virtual
+    host devices TIMESHARING the machine's physical cores — with fewer
+    cores than mesh shards the sweep measures core contention plus
+    sharded-dispatch overhead, not interconnect scaling, and efficiency
+    lands well under 1/N. The >1.5x-at-4-devices figure is informational
+    (reported as target_met) and expects >=4 physical cores or a real
+    multi-chip platform."""
+    import subprocess
+
+    _lint_preflight()
+    counts = [
+        int(c)
+        for c in os.environ.get("BENCH_MC_DEVICES", "1,2,4,8").split(",")
+    ]
+    env = {**os.environ, "BENCH_SKIP_LINT": "1"}
+    results: "dict[int, dict]" = {}
+    for c in counts:
+        t0 = time.time()
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--devices-child", str(c)],
+            capture_output=True, text=True, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        wall = time.time() - t0
+        report = None
+        for line in reversed(proc.stdout.strip().splitlines()):
+            try:
+                report = json.loads(line)
+                break
+            except (json.JSONDecodeError, ValueError):
+                continue
+        if proc.returncode != 0 or report is None:
+            print(proc.stdout, file=sys.stderr)
+            print(proc.stderr, file=sys.stderr)
+            raise SystemExit(f"multichip child devices={c} failed")
+        if "skipped" in report:
+            print(
+                f"# multichip: devices={c} skipped: {report['skipped']}",
+                file=sys.stderr,
+            )
+            continue
+        report["child_wall_s"] = round(wall, 1)
+        results[c] = report
+        print(
+            f"# multichip: devices={c} multi_verify "
+            f"{report['multi_verify_sigs_per_s']} sigs/s, firehose "
+            f"{report['firehose_sigs_per_s']} sigs/s "
+            f"(child {wall:.0f}s incl {report['mv_compile_s']}s + "
+            f"{report['fh_compile_s']}s compile)",
+            file=sys.stderr,
+        )
+    if 1 not in results:
+        raise SystemExit("multichip sweep needs the single-device baseline")
+
+    def table(key: str) -> dict:
+        base = results[1][key]
+        out = {}
+        for c in sorted(results):
+            v = results[c][key]
+            out[str(c)] = {
+                "sigs_per_s": v,
+                "speedup": round(v / base, 3) if base else 0.0,
+                "efficiency": round(v / (c * base), 3) if base else 0.0,
+            }
+        return out
+
+    mv = table("multi_verify_sigs_per_s")
+    fh = table("firehose_sigs_per_s")
+    cores = os.cpu_count() or 1
+    top = max(results)
+    speedup4 = mv.get("4", {}).get("speedup", 0.0)
+    print(json.dumps({
+        "metric": "multichip_scaling",
+        "unit": "sigs/s",
+        "value": results[top]["multi_verify_sigs_per_s"],
+        "devices": sorted(results),
+        "n": results[top]["n"],
+        "multi_verify": mv,
+        "firehose": fh,
+        "speedup_4dev_multi_verify": speedup4,
+        "target_4dev_speedup": 1.5,
+        "target_met": speedup4 > 1.5,
+        "host_cores": cores,
+        "platform": results[top].get("platform", "cpu"),
+    }))
+    print(
+        f"# multichip: {cores} host core(s) behind the "
+        f"{results[top].get('platform', 'cpu')} mesh — virtual device "
+        f"shards timeshare those cores, so efficiency reflects core "
+        f"contention + dispatch overhead, not interconnect scaling; "
+        f"4-dev multi_verify speedup {speedup4}x (informational target "
+        f">1.5x expects >=4 physical cores or a real multi-chip platform)",
+        file=sys.stderr,
+    )
+
+
 if __name__ == "__main__":
-    if "--coldstart-child" in sys.argv:
+    if "--devices-child" in sys.argv:
+        bench_multichip_child(
+            int(sys.argv[sys.argv.index("--devices-child") + 1])
+        )
+    elif "--coldstart-child" in sys.argv:
         bench_coldstart_child(
             sys.argv[sys.argv.index("--coldstart-child") + 1]
         )
+    elif "--devices" in sys.argv or os.environ.get("BENCH_MULTICHIP") == "1":
+        bench_multichip()
     elif "--coldstart" in sys.argv or os.environ.get("BENCH_COLDSTART") == "1":
         bench_coldstart()
     elif "--chaos" in sys.argv or os.environ.get("BENCH_CHAOS") == "1":

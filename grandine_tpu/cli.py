@@ -51,6 +51,14 @@ def build_parser() -> argparse.ArgumentParser:
         "--use-device", action="store_true",
         help="route batch verification through the TPU backend")
     parser.add_argument(
+        "--devices", type=int, default=None, metavar="N",
+        help="shard the verify plane over an N-device mesh (power of "
+             "two; requires --use-device). On the CPU platform the "
+             "visible device count comes from XLA_FLAGS="
+             "--xla_force_host_platform_device_count=N, which XLA reads "
+             "once at startup — set it in the environment BEFORE "
+             "launching; --devices only selects from what is visible")
+    parser.add_argument(
         "--no-warm", action="store_true",
         help="skip the startup kernel-bucket precompile warmer")
 
@@ -215,11 +223,20 @@ def _node_once(args, cfg) -> int:
 
     operation_pool = OperationPool(cfg)
     slasher = Slasher(db)
+    mesh = None
+    if getattr(args, "devices", None):
+        if not args.use_device:
+            raise SystemExit("--devices requires --use-device")
+        from grandine_tpu.tpu.mesh import VerifyMesh
+
+        mesh = VerifyMesh.build(args.devices)
+        print(f"verify mesh: {mesh.describe()}")
     node = InProcessNode(
         stored, cfg, use_device_firehose=args.use_device,
         execution_engine=engine,
         slasher=slasher, operation_pool=operation_pool,
         metrics=metrics, tracer=tracer,
+        mesh=mesh,
     )
     if args.use_device and not getattr(args, "no_warm", False):
         # precompile the kernel shape manifest in the background while
@@ -235,6 +252,7 @@ def _node_once(args, cfg) -> int:
             progress=lambda m: print(f"[warmup] {m}"),
             registry=getattr(verifier, "registry", None),
             metrics=metrics,
+            mesh=node.mesh,
         )
     if getattr(args, "web3signer_url", None):
         # remote-signer registry for a ValidatorService embedding; the

@@ -72,11 +72,17 @@ class AttestationVerifier:
         health: "Optional[_health.BackendHealthSupervisor]" = None,
         settle_timeout_s: float = 5.0,
         flight: "Optional[_flight.FlightRecorder]" = None,
+        mesh=None,
     ) -> None:
+        from grandine_tpu.tpu.mesh import mesh_or_none
+
         self.controller = controller
         self.cfg = controller.cfg
         self.backend = backend
         self.use_device = use_device
+        #: injected VerifyMesh (tpu/mesh.py) threaded into the backend and
+        #: the pubkey registry; None / 1-device collapses to single-chip
+        self.mesh = mesh_or_none(mesh)
         #: observability: default to whatever the controller carries so
         #: node wiring stays one assignment; NULL_TRACER keeps span calls
         #: branch-free when tracing is off
@@ -145,7 +151,9 @@ class AttestationVerifier:
         if use_device and use_registry:
             from grandine_tpu.tpu.registry import DevicePubkeyRegistry
 
-            self.registry = DevicePubkeyRegistry(metrics=self.metrics)
+            self.registry = DevicePubkeyRegistry(
+                metrics=self.metrics, mesh=self.mesh
+            )
             hooks = getattr(controller, "on_validator_set_change", None)
             if hooks is not None:
                 hooks.append(lambda old, new: self.registry.mark_stale())
@@ -308,6 +316,7 @@ class AttestationVerifier:
                 0.0, time.time() - min(it.received_at for it in batch)
             ),
             breaker_state=self.health.state if self.use_device else "",
+            devices=self.mesh.device_count if self.mesh is not None else 1,
         )
         skipped = False
         if self.use_device and self._completion is not None:
@@ -352,7 +361,12 @@ class AttestationVerifier:
         on failure. Runs on the pool thread (sync path) or the completion
         thread (pipelined path)."""
         if fl is None:
-            fl = self.flight.begin_batch(self.lane, "", len(prepared))
+            fl = self.flight.begin_batch(
+                self.lane, "", len(prepared),
+                devices=(
+                    self.mesh.device_count if self.mesh is not None else 1
+                ),
+            )
         if ok:
             self.stats["accepted"] += len(prepared)
             with self._stage("feedback", items=len(prepared)):
@@ -464,7 +478,7 @@ class AttestationVerifier:
             from grandine_tpu.tpu.bls import TpuBlsBackend
 
             backend = self.backend = TpuBlsBackend(
-                metrics=self.metrics, tracer=self.tracer
+                metrics=self.metrics, tracer=self.tracer, mesh=self.mesh
             )
             self.health.ensure_probe(_health.make_canary_probe(
                 backend, timeout_s=self.health.settle_timeout_s

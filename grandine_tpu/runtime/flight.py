@@ -118,7 +118,7 @@ class BatchRecord:
         "seq", "t", "kind", "lane", "kernel", "items", "bucket", "fill",
         "queue_wait_s", "device_s", "host_s", "bisect_s", "verdict",
         "fault", "retries", "bisect_depth", "breaker_state", "recompile",
-        "slo_miss", "slo_cause", "origin", "note",
+        "slo_miss", "slo_cause", "origin", "note", "devices",
     )
 
     def __init__(self, kind: str, lane: str) -> None:
@@ -144,6 +144,9 @@ class BatchRecord:
         self.slo_cause: "Optional[str]" = None
         self.origin: "Optional[str]" = None
         self.note = ""
+        #: mesh width the batch dispatched over (a record FIELD, never a
+        #: Prometheus label — per-device label cardinality is forbidden)
+        self.devices = 1
 
     def total_s(self) -> float:
         return self.queue_wait_s + self.device_s + self.host_s + self.bisect_s
@@ -173,6 +176,7 @@ class BatchRecord:
             "slo_cause": self.slo_cause,
             "origin": self.origin,
             "note": self.note,
+            "devices": self.devices,
         }
 
 
@@ -335,7 +339,8 @@ class FlightRecorder:
 
     def begin_batch(self, lane: str, kernel: str, items: int,
                     queue_wait_s: float = 0.0,
-                    breaker_state: str = "") -> BatchFlight:
+                    breaker_state: str = "",
+                    devices: int = 1) -> BatchFlight:
         """Open one batch's flight context at dispatch time. Fill/waste
         are derived from the pow-2 bucket the device actually pads to."""
         rec = BatchRecord(BATCH, lane)
@@ -345,6 +350,7 @@ class FlightRecorder:
         rec.fill = rec.items / rec.bucket if rec.bucket else 0.0
         rec.queue_wait_s = max(0.0, float(queue_wait_s))
         rec.breaker_state = breaker_state
+        rec.devices = max(1, int(devices))
         return BatchFlight(self, rec)
 
     def _slo_cause(self, rec: BatchRecord) -> str:

@@ -36,15 +36,21 @@ class InProcessNode:
         operation_pool=None,
         metrics=None,
         tracer=None,
+        mesh=None,
     ) -> None:
         from grandine_tpu.consensus.verifier import MultiVerifier
 
         from grandine_tpu.runtime.flight import FlightRecorder
         from grandine_tpu.runtime.health import BackendHealthSupervisor
+        from grandine_tpu.tpu.mesh import mesh_or_none
 
         self.cfg = cfg
         self.metrics = metrics
         self.tracer = tracer
+        #: injected VerifyMesh (cli --devices → VerifyMesh.build): threaded
+        #: into the scheduler and the attestation firehose, which shard
+        #: the registry + kernels over it; None / 1-device is single-chip
+        self.mesh = mesh_or_none(mesh)
         #: ONE flight recorder for the whole verify plane: scheduler
         #: batches, firehose batches, canary probes, and breaker
         #: transitions share a single ordered timeline (the debug
@@ -66,6 +72,7 @@ class InProcessNode:
                 tracer=tracer,
                 health=self.health,
                 flight=self.flight,
+                mesh=self.mesh,
             )
             if verifier_factory is None:
                 # block proposer-signature batches ride the HIGH lane
@@ -90,6 +97,7 @@ class InProcessNode:
             tracer=tracer,
             health=self.health,
             flight=self.flight,
+            mesh=self.mesh,
         )
         if (
             self.verify_scheduler is not None

@@ -239,10 +239,16 @@ class VerifyScheduler:
         health: "Optional[_health.BackendHealthSupervisor]" = None,
         settle_timeout_s: float = 5.0,
         flight: "Optional[_flight.FlightRecorder]" = None,
+        mesh=None,
     ) -> None:
+        from grandine_tpu.tpu.mesh import mesh_or_none
+
         self.metrics = metrics
         self.tracer = tracer or NULL_TRACER
         self.use_device = use_device
+        #: injected VerifyMesh (tpu/mesh.py) threaded into every per-lane
+        #: backend; None / 1-device collapses to the single-chip plane
+        self.mesh = mesh_or_none(mesh)
         #: flight recorder — always-on (a private ring when none is
         #: injected; node.py shares one across the whole verify plane)
         self.flight = (
@@ -513,7 +519,8 @@ class VerifyScheduler:
             from grandine_tpu.tpu.bls import TpuBlsBackend
 
             backend = self._backends[lane.name] = TpuBlsBackend(
-                metrics=self.metrics, tracer=self.tracer, lane=lane.name
+                metrics=self.metrics, tracer=self.tracer, lane=lane.name,
+                mesh=self.mesh,
             )
             # the first real backend also answers canary probes for
             # HALF_OPEN re-promotion (injected backends keep whatever
@@ -562,6 +569,7 @@ class VerifyScheduler:
             lane.name, "", len(items),
             queue_wait_s=now - jobs[0].ticket.enqueued_at,
             breaker_state=self.health.state if self.use_device else "",
+            devices=self.mesh.device_count if self.mesh is not None else 1,
         )
         settle = None
         device_allowed = False

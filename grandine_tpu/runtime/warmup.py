@@ -38,9 +38,15 @@ MULTI_VERIFY_BUCKETS = (64, 256, 1024, 4096)
 SIGN_BUCKETS = (64, 512)
 SUBGROUP_BUCKETS = (4, 8, 16, 32, 64, 128)
 
-#: warm kinds the runner understands, in manifest order
+#: warm kinds the runner understands, in manifest order. The sharded_*
+#: kinds compile the multi-chip dispatch targets (tpu/bls.py
+#: sharded_multi_verify / sharded_multi_verify_msm) and are skipped with
+#: a progress note on a mesh-less node — the MULTICHIP dryruns measured
+#: a cold 2m51s sharded compile, which warmup must eat at startup so a
+#: restart never pays it mid-chain.
 WARM_KINDS = ("aggregate", "aggregate_idx", "multi_verify", "sign",
-              "subgroup")
+              "subgroup", "sharded_multi_verify",
+              "sharded_multi_verify_msm")
 
 
 def _repo_root() -> str:
@@ -96,6 +102,9 @@ def manifest() -> "list[tuple[str, int]]":
     out += [("multi_verify", b) for b in MULTI_VERIFY_BUCKETS]
     out += [("sign", b) for b in SIGN_BUCKETS]
     out += [("subgroup", b) for b in SUBGROUP_BUCKETS]
+    # sharded rows are no-ops without a mesh (skipped with a note)
+    out += [("sharded_multi_verify", b) for b in MULTI_VERIFY_BUCKETS]
+    out += [("sharded_multi_verify_msm", b) for b in MULTI_VERIFY_BUCKETS]
     return out
 
 
@@ -127,21 +136,32 @@ def warm_all(
     metrics=None,
     seal: bool = True,
     enable_cache: bool = True,
+    mesh=None,
 ) -> int:
     """Compile-and-run every manifest entry once. Returns the number of
     entries warmed. Call from a background thread at node startup.
 
     `registry` (a DevicePubkeyRegistry with at least one key) unlocks
     the aggregate_idx kind; without it those rows are skipped with a
-    progress note. With `seal` the shape ledger is sealed on completion
-    so later novel shapes count as recompiles."""
+    progress note. `mesh` (a VerifyMesh, cli --devices) unlocks the
+    sharded_* kinds, warmed through a mesh-attached backend so the
+    multi-chip dispatch targets compile at startup; single-device kinds
+    still warm through a plain backend (they stay the fallback for
+    batches the mesh gates reject). With `seal` the shape ledger is
+    sealed on completion so later novel shapes count as recompiles."""
     from grandine_tpu.crypto import bls as A
     from grandine_tpu.crypto.curves import G1
     from grandine_tpu.crypto.hash_to_curve import hash_to_g2
     from grandine_tpu.tpu import bls as B
+    from grandine_tpu.tpu.mesh import mesh_or_none
 
     if enable_cache:
         enable_persistent_cache()
+    mesh_backend = (
+        backend if getattr(backend, "mesh", None) is not None else None
+    )
+    if mesh_backend is None and mesh_or_none(mesh) is not None:
+        mesh_backend = B.TpuBlsBackend(metrics=metrics, mesh=mesh)
     if backend is None:
         backend = B.TpuBlsBackend(metrics=metrics)
     pk = A.PublicKey(G1)
@@ -185,6 +205,31 @@ def warm_all(
                                    [sk] * b)
             elif kind == "subgroup":
                 backend.g2_subgroup_check_batch([h] * b)
+            elif kind == "sharded_multi_verify":
+                if mesh_backend is None:
+                    if progress:
+                        progress(f"warm {kind}/{b} skipped: no mesh")
+                    continue
+                # ALL-distinct messages defeat the grouping heuristic so
+                # dispatch takes the flat sharded-RLC path
+                mesh_backend.multi_verify(
+                    [b"warm-%d" % i for i in range(b)],
+                    [sig] * b,
+                    [pk] * b,
+                )
+            elif kind == "sharded_multi_verify_msm":
+                if mesh_backend is None:
+                    if progress:
+                        progress(f"warm {kind}/{b} skipped: no mesh")
+                    continue
+                # grouped messages route to the sharded grouped-MSM path
+                # (both group axes divide any power-of-two mesh)
+                n_groups = max(2, b // 8)
+                mesh_backend.multi_verify(
+                    [b"warm-%d" % (i % n_groups) for i in range(b)],
+                    [sig] * b,
+                    [pk] * b,
+                )
         except Exception as e:  # a failed warm is a lost optimization only
             if progress:
                 progress(f"warm {kind}/{b} FAILED: {e!r}")

@@ -61,8 +61,17 @@ class DevicePubkeyRegistry:
     validator-set-change hook.
     """
 
-    def __init__(self, metrics=None) -> None:
+    def __init__(self, metrics=None, mesh=None) -> None:
+        from grandine_tpu.tpu.mesh import mesh_or_none
+
         self.metrics = metrics
+        #: injected VerifyMesh (tpu/mesh.py): with a multi-device mesh the
+        #: device arrays are row-sharded over it (`P("batch")` on axis 0),
+        #: so the table's residency scales with the fleet — capacity is
+        #: always a power of two ≥ MIN_CAPACITY, so any power-of-two mesh
+        #: divides it evenly. None (or a 1-device mesh) keeps the plain
+        #: single-chip placement byte-for-byte.
+        self.mesh = mesh_or_none(mesh)
         self._lock = threading.RLock()
         #: host mirror: the exact compressed-bytes tuple the device arrays
         #: were built from (identity-compared against head-state columns)
@@ -208,6 +217,13 @@ class DevicePubkeyRegistry:
             # in-place device scatter: uploads O(new) bytes
             self._x = self._x.at[start:end].set(jnp.asarray(nx))
             self._y = self._y.at[start:end].set(jnp.asarray(ny))
+            if self.mesh is not None:
+                # re-pin the row sharding: the eager scatter's output
+                # layout is XLA's choice, and the shard-per-device
+                # invariant is what the indexed kernels compile against
+                sharding = self.mesh.batch_sharding()
+                self._x = jax.device_put(self._x, sharding)
+                self._y = jax.device_put(self._y, sharding)
             self._count_upload(int(nx.nbytes + ny.nbytes))
         else:
             self._upload_full(end)
@@ -228,12 +244,23 @@ class DevicePubkeyRegistry:
         import jax
 
         cap = _next_pow2(count)
+        if self.mesh is not None:
+            # a power-of-two mesh must divide the power-of-two capacity;
+            # MIN_CAPACITY floors the row count above any sane mesh width
+            cap = max(cap, _next_pow2(self.mesh.device_count))
         px = np.zeros((cap, L.NLIMBS), np.int32)
         py = np.zeros((cap, L.NLIMBS), np.int32)
         px[:count] = self._hx
         py[:count] = self._hy
-        self._x = jax.device_put(px)
-        self._y = jax.device_put(py)
+        if self.mesh is not None:
+            # row-sharded residency: the indexed kernels gather rows
+            # on-device and XLA routes cross-shard lookups over the mesh
+            sharding = self.mesh.batch_sharding()
+            self._x = jax.device_put(px, sharding)
+            self._y = jax.device_put(py, sharding)
+        else:
+            self._x = jax.device_put(px)
+            self._y = jax.device_put(py)
         self._count_upload(int(px.nbytes + py.nbytes))
 
 

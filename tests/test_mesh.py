@@ -1,0 +1,326 @@
+"""Mesh seam tests: VerifyMesh topology/sharding vocabulary, the
+degenerate-mesh collapse, the row-sharded pubkey registry lifecycle, the
+mesh threading through scheduler/verifier/node ctors, the flight
+recorder's devices field, and the mesh-vs-single verdict differential
+through the real scheduler seam.
+
+Tier-1 here is kernel-free: registry lifecycle uses only eager scatters
+and device_put (no jit compiles), and the fast differential witnesses run
+the REAL VerifyScheduler dispatch/bisect/settle machinery over an
+injected fake async backend — a 2-device mesh never reaches a kernel, so
+the seam's mesh handling (flight attribution, degenerate collapse,
+verdict plumbing) is proven without a multi-device compile. The
+device-kernel differential (sharded registry + indexed aggregate
+executables, minutes of multi-device XLA compile the persistent cache
+cannot hold) is marked slow.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from grandine_tpu.crypto import bls as A
+from grandine_tpu.metrics import Metrics
+from grandine_tpu.runtime.flight import FlightRecorder
+from grandine_tpu.runtime.thread_pool import Priority
+from grandine_tpu.runtime.verify_scheduler import (
+    LaneConfig,
+    VerifyItem,
+    VerifyScheduler,
+)
+from grandine_tpu.tpu.mesh import BATCH_AXIS, VerifyMesh, mesh_or_none
+from grandine_tpu.tpu.registry import DevicePubkeyRegistry
+
+_seed_rng = random.Random(0x6E51)
+
+
+def _rng_bytes(n: int) -> bytes:
+    return bytes(_seed_rng.randrange(256) for _ in range(n))
+
+
+@pytest.fixture(scope="module")
+def keypairs():
+    sks = [A.SecretKey.keygen(_rng_bytes(32)) for _ in range(8)]
+    return sks, tuple(sk.public_key().to_bytes() for sk in sks)
+
+
+# ------------------------------------------------------------- topology
+
+
+def test_build_topology_and_sharding_vocabulary():
+    """conftest pins an 8-virtual-device CPU platform, so explicit counts
+    up to 8 are always satisfiable here."""
+    from jax.sharding import PartitionSpec as P
+
+    m = VerifyMesh.build(2, platform="cpu")
+    assert m.device_count == 2
+    assert not m.is_single
+    assert m.describe() == "batch:2"
+    assert m.axis == BATCH_AXIS
+    # even row split over the mesh, nothing else
+    assert m.divides(4) and m.divides(2) and m.divides(256)
+    assert not m.divides(3) and not m.divides(1)
+    assert m.batch_sharding().spec == P(BATCH_AXIS)
+    assert m.member_sharding().spec == P(None, BATCH_AXIS)
+    assert m.replicated().spec == P()
+
+
+def test_build_validation_and_default_count():
+    with pytest.raises(ValueError):
+        VerifyMesh.build(3, platform="cpu")  # not a power of two
+    with pytest.raises(ValueError):
+        VerifyMesh.build(1024, platform="cpu")  # beyond the platform
+    # count=None: every visible device, rounded down to a power of two
+    m = VerifyMesh.build(platform="cpu")
+    assert m.device_count == 8
+    assert m.divides(8) and not m.divides(4)
+
+
+def test_mesh_or_none_collapses_the_degenerate_mesh():
+    assert mesh_or_none(None) is None
+    single = VerifyMesh.build(1, platform="cpu")
+    assert single.is_single
+    assert mesh_or_none(single) is None  # 1-device == no mesh, everywhere
+    two = VerifyMesh.build(2, platform="cpu")
+    assert mesh_or_none(two) is two
+
+
+# ------------------------------------------------- registry row sharding
+
+
+def _rows(reg):
+    return np.asarray(reg._x), np.asarray(reg._y)
+
+
+def test_registry_sharded_lifecycle_matches_plain(keypairs):
+    """The full registry lifecycle on a 2-device mesh — refresh, identity
+    hit, prefix append, full refresh, capacity growth — must hold rows
+    numerically identical to the unsharded registry, with the batch-row
+    sharding preserved across every mutation (the indexed kernels compile
+    against the shard-per-device invariant)."""
+    from jax.sharding import PartitionSpec as P
+
+    _sks, pkb = keypairs
+    mesh = VerifyMesh.build(2, platform="cpu")
+    plain = DevicePubkeyRegistry(metrics=Metrics())
+    shard = DevicePubkeyRegistry(metrics=Metrics(), mesh=mesh)
+
+    def assert_mirrored():
+        px, py = _rows(plain)
+        sx, sy = _rows(shard)
+        assert px.shape == sx.shape and py.shape == sy.shape
+        assert (px == sx).all() and (py == sy).all()
+        assert shard.capacity % mesh.device_count == 0
+        for a in (shard._x, shard._y):
+            assert a.sharding.spec == P(BATCH_AXIS)
+
+    head = pkb[:5]  # the hit below is by OBJECT identity (head-state tuple)
+    assert plain.ensure(head) and shard.ensure(head)
+    assert shard.stats["refreshes"] == 1
+    assert_mirrored()
+
+    # identity hit: no upload, sharding untouched
+    assert shard.ensure(head)
+    assert shard.stats["hits"] == 1
+    assert_mirrored()
+
+    # prefix growth: O(new) append, then the row sharding is re-pinned
+    assert plain.ensure(pkb) and shard.ensure(pkb)
+    assert shard.stats["appends"] == 1
+    assert_mirrored()
+
+    # anything else: full refresh (drop one key from the front)
+    assert plain.ensure(pkb[1:]) and shard.ensure(pkb[1:])
+    assert shard.stats["refreshes"] == 2
+    assert_mirrored()
+
+
+def test_registry_capacity_floor_covers_wide_meshes(keypairs):
+    """Capacity stays a power of two divisible by any power-of-two mesh
+    width the platform can offer — one key on an 8-device mesh still
+    shards evenly."""
+    _sks, pkb = keypairs
+    mesh = VerifyMesh.build(8, platform="cpu")
+    reg = DevicePubkeyRegistry(mesh=mesh)
+    assert reg.ensure(pkb[:1])
+    assert reg.capacity >= mesh.device_count
+    assert reg.capacity % mesh.device_count == 0
+    assert reg.capacity & (reg.capacity - 1) == 0
+
+
+# ------------------------------------------------ flight + ctor threading
+
+
+def test_flight_record_devices_field():
+    """`devices` is a record FIELD (and summary/snapshot payload), never a
+    Prometheus label — per-device label cardinality is forbidden."""
+    fl = FlightRecorder(metrics=Metrics())
+    rec = fl.begin_batch("block", "multi_verify", 4, devices=2)
+    assert rec.record.devices == 2
+    rec.finish(True)
+    rec1 = fl.begin_batch("block", "multi_verify", 4)
+    assert rec1.record.devices == 1  # single-chip default
+    rec1.finish(True)
+    snap = fl.snapshot(lane="block")
+    assert [r.devices for r in snap] == [2, 1]
+    assert all("devices" in r.as_dict() for r in snap)
+
+
+def test_scheduler_and_verifier_mesh_threading():
+    """The injected mesh reaches every consumer ctor — scheduler, the
+    attestation verifier, and the verifier's pubkey registry — and the
+    1-device mesh collapses to None at each seam (single-chip
+    byte-identical)."""
+    import types
+
+    from grandine_tpu.runtime.attestation_verifier import AttestationVerifier
+
+    two = VerifyMesh.build(2, platform="cpu")
+    one = VerifyMesh.build(1, platform="cpu")
+    s2 = VerifyScheduler(use_device=False, mesh=two)
+    s1 = VerifyScheduler(use_device=False, mesh=one)
+    try:
+        assert s2.mesh is two
+        assert s1.mesh is None
+    finally:
+        s2.stop()
+        s1.stop()
+
+    def controller():
+        return types.SimpleNamespace(
+            cfg=None, metrics=None, tracer=None,
+            pool=types.SimpleNamespace(n_threads=2),
+            on_validator_set_change=[],
+        )
+
+    v2 = AttestationVerifier(controller(), mesh=two)
+    v1 = AttestationVerifier(controller(), mesh=one)
+    try:
+        assert v2.mesh is two and v2.registry.mesh is two
+        assert v1.mesh is None and v1.registry.mesh is None
+    finally:
+        v2.stop()
+        v1.stop()
+
+
+# ------------------------------------- scheduler-seam differential (fast)
+
+
+class _TruthBackend:
+    """Async-seam double keyed by message bytes (same shape as
+    test_scheduler's fake): lets the mesh/no-mesh schedulers run the full
+    dispatch → bisect → settle machinery without compiling kernels."""
+
+    def __init__(self, truth):
+        self.truth = dict(truth)
+        self.batches: "list[int]" = []
+
+    def g2_subgroup_check_batch_async(self, points):
+        out = np.ones(len(points), dtype=bool)
+        return lambda: out
+
+    def fast_aggregate_verify_batch_async(self, messages, signatures, keys):
+        self.batches.append(len(messages))
+        ok = all(self.truth.get(bytes(m), False) for m in messages)
+        return lambda: ok
+
+
+def _mixed_items(n_valid: int = 3):
+    """n_valid real signatures + one forgery (a REAL G2 point over the
+    wrong message, so it decompresses fine and must be rejected by
+    verification, not parsing)."""
+    from grandine_tpu.validator.duties import _interop_keys
+
+    key = _interop_keys(0)
+    msgs = [bytes([0x40 + i]) * 32 for i in range(n_valid + 1)]
+    sigs = [key.sign(m).to_bytes() for m in msgs[:n_valid]]
+    sigs.append(sigs[0])  # forged: valid point, wrong message
+    items = [
+        VerifyItem(m, s, public_keys=(key.public_key(),))
+        for m, s in zip(msgs, sigs)
+    ]
+    truth = {bytes(m): True for m in msgs[:n_valid]}
+    return items, truth, [True] * n_valid + [False]
+
+
+def _run_through_scheduler(mesh, items, truth, metrics):
+    lanes = (LaneConfig("sync_message", Priority.LOW, 128, 0.05, 100, True),)
+    s = VerifyScheduler(
+        backend=_TruthBackend(truth), lanes=lanes, use_device=True,
+        metrics=metrics, mesh=mesh,
+    )
+    try:
+        tickets = [s.submit("sync_message", [it]) for it in items]
+        return [t.result(60.0) for t in tickets], s.flight.snapshot()
+    finally:
+        s.stop()
+
+
+def test_mesh_vs_single_verdicts_fast_witness():
+    """Differential through the REAL scheduler seam at mesh widths
+    {None, 1, 2}: identical per-item verdicts on a mixed valid/forged
+    batch, and the flight records attribute the mesh width the batch
+    dispatched over. The fake backend keeps this kernel-free (tier-1);
+    the device-kernel differential below is the slow twin."""
+    items, truth, expect = _mixed_items()
+    got = {}
+    for label, mesh in (
+        ("none", None),
+        ("one", VerifyMesh.build(1, platform="cpu")),
+        ("two", VerifyMesh.build(2, platform="cpu")),
+    ):
+        verdicts, snap = _run_through_scheduler(mesh, items, truth, Metrics())
+        got[label] = verdicts
+        want_devices = 2 if label == "two" else 1
+        batch_recs = [r for r in snap if r.kind == "batch"]
+        assert batch_recs, "scheduler filed no batch flight records"
+        assert all(r.devices == want_devices for r in batch_recs)
+    assert got["none"] == got["one"] == got["two"] == expect
+
+
+# ----------------------------------- scheduler-seam differential (device)
+
+
+@pytest.mark.slow
+@pytest.mark.kernel
+def test_mesh_vs_single_device_verdicts_differential(keypairs):
+    """The device twin of the fast witness: the same mixed valid/forged
+    indexed batch through TWO real schedulers — one single-chip, one on a
+    2-device mesh with the row-sharded registry — must settle
+    byte-identical verdict lists, forged rejection included. The mesh
+    side dispatches the indexed aggregate kernel against mesh-committed
+    registry rows (a multi-device executable, cache-bypassed), then
+    bisects down to host leaves exactly like the single side."""
+    sks, pkb = keypairs
+    msgs = [bytes([0x60 + i]) * 32 for i in range(4)]
+    committees = [(0, 1), (2, 3), (4, 5), (6, 7)]
+    sigs = [
+        A.Signature.aggregate(
+            [sks[j].sign(m) for j in committees[i]]
+        ).to_bytes()
+        for i, m in enumerate(msgs[:3])
+    ]
+    sigs.append(sigs[0])  # forged aggregate over msgs[3]
+    items = [
+        VerifyItem(m, s, member_indices=committees[i], pubkey_columns=pkb)
+        for i, (m, s) in enumerate(zip(msgs, sigs))
+    ]
+    expect = [True, True, True, False]
+
+    verdicts = {}
+    for label, mesh in (
+        ("single", None),
+        ("mesh", VerifyMesh.build(2, platform="cpu")),
+    ):
+        reg = DevicePubkeyRegistry(metrics=Metrics(), mesh=mesh)
+        s = VerifyScheduler(
+            use_device=True, metrics=Metrics(), mesh=mesh, registry=reg,
+        )
+        try:
+            tickets = [s.submit("sync_message", [it]) for it in items]
+            verdicts[label] = [t.result(600.0) for t in tickets]
+        finally:
+            s.stop()
+        assert reg.stats["refreshes"] >= 1  # the indexed path ran
+    assert verdicts["single"] == verdicts["mesh"] == expect

@@ -8,6 +8,7 @@ def all_rules():
     from tools.lint.rules.host_sync import HostSyncRule
     from tools.lint.rules.jit_purity import JitPurityRule
     from tools.lint.rules.lock_order import LockOrderRule
+    from tools.lint.rules.mesh_topology import MeshTopologyRule
     from tools.lint.rules.metrics_cardinality import MetricsCardinalityRule
     from tools.lint.rules.no_inline_gossip_verify import (
         NoInlineGossipVerifyRule,
@@ -22,6 +23,7 @@ def all_rules():
         NoInlineGossipVerifyRule(),
         HostSyncRule(),
         LockOrderRule(),
+        MeshTopologyRule(),
         MetricsCardinalityRule(),
         JitPurityRule(),
         NoPerBatchUploadRule(),

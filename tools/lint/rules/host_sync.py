@@ -52,6 +52,7 @@ class HostSyncRule(Rule):
     )
     default_paths = (
         "grandine_tpu/tpu/bls.py",
+        "grandine_tpu/tpu/mesh.py",
         "grandine_tpu/tpu/registry.py",
         "grandine_tpu/runtime/attestation_verifier.py",
         "grandine_tpu/runtime/verify_scheduler.py",

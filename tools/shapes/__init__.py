@@ -64,6 +64,7 @@ REPLAY_PATH = "grandine_tpu/runtime/replay.py"
 
 TPU_FILES = (
     BLS_PATH,
+    "grandine_tpu/tpu/mesh.py",
     "grandine_tpu/tpu/msm.py",
     "grandine_tpu/tpu/pairing.py",
     REGISTRY_PATH,
@@ -98,7 +99,7 @@ _PAD_HELPERS = {
 #: calls that produce device MSM plans (shape-static per bucket: msm.py
 #: derives S/T from the UNPRUNED total and J from a data-independent
 #: tail bound) — counted per dispatch site for the manifest
-_PLAN_SUFFIXES = ("plan_msm", "_g2_plan")
+_PLAN_SUFFIXES = ("plan_msm", "_g2_plan", "msm_plans")
 
 _CONST_NAME_RE = re.compile(r"[A-Z_][A-Z0-9_]*\Z")
 
@@ -231,6 +232,16 @@ class Analysis:
                     "subgroup", tuple(bulk),
                     "policy:bulk-replay(window_blocks)",
                 ))
+        # the promoted mesh dispatch targets share the multi_verify
+        # policy ladder: a mesh node's replay/bulk batches route to the
+        # sharded kernels at exactly these bucket widths (warmup skips
+        # the rows on a mesh-less node)
+        if any(e.sharding.startswith("mesh") for e in self.entries):
+            for kind in ("sharded_multi_verify", "sharded_multi_verify_msm"):
+                if any(e.kernel == kind for e in self.entries):
+                    rows.append((
+                        kind, (64, 256, 1024, 4096), "policy:mesh-replay",
+                    ))
         return rows
 
 
@@ -573,6 +584,45 @@ def _resolve_bare_jit(scan, call, target, fn_names) -> "KernelEntry | None":
     return None
 
 
+def _promote_wrappers(
+    scan: _FileScan, entries: "list[KernelEntry]"
+) -> "list[KernelEntry]":
+    """Promoted sharded dispatch targets: a module-level `foo(...)` that
+    returns a (cached) `make_foo(...)` kernel IS the registered entry the
+    dispatch sites name — `_run_kernel("foo", ...)` must resolve to it.
+    The promoted entry inherits the factory entry's sharding; its statics
+    are the wrapper's non-topology parameters (they select the cached
+    executable exactly like jit static kwargs)."""
+    by_kernel = {e.kernel: e for e in entries if e.path == scan.path}
+    promoted: "list[KernelEntry]" = []
+    for cls, fn in scan.functions:
+        if cls is not None:
+            continue
+        maker = by_kernel.get(f"make_{fn.name}")
+        if maker is None or maker.factory != "shard_map":
+            continue
+        calls_maker = any(
+            isinstance(node, ast.Call)
+            and _suffix(dotted(node.func)) == maker.kernel
+            for node in scan.scope_statements(fn)
+        )
+        if not calls_maker:
+            continue
+        static = tuple(sorted(
+            a.arg for a in fn.args.args if a.arg not in ("mesh", "axis")
+        ))
+        promoted.append(KernelEntry(
+            kernel=fn.name,
+            qualname=fn.name,
+            path=scan.path,
+            factory="shard_map",
+            static=static,
+            sharding=maker.sharding,
+            line=fn.lineno,
+        ))
+    return promoted
+
+
 def _is_device_feeding(scan: _FileScan, fn: ast.FunctionDef) -> bool:
     for node in scan.scope_statements(fn):
         if isinstance(node, ast.Call):
@@ -879,7 +929,9 @@ def analyze(
             continue
         scan = _FileScan(path, tree)
         scans.append(scan)
-        analysis.entries.extend(_collect_entries(scan, findings))
+        entries = _collect_entries(scan, findings)
+        entries += _promote_wrappers(scan, entries)
+        analysis.entries.extend(entries)
         scopes = {fn: _build_scope(scan, fn) for _, fn in scan.functions}
         _interprocedural_params(scan, scopes)
         for cls, fn in scan.functions:
