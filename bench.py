@@ -565,6 +565,45 @@ def bench_verify_scheduler() -> None:
     )
 
 
+def _fuzz_schedules(seeds) -> dict:
+    """Run the deterministic schedule fuzzer and emit its one parseable
+    JSON line: seeds, preemption-point count, trace hashes (equal seeds
+    reproduce equal hashes), and the violation count (must be 0)."""
+    from grandine_tpu.testing.schedule_fuzz import run_fuzz
+
+    report = run_fuzz(seeds=tuple(seeds))
+    print(json.dumps({
+        "metric": "schedule_fuzz",
+        "seeds": report["seeds"],
+        "scenarios": report["scenarios"],
+        "steps": report["steps"],
+        "switches": report["switches"],
+        "preemption_points": report["preemption_points"],
+        "violations": len(report["violations"]),
+        "traces": report["traces"],
+    }))
+    for v in report["violations"]:
+        print(f"# schedule-fuzz violation: {v}", file=sys.stderr)
+    return report
+
+
+def bench_fuzz_schedules() -> None:
+    """`--fuzz-schedules` / BENCH_FUZZ=1: the dynamic half of the
+    thread-affinity contract. Every `# lint: atomic=` annotation in the
+    runtime sources is backed by a schedule-fuzz scenario
+    (grandine_tpu/testing/schedule_fuzz.COVERAGE); this entry point runs
+    all scenarios under BENCH_FUZZ_SEEDS (default "0,1,2") and exits
+    non-zero on any interleaving that breaks an invariant, deadlocks,
+    or raises. No accelerator: pure host-thread interleaving."""
+    _lint_preflight()
+    seeds = [
+        int(s) for s in
+        os.environ.get("BENCH_FUZZ_SEEDS", "0,1,2").split(",") if s.strip()
+    ]
+    report = _fuzz_schedules(seeds)
+    raise SystemExit(1 if report["violations"] else 0)
+
+
 def bench_chaos() -> None:
     """Chaos soak for the verify plane's health supervisor (`--chaos` /
     BENCH_CHAOS=1): a seeded FaultPlan injects all five fault kinds
@@ -605,6 +644,19 @@ def bench_chaos() -> None:
     seed = int(os.environ.get("BENCH_CHAOS_SEED", "7"))
     n_jobs = int(os.environ.get("BENCH_CHAOS_JOBS", "400"))
     rate = float(os.environ.get("BENCH_CHAOS_RATE", "0.15"))
+
+    # schedule-fuzz preflight: don't soak a supervisor whose concurrent
+    # structures fail their fuzzed invariants under ANY interleaving —
+    # the soak's own pass would not mean what it claims. Reuses the
+    # chaos seed so the soak and its preflight vary together.
+    if os.environ.get("BENCH_SKIP_FUZZ") != "1":
+        if _fuzz_schedules(seeds=(seed,))["violations"]:
+            print(
+                "# chaos soak aborted: schedule-fuzz preflight found "
+                "violations (BENCH_SKIP_FUZZ=1 overrides)",
+                file=sys.stderr,
+            )
+            raise SystemExit(1)
 
     # one REAL signature's bytes reused for every item: the scheduler's
     # host prep decompresses each signature (and rejects infinity), but
@@ -1405,6 +1457,8 @@ if __name__ == "__main__":
         bench_multichip()
     elif "--coldstart" in sys.argv or os.environ.get("BENCH_COLDSTART") == "1":
         bench_coldstart()
+    elif "--fuzz-schedules" in sys.argv or os.environ.get("BENCH_FUZZ") == "1":
+        bench_fuzz_schedules()
     elif "--chaos" in sys.argv or os.environ.get("BENCH_CHAOS") == "1":
         bench_chaos()
     elif "--replay" in sys.argv or os.environ.get("BENCH_REPLAY") == "1":

@@ -29,13 +29,16 @@ class Counter:
 
     @property
     def value(self) -> float:
-        return self._value
+        with self._lock:
+            return self._value
 
     def expose(self) -> str:
+        with self._lock:
+            value = self._value
         return (
             f"# HELP {self.name} {self.help}\n"
             f"# TYPE {self.name} counter\n"
-            f"{self.name} {self._value}\n"
+            f"{self.name} {value}\n"
         )
 
 
@@ -48,10 +51,12 @@ class Gauge(Counter):
         self.inc(-amount)
 
     def expose(self) -> str:
+        with self._lock:
+            value = self._value
         return (
             f"# HELP {self.name} {self.help}\n"
             f"# TYPE {self.name} gauge\n"
-            f"{self.name} {self._value}\n"
+            f"{self.name} {value}\n"
         )
 
 
@@ -87,18 +92,21 @@ class Histogram:
         return _Timer(self)
 
     def expose(self) -> str:
+        with self._lock:
+            counts = list(self._counts)
+            total, count = self._sum, self._count
         out = [
             f"# HELP {self.name} {self.help}",
             f"# TYPE {self.name} histogram",
         ]
         cumulative = 0
-        for bound, count in zip(self.buckets, self._counts):
-            cumulative += count
+        for bound, bucket in zip(self.buckets, counts):
+            cumulative += bucket
             out.append(f'{self.name}_bucket{{le="{bound}"}} {cumulative}')
-        cumulative += self._counts[-1]
+        cumulative += counts[-1]
         out.append(f'{self.name}_bucket{{le="+Inf"}} {cumulative}')
-        out.append(f"{self.name}_sum {self._sum}")
-        out.append(f"{self.name}_count {self._count}")
+        out.append(f"{self.name}_sum {total}")
+        out.append(f"{self.name}_count {count}")
         return "\n".join(out) + "\n"
 
 
@@ -192,13 +200,14 @@ class _LabeledFamily:
                 f"{self.name}: expected {len(self.labelnames)} label "
                 f"values, got {len(values)}"
             )
-        child = self._children.get(values)
-        if child is None:
-            with self._lock:
-                child = self._children.get(values)
-                if child is None:
-                    child = self._make_child()
-                    self._children[values] = child
+        # single-lock lookup (no bare double-checked read): an uncontended
+        # Lock acquire is cheap enough for the inc() hot path, and every
+        # thread then agrees on one child per label tuple
+        with self._lock:
+            child = self._children.get(values)
+            if child is None:
+                child = self._make_child()
+                self._children[values] = child
         return child
 
     def children(self) -> dict:
@@ -226,7 +235,8 @@ class LabeledCounter(_LabeledFamily):
 
         @property
         def value(self) -> float:
-            return self._value
+            with self._lock:
+                return self._value
 
     def _make_child(self):
         return self.Child()
@@ -244,7 +254,7 @@ class LabeledCounter(_LabeledFamily):
         ]
         for values, child in self._sorted_children():
             ls = _label_str(self.labelnames, values)
-            out.append(f"{self.name}{{{ls}}} {child._value}")
+            out.append(f"{self.name}{{{ls}}} {child.value}")
         return "\n".join(out) + "\n"
 
 
@@ -298,11 +308,19 @@ class LabeledHistogram(_LabeledFamily):
 
         @property
         def count(self) -> int:
-            return self._count
+            with self._lock:
+                return self._count
 
         @property
         def sum(self) -> float:
-            return self._sum
+            with self._lock:
+                return self._sum
+
+        def snapshot(self) -> "tuple[list, float, int]":
+            """(bucket counts, sum, count) read consistently under the
+            child's lock — the scrape path's view."""
+            with self._lock:
+                return list(self._counts), self._sum, self._count
 
     def _make_child(self):
         return self.Child(self.buckets)
@@ -320,16 +338,17 @@ class LabeledHistogram(_LabeledFamily):
         ]
         for values, child in self._sorted_children():
             base = _label_str(self.labelnames, values)
+            counts, total, count = child.snapshot()
             cumulative = 0
-            for bound, count in zip(self.buckets, child._counts):
-                cumulative += count
+            for bound, bucket in zip(self.buckets, counts):
+                cumulative += bucket
                 out.append(
                     f'{self.name}_bucket{{{base},le="{bound}"}} {cumulative}'
                 )
-            cumulative += child._counts[-1]
+            cumulative += counts[-1]
             out.append(f'{self.name}_bucket{{{base},le="+Inf"}} {cumulative}')
-            out.append(f"{self.name}_sum{{{base}}} {child._sum}")
-            out.append(f"{self.name}_count{{{base}}} {child._count}")
+            out.append(f"{self.name}_sum{{{base}}} {total}")
+            out.append(f"{self.name}_count{{{base}}} {count}")
         return "\n".join(out) + "\n"
 
 
@@ -684,7 +703,12 @@ class RemoteMetricsService:
         self.data_dir = data_dir
         self.post = post or self._default_post
         self.stats = {"pushes": 0, "failures": 0}
-        self._stop = False
+        #: guards `stats` (push thread + direct push_once callers) and
+        #: the start()/stop() thread handle
+        self._lock = threading.Lock()
+        #: stop signal as an Event: set() from any thread, is_set()/wait()
+        #: from the push loop — no bare-bool publication
+        self._stop = threading.Event()
         self._thread = None
 
     @staticmethod
@@ -743,34 +767,40 @@ class RemoteMetricsService:
             ok = 200 <= int(status) < 300
         except Exception:
             ok = False
-        self.stats["pushes" if ok else "failures"] += 1
+        with self._lock:
+            self.stats["pushes" if ok else "failures"] += 1
         return ok
 
     def start(self) -> None:
         import threading
 
         def loop() -> None:
-            while not self._stop:
+            # thread ownership: the single "metrics-push" daemon owns
+            # this loop; it shares `stats` with direct push_once()
+            # callers under _lock and watches the _stop Event
+            while not self._stop.is_set():
                 # push_once contains its own network errors, but snapshot
                 # assembly reads live controller/metrics state — contain
                 # every iteration so one bad snapshot can't kill the
                 # push thread for the life of the process
                 try:
                     self.push_once()
-                    deadline = time.monotonic() + self.INTERVAL_S
-                    while not self._stop and time.monotonic() < deadline:
-                        time.sleep(0.25)
+                    self._stop.wait(self.INTERVAL_S)
                 except Exception:
-                    self.stats["failures"] += 1
-                    time.sleep(1.0)
+                    with self._lock:
+                        self.stats["failures"] += 1
+                    self._stop.wait(1.0)
 
-        self._thread = threading.Thread(
-            target=loop, name="metrics-push", daemon=True
-        )
-        self._thread.start()
+        with self._lock:
+            if self._thread is not None:
+                return  # already running: keep the singleton push loop
+            self._thread = threading.Thread(
+                target=loop, name="metrics-push", daemon=True
+            )
+            self._thread.start()
 
     def stop(self) -> None:
-        self._stop = True
+        self._stop.set()
 
 
 __all__ = [

@@ -140,6 +140,11 @@ class AttestationVerifier:
             "batches": 0, "accepted": 0, "rejected": 0, "fallbacks": 0,
             "breaker_skips": 0, "retries": 0,
         }
+        #: guards every `stats` bump — concurrent pool workers, the
+        #: completion thread, and the slasher feed all mutate them
+        self._stats_lock = threading.Lock()
+        #: guards the lazy TpuBlsBackend build (pool workers race to it)
+        self._backend_lock = threading.Lock()
 
         #: device-resident pubkey registry (tpu/registry.py): the verify
         #: plane's warm path gathers committee pubkeys on-device by
@@ -173,11 +178,13 @@ class AttestationVerifier:
             self._completion_thread = threading.Thread(
                 target=self._complete, name="attestation-settle", daemon=True
             )
-            self._completion_thread.start()
-
+        # construct every thread before starting any: a started thread
+        # must never observe a half-initialized verifier
         self._collector = threading.Thread(
             target=self._collect, name="attestation-verifier", daemon=True
         )
+        if self._completion_thread is not None:
+            self._completion_thread.start()
         self._collector.start()
 
     # ----------------------------------------------------------- ingestion
@@ -198,6 +205,9 @@ class AttestationVerifier:
     # ----------------------------------------------------------- collector
 
     def _collect(self) -> None:
+        """Runs ONLY on the collector thread: owns the pending queue
+        (under _cond) and batch formation; pool workers run the host
+        fallback, the completion thread settles device batches."""
         while True:
             # crash containment: the collector must outlive any single
             # batch-forming failure (thread-crash-containment rule) —
@@ -284,7 +294,8 @@ class AttestationVerifier:
             with self._cond:
                 self._active -= 1
                 self._cond.notify()
-            self.stats["batches"] += 1
+            with self._stats_lock:
+                self.stats["batches"] += 1
             if self.metrics is not None:
                 self.metrics.att_batches.inc()
                 self.metrics.att_batch_times.observe(
@@ -305,7 +316,8 @@ class AttestationVerifier:
                 except (ForkChoiceError, ValueError, KeyError):
                     # KeyError: raced the mutator's finalization prune (the
                     # same race the block task path catches)
-                    self.stats["rejected"] += 1
+                    with self._stats_lock:
+                        self.stats["rejected"] += 1
         if not prepared:
             return
         # accumulate-wait of the OLDEST attestation in the batch is its
@@ -323,7 +335,8 @@ class AttestationVerifier:
             if not self.health.allow_device():
                 # breaker OPEN: zero device dispatch attempts — straight
                 # to the host anchor below, no per-batch fault tax
-                self.stats["breaker_skips"] += 1
+                with self._stats_lock:
+                    self.stats["breaker_skips"] += 1
                 skipped = True
             else:
                 t0 = time.perf_counter()
@@ -368,7 +381,8 @@ class AttestationVerifier:
                 ),
             )
         if ok:
-            self.stats["accepted"] += len(prepared)
+            with self._stats_lock:
+                self.stats["accepted"] += len(prepared)
             with self._stage("feedback", items=len(prepared)):
                 self.controller.on_valid_attestation_batch(
                     [p[3] for p in prepared]
@@ -386,7 +400,8 @@ class AttestationVerifier:
         # signature per batch that re-verifies EVERY item and blows
         # the 4 s deadline — this is the DoS surface of batch
         # verification, and bisection caps it.
-        self.stats["fallbacks"] += 1
+        with self._stats_lock:
+            self.stats["fallbacks"] += 1
         if self.metrics is not None:
             self.metrics.att_fallbacks.inc()
         with self._stage("fallback", items=len(prepared)):
@@ -409,8 +424,9 @@ class AttestationVerifier:
             for p in prepared:
                 if id(p) not in good_ids:
                     fl.note_origin_failure(p[7])
-        self.stats["accepted"] += len(good_items)
-        self.stats["rejected"] += bad_count
+        with self._stats_lock:
+            self.stats["accepted"] += len(good_items)
+            self.stats["rejected"] += bad_count
         if good_items:
             with self._stage("feedback", items=len(good_items)):
                 self.controller.on_valid_attestation_batch(
@@ -472,17 +488,20 @@ class AttestationVerifier:
     def _ensure_backend(self):
         """The verify backend, lazily building the real TpuBlsBackend
         (which then also answers the supervisor's canary probes;
-        injected backends keep whatever probe the caller wired)."""
-        backend = self.backend
-        if backend is None:
-            from grandine_tpu.tpu.bls import TpuBlsBackend
+        injected backends keep whatever probe the caller wired).
+        Concurrent pool workers race to the first build: the lock keeps
+        the backend a singleton (one jit cache, one canary probe)."""
+        with self._backend_lock:
+            backend = self.backend
+            if backend is None:
+                from grandine_tpu.tpu.bls import TpuBlsBackend
 
-            backend = self.backend = TpuBlsBackend(
-                metrics=self.metrics, tracer=self.tracer, mesh=self.mesh
-            )
-            self.health.ensure_probe(_health.make_canary_probe(
-                backend, timeout_s=self.health.settle_timeout_s
-            ))
+                backend = self.backend = TpuBlsBackend(
+                    metrics=self.metrics, tracer=self.tracer, mesh=self.mesh
+                )
+                self.health.ensure_probe(_health.make_canary_probe(
+                    backend, timeout_s=self.health.settle_timeout_s
+                ))
         return backend
 
     def _retry_dispatch(self, prepared, fl=None):
@@ -490,7 +509,8 @@ class AttestationVerifier:
         dispatch fault, breaker permitting."""
         if not self.health.allow_device():
             return None
-        self.stats["retries"] += 1
+        with self._stats_lock:
+            self.stats["retries"] += 1
         if self.metrics is not None:
             self.metrics.verify_retry.inc(self.lane)
         if fl is not None:
@@ -532,7 +552,9 @@ class AttestationVerifier:
         """Hand a dispatched batch to the completion thread. Blocks when
         `pipeline_depth` batches are already in flight — backpressure that
         bounds device residency."""
-        self._dispatch_sem.acquire()
+        # the slot is released on the completion thread in _complete's
+        # finally, so a `with` cannot express this handoff
+        self._dispatch_sem.acquire()  # lint: disable=thread-affinity
         with self._cond:
             self._inflight += 1
             depth = self._inflight
@@ -556,9 +578,10 @@ class AttestationVerifier:
             except Exception:
                 # the completion thread must survive backend faults; the
                 # batch is dropped (counted), not silently accepted
-                self.stats["settle_errors"] = (
-                    self.stats.get("settle_errors", 0) + 1
-                )
+                with self._stats_lock:
+                    self.stats["settle_errors"] = (
+                        self.stats.get("settle_errors", 0) + 1
+                    )
                 if fl is not None:
                     fl.finish(None)
             finally:
@@ -599,7 +622,10 @@ class AttestationVerifier:
             self.health.record_fault("settle")
             if fl is not None:
                 fl.note_fault("settle")
-        self.stats["settle_errors"] = self.stats.get("settle_errors", 0) + 1
+        with self._stats_lock:
+            self.stats["settle_errors"] = (
+                self.stats.get("settle_errors", 0) + 1
+            )
         t0 = time.perf_counter()
         ok = self._batch_check(
             [p[0] for p in prepared],
@@ -741,9 +767,10 @@ class AttestationVerifier:
                         if newly:
                             covered |= newly
         except Exception:
-            self.stats["slasher_errors"] = (
-                self.stats.get("slasher_errors", 0) + 1
-            )
+            with self._stats_lock:
+                self.stats["slasher_errors"] = (
+                    self.stats.get("slasher_errors", 0) + 1
+                )
 
     def _build_slashing_op(self, hit, attestation, indices):
         """Build + pool one AttesterSlashing for `hit`; returns the set
@@ -803,9 +830,10 @@ class AttestationVerifier:
             attestation_1=att1, attestation_2=att2
         )
         if self.operation_pool.insert_attester_slashing(slashing):
-            self.stats["slashings_emitted"] = (
-                self.stats.get("slashings_emitted", 0) + 1
-            )
+            with self._stats_lock:
+                self.stats["slashings_emitted"] = (
+                    self.stats.get("slashings_emitted", 0) + 1
+                )
         return set(prev_indices) & set(indices)
 
     def _batch_check(self, messages, signatures, members) -> bool:

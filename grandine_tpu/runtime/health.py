@@ -115,6 +115,8 @@ def run_with_deadline(fn: Callable[[], object],
     settled = threading.Event()
 
     def _run() -> None:
+        # watchdog thread: sole writer of `box`; the caller reads it
+        # only after `settled` fires (or abandons it on timeout)
         try:
             box["value"] = fn()
         except BaseException as e:
@@ -352,6 +354,14 @@ class CircuitBreaker:
             if self._state == HALF_OPEN:
                 self._close()
 
+    def ensure_probe(self, probe: "Callable[[], bool]") -> None:
+        """Install `probe` if none is configured yet — first writer wins,
+        atomically. Concurrent lazy backend builds race to register their
+        canary; `allow()` reads `probe` under the same lock."""
+        with self._lock:
+            if self.probe is None:
+                self.probe = probe
+
     def record_fault(self, kind: str = "settle") -> None:
         with self._lock:
             faults = self.stats["faults"]
@@ -477,9 +487,9 @@ class BackendHealthSupervisor:
     def ensure_probe(self, probe: Callable[[], bool]) -> None:
         """Install a canary probe if none is configured yet (the lazily
         built real backend registers itself here; injected test backends
-        keep whatever the test wired)."""
-        if self.breaker.probe is None:
-            self.breaker.probe = probe
+        keep whatever the test wired). Delegates to the breaker so the
+        check-then-set is atomic under the breaker's lock."""
+        self.breaker.ensure_probe(probe)
 
     def guard_settle(self, settle: Callable[[], object],
                      timeout_s: "Optional[float]" = None,

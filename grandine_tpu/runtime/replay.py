@@ -123,7 +123,11 @@ class BulkReplayPipeline:
     or the underlying `TransitionError`/`StateRootMismatch` on a
     structurally invalid block. With `slasher` set, every verified
     block's attestations and header feed the slashing database, so
-    back-fill doubles as historical surveillance."""
+    back-fill doubles as historical surveillance.
+
+    Thread ownership: `replay` drives everything on the CALLING thread;
+    window state is single-owned and only the injected scheduler's own
+    threads run concurrently behind the ticket API."""
 
     def __init__(
         self,

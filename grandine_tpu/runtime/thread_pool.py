@@ -114,6 +114,8 @@ class ThreadPool:
         return None
 
     def _run(self) -> None:
+        """Runs on EVERY pool worker thread: all shared state (queues,
+        counters, stop flag) is touched only under _cond."""
         while True:
             with self._cond:
                 task = self._next_task()

@@ -168,6 +168,8 @@ class VerifyTicket:
         self.enqueued_at = time.monotonic()
         self.settled_at: "Optional[float]" = None
         self.dropped = False
+        # lint: atomic=_ok: _resolve writes it under _lock before
+        # _event.set(); readers gate on the Event — happens-before edge
         self._ok = False
         self._event = threading.Event()
         self._callbacks: "list[Callable]" = []
@@ -181,13 +183,13 @@ class VerifyTicket:
         """The settled verdict (False until resolved). Safe bare read:
         _resolve writes _ok before _event.set(), and the advertised
         contract is done()-then-ok."""
-        return self._ok  # lint: disable=lock-order
+        return self._ok
 
     def result(self, timeout: "Optional[float]" = None) -> bool:
         if not self._event.wait(timeout):
             raise TimeoutError(f"{self.lane} verify ticket not settled")
         # Event.wait() is the happens-before edge for the _ok write
-        return self._ok  # lint: disable=lock-order
+        return self._ok
 
     def add_callback(self, fn: "Callable[[VerifyTicket], None]") -> None:
         """Run fn(ticket) once settled (immediately if already done)."""
@@ -277,6 +279,7 @@ class VerifyScheduler:
         #: the global jit cache)
         self._shared_backend = backend
         self._backends: dict = {}
+        self._backend_lock = threading.Lock()  # lazy per-lane build
         self.registry = registry
         self.lanes = {l.name: l for l in (lanes or DEFAULT_LANES)}
         self._queues = {n: deque() for n in self.lanes}
@@ -293,17 +296,22 @@ class VerifyScheduler:
             }
             for n in self.lanes
         }
+        #: guards every `stats` counter bump — the caller (submit/shed),
+        #: dispatcher, settle, and watchdog threads all mutate them
+        self._stats_lock = threading.Lock()
 
         self.pipeline_depth = max(1, int(pipeline_depth))
         self._sem = threading.BoundedSemaphore(self.pipeline_depth)
         self._completion: "queue.Queue" = queue.Queue()
+        # construct BOTH threads before starting either: a started
+        # thread must never observe a half-initialized scheduler
         self._completion_thread = threading.Thread(
             target=self._complete, name="verify-settle", daemon=True
         )
-        self._completion_thread.start()
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, name="verify-scheduler", daemon=True
         )
+        self._completion_thread.start()
         self._dispatcher.start()
 
     # ------------------------------------------------------------ submit
@@ -341,7 +349,8 @@ class VerifyScheduler:
             q.append(job)
             self._item_counts[lane_name] += len(job.items)
             self._pending += 1
-            self.stats[lane_name]["submitted"] += 1
+            with self._stats_lock:
+                self.stats[lane_name]["submitted"] += 1
             self._set_depth(lane_name)
             self._cond.notify_all()
         for old in shed:
@@ -408,6 +417,8 @@ class VerifyScheduler:
         return jobs
 
     def _dispatch_loop(self) -> None:
+        """Runs ONLY on the dispatcher thread: owns lane queues (under
+        _cond), batch formation, and device dispatch."""
         while True:
             # crash containment: one poisoned batch must not kill the
             # dispatcher — resolve its tickets dropped, account the
@@ -495,7 +506,8 @@ class VerifyScheduler:
             self.metrics.verify_lane_batches.labels(lane.name, result).inc()
 
     def _count_shed(self, lane_name: str) -> None:
-        self.stats[lane_name]["shed"] += 1
+        with self._stats_lock:
+            self.stats[lane_name]["shed"] += 1
         if self.metrics is not None:
             self.metrics.verify_lane_dropped.labels(lane_name).inc()
 
@@ -514,20 +526,24 @@ class VerifyScheduler:
     def _backend_for(self, lane: LaneConfig):
         if self._shared_backend is not None:
             return self._shared_backend
-        backend = self._backends.get(lane.name)
-        if backend is None:
-            from grandine_tpu.tpu.bls import TpuBlsBackend
+        # dispatcher AND settle-thread bisection both build lazily; the
+        # lock keeps the per-lane backend a singleton (no double compile
+        # cache, no torn publication)
+        with self._backend_lock:
+            backend = self._backends.get(lane.name)
+            if backend is None:
+                from grandine_tpu.tpu.bls import TpuBlsBackend
 
-            backend = self._backends[lane.name] = TpuBlsBackend(
-                metrics=self.metrics, tracer=self.tracer, lane=lane.name,
-                mesh=self.mesh,
-            )
-            # the first real backend also answers canary probes for
-            # HALF_OPEN re-promotion (injected backends keep whatever
-            # probe the caller wired — tests drive their own canaries)
-            self.health.ensure_probe(_health.make_canary_probe(
-                backend, timeout_s=self.health.settle_timeout_s
-            ))
+                backend = self._backends[lane.name] = TpuBlsBackend(
+                    metrics=self.metrics, tracer=self.tracer, lane=lane.name,
+                    mesh=self.mesh,
+                )
+                # the first real backend also answers canary probes for
+                # HALF_OPEN re-promotion (injected backends keep whatever
+                # probe the caller wired — tests drive their own canaries)
+                self.health.ensure_probe(_health.make_canary_probe(
+                    backend, timeout_s=self.health.settle_timeout_s
+                ))
         return backend
 
     def _retry_dispatch(self, lane: LaneConfig, items, fl=None):
@@ -537,7 +553,8 @@ class VerifyScheduler:
         batch's first failure already counted)."""
         if not self.health.allow_device():
             return None
-        self.stats[lane.name]["retries"] += 1
+        with self._stats_lock:
+            self.stats[lane.name]["retries"] += 1
         self._count_retry(lane.name)
         if fl is not None:
             fl.note_retry()
@@ -560,9 +577,10 @@ class VerifyScheduler:
             waits = self.metrics.verify_lane_wait_seconds.labels(lane.name)
             for j in jobs:
                 waits.observe(now - j.ticket.enqueued_at)
-        st = self.stats[lane.name]
-        st["batches"] += 1
-        st["max_batch_items"] = max(st["max_batch_items"], len(items))
+        with self._stats_lock:
+            st = self.stats[lane.name]
+            st["batches"] += 1
+            st["max_batch_items"] = max(st["max_batch_items"], len(items))
         # jobs pop FIFO, so jobs[0] is the oldest: its wait is the
         # batch's queue_wait component for SLO attribution
         fl = self.flight.begin_batch(
@@ -582,7 +600,8 @@ class VerifyScheduler:
                 if not device_allowed:
                     # breaker OPEN: no per-batch device fault tax —
                     # straight to the host path, zero dispatch attempts
-                    st["breaker_skips"] += 1
+                    with self._stats_lock:
+                        st["breaker_skips"] += 1
                 else:
                     t0 = time.perf_counter()
                     try:
@@ -590,7 +609,8 @@ class VerifyScheduler:
                         fl.note_device(time.perf_counter() - t0)
                     except Exception:
                         fl.note_device(time.perf_counter() - t0)
-                        st["device_faults"] += 1
+                        with self._stats_lock:
+                            st["device_faults"] += 1
                         fl.note_fault("dispatch")
                         self.health.record_fault("dispatch")
                         # bounded transient retry: one immediate
@@ -616,8 +636,10 @@ class VerifyScheduler:
                 return
             ctx = self.tracer.capture()
         fl.record.kernel = "fast_aggregate"
-        # two-deep pipelined handoff (backpressure bounds device residency)
-        self._sem.acquire()
+        # two-deep pipelined handoff (backpressure bounds device
+        # residency); the slot is released on the settle thread in
+        # _complete's finally, so a `with` cannot express it
+        self._sem.acquire()  # lint: disable=thread-affinity
         self.flight.device_enter()
         self._completion.put((lane, jobs, items, settle, ctx, fl))
 
@@ -710,6 +732,8 @@ class VerifyScheduler:
     # ------------------------------------------------------------ settle
 
     def _complete(self) -> None:
+        """Runs ONLY on the completion thread: forces device verdicts in
+        dispatch order, settles tickets, releases the pipeline slot."""
         while True:
             entry = self._completion.get()
             if entry is None:
@@ -757,7 +781,8 @@ class VerifyScheduler:
             if fl is not None:
                 fl.note_fault("settle")
         if count_stats:
-            self.stats[lane.name]["device_faults"] += 1
+            with self._stats_lock:
+                self.stats[lane.name]["device_faults"] += 1
         return outcome
 
     def _settle_batch(self, lane, jobs, items, settle, fl=None) -> None:
@@ -818,7 +843,8 @@ class VerifyScheduler:
             try:
                 ok = self._batch_check(lane, half, deadline)
             except Exception:
-                self.stats[lane.name]["device_faults"] += 1
+                with self._stats_lock:
+                    self.stats[lane.name]["device_faults"] += 1
                 ok = False  # descend; leaves verify on the host
             out.extend(
                 [True] * len(half)
@@ -860,13 +886,13 @@ class VerifyScheduler:
             return [host_check_item(it) for it in items]
 
     def _deliver(self, lane: LaneConfig, jobs, verdicts) -> None:
-        st = self.stats[lane.name]
         i = 0
         for job in jobs:
             n = len(job.items)
             ok = all(verdicts[i:i + n])
             i += n
-            st["accepted" if ok else "rejected"] += 1
+            with self._stats_lock:
+                self.stats[lane.name]["accepted" if ok else "rejected"] += 1
             if not ok and job.ticket.origin is not None:
                 # bisection named this job's items bad: attribute the
                 # failure to its gossip origin (bounded top-K table)

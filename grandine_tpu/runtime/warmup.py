@@ -139,7 +139,9 @@ def warm_all(
     mesh=None,
 ) -> int:
     """Compile-and-run every manifest entry once. Returns the number of
-    entries warmed. Call from a background thread at node startup.
+    entries warmed. Call from a background thread at node startup
+    (`warm_in_background`'s `kernel-warmup` daemon): all state here is
+    thread-local; the shared ledger/cache seams take their own locks.
 
     `registry` (a DevicePubkeyRegistry with at least one key) unlocks
     the aggregate_idx kind; without it those rows are skipped with a

@@ -15,6 +15,7 @@ def all_rules():
     )
     from tools.lint.rules.no_per_batch_upload import NoPerBatchUploadRule
     from tools.lint.rules.shape_contract import ShapeContractRule
+    from tools.lint.rules.thread_affinity import ThreadAffinityRule
     from tools.lint.rules.thread_crash_containment import (
         ThreadCrashContainmentRule,
     )
@@ -28,5 +29,6 @@ def all_rules():
         JitPurityRule(),
         NoPerBatchUploadRule(),
         ThreadCrashContainmentRule(),
+        ThreadAffinityRule(),
         ShapeContractRule(),
     ]

@@ -85,26 +85,35 @@ class LockOrderRule(Rule):
     default_paths = (
         "grandine_tpu/runtime/verify_scheduler.py",
         "grandine_tpu/runtime/thread_pool.py",
+        "grandine_tpu/runtime/replay.py",
+        "grandine_tpu/runtime/flight.py",
         "grandine_tpu/tpu/registry.py",
     )
 
     def check(self, ctx: Context, files):
+        from tools.lint.thread_graph import class_annotations
+
         out: "list[Finding]" = []
         edges: "dict[tuple[str, str], tuple[str, int]]" = {}
-        infos: "list[tuple[str, _ClassInfo]]" = []
+        infos: "list[tuple[str, _ClassInfo, dict]]" = []
         for path in files:
             tree = ctx.tree(path)
-            if tree is None:
+            src = ctx.source(path)
+            if tree is None or src is None:
                 continue
+            anns = class_annotations(tree, src)
             for node in ast.walk(tree):
                 if isinstance(node, ast.ClassDef):
                     info = _ClassInfo(node)
                     if info.locks:
-                        infos.append((path, info))
+                        infos.append((path, info, anns.get(node.name, {})))
 
-        for path, info in infos:
+        for path, info, anns in infos:
             self._collect_edges(path, info, edges)
-            out.extend(self._guarded_attr_findings(path, info))
+            # `# lint: atomic=<attr>:` annotations transfer ownership of
+            # the bare-read question to the thread-affinity rule (each
+            # annotation is backed by a schedule-fuzz invariant there)
+            out.extend(self._guarded_attr_findings(path, info, set(anns)))
 
         # cycle = both directions of an edge pair present anywhere in
         # the scanned set (cross-class, cross-file pairs included)
@@ -182,7 +191,8 @@ class LockOrderRule(Rule):
 
     # ------------------------------------------------- guarded attrs
 
-    def _guarded_attr_findings(self, path, info: _ClassInfo):
+    def _guarded_attr_findings(self, path, info: _ClassInfo,
+                               atomic: "set[str]" = frozenset()):
         held_methods = self._held_methods(info)
         guarded: "dict[str, str]" = {}  # attr -> lock it's written under
         for mname, m in info.methods.items():
@@ -190,7 +200,8 @@ class LockOrderRule(Rule):
                 continue
             start = "a caller-held lock" if mname in held_methods else None
             for attr, lock in self._writes_under_lock(m, info, start):
-                guarded.setdefault(attr, lock)
+                if attr not in atomic:
+                    guarded.setdefault(attr, lock)
         if not guarded:
             return
         for mname, m in info.methods.items():

@@ -1,0 +1,205 @@
+"""Rule: whole-program thread-affinity + lock-coverage race detection.
+
+Builds the thread graph for each runtime file (see
+`tools/lint/thread_graph`), then classifies every attribute of every
+threaded class (a class with lock attributes, a resolved thread entry
+point, or an `atomic=` annotation) into one of four sharing classes:
+
+1. **immutable-after-init** — never written outside `__init__`;
+2. **single-thread-owned** — every access happens on one thread label;
+3. **consistently-lock-protected** — every access from a multi-thread
+   context holds a class lock (lexically or via the caller-held-lock
+   fixpoint ported from `lock-order`);
+4. **annotated benign** — `# lint: atomic=<attr>: <one-line why>`
+   inside the class body, each backed by a schedule-fuzz invariant
+   (`grandine_tpu/testing/schedule_fuzz.COVERAGE`).
+
+Anything reachable from ≥2 threads that fits none of these is flagged.
+Three hazards are flagged regardless of classification:
+
+* read-modify-write (`+=`, `self.d[k] += 1`) without a lock from a
+  multi-thread context — annotations do NOT excuse RMW, because a torn
+  increment is a lost update no happens-before comment can fix;
+* publication-before-init escape — `self.x = ...` in `__init__` after a
+  thread has already been started (the thread can observe a
+  half-constructed object);
+* `self.<lock>.acquire()` outside a `with` — release is not guaranteed
+  on all exit paths.
+"""
+
+from __future__ import annotations
+
+from tools.lint.core import Context, Finding, Rule
+from tools.lint import thread_graph as tg
+
+#: dunder methods whose accesses are reporting-only by convention
+_EXEMPT_READERS = {"__repr__", "__str__", "__len__"}
+
+
+class ThreadAffinityRule(Rule):
+    name = "thread-affinity"
+    description = (
+        "every attribute of a threaded runtime class is immutable-after-"
+        "init, single-thread-owned, consistently lock-protected, or "
+        "explicitly annotated atomic with a justification; RMW, init "
+        "escapes, and bare lock acquires are flagged unconditionally"
+    )
+    default_paths = (
+        "grandine_tpu/runtime/verify_scheduler.py",
+        "grandine_tpu/runtime/attestation_verifier.py",
+        "grandine_tpu/runtime/health.py",
+        "grandine_tpu/runtime/flight.py",
+        "grandine_tpu/runtime/replay.py",
+        "grandine_tpu/runtime/warmup.py",
+        "grandine_tpu/runtime/thread_pool.py",
+        "grandine_tpu/metrics.py",
+        "grandine_tpu/tpu/registry.py",
+    )
+
+    def check(self, ctx: Context, files):
+        out: "list[Finding]" = []
+        for path in files:
+            tree = ctx.tree(path)
+            src = ctx.source(path)
+            if tree is None or src is None:
+                continue
+            annotations = tg.class_annotations(tree, src)
+            roots = tg.collect_roots(tree, path)
+            rooted = {r.cls for r in roots if r.cls}
+            for model in tg.build_class_models(tree, path):
+                anns = annotations.get(model.name, {})
+                if not model.locks and model.name not in rooted and not anns:
+                    continue  # plain data class: no concurrency contract
+                out.extend(self._check_class(path, model, anns))
+                out.extend(self._init_escapes(path, model, roots))
+                for lock, method, line in model.bare_acquires:
+                    out.append(Finding(
+                        self.name, path, line,
+                        f"{model.name}.{lock}.acquire() outside a `with` "
+                        f"in {method} — release is not guaranteed on all "
+                        f"exit paths; use `with self.{lock}:`",
+                        key=(f"{self.name}:{path}:{model.name}.{lock}"
+                             f":bare-acquire:{method}"),
+                    ))
+        return out
+
+    # --------------------------------------------- per-class classifier
+
+    def _check_class(self, path, model: "tg.ClassModel", anns):
+        by_attr: "dict[str, list[tg.Access]]" = {}
+        for a in model.accesses:
+            if a.method in _EXEMPT_READERS:
+                continue
+            labels = model.labels.get(a.method, set())
+            if labels <= {tg.INIT}:
+                continue  # pre-publication: __init__ and its helpers
+            by_attr.setdefault(a.attr, []).append(a)
+
+        for attr, accesses in sorted(by_attr.items()):
+            writes = [a for a in accesses if a.kind in ("write", "rmw")]
+            if not writes:
+                continue  # immutable-after-init
+            labels: "set[str]" = set()
+            for a in accesses:
+                labels |= model.labels.get(a.method, set())
+            if model.thread_count(labels) <= 1:
+                continue  # single-thread-owned
+            bare = [a for a in accesses if not a.locked]
+            if not bare:
+                continue  # consistently-lock-protected
+            threads = ", ".join(sorted(labels - {tg.INIT}))
+            ann = anns.get(attr)
+            if ann is not None:
+                if not ann.justification:
+                    yield Finding(
+                        self.name, path, ann.line,
+                        f"atomic={attr} annotation on {model.name} has no "
+                        f"justification — say why the bare access is safe",
+                        key=(f"{self.name}:{path}:{model.name}.{attr}"
+                             f":empty-justification"),
+                    )
+                bare_rmw = [a for a in bare if a.kind == "rmw"]
+                if bare_rmw:
+                    a = bare_rmw[0]
+                    yield Finding(
+                        self.name, path, a.line,
+                        f"{model.name}.{attr} is annotated atomic but "
+                        f"{a.method} does an unlocked read-modify-write "
+                        f"on it — a torn increment is a lost update; "
+                        f"take a lock",
+                        key=(f"{self.name}:{path}:{model.name}.{attr}"
+                             f":rmw-on-atomic"),
+                    )
+                continue  # annotated benign
+            a = bare[0]
+            yield Finding(
+                self.name, path, a.line,
+                f"{model.name}.{attr} is reachable from threads "
+                f"[{threads}] but {a.method} accesses it with no lock "
+                f"held ({a.kind}) and it is not immutable, single-"
+                f"thread-owned, or annotated atomic — data race",
+                key=f"{self.name}:{path}:{model.name}.{attr}:unguarded",
+            )
+
+    # ----------------------------------------------------- init escapes
+
+    def _init_escapes(self, path, model: "tg.ClassModel", roots):
+        init = model.methods.get("__init__")
+        if init is None:
+            return
+        import ast
+
+        # thread starts inside __init__: `<thread var>.start()` for a
+        # Thread constructed in __init__, or a spawn/run_with_deadline
+        # root whose call site is lexically inside __init__.
+        lo, hi = init.lineno, init.end_lineno or init.lineno
+        start_line = None
+        thread_vars: "set[str]" = set()
+        for node in ast.walk(init):
+            if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call):
+                name = tg.dotted(node.value.func)
+                if name and name.rsplit(".", 1)[-1] == "Thread":
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            thread_vars.add(t.id)
+                        attr = tg._self_attr(t)
+                        if attr:
+                            thread_vars.add(f"self.{attr}")
+        for node in ast.walk(init):
+            if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute) and node.func.attr == "start":
+                base = node.func.value
+                ref = (
+                    base.id if isinstance(base, ast.Name)
+                    else f"self.{tg._self_attr(base)}"
+                    if tg._self_attr(base) else None
+                )
+                if ref in thread_vars:
+                    start_line = min(start_line or node.lineno, node.lineno)
+        for r in roots:
+            # Thread(...) construction only runs after .start() (tracked
+            # above); pool/watchdog call sites launch immediately
+            if (
+                r.kind != "thread"
+                and r.cls == model.name
+                and lo <= r.line <= hi
+            ):
+                start_line = min(start_line or r.line, r.line)
+        if start_line is None:
+            return
+        for node in ast.walk(init):
+            if isinstance(node, ast.Assign) and node.lineno > start_line:
+                for t in node.targets:
+                    attr = tg._self_attr(t)
+                    if attr:
+                        yield Finding(
+                            self.name, path, node.lineno,
+                            f"{model.name}.__init__ assigns self.{attr} "
+                            f"after starting a thread at line "
+                            f"{start_line} — the thread can observe a "
+                            f"half-constructed object; move the "
+                            f"assignment before the start()",
+                            key=(f"{self.name}:{path}:{model.name}."
+                                 f"{attr}:init-escape"),
+                        )

@@ -1,0 +1,442 @@
+"""Whole-program thread graph for the runtime's threaded classes.
+
+Resolves every thread entry point in a file — `threading.Thread(
+target=...)` constructions, `pool.spawn(...)` / `.submit(...)`
+submissions, and watchdog `run_with_deadline(...)` closures — then
+propagates thread labels through each class's self-call graph to a
+fixpoint, so the `thread-affinity` rule can ask "which threads can
+execute this method?" for every method in the file.
+
+Labels are plain strings. Three are special:
+
+* ``<caller>`` — any public method is callable from arbitrary
+  application threads; it is *multi* (two callers may run it
+  concurrently).
+* ``<init>`` — code reachable only from ``__init__`` runs before the
+  object is published; accesses there are exempt.
+* roots created inside a loop, via a pool ``spawn``/``submit``, or via
+  ``run_with_deadline`` are *multi*: several OS threads run the same
+  entry concurrently.
+
+The module also owns the `# lint: atomic=<attr>: <justification>`
+annotation contract shared by `thread-affinity` (which grandfathers the
+attribute) and `lock-order` (which defers to it instead of demanding a
+lock). An annotation is scoped to the innermost class whose body
+contains the comment line, and the one-line justification is
+mandatory — `thread-affinity` flags empty ones.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+
+from tools.lint.core import dotted
+
+CALLER = "<caller>"
+INIT = "<init>"
+
+#: comment annotation:  # lint: atomic=_ok: writer settles before Event.set
+ATOMIC_RE = re.compile(r"#\s*lint:\s*atomic=(\w+)\s*:?\s*(.*)$")
+
+_LOCK_FACTORIES = {
+    "Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore",
+}
+_SPAWN_METHODS = {"spawn", "submit"}
+
+
+def _self_attr(node: ast.AST) -> "str | None":
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _is_lock_factory(call: ast.Call) -> bool:
+    name = dotted(call.func)
+    return name is not None and name.rsplit(".", 1)[-1] in _LOCK_FACTORIES
+
+
+# ---------------------------------------------------------- annotations
+
+
+@dataclass
+class Annotation:
+    attr: str
+    line: int
+    justification: str
+
+
+def file_annotations(src: str) -> "list[Annotation]":
+    out = []
+    for i, raw in enumerate(src.splitlines(), start=1):
+        m = ATOMIC_RE.search(raw)
+        if m:
+            out.append(Annotation(m.group(1), i, m.group(2).strip()))
+    return out
+
+
+def class_annotations(
+    tree: ast.AST, src: str,
+) -> "dict[str, dict[str, Annotation]]":
+    """classname -> {attr -> Annotation}, scoping each annotation to the
+    innermost class whose lexical body contains the comment line."""
+    spans: "list[tuple[int, int, str]]" = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            spans.append((node.lineno, node.end_lineno or node.lineno,
+                          node.name))
+    out: "dict[str, dict[str, Annotation]]" = {}
+    for ann in file_annotations(src):
+        best = None
+        for lo, hi, name in spans:
+            if lo <= ann.line <= hi:
+                if best is None or (hi - lo) < (best[1] - best[0]):
+                    best = (lo, hi, name)
+        if best is not None:
+            out.setdefault(best[2], {})[ann.attr] = ann
+    return out
+
+
+# -------------------------------------------------------------- roots
+
+
+@dataclass
+class Root:
+    """One resolved thread entry point."""
+
+    label: str
+    cls: "str | None"   # class owning the target method, if any
+    target: str         # method or function name
+    line: int
+    multi: bool         # can several OS threads run this entry at once?
+    #: "thread" = Thread(...) construction (runs only after .start());
+    #: "pool" / "watchdog" = the call site itself launches the thread
+    kind: str = "thread"
+
+
+def _callable_targets(arg: ast.AST, cls: "str | None",
+                      known_methods: "set[str]",
+                      known_funcs: "set[str]"):
+    """Resolve a thread-target expression to (cls, name) pairs."""
+    attr = _self_attr(arg)
+    if attr is not None and attr in known_methods:
+        yield cls, attr
+        return
+    if isinstance(arg, ast.Name):
+        if arg.id in known_methods:
+            yield cls, arg.id          # nested def used as a closure
+        elif arg.id in known_funcs:
+            yield None, arg.id
+        return
+    if isinstance(arg, ast.Lambda):
+        for node in ast.walk(arg.body):
+            if isinstance(node, ast.Call):
+                a = _self_attr(node.func)
+                if a is not None and a in known_methods:
+                    yield cls, a
+                elif (
+                    isinstance(node.func, ast.Name)
+                    and node.func.id in known_funcs
+                ):
+                    yield None, node.func.id
+
+
+def _thread_name_kwarg(call: ast.Call) -> "str | None":
+    for kw in call.keywords:
+        if kw.arg == "name" and isinstance(kw.value, ast.Constant) and (
+            isinstance(kw.value.value, str)
+        ):
+            return kw.value.value
+    return None
+
+
+def collect_roots(tree: ast.AST, path: str) -> "list[Root]":
+    """Every thread entry point in the file, with targets resolved."""
+    class_methods: "dict[str, set[str]]" = {}
+    module_funcs: "set[str]" = set()
+    for node in ast.iter_child_nodes(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            module_funcs.add(node.name)
+
+    def method_names(cls_node: ast.ClassDef) -> "set[str]":
+        names = set()
+        for n in ast.walk(cls_node):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                names.add(n.name)
+        return names
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            class_methods[node.name] = method_names(node)
+
+    roots: "list[Root]" = []
+    base = path.rsplit("/", 1)[-1]
+
+    def visit(node, cls, loop_depth):
+        for child in ast.iter_child_nodes(node):
+            child_cls = child.name if isinstance(child, ast.ClassDef) else cls
+            child_loop = loop_depth + (
+                1 if isinstance(child, (ast.For, ast.While)) else 0
+            )
+            if isinstance(child, ast.Call):
+                known = class_methods.get(cls or "", set())
+                name = dotted(child.func)
+                leaf = name.rsplit(".", 1)[-1] if name else None
+                if leaf == "Thread":
+                    target = next(
+                        (kw.value for kw in child.keywords
+                         if kw.arg == "target"), None)
+                    if target is not None:
+                        label = _thread_name_kwarg(child) or (
+                            f"thread@{base}:{child.lineno}"
+                        )
+                        for tcls, tname in _callable_targets(
+                                target, cls, known, module_funcs):
+                            roots.append(Root(label, tcls, tname,
+                                              child.lineno,
+                                              multi=loop_depth > 0,
+                                              kind="thread"))
+                elif leaf == "run_with_deadline" and child.args:
+                    for tcls, tname in _callable_targets(
+                            child.args[0], cls, known, module_funcs):
+                        roots.append(Root(
+                            f"watchdog@{base}:{child.lineno}",
+                            tcls, tname, child.lineno, multi=True,
+                            kind="watchdog"))
+                elif (
+                    isinstance(child.func, ast.Attribute)
+                    and child.func.attr in _SPAWN_METHODS
+                    and child.args
+                ):
+                    for tcls, tname in _callable_targets(
+                            child.args[0], cls, known, module_funcs):
+                        roots.append(Root(
+                            f"pool@{base}:{child.lineno}",
+                            tcls, tname, child.lineno, multi=True,
+                            kind="pool"))
+            visit(child, child_cls, child_loop)
+
+    visit(tree, None, 0)
+    return roots
+
+
+# -------------------------------------------------------- class model
+
+
+@dataclass
+class Access:
+    attr: str
+    kind: str        # "read" | "write" | "rmw"
+    locked: bool
+    method: str
+    line: int
+
+
+@dataclass
+class ClassModel:
+    """One runtime class: its methods (class-body defs plus nested defs
+    such as daemon-loop closures), lock attributes, per-method thread
+    labels, and every `self.<attr>` access with lock-held state."""
+
+    name: str
+    node: ast.ClassDef
+    methods: "dict[str, ast.FunctionDef]" = field(default_factory=dict)
+    locks: "set[str]" = field(default_factory=set)
+    labels: "dict[str, set[str]]" = field(default_factory=dict)
+    multi: "set[str]" = field(default_factory=set)   # multi-thread labels
+    accesses: "list[Access]" = field(default_factory=list)
+    bare_acquires: "list[tuple[str, str, int]]" = field(
+        default_factory=list)  # (lock, method, line)
+
+    def thread_count(self, labels: "set[str]") -> int:
+        """Distinct concurrent threads a label set represents; a single
+        *multi* label already means two."""
+        live = labels - {INIT}
+        if not live:
+            return 0
+        if len(live) == 1 and next(iter(live)) in self.multi:
+            return 2
+        return len(live)
+
+
+def _attr_base(node: ast.AST) -> "str | None":
+    """`stats` from self.stats, self.stats[k], self.stats[k][j]."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    return _self_attr(node)
+
+
+def build_class_models(tree: ast.AST, path: str) -> "list[ClassModel]":
+    roots = collect_roots(tree, path)
+    models: "dict[str, ClassModel]" = {}
+
+    def collect_class(cls_node: ast.ClassDef) -> ClassModel:
+        model = ClassModel(cls_node.name, cls_node)
+        # class-body methods plus nested defs (closures used as thread
+        # targets); nearest-class attribution mirrors walk_functions.
+        def visit_defs(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    continue  # inner classes modelled separately
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    model.methods.setdefault(child.name, child)
+                    visit_defs(child)
+                else:
+                    visit_defs(child)
+
+        visit_defs(cls_node)
+        for m in model.methods.values():
+            for node in ast.walk(m):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    attr = _self_attr(node.targets[0])
+                    if (
+                        attr
+                        and isinstance(node.value, ast.Call)
+                        and _is_lock_factory(node.value)
+                    ):
+                        model.locks.add(attr)
+        return model
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            models[node.name] = collect_class(node)
+
+    for model in models.values():
+        _label_methods(model, roots)
+        _collect_accesses(model)
+    return list(models.values())
+
+
+def _label_methods(model: ClassModel, roots: "list[Root]") -> None:
+    """Seed labels from roots / publicness, then propagate through the
+    self-call graph to a fixpoint."""
+    calls: "dict[str, set[str]]" = {m: set() for m in model.methods}
+    for mname, m in model.methods.items():
+        for node in ast.walk(m):
+            if isinstance(node, ast.Call):
+                attr = _self_attr(node.func)
+                if attr in model.methods:
+                    calls[mname].add(attr)
+                elif (
+                    isinstance(node.func, ast.Name)
+                    and node.func.id in model.methods
+                    and node.func.id != mname
+                ):
+                    calls[mname].add(node.func.id)
+
+    labels: "dict[str, set[str]]" = {m: set() for m in model.methods}
+    multi: "set[str]" = {CALLER}
+    for root in roots:
+        if root.cls == model.name and root.target in model.methods:
+            labels[root.target].add(root.label)
+            if root.multi:
+                multi.add(root.label)
+    for mname in model.methods:
+        if mname == "__init__":
+            labels[mname].add(INIT)
+        elif not mname.startswith("_") or mname.startswith("__"):
+            labels[mname].add(CALLER)
+
+    changed = True
+    while changed:
+        changed = False
+        for mname, callees in calls.items():
+            for callee in callees:
+                if not labels[mname] <= labels[callee]:
+                    labels[callee] |= labels[mname]
+                    changed = True
+
+    # A private method no caller reaches is still importable/testable
+    # from outside: treat it like a public entry.
+    for mname in model.methods:
+        if not labels[mname]:
+            labels[mname].add(CALLER)
+    model.labels = labels
+    model.multi = multi
+
+
+def _with_locks(node: ast.AST, model: ClassModel) -> "list[str]":
+    if not isinstance(node, ast.With):
+        return []
+    return [
+        a for item in node.items
+        if (a := _self_attr(item.context_expr)) in model.locks
+    ]
+
+
+def held_methods(model: ClassModel) -> "set[str]":
+    """Private methods whose every in-class call site runs with a lock
+    held (lexically or from another held method — greatest fixpoint).
+    Ports the lock-order caller-held-lock analysis."""
+    sites: "dict[str, list[tuple[str, bool]]]" = {}
+
+    def collect(caller, node, held):
+        for child in ast.iter_child_nodes(node):
+            now = held or bool(_with_locks(child, model))
+            if isinstance(child, ast.Call):
+                attr = _self_attr(child.func)
+                if attr in model.methods:
+                    sites.setdefault(attr, []).append((caller, now))
+            collect(caller, child, now)
+
+    for mname, m in model.methods.items():
+        collect(mname, m, False)
+
+    held = {
+        m for m in sites if m.startswith("_") and not m.startswith("__")
+    }
+    changed = True
+    while changed:
+        changed = False
+        for m in sorted(held):
+            if any(not lex and caller not in held for caller, lex in sites[m]):
+                held.discard(m)
+                changed = True
+    return held
+
+
+def _collect_accesses(model: ClassModel) -> None:
+    held = held_methods(model)
+    for mname, m in model.methods.items():
+        start_held = mname in held
+
+        def walk(node, locked, mname=mname):
+            for child in ast.iter_child_nodes(node):
+                now = locked or bool(_with_locks(child, model))
+                if isinstance(child, ast.Assign):
+                    for t in child.targets:
+                        attr = _attr_base(t)
+                        if attr and attr not in model.locks:
+                            model.accesses.append(Access(
+                                attr, "write", now, mname, child.lineno))
+                elif isinstance(child, ast.AugAssign):
+                    attr = _attr_base(child.target)
+                    if attr and attr not in model.locks:
+                        model.accesses.append(Access(
+                            attr, "rmw", now, mname, child.lineno))
+                elif (
+                    isinstance(child, ast.Attribute)
+                    and isinstance(child.ctx, ast.Load)
+                ):
+                    attr = _self_attr(child)
+                    if attr and attr not in model.locks:
+                        model.accesses.append(Access(
+                            attr, "read", now, mname, child.lineno))
+                if isinstance(child, ast.Call):
+                    fn = child.func
+                    if (
+                        isinstance(fn, ast.Attribute)
+                        and fn.attr == "acquire"
+                        and (lock := _self_attr(fn.value)) in model.locks
+                    ):
+                        model.bare_acquires.append(
+                            (lock, mname, child.lineno))
+                walk(child, now, mname)
+
+        walk(m, start_held)
