@@ -933,6 +933,159 @@ def bench_chaos() -> None:
         raise SystemExit(1)
 
 
+def bench_adversarial() -> None:
+    """Adversarial isolation soak (runs with `--chaos`): REAL device
+    kernels, real BLS signatures, a trickle of forged ones. BENCH_CONFIG4
+    measured the pre-isolation collapse — 1.5% forged cut firehose
+    throughput 121→13 atts/s and pushed item p50 0.7s→56s, because a
+    poisoned batch fell back to linear host bisection. With the
+    on-device fault localizer (runtime/isolation.py) a failed batch
+    costs O(log n) warm device passes plus host checks of only the
+    named-bad leaves, so adversarial traffic is a bounded tax.
+
+    Gates (exit 1 on miss): forged-phase throughput >= 0.5x clean,
+    forged-phase p50 <= 5x clean, ZERO steady-state recompiles, and no
+    failed batch exceeding the ceil(log2(bucket))+1 device-pass bound.
+    Verdicts are also checked against ground truth — forged tickets
+    False, honest True. Knobs: BENCH_ADV_ITEMS, BENCH_ADV_FORGED_PCT."""
+    import statistics
+
+    from grandine_tpu.crypto.hash_to_curve import hash_to_g2
+    from grandine_tpu.metrics import Metrics
+    from grandine_tpu.runtime import health as _health
+    from grandine_tpu.runtime import isolation as iso
+    from grandine_tpu.runtime import verify_scheduler as vs
+    from grandine_tpu.runtime.thread_pool import Priority
+    from grandine_tpu.tpu import bls as B
+    from grandine_tpu.transition.genesis import interop_secret_key
+
+    n_items = int(os.environ.get("BENCH_ADV_ITEMS", "96"))
+    forged_pct = float(os.environ.get("BENCH_ADV_FORGED_PCT", "1.5"))
+    batch = 8  # small lane: bucket 8 compiles fast on the CPU platform
+
+    sk = interop_secret_key(0)
+    pk = sk.public_key()
+    metrics = Metrics()
+    backend = B.TpuBlsBackend(metrics=metrics)
+
+    # warm every shape both phases can form (the aggregate+subgroup
+    # verify buckets, and the localization ladder for full and tail
+    # batches), then seal: the soak models a post-warmup node, so any
+    # recompile after this point is a gate failure. The ledger resets
+    # BEFORE warming — the warm shapes must stay on it, or their first
+    # live dispatch would count as a phantom recompile.
+    B.reset_shape_tracking()
+    sig_w = sk.sign(b"adv-warm")
+    h_w = hash_to_g2(b"adv-warm")
+    for b in (4, batch):
+        msgs = [b"adv-warm-%d" % i for i in range(b)]
+        backend.fast_aggregate_verify_batch(msgs, [sig_w] * b, [[pk]] * b)
+        backend.g2_subgroup_check_batch([h_w] * b)
+        for g in iso.ladder(b):
+            backend.rlc_partition_verify(msgs, [sig_w] * b, [[pk]] * b, g)
+    B.declare_warmup_complete()
+
+    def run_phase(tag: str, forged_idx: "set[int]"):
+        sched = vs.VerifyScheduler(
+            backend=backend,
+            lanes=(vs.LaneConfig("adv", Priority.LOW, batch, 0.005, 4096,
+                                 shed=False),),
+            use_device=True,
+            metrics=metrics,
+            # generous watchdog: the soak gates ISOLATION economics, and
+            # the CPU-emulated kernels here can blow the 5s production
+            # default without that meaning anything about localization
+            health=_health.BackendHealthSupervisor(
+                metrics=metrics, settle_timeout_s=60.0
+            ),
+        )
+        tickets = []
+        t0 = time.time()
+        try:
+            for i in range(n_items):
+                msg = b"adv-%s-%04d" % (tag.encode(), i)
+                signed = msg if i not in forged_idx else b"forged-" + msg
+                item = vs.VerifyItem(
+                    msg, sk.sign(signed).to_bytes(), public_keys=(pk,)
+                )
+                tickets.append((sched.submit("adv", [item]),
+                                i not in forged_idx))
+            sched.flush(600.0)
+        finally:
+            sched.stop()
+        wall = time.time() - t0
+        lat = [tk.settled_at - tk.enqueued_at for tk, _ in tickets]
+        wrong = sum(1 for tk, expect in tickets if tk.ok is not expect)
+        return {
+            "throughput": n_items / wall,
+            "p50_s": statistics.median(lat),
+            "wall_s": wall,
+            "verdict_mismatches": wrong,
+        }
+
+    clean = run_phase("clean", set())
+    n_forged = max(2, round(n_items * forged_pct / 100.0))
+    step = n_items // n_forged
+    forged = run_phase(
+        "adv", {i * step + step // 2 for i in range(n_forged)}
+    )
+
+    recompiles = B.post_warmup_recompiles()
+    invalid_batches = metrics.verify_lane_batches.labels(
+        "adv", "invalid"
+    ).value
+    passes = {
+        k: metrics.verify_isolation_passes.labels(k).value
+        for k in ("rlc_partition", "g2_subgroup", "host")
+    }
+    device_passes = passes["rlc_partition"] + passes["g2_subgroup"]
+    pass_bound = invalid_batches * iso.max_device_passes(batch)
+    throughput_ratio = forged["throughput"] / max(clean["throughput"], 1e-9)
+    p50_ratio = forged["p50_s"] / max(clean["p50_s"], 1e-9)
+
+    soak_ok = (
+        clean["verdict_mismatches"] == 0
+        and forged["verdict_mismatches"] == 0
+        and recompiles == 0
+        and invalid_batches > 0
+        and device_passes <= pass_bound
+        and throughput_ratio >= 0.5
+        and p50_ratio <= 5.0
+    )
+    print(
+        json.dumps({
+            "metric": "verify_adversarial_soak",
+            "unit": "x clean throughput under forgery",
+            "value": round(throughput_ratio, 3),
+            "items_per_phase": n_items,
+            "forged_pct": forged_pct,
+            "forged_items": n_forged,
+            "clean": {k: round(v, 4) if isinstance(v, float) else v
+                      for k, v in clean.items()},
+            "forged": {k: round(v, 4) if isinstance(v, float) else v
+                       for k, v in forged.items()},
+            "p50_ratio": round(p50_ratio, 3),
+            "invalid_batches": invalid_batches,
+            "isolation_passes": passes,
+            "device_pass_bound": pass_bound,
+            "verify_recompiles_total": recompiles,
+            "soak_ok": soak_ok,
+        })
+    )
+    print(
+        f"# adversarial soak: {n_forged} forged of {n_items} "
+        f"({forged_pct}%): throughput {throughput_ratio:.2f}x clean "
+        f"(gate >=0.5), p50 {p50_ratio:.2f}x (gate <=5), "
+        f"{int(device_passes)} device localization passes over "
+        f"{int(invalid_batches)} failed batches (bound "
+        f"{int(pass_bound)}), {recompiles} recompiles; "
+        + ("OK" if soak_ok else "FAILED"),
+        file=sys.stderr,
+    )
+    if not soak_ok:
+        raise SystemExit(1)
+
+
 def bench_coldstart_child(mode: str) -> None:
     """One simulated node restart (child process of bench_coldstart).
 
@@ -1461,6 +1614,8 @@ if __name__ == "__main__":
         bench_fuzz_schedules()
     elif "--chaos" in sys.argv or os.environ.get("BENCH_CHAOS") == "1":
         bench_chaos()
+        if os.environ.get("BENCH_SKIP_ADVERSARIAL") != "1":
+            bench_adversarial()
     elif "--replay" in sys.argv or os.environ.get("BENCH_REPLAY") == "1":
         bench_replay()
     elif os.environ.get("BENCH_SCHED_ONLY") == "1":

@@ -61,6 +61,20 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument(
         "--no-warm", action="store_true",
         help="skip the startup kernel-bucket precompile warmer")
+    parser.add_argument(
+        "--no-isolation", action="store_true",
+        help="disable on-device fault localization of failed verify "
+             "batches (falls back to recursive host bisection)")
+    parser.add_argument(
+        "--quarantine-exit-clean", type=int, default=None, metavar="K",
+        help="consecutive clean quarantine batches before a suspect "
+             "origin exits quarantine (default 3)")
+    parser.add_argument(
+        "--admission-max-share", type=float, default=None, metavar="F",
+        help="fair-share admission cap: one gossip origin may hold at "
+             "most this fraction of the verify plane's sliding window "
+             "(default 0.5; origins under the absolute floor are never "
+             "rejected)")
 
     sub = parser.add_subparsers(dest="command")
 
@@ -237,7 +251,12 @@ def _node_once(args, cfg) -> int:
         slasher=slasher, operation_pool=operation_pool,
         metrics=metrics, tracer=tracer,
         mesh=mesh,
+        use_isolation=not getattr(args, "no_isolation", False),
     )
+    if getattr(args, "quarantine_exit_clean", None):
+        node.reputation.exit_clean = max(1, args.quarantine_exit_clean)
+    if getattr(args, "admission_max_share", None):
+        node.admission.max_share = args.admission_max_share
     if args.use_device and not getattr(args, "no_warm", False):
         # precompile the kernel shape manifest in the background while
         # the node syncs — an uncompiled bucket mid-chain stalls
@@ -316,6 +335,8 @@ def _node_once(args, cfg) -> int:
             attestation_verifier=node.attestation_verifier,
             storage=storage,
             operation_pool=operation_pool,
+            verify_scheduler=node.verify_scheduler,
+            admission=node.admission,
         )
         print(f"p2p listening on 127.0.0.1:{transport.port}", flush=True)
         for addr in args.peer:

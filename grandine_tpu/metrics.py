@@ -583,6 +583,33 @@ class Metrics:
             "kernel",
             ("kernel",),
         )
+        # adversarial isolation plane (runtime/isolation.py): on-device
+        # fault localization passes, the quarantine lane, and per-origin
+        # admission control. Origin identities are NEVER labels — the
+        # `kernel` and `lane` labels here are closed sets; attribution
+        # lives in the flight recorder's bounded top-K origin table.
+        self.verify_isolation_passes = LabeledCounter(
+            "verify_isolation_passes_total",
+            "fault-localization passes run against a failed verify "
+            "batch, by kernel (rlc_partition/g2_subgroup device passes, "
+            "host for degraded host sweeps)",
+            ("kernel",),
+        )
+        self.verify_quarantine_lane_depth = Gauge(
+            "verify_quarantine_lane_depth",
+            "verify jobs queued in the quarantine lane (suspect-origin "
+            "traffic isolated from honest batches)",
+        )
+        self.verify_quarantine_batches = Counter(
+            "verify_quarantine_batches_total",
+            "verify batches flushed from the quarantine lane",
+        )
+        self.verify_admission_rejected = LabeledCounter(
+            "verify_admission_rejected_total",
+            "verify submissions rejected by per-origin fair-share "
+            "admission control, by lane",
+            ("lane",),
+        )
         self.verify_device_duty_cycle = Gauge(
             "verify_device_duty_cycle",
             "fraction of wall time with at least one verify batch on "

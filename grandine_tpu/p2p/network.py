@@ -250,6 +250,7 @@ class Network:
         operation_pool=None,
         metrics=None,
         verify_scheduler=None,
+        admission=None,
     ) -> None:
         self.transport = transport
         self.controller = controller
@@ -264,6 +265,12 @@ class Network:
         #: handlers verify eagerly inline (the historical synchronous
         #: path — tests and minimal deployments)
         self.verify_scheduler = verify_scheduler
+        #: per-origin fair-share admission control
+        #: (runtime/isolation.AdmissionController): when wired, gossip
+        #: verify submissions from an over-quota origin are shed at the
+        #: door — a gossipsub "ignore", never a "reject" — before they
+        #: can queue against honest traffic; None admits everything
+        self.admission = admission
         #: shared Metrics struct (labeled per-topic gossip counters +
         #: per-protocol req/resp counters); defaults to the controller's
         self.metrics = (
@@ -423,6 +430,16 @@ class Network:
             self._count_gossip(topic, "accept")
             on_accept()
 
+        if (
+            self.admission is not None
+            and not self.admission.admit(origin, len(items), lane=lane)
+        ):
+            # over fair share: shed at the door, before the job can
+            # queue against honest traffic (the controller counts
+            # verify_admission_rejected_total by lane)
+            self.stats["verify_admission_rejected"] += 1
+            deliver(False, dropped=True)
+            return
         sched = self.verify_scheduler
         if sched is not None:
             sched.submit(

@@ -80,6 +80,10 @@ DEFAULT_SLO_BUDGETS = {
     "slashing": 2.0,
     "exit": 2.0,
     "bls_change": 2.0,
+    # suspect-origin traffic: correctness matters, latency does not —
+    # small batches from quarantined origins may wait behind every
+    # honest lane
+    "quarantine": 5.0,
     "attestation": 4.0,
     "replay": 120.0,
 }
@@ -119,6 +123,7 @@ class BatchRecord:
         "queue_wait_s", "device_s", "host_s", "bisect_s", "verdict",
         "fault", "retries", "bisect_depth", "breaker_state", "recompile",
         "slo_miss", "slo_cause", "origin", "note", "devices",
+        "quarantined",
     )
 
     def __init__(self, kind: str, lane: str) -> None:
@@ -147,6 +152,9 @@ class BatchRecord:
         #: mesh width the batch dispatched over (a record FIELD, never a
         #: Prometheus label — per-device label cardinality is forbidden)
         self.devices = 1
+        #: True for quarantine-lane batches (suspect-origin traffic
+        #: isolated from honest batches — runtime/isolation.py)
+        self.quarantined = False
 
     def total_s(self) -> float:
         return self.queue_wait_s + self.device_s + self.host_s + self.bisect_s
@@ -177,6 +185,7 @@ class BatchRecord:
             "origin": self.origin,
             "note": self.note,
             "devices": self.devices,
+            "quarantined": self.quarantined,
         }
 
 
@@ -340,7 +349,8 @@ class FlightRecorder:
     def begin_batch(self, lane: str, kernel: str, items: int,
                     queue_wait_s: float = 0.0,
                     breaker_state: str = "",
-                    devices: int = 1) -> BatchFlight:
+                    devices: int = 1,
+                    quarantined: bool = False) -> BatchFlight:
         """Open one batch's flight context at dispatch time. Fill/waste
         are derived from the pow-2 bucket the device actually pads to."""
         rec = BatchRecord(BATCH, lane)
@@ -351,6 +361,7 @@ class FlightRecorder:
         rec.queue_wait_s = max(0.0, float(queue_wait_s))
         rec.breaker_state = breaker_state
         rec.devices = max(1, int(devices))
+        rec.quarantined = bool(quarantined)
         return BatchFlight(self, rec)
 
     def _slo_cause(self, rec: BatchRecord) -> str:

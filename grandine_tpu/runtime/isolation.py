@@ -1,0 +1,509 @@
+"""Adversarial isolation plane: fault localization, quarantine
+reputation, and per-origin admission control.
+
+BENCH_CONFIG4 showed the verify plane collapsing under 1.5% forged
+signatures (121 → 13 atts/s, item p50 0.7s → 56s): a poisoned batch fell
+back to recursive host bisection whose leaves are single host verifies,
+so a trickle of forgeries bought the attacker a host-bound plane. This
+module makes adversarial traffic a bounded tax with three cooperating
+pieces, all fed by attribution the flight recorder already keeps:
+
+  FaultLocalizer — on-device localization of a failed batch. ONE device
+      pass of the RLC-partition kernel (tpu/bls.py
+      rlc_partition_verify_kernel) yields per-sub-batch verdicts; a
+      fixed-fanout descent (groups = F, F², … capped at the bucket)
+      names the bad items in at most ⌈log_F(bucket)⌉ device passes plus
+      one per-item subgroup pass. Every pass dispatches the SAME padded
+      bucket with a coarser-to-finer group ladder, so the shape set is
+      finite and warmable (tools/shapes manifest `rlc_partition` rows) —
+      localization never recompiles at incident time. The host verifies
+      only device-named-bad leaves (host verdict wins per item, exactly
+      the old bisection-leaf semantics).
+  ReputationTable — decaying per-origin quarantine state. An origin
+      named bad by localization enters quarantine; the scheduler then
+      routes its sheddable traffic into the small-batch `quarantine`
+      lane so honest traffic never shares a batch (and therefore never
+      shares a localization descent) with a known-bad origin. K
+      consecutive clean quarantine batches — or time decay — exit it.
+  AdmissionController — sliding-window fair-share quotas at gossip
+      submit time (p2p/network.py), so one hot or hostile origin cannot
+      starve the rest of the verify plane no matter how fast it sends.
+
+Origin identities (peer ids, validator indices) are NEVER Prometheus
+label values — metrics carry only closed `kernel`/`lane` label sets;
+per-origin attribution lives in the bounded tables here and in the
+flight recorder.
+
+Deliberately import-light: no jax / tpu.bls at module load (host-only
+deployments, fault-injection tests); the device seam is the injected
+backend's `rlc_partition_verify_async` ASYNC_SEAM method,
+feature-detected via `FaultLocalizer.supports`.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+from grandine_tpu.consensus.verifier import SignatureInvalid
+from grandine_tpu.crypto import bls as A
+from grandine_tpu.runtime import health as _health
+
+#: descent fanout: each device pass splits every still-suspect group
+#: into F sub-groups. 8 keeps the warm-shape ladder tiny (≤3 rungs for
+#: the widest scheduler lane) while staying within the ⌈log2(bucket)⌉+1
+#: pass bound.
+FANOUT = 8
+
+#: quarantine exits after this many consecutive clean batches
+DEFAULT_EXIT_CLEAN = 3
+#: …or after this long without a new failure (decay), whichever first
+DEFAULT_DECAY_S = 60.0
+
+#: admission window + fair-share cap + absolute per-origin floor: an
+#: origin is rejected only when it already holds `max_share` of the
+#: whole window AND is over the floor — a lone origin on a quiet node
+#: is never throttled.
+DEFAULT_WINDOW_S = 1.0
+DEFAULT_MAX_SHARE = 0.5
+DEFAULT_MIN_QUOTA = 256
+
+
+def _bucket(n: int, lo: int = 4) -> int:
+    """The pow-2 device bucket a batch of n pads into — must mirror
+    tpu/bls._bucket (lo=4) WITHOUT importing jax here."""
+    b = lo
+    while b < n:
+        b <<= 1
+    return b
+
+
+def ladder(bucket: int, fanout: int = FANOUT) -> "list[int]":
+    """The group-count ladder one localization runs: fanout, fanout², …
+    capped at (and always ending with) the bucket — the final rung is
+    per-item. This is ALSO the warm-shape contract: tools/shapes emits a
+    `warm rlc_partition` row per (bucket, groups) pair of this ladder."""
+    out: "list[int]" = []
+    g = fanout if fanout < bucket else bucket
+    while True:
+        out.append(g)
+        if g >= bucket:
+            return out
+        g = g * fanout if g * fanout < bucket else bucket
+
+
+def max_device_passes(items: int, fanout: int = FANOUT) -> int:
+    """Upper bound on device passes one localization may take (the
+    subgroup pass plus the full group ladder) — asserted ≤
+    ⌈log2(bucket)⌉+1 by the adversarial soak gate."""
+    return 1 + len(ladder(_bucket(max(1, int(items))), fanout))
+
+
+class FaultLocalizer:
+    """On-device localization of a failed verify batch.
+
+    Stateless between calls (config + injected seams only), so one
+    instance serves every scheduler thread without locking. `localize`
+    runs on the scheduler's completion thread inside the same watchdog
+    budget the old host bisection shared."""
+
+    def __init__(
+        self,
+        health: "Optional[_health.BackendHealthSupervisor]" = None,
+        metrics=None,
+        host_check: "Optional[Callable]" = None,
+        fanout: int = FANOUT,
+    ) -> None:
+        assert fanout >= 2 and fanout & (fanout - 1) == 0
+        self.health = health
+        self.metrics = metrics
+        self.fanout = fanout
+        #: None → resolve verify_scheduler.host_check_item PER CALL, so
+        #: test/bench monkeypatches of that module global keep working
+        #: exactly as they do for the legacy bisection path
+        self.host_check = host_check
+
+    def _leaf_check(self, item) -> bool:
+        if self.host_check is not None:
+            return bool(self.host_check(item))
+        from grandine_tpu.runtime import verify_scheduler as _vs
+        return bool(_vs.host_check_item(item))
+
+    @staticmethod
+    def supports(backend) -> bool:
+        """True when `backend` offers the RLC-partition ASYNC_SEAM
+        method (feature detection — test fakes and older backends fall
+        back to host bisection in the scheduler)."""
+        return backend is not None and hasattr(
+            backend, "rlc_partition_verify_async"
+        )
+
+    # ------------------------------------------------------- device seam
+
+    def _device_dispatch(self, backend, messages, signatures,
+                         member_keys, groups: int):
+        """The one isolation→device crossing for partition verdicts
+        (tools/shapes seam check pins this to ASYNC_SEAM methods)."""
+        return backend.rlc_partition_verify_async(
+            messages, signatures, member_keys, groups
+        )
+
+    def _subgroup_dispatch(self, backend, points):
+        """Per-item ψ-ladder subgroup verdicts (the whole-batch dispatch
+        only learns a single ANDed bool; localization needs each)."""
+        return backend.g2_subgroup_check_batch_async(points)
+
+    # ------------------------------------------------------- bookkeeping
+
+    def _count_pass(self, kernel: str) -> None:
+        if self.metrics is not None:
+            self.metrics.verify_isolation_passes.labels(kernel).inc()
+
+    def _budget(self, deadline: "Optional[float]") -> "Optional[float]":
+        budget = (
+            self.health.settle_timeout_s if self.health is not None else None
+        )
+        if deadline is not None:
+            remaining = deadline - time.monotonic()
+            budget = remaining if budget is None else min(budget, remaining)
+        return budget
+
+    def _guard(self, settle, budget: "Optional[float]"):
+        """Watchdog-bounded settle with breaker fault accounting; the
+        (status, value) shape of health.guard_settle with or without a
+        supervisor."""
+        if self.health is not None:
+            outcome = self.health.guard_settle(settle, timeout_s=budget)
+            if outcome.status == _health.OK:
+                self.health.record_success()
+            elif outcome.status == _health.TIMEOUT:
+                self.health.record_fault("watchdog")
+            else:
+                self.health.record_fault("settle")
+            return outcome.status, outcome.value
+        try:
+            return _health.OK, settle()
+        except Exception:
+            return _health.FAULT, None
+
+    def _device_ok(self) -> bool:
+        return self.health is None or self.health.allow_device()
+
+    # -------------------------------------------------------- localization
+
+    def localize(self, backend, items, deadline: "Optional[float]" = None,
+                 fl=None) -> "list[bool]":
+        """Per-item verdicts for a batch the device called invalid.
+
+        Host pre-pass names items that cannot even reach the device
+        (undecodable signature, no key material) via the eager host
+        check; one device pass yields per-item subgroup verdicts; then
+        the fixed-fanout partition descent narrows suspects until the
+        per-item rung, whose named-bad leaves the host confirms. Any
+        device fault / watchdog expiry / breaker-open mid-descent sweeps
+        the remaining suspects on the host — the same degradation target
+        as the plane everywhere else."""
+        n = len(items)
+        verdicts: "list[Optional[bool]]" = [None] * n
+        points: list = [None] * n
+        keys: list = [None] * n
+        for i, it in enumerate(items):
+            try:
+                p = A.g2_from_bytes(it.signature, subgroup_check=False)
+                if p.is_infinity():
+                    raise A.BlsError("infinity signature")
+                keys[i] = it.resolve_keys()
+                points[i] = p
+            except (A.BlsError, SignatureInvalid):
+                # host-named leaf: the eager host path is the verdict of
+                # record for anything the device cannot represent
+                verdicts[i] = self._leaf_check(it)
+
+        live = [i for i in range(n) if verdicts[i] is None]
+        if not live:
+            return [bool(v) for v in verdicts]
+
+        if not self._device_ok():
+            return self._host_sweep(items, verdicts, live)
+
+        # device pass 0: per-item subgroup verdicts (the failed batch's
+        # own subgroup dispatch only reported the ANDed bool)
+        budget = self._budget(deadline)
+        if budget is not None and budget <= 0:
+            return self._host_sweep(items, verdicts, live)
+        try:
+            sub_settle = self._subgroup_dispatch(
+                backend, [points[i] for i in live]
+            )
+        except Exception:
+            if self.health is not None:
+                self.health.record_fault("dispatch")
+            return self._host_sweep(items, verdicts, live)
+        status, flags = self._guard(sub_settle, budget)
+        if status != _health.OK:
+            return self._host_sweep(items, verdicts, live)
+        self._count_pass("g2_subgroup")
+        if fl is not None:
+            fl.note_bisect(0.0, 1)
+        flags = np.asarray(flags, bool)
+        for pos, idx in enumerate(live):
+            if not flags[pos]:
+                # device-named-bad leaf — host verdict wins per item
+                verdicts[idx] = self._leaf_check(items[idx])
+        live = [i for i in live if verdicts[i] is None]
+        if not live:
+            return [bool(v) for v in verdicts]
+
+        # partition descent: same padded bucket every pass, group ladder
+        # fanout → … → per-item; only bad groups stay suspect
+        messages = [items[i].message for i in live]
+        signatures = [A.Signature(points[i]) for i in live]
+        member_keys = [keys[i] for i in live]
+        b = _bucket(len(live))
+        suspects = set(range(len(live)))
+        for depth, groups in enumerate(ladder(b, self.fanout), start=2):
+            if not suspects:
+                break
+            budget = self._budget(deadline)
+            if (budget is not None and budget <= 0) or not self._device_ok():
+                return self._host_sweep(
+                    items, verdicts, [live[p] for p in sorted(suspects)]
+                )
+            try:
+                settle = self._device_dispatch(
+                    backend, messages, signatures, member_keys, groups
+                )
+            except Exception:
+                if self.health is not None:
+                    self.health.record_fault("dispatch")
+                return self._host_sweep(
+                    items, verdicts, [live[p] for p in sorted(suspects)]
+                )
+            status, group_verdicts = self._guard(settle, budget)
+            if status != _health.OK:
+                return self._host_sweep(
+                    items, verdicts, [live[p] for p in sorted(suspects)]
+                )
+            self._count_pass("rlc_partition")
+            if fl is not None:
+                fl.note_bisect(0.0, depth)
+            group_verdicts = np.asarray(group_verdicts, bool)
+            span = b // groups
+            for p in sorted(suspects):
+                if group_verdicts[p // span]:
+                    verdicts[live[p]] = True
+                    suspects.discard(p)
+            if groups >= b:
+                # per-item rung: whatever is still suspect was named bad
+                # by the device — host-confirm exactly those leaves
+                for p in sorted(suspects):
+                    verdicts[live[p]] = self._leaf_check(items[live[p]])
+                suspects.clear()
+        for p in range(n):
+            if verdicts[p] is None:  # cleared mid-ladder
+                verdicts[p] = True
+        return [bool(v) for v in verdicts]
+
+    def _host_sweep(self, items, verdicts, remaining) -> "list[bool]":
+        """Degradation target: host-verify every still-undecided item
+        (breaker-open, device fault, or budget exhausted mid-descent)."""
+        self._count_pass("host")
+        for i in remaining:
+            verdicts[i] = self._leaf_check(items[i])
+        return [bool(v) if v is not None else False for v in verdicts]
+
+
+class ReputationTable:
+    """Bounded, decaying per-origin quarantine state.
+
+    Entry: any localization-attributed failure. Exit: `exit_clean`
+    CONSECUTIVE clean quarantine batches, or `decay_s` without a new
+    failure. Bounded at `capacity` origins — at capacity a new offender
+    evicts the entry with the stalest failure (closest to decaying out
+    anyway), so adversarial origin churn cannot grow the table."""
+
+    def __init__(self, capacity: int = 256,
+                 exit_clean: int = DEFAULT_EXIT_CLEAN,
+                 decay_s: float = DEFAULT_DECAY_S,
+                 clock=time.monotonic) -> None:
+        self.capacity = max(1, int(capacity))
+        self.exit_clean = max(1, int(exit_clean))
+        self.decay_s = float(decay_s)
+        self.clock = clock
+        self._lock = threading.Lock()
+        #: origin -> [failures, consecutive_clean, last_bad_t]
+        self._entries: "dict[str, list]" = {}
+
+    def note_failure(self, origin: "Optional[str]") -> None:
+        if not origin:
+            return
+        origin = str(origin)
+        now = self.clock()
+        with self._lock:
+            ent = self._entries.get(origin)
+            if ent is not None:
+                ent[0] += 1
+                ent[1] = 0
+                ent[2] = now
+                return
+            if len(self._entries) >= self.capacity:
+                victim = min(self._entries, key=lambda o: self._entries[o][2])
+                del self._entries[victim]
+            self._entries[origin] = [1, 0, now]
+
+    def note_clean_batch(self, origin: "Optional[str]") -> None:
+        """One quarantine-lane batch from `origin` settled fully valid."""
+        if not origin:
+            return
+        with self._lock:
+            ent = self._entries.get(str(origin))
+            if ent is None:
+                return
+            ent[1] += 1
+            if ent[1] >= self.exit_clean:
+                del self._entries[str(origin)]
+
+    def is_quarantined(self, origin: "Optional[str]") -> bool:
+        if not origin:
+            return False
+        now = self.clock()
+        with self._lock:
+            ent = self._entries.get(str(origin))
+            if ent is None:
+                return False
+            if now - ent[2] > self.decay_s:
+                del self._entries[str(origin)]
+                return False
+            return True
+
+    def snapshot(self) -> "list[dict]":
+        with self._lock:
+            rows = [
+                {"origin": o, "failures": e[0], "clean": e[1],
+                 "age_s": round(self.clock() - e[2], 3)}
+                for o, e in self._entries.items()
+            ]
+        rows.sort(key=lambda r: (-r["failures"], r["origin"]))
+        return rows
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+class AdmissionController:
+    """Sliding-window per-origin fair-share quotas at submit time.
+
+    An origin is rejected only when its items in the current window
+    already exceed max(min_quota, max_share × window total) — so honest
+    origins under their fair share are never rejected regardless of how
+    hard one hostile origin pushes, and a lone origin on an idle node is
+    never throttled (the absolute floor). Unattributed submissions
+    (origin None — local work, tests) are always admitted. The per-origin
+    window map is bounded: at `capacity` tracked origins a NEW origin is
+    admitted but untracked (it is necessarily under the floor), so sybil
+    churn cannot grow the table or evict the heavy hitters."""
+
+    def __init__(self, window_s: float = DEFAULT_WINDOW_S,
+                 max_share: float = DEFAULT_MAX_SHARE,
+                 min_quota: int = DEFAULT_MIN_QUOTA,
+                 capacity: int = 1024,
+                 metrics=None, clock=time.monotonic) -> None:
+        self.window_s = float(window_s)
+        self.max_share = float(max_share)
+        self.min_quota = max(1, int(min_quota))
+        self.capacity = max(1, int(capacity))
+        self.metrics = metrics
+        self.clock = clock
+        self._lock = threading.Lock()
+        #: origin -> list[(t, items)] (window entries, oldest first)
+        self._windows: "dict[str, list]" = {}
+        #: origin -> current window sum (kept in lockstep with _windows)
+        self._totals: "dict[str, int]" = {}
+        self._global_total = 0
+
+    def _prune(self, now: float) -> None:
+        horizon = now - self.window_s
+        dead = []
+        for origin, entries in self._windows.items():
+            drop = 0
+            for t, count in entries:
+                if t >= horizon:
+                    break
+                drop += 1
+                self._totals[origin] -= count
+                self._global_total -= count
+            if drop:
+                del entries[:drop]
+            if not entries:
+                dead.append(origin)
+        for origin in dead:
+            del self._windows[origin]
+            del self._totals[origin]
+
+    def admit(self, origin: "Optional[str]", items: int = 1,
+              lane: str = "") -> bool:
+        """True → caller may submit; False → shed at the door (callers
+        count a gossip "ignore" and `verify_admission_rejected_total`)."""
+        if not origin:
+            return True
+        origin = str(origin)
+        items = max(1, int(items))
+        now = self.clock()
+        with self._lock:
+            self._prune(now)
+            quota = max(
+                self.min_quota, int(self.max_share * self._global_total)
+            )
+            used = self._totals.get(origin, 0)
+            if used + items > quota:
+                rejected = True
+            else:
+                rejected = False
+                self._global_total += items
+                if origin in self._windows:
+                    self._windows[origin].append((now, items))
+                    self._totals[origin] += items
+                elif len(self._windows) < self.capacity:
+                    self._windows[origin] = [(now, items)]
+                    self._totals[origin] = items
+                # at capacity: admitted-but-untracked (under the floor
+                # by construction; sybil churn cannot evict heavy
+                # hitters). _global_total still drains via a shadow
+                # window under the reserved key below.
+                else:
+                    shadow = self._windows.setdefault("", [])
+                    shadow.append((now, items))
+                    self._totals[""] = self._totals.get("", 0) + items
+        if rejected and self.metrics is not None:
+            self.metrics.verify_admission_rejected.labels(lane).inc()
+        return not rejected
+
+    def window_share(self, origin: "Optional[str]") -> float:
+        """origin's admitted fraction of the current window (debug)."""
+        if not origin:
+            return 0.0
+        now = self.clock()
+        with self._lock:
+            self._prune(now)
+            if not self._global_total:
+                return 0.0
+            return self._totals.get(str(origin), 0) / self._global_total
+
+
+__all__ = [
+    "FANOUT",
+    "FaultLocalizer",
+    "ReputationTable",
+    "AdmissionController",
+    "ladder",
+    "max_device_passes",
+    "DEFAULT_EXIT_CLEAN",
+    "DEFAULT_DECAY_S",
+    "DEFAULT_WINDOW_S",
+    "DEFAULT_MAX_SHARE",
+    "DEFAULT_MIN_QUOTA",
+]

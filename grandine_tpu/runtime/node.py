@@ -37,11 +37,16 @@ class InProcessNode:
         metrics=None,
         tracer=None,
         mesh=None,
+        use_isolation: bool = True,
     ) -> None:
         from grandine_tpu.consensus.verifier import MultiVerifier
 
         from grandine_tpu.runtime.flight import FlightRecorder
         from grandine_tpu.runtime.health import BackendHealthSupervisor
+        from grandine_tpu.runtime.isolation import (
+            AdmissionController,
+            ReputationTable,
+        )
         from grandine_tpu.tpu.mesh import mesh_or_none
 
         self.cfg = cfg
@@ -62,6 +67,11 @@ class InProcessNode:
         self.health = BackendHealthSupervisor(
             metrics=metrics, flight=self.flight
         )
+        #: ONE reputation table + admission controller for the whole
+        #: node (runtime/isolation.py): the scheduler quarantines by it,
+        #: the gossip plane (p2p/network.py `admission=`) sheds by it
+        self.reputation = ReputationTable()
+        self.admission = AdmissionController(metrics=metrics)
         self.verify_scheduler = None
         if use_verify_scheduler:
             from grandine_tpu.runtime.verify_scheduler import VerifyScheduler
@@ -73,6 +83,8 @@ class InProcessNode:
                 health=self.health,
                 flight=self.flight,
                 mesh=self.mesh,
+                reputation=self.reputation,
+                use_isolation=use_isolation,
             )
             if verifier_factory is None:
                 # block proposer-signature batches ride the HIGH lane

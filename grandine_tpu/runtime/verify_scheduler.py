@@ -52,6 +52,7 @@ from grandine_tpu.consensus.verifier import (
 from grandine_tpu.crypto import bls as A
 from grandine_tpu.runtime import flight as _flight
 from grandine_tpu.runtime import health as _health
+from grandine_tpu.runtime import isolation as _isolation
 from grandine_tpu.runtime.thread_pool import Priority
 from grandine_tpu.tracing import NULL_TRACER
 
@@ -84,6 +85,9 @@ DEFAULT_LANES = (
     LaneConfig("slashing", Priority.LOW, 16, 0.100, 512, shed=True),
     LaneConfig("exit", Priority.LOW, 16, 0.100, 512, shed=True),
     LaneConfig("bls_change", Priority.LOW, 32, 0.100, 1024, shed=True),
+    # quarantined-origin traffic: small batches so one forgery poisons
+    # little, sheddable so a hostile origin only backpressures itself
+    LaneConfig("quarantine", Priority.LOW, 8, 0.050, 512, shed=True),
 )
 
 
@@ -242,6 +246,8 @@ class VerifyScheduler:
         settle_timeout_s: float = 5.0,
         flight: "Optional[_flight.FlightRecorder]" = None,
         mesh=None,
+        reputation: "Optional[_isolation.ReputationTable]" = None,
+        use_isolation: bool = True,
     ) -> None:
         from grandine_tpu.tpu.mesh import mesh_or_none
 
@@ -277,6 +283,21 @@ class VerifyScheduler:
         #: lazily-built TpuBlsBackend per lane, so device stage spans
         #: attribute to the dispatching lane (kernels stay shared via
         #: the global jit cache)
+        #: decaying per-origin quarantine state (runtime/isolation.py);
+        #: node.py shares one table between scheduler and gossip plane
+        self.reputation = (
+            reputation if reputation is not None
+            else _isolation.ReputationTable()
+        )
+        #: on-device fault localization of failed batches; None reverts
+        #: _isolate to the legacy host bisection (--no-isolation knob)
+        self._localizer = (
+            # host_check unset → the localizer resolves this module's
+            # host_check_item per call, so monkeypatched truth tables
+            # reach the leaves the same way they reach _bisect
+            _isolation.FaultLocalizer(health=self.health, metrics=metrics)
+            if use_isolation else None
+        )
         self._shared_backend = backend
         self._backends: dict = {}
         self._backend_lock = threading.Lock()  # lazy per-lane build
@@ -322,8 +343,20 @@ class VerifyScheduler:
         resolve True). Returns immediately; LOW lanes shed oldest-first
         at capacity, HIGH lanes block the caller until there is room.
         `origin` attributes a rejected job to its gossip peer/validator
-        in the flight recorder's failing-origin table."""
+        in the flight recorder's failing-origin table.
+
+        A quarantined origin's SHEDDABLE traffic is rerouted into the
+        small-batch quarantine lane so it never shares a batch (nor a
+        localization descent) with honest traffic; HIGH lanes are never
+        rerouted — block import correctness beats isolation."""
         lane = self.lanes[lane_name]
+        if (
+            origin is not None and lane.shed
+            and lane_name != "quarantine" and "quarantine" in self.lanes
+            and self.reputation.is_quarantined(origin)
+        ):
+            lane_name = "quarantine"
+            lane = self.lanes[lane_name]
         ticket = VerifyTicket(lane_name, origin=origin)
         if callback is not None:
             ticket.add_callback(callback)
@@ -497,9 +530,10 @@ class VerifyScheduler:
 
     def _set_depth(self, lane_name: str) -> None:
         if self.metrics is not None:
-            self.metrics.verify_lane_depth.labels(lane_name).set(
-                len(self._queues[lane_name])
-            )
+            depth = len(self._queues[lane_name])
+            self.metrics.verify_lane_depth.labels(lane_name).set(depth)
+            if lane_name == "quarantine":
+                self.metrics.verify_quarantine_lane_depth.set(depth)
 
     def _count_batch(self, lane: LaneConfig, result: str) -> None:
         if self.metrics is not None:
@@ -588,7 +622,10 @@ class VerifyScheduler:
             queue_wait_s=now - jobs[0].ticket.enqueued_at,
             breaker_state=self.health.state if self.use_device else "",
             devices=self.mesh.device_count if self.mesh is not None else 1,
+            quarantined=(lane.name == "quarantine"),
         )
+        if lane.name == "quarantine" and self.metrics is not None:
+            self.metrics.verify_quarantine_batches.inc()
         settle = None
         device_allowed = False
         with self.tracer.span(
@@ -828,8 +865,27 @@ class VerifyScheduler:
         fl.finish(all(verdicts))
 
     def _isolate(self, lane: LaneConfig, items,
-                 deadline: "Optional[float]" = None, fl=None,
-                 depth: int = 1) -> "list[bool]":
+                 deadline: "Optional[float]" = None,
+                 fl=None) -> "list[bool]":
+        """Per-item verdicts for a failed batch. Preferred path: the
+        on-device fault localizer (runtime/isolation.py) — O(log n)
+        RLC-partition passes, host work bounded by named-bad leaves.
+        Fallback (no localizer, no partition seam, breaker open): the
+        legacy recursive host bisection."""
+        if (
+            self._localizer is not None and self.use_device
+            and self.health.allow_device()
+        ):
+            backend = self._backend_for(lane)
+            if _isolation.FaultLocalizer.supports(backend):
+                return self._localizer.localize(
+                    backend, items, deadline=deadline, fl=fl
+                )
+        return self._bisect(lane, items, deadline, fl, 1)
+
+    def _bisect(self, lane: LaneConfig, items,
+                deadline: "Optional[float]" = None, fl=None,
+                depth: int = 1) -> "list[bool]":
         """Recursive bisection of a failed batch — batch-check halves,
         descend only into failing halves, SingleVerifier at the leaf —
         so k bad items cost O(k·log n) checks, not n."""
@@ -848,7 +904,7 @@ class VerifyScheduler:
                 ok = False  # descend; leaves verify on the host
             out.extend(
                 [True] * len(half)
-                if ok else self._isolate(lane, half, deadline, fl, depth + 1)
+                if ok else self._bisect(lane, half, deadline, fl, depth + 1)
             )
         return out
 
@@ -894,9 +950,17 @@ class VerifyScheduler:
             with self._stats_lock:
                 self.stats[lane.name]["accepted" if ok else "rejected"] += 1
             if not ok and job.ticket.origin is not None:
-                # bisection named this job's items bad: attribute the
-                # failure to its gossip origin (bounded top-K table)
+                # localization named this job's items bad: attribute the
+                # failure to its gossip origin (bounded top-K table) and
+                # quarantine it
                 self.flight.note_origin_failure(job.ticket.origin)
+                self.reputation.note_failure(job.ticket.origin)
+            elif (
+                ok and lane.name == "quarantine"
+                and job.ticket.origin is not None
+            ):
+                # a clean quarantine batch steps the origin toward exit
+                self.reputation.note_clean_batch(job.ticket.origin)
             job.ticket._resolve(ok)
         with self._cond:
             self._pending -= len(jobs)

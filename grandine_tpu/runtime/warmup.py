@@ -45,7 +45,7 @@ SUBGROUP_BUCKETS = (4, 8, 16, 32, 64, 128)
 #: a cold 2m51s sharded compile, which warmup must eat at startup so a
 #: restart never pays it mid-chain.
 WARM_KINDS = ("aggregate", "aggregate_idx", "multi_verify", "sign",
-              "subgroup", "sharded_multi_verify",
+              "subgroup", "rlc_partition", "sharded_multi_verify",
               "sharded_multi_verify_msm")
 
 
@@ -102,6 +102,7 @@ def manifest() -> "list[tuple[str, int]]":
     out += [("multi_verify", b) for b in MULTI_VERIFY_BUCKETS]
     out += [("sign", b) for b in SIGN_BUCKETS]
     out += [("subgroup", b) for b in SUBGROUP_BUCKETS]
+    out += [("rlc_partition", b) for b in FIREHOSE_BUCKETS]
     # sharded rows are no-ops without a mesh (skipped with a note)
     out += [("sharded_multi_verify", b) for b in MULTI_VERIFY_BUCKETS]
     out += [("sharded_multi_verify_msm", b) for b in MULTI_VERIFY_BUCKETS]
@@ -207,6 +208,20 @@ def warm_all(
                                    [sk] * b)
             elif kind == "subgroup":
                 backend.g2_subgroup_check_batch([h] * b)
+            elif kind == "rlc_partition":
+                # fault localization dispatches each bucket at every
+                # rung of its fixed group ladder (runtime/isolation.py);
+                # warm all (bucket, groups) variants so an adversarial
+                # incident never compiles mid-descent
+                from grandine_tpu.runtime.isolation import ladder
+
+                for g in ladder(b):
+                    backend.rlc_partition_verify(
+                        [b"warm-%d" % i for i in range(b)],
+                        [sig] * b,
+                        [[pk]] * b,
+                        g,
+                    )
             elif kind == "sharded_multi_verify":
                 if mesh_backend is None:
                     if progress:

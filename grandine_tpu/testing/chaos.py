@@ -168,6 +168,14 @@ class ChaosBackend:
             (points,),
         )
 
+    def rlc_partition_verify_async(self, messages, signatures, member_keys,
+                                   groups):
+        return self._wrap(
+            "rlc_partition_verify_async",
+            lambda arr: ~np.asarray(arr),
+            (messages, signatures, member_keys, groups),
+        )
+
 
 class KnownAnswerBackend:
     """Truth-table async seam: the batch verdict is the AND of
@@ -178,6 +186,9 @@ class KnownAnswerBackend:
     def __init__(self, truth: "Optional[dict]" = None) -> None:
         self.truth = dict(truth or {})
         self.batches: "list[int]" = []
+        #: (items, groups) per rlc_partition dispatch — lets tests
+        #: assert the localization pass count and ladder shape
+        self.partitions: "list[tuple]" = []
 
     def g2_subgroup_check_batch_async(self, points):
         n = len(points)
@@ -187,6 +198,29 @@ class KnownAnswerBackend:
         self.batches.append(len(messages))
         msgs = [bytes(m) for m in messages]
         return lambda: all(self.truth.get(m, False) for m in msgs)
+
+    def rlc_partition_verify_async(self, messages, signatures, member_keys,
+                                   groups):
+        """Per-group AND over the truth table with the device backend's
+        padding geometry (pow-2 bucket lo=4, pad groups are clean)."""
+        n = len(messages)
+        self.partitions.append((n, int(groups)))
+        b = 4
+        while b < n:
+            b <<= 1
+        g = 4
+        while g < groups:
+            g <<= 1
+        if g > b:
+            g = b
+        span = b // g
+        flags = [self.truth.get(bytes(m), False) for m in messages]
+        flags += [True] * (b - n)
+        out = np.array(
+            [all(flags[j * span:(j + 1) * span]) for j in range(g)],
+            dtype=bool,
+        )
+        return lambda: out
 
 
 __all__ = [

@@ -172,6 +172,22 @@ def _rlc_finish(f, sig_acc_jac):
     return TP.final_exp_is_one(f_total)
 
 
+def _rlc_finish_grouped(f_groups, sig_acc_jac, g: int):
+    """Width-g generalization of _rlc_finish: f_groups is a (g,)-batched
+    Fp12 (per-group Miller products), sig_acc_jac a (g,)-batched Jacobian
+    G2 (per-group Σ rᵢ·sigᵢ). Each group gets its own e(−g1, ·) factor and
+    the shared final exponentiation runs ONCE at width g — the per-group
+    verdicts cost one device pass, not g."""
+    sig_inf = F.fp2_is_zero(sig_acc_jac[2])
+    sig_h = TP.jacobian_to_homogeneous(sig_acc_jac)
+    neg_x = L.const_fp([int(d) for d in _NEG_G1_DEV[0]], (g,))
+    neg_y = L.const_fp([int(d) for d in _NEG_G1_DEV[1]], (g,))
+    neg_z = L.const_fp(L.ONE_MONT_DIGITS, (g,))
+    f_sig = TP.miller_loop((neg_x, neg_y, neg_z), sig_h, sig_inf)
+    f_total = F.fp12_mul(f_groups, f_sig)
+    return TP.final_exp_is_one(f_total)
+
+
 def _rlc_pairing_check(rpk_jac, pair_inf, msg_x, msg_y, sig_acc_jac):
     """Shared tail of the verify kernels: given rᵢ·pkᵢ (Jacobian G1), the
     per-pair infinity mask, affine message points H(mᵢ) on the twist, and
@@ -213,6 +229,45 @@ def multi_verify_kernel(
     sig_acc = C.sum_points(rsig, C.FP2_OPS)
     pair_inf = pk_inf | msg_inf
     return _rlc_pairing_check(rpk, pair_inf, msg[0], msg[1], sig_acc)
+
+
+def rlc_partition_verify_kernel(
+    pk_x, pk_y, pk_inf, sig_x, sig_y, sig_inf, msg_x, msg_y, msg_inf,
+    r_bits, group_tag
+):
+    """Fault-localization variant of multi_verify_kernel: same RLC math,
+    but instead of one whole-batch verdict it returns PER-SUB-BATCH
+    verdicts — the batch's N slots split into G = group_tag.shape[0]
+    contiguous groups of N/G, each group evaluating its own
+
+        ∏ᵢ∈g e(rᵢ·pkᵢ, H(mᵢ)) · e(−g1, Σᵢ∈g rᵢ·sigᵢ) == 1
+
+    in ONE device pass (the ladders and Miller loops run once at full
+    width; only the product tree stops at group boundaries and the final
+    exponentiation runs at width G). Returns a (G,) bool array. group_tag
+    is a (G,)-shaped carrier whose only job is making G part of the jit
+    shape signature (and the dispatch shape ledger). All-padding groups
+    (all-infinity slots) report True — neutral, like padding in the
+    whole-batch kernel. N and G must be powers of two with G | N."""
+    pk = _g1_in(pk_x, pk_y)
+    sig = _g2_in(sig_x, sig_y)
+    msg = _g2_in(msg_x, msg_y)
+    pk_inf = jnp.asarray(pk_inf)
+    sig_inf = jnp.asarray(sig_inf)
+    msg_inf = jnp.asarray(msg_inf)
+    n = pk_inf.shape[0]
+    g = group_tag.shape[0]
+    lo, hi = _rlc_ladders(r_bits)
+    rpk = C.scalar_mul_glv(pk[0], pk[1], pk_inf, lo, hi, _g1_endo(n), C.FP_OPS)
+    rsig = C.scalar_mul_glv(
+        sig[0], sig[1], sig_inf, lo, hi, _g2_endo(n), C.FP2_OPS
+    )
+    sig_acc = C.sum_points_contiguous(rsig, n // g, C.FP2_OPS)
+    pair_inf = pk_inf | msg_inf
+    msg_q = (msg[0], msg[1], F.fp2_one((n,)))
+    f_items = TP.miller_loop(rpk, msg_q, pair_inf)
+    f_groups = TP.fp12_product_tree_grouped(f_items, n // g)
+    return _rlc_finish_grouped(f_groups, sig_acc, g)
 
 
 def grouped_multi_verify_kernel(
@@ -1205,6 +1260,7 @@ class TpuBlsBackend:
         "g2_subgroup_check_batch_async",
         "fast_aggregate_verify_batch_indexed_async",
         "multi_verify_async",
+        "rlc_partition_verify_async",
     )
 
     def __init__(self, metrics=None, tracer=None,
@@ -1978,6 +2034,103 @@ class TpuBlsBackend:
 
         return settle
 
+    # -- fault localization ------------------------------------------------
+
+    def rlc_partition_verify(
+        self,
+        messages: Sequence[bytes],
+        signatures: Sequence["A.Signature"],
+        member_keys: Sequence[Sequence["A.PublicKey"]],
+        groups: int,
+        dst: bytes = constants.DST_SIGNATURE,
+        rng=secrets,
+    ) -> "np.ndarray":
+        return self.rlc_partition_verify_async(
+            messages, signatures, member_keys, groups, dst, rng
+        )()
+
+    def rlc_partition_verify_async(
+        self,
+        messages: Sequence[bytes],
+        signatures: Sequence["A.Signature"],
+        member_keys: Sequence[Sequence["A.PublicKey"]],
+        groups: int,
+        dst: bytes = constants.DST_SIGNATURE,
+        rng=secrets,
+    ):
+        """Per-sub-batch verdicts for fault localization: the batch's
+        bucket splits into `groups` contiguous groups and ONE device pass
+        (rlc_partition_verify_kernel) reports a bool per group — the seam
+        runtime/isolation.py descends through after a failed batch, so
+        the host never single-verifies more than the named-bad leaves.
+        Items keep the firehose shape (one signature over an aggregate of
+        member keys); committees collapse to one key by host aggregation
+        (only paid on already-failed batches). Items with no keys or an
+        identity key are named bad on the host and their slots stay
+        padding, so they cannot poison their group's device verdict.
+        Returns a zero-arg settle producing a (groups,) bool array
+        (padding-only groups True)."""
+        n = len(messages)
+        g = _bucket(groups, lo=4)
+        if not (n and n == len(signatures) == len(member_keys)):
+            return lambda: np.zeros((0,), bool)
+        b = _bucket(n)
+        if g > b:
+            g = b
+        with self._stage("host_prep", op="pack_partition", items=n):
+            bad_host = np.zeros((b,), bool)
+            agg_pts = []
+            slots = []
+            for i, ks in enumerate(member_keys):
+                if not ks or any(pk.point.is_infinity() for pk in ks):
+                    bad_host[i] = True
+                    continue
+                key = ks[0] if len(ks) == 1 else A.PublicKey.aggregate(ks)
+                agg_pts.append(key.point)
+                slots.append(i)
+            pk_x = np.zeros((b, L.NLIMBS), np.int32)
+            pk_y = np.zeros((b, L.NLIMBS), np.int32)
+            pk_inf = np.ones((b,), bool)
+            sig_x = np.zeros((b, 2, L.NLIMBS), np.int32)
+            sig_y = np.zeros((b, 2, L.NLIMBS), np.int32)
+            sig_inf = np.ones((b,), bool)
+            msg_x = np.zeros((b, 2, L.NLIMBS), np.int32)
+            msg_y = np.zeros((b, 2, L.NLIMBS), np.int32)
+            msg_inf = np.ones((b,), bool)
+            if agg_pts:
+                g1x, g1y, g1inf = C.g1_points_to_dev(agg_pts)
+                g2x, g2y, g2inf = C.g2_points_to_dev(
+                    [signatures[i].point for i in slots]
+                )
+                pk_x[slots], pk_y[slots], pk_inf[slots] = g1x, g1y, g1inf
+                sig_x[slots], sig_y[slots], sig_inf[slots] = g2x, g2y, g2inf
+                for i in slots:
+                    x, y, inf = self._hash_to_g2_dev(messages[i], dst)
+                    msg_x[i], msg_y[i], msg_inf[i] = x, y, inf
+            pairs = [self._rlc_pair(rng) for _ in range(n)]
+            r_bits = rlc_bits_host(pairs, b)
+            group_tag = np.zeros((g,), np.int32)
+        fn = self._jitted("rlc_partition", rlc_partition_verify_kernel)
+        args = self._upload((
+            pk_x, pk_y, pk_inf, sig_x, sig_y, sig_inf,
+            msg_x, msg_y, msg_inf, r_bits, group_tag,
+        ), kernel="rlc_partition")
+        dev_out = self._run_kernel(
+            "rlc_partition", fn, args, sigs=n, block=False
+        )
+        span = b // g
+
+        def settle() -> "np.ndarray":
+            if self._observed():
+                with self._stage("execute", kernel="rlc_partition"):
+                    self._block(dev_out)
+            verdicts = np.array(np.asarray(dev_out), bool)
+            for i in np.nonzero(bad_host)[0]:
+                verdicts[i // span] = False
+            return verdicts
+
+        return settle
+
     # -- signing -----------------------------------------------------------
 
     def batch_sign(
@@ -2037,6 +2190,7 @@ __all__ = [
     "sign_bits_host",
     "pick_msm_window",
     "multi_verify_kernel",
+    "rlc_partition_verify_kernel",
     "multi_verify_msm_kernel",
     "multi_verify_msm_idx_kernel",
     "grouped_multi_verify_kernel",

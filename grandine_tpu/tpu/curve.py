@@ -367,6 +367,22 @@ def sum_points_grouped(p, k: int, ops: FieldOps):
     return tuple(ops.index(e, slice(0, m)) for e in y)
 
 
+def sum_points_contiguous(p, s: int, ops: FieldOps):
+    """Reduce a flat batch of N Jacobian points into N/s sums over
+    CONTIGUOUS groups [0,s), [s,2s), ... (pad with infinity — neutral).
+    s must be a power of two. Same masked-roll reduction as sum_points,
+    but the level strides stop at group width: after strides s/2 ... 1,
+    position g*s holds the sum of group g, read out with one strided
+    slice. This is the fault-localization kernel's reducer: one device
+    pass yields per-sub-batch signature aggregates for every group."""
+    assert s & (s - 1) == 0, "sum_points_contiguous requires power-of-two s"
+    total = ops.batch_len(p[0])
+    if s <= 1:
+        return p
+    y = _tree_reduce_points(p, s.bit_length() - 1, s // 2, ops)
+    return tuple(ops.index(e, slice(0, total, s)) for e in y)
+
+
 def scalars_to_bits_msb(scalars, nbits: int) -> np.ndarray:
     """Host helper: int scalars → (len, nbits) int32 MSB-first bit array.
     Vectorized: ints → little-endian bytes → one unpackbits (the Python

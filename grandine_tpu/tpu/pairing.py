@@ -348,6 +348,31 @@ def fp12_product_tree(f):
     return tuple(F.take6(c, 0) for c in f)
 
 
+def fp12_product_tree_grouped(f, group_size: int):
+    """Reduce a batch of Fp12 elements to N/group_size products over
+    CONTIGUOUS groups [0,S), [S,2S), ... (pad with one — neutral).
+    group_size must be a power of two. Same one-compiled-body roll
+    reduction as fp12_product_tree, but the strides stop at the group
+    width so position g*S holds group g's product; the group products
+    come out as a width-N/S batch via one strided slice. Feeds the
+    fault-localization kernel's per-sub-batch pairing verdicts."""
+    assert group_size & (group_size - 1) == 0, (
+        "fp12_product_tree_grouped requires a power-of-two group size"
+    )
+    if group_size <= 1:
+        return f
+    levels = group_size.bit_length() - 1
+
+    def body(_, carry):
+        y, s = carry
+        rolled = jax.tree.map(lambda x: jnp.roll(x, -s, axis=1), y)
+        y = F.fp12_mul_many(y, rolled)
+        return (y, s // 2)
+
+    f, _ = lax.fori_loop(0, levels, body, (f, jnp.int32(group_size // 2)))
+    return jax.tree.map(lambda x: x[:, ::group_size], f)
+
+
 def jacobian_to_homogeneous(P):
     """(X, Y, Z) Jacobian → (XZ, Y, Z³) homogeneous (no inversion), Fp2."""
     Xj, Yj, Zj = P

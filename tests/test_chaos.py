@@ -147,12 +147,15 @@ def test_chaos_soak_preserves_rejections_without_verdict_faults(monkeypatch):
 
     tickets = []
     try:
-        for _ in range(80):
+        for i in range(80):
             msgs = [rng.choice(messages) for _ in range(rng.randrange(1, 3))]
             tickets.append((
                 sched.submit("sync_message", [_item(m) for m in msgs]),
                 all(truth[m] for m in msgs),
             ))
+            if i % 4 == 3:  # cut batches: on-device localization needs
+                sched.flush(30.0)  # so few seam calls that one big
+                # coalesced batch would leave the plan nothing to hit
         sched.flush(60.0)
     finally:
         sched.stop()

@@ -1,0 +1,514 @@
+"""Adversarial isolation plane tests (runtime/isolation.py).
+
+Three surfaces, each against fast fakes so nothing here compiles:
+
+- FaultLocalizer: differential verdict equivalence against the eager
+  host path for forged-position patterns, the O(log n) device-pass
+  bound, host work bounded by named-bad leaves, and every degradation
+  edge (undecodable signature, subgroup-named-bad, device fault,
+  breaker open, budget exhausted).
+- ReputationTable: quarantine entry / consecutive-clean exit / time
+  decay / bounded capacity, on a fake clock.
+- AdmissionController: fair-share starvation resistance — a hostile
+  origin at 10x the honest rate is clamped to its share while honest
+  origins keep >=80% (in fact all) of theirs.
+
+Scheduler integration (quarantine reroute, localizer delegation, the
+quarantined flight flag) runs over the same truth-table stub the chaos
+suite uses, with the host path monkeypatched onto the truth table —
+fault-free expectations are exact.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import pytest
+
+from grandine_tpu.crypto import bls as A
+from grandine_tpu.metrics import Metrics
+from grandine_tpu.runtime import health as _health
+from grandine_tpu.runtime import isolation as iso
+from grandine_tpu.runtime import verify_scheduler as vs
+from grandine_tpu.runtime.flight import BATCH, FlightRecorder
+from grandine_tpu.testing.chaos import KnownAnswerBackend
+from grandine_tpu.transition.genesis import interop_secret_key
+
+# one REAL signature reused everywhere: the localizer's host pre-pass
+# decompresses each item's signature bytes (and rejects infinity);
+# verdicts come from truth tables, not the crypto
+_SK = interop_secret_key(0)
+_SIG_BYTES = _SK.sign(b"isolation-test").to_bytes()
+_PK = _SK.public_key()
+
+
+def _item(message: bytes) -> vs.VerifyItem:
+    return vs.VerifyItem(message, _SIG_BYTES, public_keys=(_PK,))
+
+
+def _truth_and_items(n: int, forged: "set[int]"):
+    messages = [b"iso-%04d" % i + b"\x00" * 23 for i in range(n)]
+    truth = {m: i not in forged for i, m in enumerate(messages)}
+    return truth, [_item(m) for m in messages]
+
+
+def _localizer_for(truth, counter: "list[int]" = None, **kw):
+    def host_check(item):
+        if counter is not None:
+            counter[0] += 1
+        return truth.get(bytes(item.message), False)
+
+    return iso.FaultLocalizer(host_check=host_check, **kw)
+
+
+# ---------------------------------------------------------- ladder math
+
+
+def test_ladder_ends_per_item_and_is_monotone():
+    for bucket in (4, 8, 16, 32, 64, 128, 1024):
+        rungs = iso.ladder(bucket)
+        assert rungs[-1] == bucket  # final rung is per-item
+        assert rungs == sorted(set(rungs))
+        assert all(bucket % g == 0 for g in rungs)  # groups divide bucket
+
+
+@pytest.mark.parametrize("n", [1, 2, 4, 5, 8, 16, 100, 128, 1000, 16384])
+def test_max_device_passes_within_log2_bound(n):
+    bucket = iso._bucket(n)
+    assert iso.max_device_passes(n) <= math.ceil(math.log2(bucket)) + 1
+
+
+# ------------------------------------------- differential localization
+
+#: forged-position patterns the acceptance gate names: first, last,
+#: adjacent pairs, all-bad — plus a scattered set
+_PATTERNS = [
+    (5, {0}),
+    (5, {4}),
+    (8, {0}),
+    (8, {7}),
+    (8, {3, 4}),          # adjacent pair straddling a group boundary
+    (13, {0, 1}),         # adjacent pair at the front
+    (13, set(range(13))),  # all bad
+    (16, {0, 15}),
+    (32, {5}),
+    (32, {7, 8, 30, 31}),
+    (32, set(range(32))),
+]
+
+
+@pytest.mark.parametrize("n,forged", _PATTERNS)
+def test_localize_matches_eager_host_path(n, forged):
+    """Verdicts are byte-identical to what the eager host path would
+    say for every item, for every forged-position pattern."""
+    truth, items = _truth_and_items(n, forged)
+    kab = KnownAnswerBackend(truth)
+    loc = _localizer_for(truth)
+    verdicts = loc.localize(kab, items)
+    expected = [truth[bytes(it.message)] for it in items]
+    assert verdicts == expected
+
+
+@pytest.mark.parametrize("n,forged", _PATTERNS)
+def test_localize_device_pass_bound_and_leaf_economy(n, forged):
+    """No batch takes more device passes than the ceil(log2)+1 bound,
+    and the host verifies EXACTLY the named-bad leaves — never a clean
+    item."""
+    truth, items = _truth_and_items(n, forged)
+    kab = KnownAnswerBackend(truth)
+    host_calls = [0]
+    loc = _localizer_for(truth, counter=host_calls)
+    loc.localize(kab, items)
+    # 1 subgroup pass + the partition rungs actually dispatched
+    device_passes = 1 + len(kab.partitions)
+    assert device_passes <= iso.max_device_passes(n)
+    # the fake backend's subgroup check passes everything, so host
+    # leaves are exactly the per-item-rung named-bad set == the forgeries
+    assert host_calls[0] == len(forged)
+    # the descent never dispatches a wider group count than the bucket
+    assert all(g <= iso._bucket(n) for _, g in kab.partitions)
+
+
+def test_localize_clean_batch_single_partition_pass():
+    """A batch the device wrongly called invalid (verdict fault) clears
+    on the FIRST partition rung: one subgroup + one partition pass, no
+    host work at all."""
+    truth, items = _truth_and_items(16, set())
+    kab = KnownAnswerBackend(truth)
+    host_calls = [0]
+    loc = _localizer_for(truth, counter=host_calls)
+    assert loc.localize(kab, items) == [True] * 16
+    assert len(kab.partitions) == 1  # first rung cleared every group
+    assert host_calls[0] == 0
+
+
+def test_localize_counts_passes_in_metrics():
+    m = Metrics()
+    truth, items = _truth_and_items(16, {3})
+    kab = KnownAnswerBackend(truth)
+    loc = iso.FaultLocalizer(
+        metrics=m,
+        host_check=lambda it: truth.get(bytes(it.message), False),
+    )
+    loc.localize(kab, items)
+    assert m.verify_isolation_passes.value("g2_subgroup") == 1
+    assert m.verify_isolation_passes.value("rlc_partition") == len(
+        kab.partitions
+    )
+    assert m.verify_isolation_passes.value("host") == 0
+
+
+# ----------------------------------------------------- degradation edges
+
+
+def test_localize_undecodable_signature_is_a_host_leaf():
+    """An item whose signature bytes cannot decompress never reaches
+    the device — the eager host check is its verdict of record."""
+    truth, items = _truth_and_items(6, {2})
+    items[4] = vs.VerifyItem(
+        items[4].message, b"\xff" * 96, public_keys=(_PK,)
+    )
+    truth[bytes(items[4].message)] = False  # host says no
+    kab = KnownAnswerBackend(truth)
+    loc = _localizer_for(truth)
+    verdicts = loc.localize(kab, items)
+    assert verdicts == [True, True, False, True, False, True]
+    # the garbage item was excluded from every device dispatch
+    assert all(n_items <= 5 for n_items, _ in kab.partitions)
+
+
+def test_localize_subgroup_named_bad_is_a_host_leaf():
+    """A per-item subgroup False becomes a host leaf (host verdict
+    wins), and the partition descent runs over the remaining items."""
+    truth, items = _truth_and_items(8, set())
+
+    class SubgroupFlagged(KnownAnswerBackend):
+        def g2_subgroup_check_batch_async(self, points):
+            flags = np.ones((len(points),), dtype=bool)
+            flags[1] = False
+            return lambda: flags
+
+    kab = SubgroupFlagged(truth)
+    host_calls = [0]
+    loc = _localizer_for(truth, counter=host_calls)
+    verdicts = loc.localize(kab, items)
+    assert verdicts == [True] * 8  # host overruled the device naming
+    assert host_calls[0] == 1
+    assert all(n_items == 7 for n_items, _ in kab.partitions)
+
+
+def test_localize_device_fault_mid_descent_sweeps_on_host():
+    """A partition dispatch that raises degrades to a host sweep of the
+    still-suspect items — verdicts stay correct and the sweep is
+    counted as a `host` pass."""
+    m = Metrics()
+    truth, items = _truth_and_items(12, {9})
+
+    class Faulting(KnownAnswerBackend):
+        def rlc_partition_verify_async(self, *a, **kw):
+            raise RuntimeError("injected partition fault")
+
+    loc = iso.FaultLocalizer(
+        metrics=m,
+        host_check=lambda it: truth.get(bytes(it.message), False),
+    )
+    verdicts = loc.localize(Faulting(truth), items)
+    assert verdicts == [truth[bytes(it.message)] for it in items]
+    assert m.verify_isolation_passes.value("host") == 1
+
+
+class _Breaker:
+    """allow_device stub with the supervisor surface localize touches."""
+
+    settle_timeout_s = 0.2
+
+    def __init__(self, allow: bool) -> None:
+        self._allow = allow
+        self.faults: "list[str]" = []
+
+    def allow_device(self) -> bool:
+        return self._allow
+
+    def record_fault(self, kind: str) -> None:
+        self.faults.append(kind)
+
+    def record_success(self) -> None:
+        pass
+
+    def guard_settle(self, settle, timeout_s=None):
+        try:
+            return _health.SettleOutcome(_health.OK, value=settle())
+        except Exception as e:
+            return _health.SettleOutcome(_health.FAULT, error=e)
+
+
+def test_localize_breaker_open_never_touches_device():
+    truth, items = _truth_and_items(8, {1})
+    kab = KnownAnswerBackend(truth)
+    loc = _localizer_for(truth, health=_Breaker(allow=False))
+    verdicts = loc.localize(kab, items)
+    assert verdicts == [truth[bytes(it.message)] for it in items]
+    assert kab.partitions == []  # zero device dispatches
+
+
+def test_localize_expired_deadline_sweeps_on_host():
+    truth, items = _truth_and_items(8, {6})
+    kab = KnownAnswerBackend(truth)
+    loc = _localizer_for(truth)
+    import time as _time
+
+    verdicts = loc.localize(kab, items, deadline=_time.monotonic() - 1.0)
+    assert verdicts == [truth[bytes(it.message)] for it in items]
+    assert kab.partitions == []
+
+
+# --------------------------------------------------------- reputation
+
+
+def _fake_clock(start: float = 0.0):
+    t = [start]
+    return t, (lambda: t[0])
+
+
+def test_reputation_entry_consecutive_clean_exit():
+    t, clock = _fake_clock()
+    rep = iso.ReputationTable(exit_clean=3, decay_s=60.0, clock=clock)
+    assert not rep.is_quarantined("peer:a")
+    rep.note_failure("peer:a")
+    assert rep.is_quarantined("peer:a")
+    rep.note_clean_batch("peer:a")
+    rep.note_clean_batch("peer:a")
+    assert rep.is_quarantined("peer:a")  # 2 clean < exit_clean
+    rep.note_clean_batch("peer:a")
+    assert not rep.is_quarantined("peer:a")  # 3rd consecutive: out
+    assert len(rep) == 0
+
+
+def test_reputation_failure_resets_clean_streak():
+    t, clock = _fake_clock()
+    rep = iso.ReputationTable(exit_clean=2, clock=clock)
+    rep.note_failure("peer:b")
+    rep.note_clean_batch("peer:b")
+    rep.note_failure("peer:b")  # streak back to zero
+    rep.note_clean_batch("peer:b")
+    assert rep.is_quarantined("peer:b")
+    rep.note_clean_batch("peer:b")
+    assert not rep.is_quarantined("peer:b")
+
+
+def test_reputation_time_decay():
+    t, clock = _fake_clock()
+    rep = iso.ReputationTable(decay_s=60.0, clock=clock)
+    rep.note_failure("peer:c")
+    t[0] = 59.0
+    assert rep.is_quarantined("peer:c")
+    t[0] = 61.0
+    assert not rep.is_quarantined("peer:c")
+    assert len(rep) == 0  # decayed entries are dropped, not kept
+
+
+def test_reputation_capacity_evicts_stalest():
+    t, clock = _fake_clock()
+    rep = iso.ReputationTable(capacity=2, clock=clock)
+    rep.note_failure("peer:old")
+    t[0] = 1.0
+    rep.note_failure("peer:new")
+    t[0] = 2.0
+    rep.note_failure("peer:newest")  # at capacity: evicts peer:old
+    assert len(rep) == 2
+    assert not rep.is_quarantined("peer:old")
+    assert rep.is_quarantined("peer:new")
+    assert rep.is_quarantined("peer:newest")
+
+
+def test_reputation_none_origin_is_noop():
+    rep = iso.ReputationTable()
+    rep.note_failure(None)
+    rep.note_failure("")
+    assert len(rep) == 0 and not rep.is_quarantined(None)
+
+
+# ----------------------------------------------------------- admission
+
+
+def test_admission_lone_origin_never_throttled():
+    t, clock = _fake_clock()
+    adm = iso.AdmissionController(min_quota=256, clock=clock)
+    assert all(adm.admit("peer:solo", 8) for _ in range(32))  # == floor
+
+
+def test_admission_hostile_origin_cannot_starve_honest():
+    """Hostile origin at 10x the honest per-origin rate: honest origins
+    keep >=80% of their submissions (here: all of them) while the
+    hostile origin is clamped to roughly its fair share."""
+    m = Metrics()
+    t, clock = _fake_clock()
+    adm = iso.AdmissionController(
+        window_s=1.0, max_share=0.5, min_quota=8, metrics=m, clock=clock
+    )
+    honest = [f"peer:honest-{i}" for i in range(5)]
+    admitted = {o: 0 for o in honest + ["peer:hostile"]}
+    attempted = {o: 0 for o in admitted}
+    for tick in range(40):  # 2s of 50ms ticks — one full window warmup
+        t[0] = tick * 0.05
+        for _ in range(10):  # 10x the honest rate
+            attempted["peer:hostile"] += 1
+            if adm.admit("peer:hostile", 1, lane="sync_message"):
+                admitted["peer:hostile"] += 1
+        for o in honest:
+            attempted[o] += 1
+            if adm.admit(o, 1, lane="sync_message"):
+                admitted[o] += 1
+    for o in honest:
+        assert admitted[o] / attempted[o] >= 0.8, (o, admitted[o])
+    # the hostile origin was actually clamped…
+    assert admitted["peer:hostile"] < attempted["peer:hostile"] * 0.75
+    # …to at most its fair share of the window (plus the floor's slack)
+    assert adm.window_share("peer:hostile") <= 0.6
+    rejected = m.verify_admission_rejected.value("sync_message")
+    assert rejected == sum(attempted.values()) - sum(admitted.values())
+    assert rejected > 0
+
+
+def test_admission_unattributed_always_admitted():
+    adm = iso.AdmissionController(min_quota=1)
+    assert all(adm.admit(None, 10_000) for _ in range(10))
+
+
+def test_admission_window_slides():
+    t, clock = _fake_clock()
+    adm = iso.AdmissionController(
+        window_s=1.0, max_share=0.5, min_quota=4, clock=clock
+    )
+    assert adm.admit("peer:x", 4)
+    assert not adm.admit("peer:x", 1)  # floor exhausted this window
+    t[0] = 1.5  # window slid past the old entries
+    assert adm.admit("peer:x", 4)
+
+
+def test_admission_capacity_churn_cannot_evict_heavy_hitters():
+    t, clock = _fake_clock()
+    adm = iso.AdmissionController(
+        window_s=10.0, max_share=0.5, min_quota=4, capacity=2, clock=clock
+    )
+    assert adm.admit("peer:tracked", 4)
+    assert adm.admit("peer:other", 4)
+    # sybil churn past capacity: admitted (under the floor) but the
+    # tracked heavy hitter's clamp survives
+    assert adm.admit("peer:sybil-1", 1)
+    assert adm.admit("peer:sybil-2", 1)
+    # global window = 10, quota = max(4, 5) = 5; tracked holds 4
+    assert not adm.admit("peer:tracked", 2)
+
+
+# ------------------------------------------- scheduler integration
+
+
+def _scheduler(truth, monkeypatch, metrics=None, flight=None,
+               exit_clean=2):
+    kab = KnownAnswerBackend(truth)
+    sched = vs.VerifyScheduler(
+        backend=kab, use_device=True, metrics=metrics, flight=flight,
+        reputation=iso.ReputationTable(exit_clean=exit_clean),
+    )
+    monkeypatch.setattr(
+        vs, "host_check_item",
+        lambda item: truth.get(bytes(item.message), False),
+    )
+    return kab, sched
+
+
+def test_scheduler_quarantine_roundtrip(monkeypatch):
+    """A forged batch quarantines its origin; later sheddable traffic
+    reroutes to the quarantine lane (HIGH lanes never reroute); clean
+    quarantine batches step the origin back out."""
+    m = Metrics()
+    fl = FlightRecorder()
+    good = b"good-msg" + b"\x00" * 24
+    bad = b"bad-msg!" + b"\x00" * 24
+    truth = {good: True, bad: False}
+    kab, sched = _scheduler(truth, monkeypatch, metrics=m, flight=fl)
+    try:
+        t1 = sched.submit("sync_message", [_item(bad)], origin="peer:evil")
+        sched.flush(30.0)
+        assert t1.done() and t1.ok is False
+        assert sched.reputation.is_quarantined("peer:evil")
+
+        # sheddable traffic from the quarantined origin: rerouted
+        t2 = sched.submit("sync_message", [_item(good)], origin="peer:evil")
+        assert t2.lane == "quarantine"
+        # HIGH lane from the same origin: never rerouted
+        t3 = sched.submit("block", [_item(good)], origin="peer:evil")
+        assert t3.lane == "block"
+        sched.flush(30.0)
+        assert t2.ok is True and t3.ok is True
+
+        # second clean quarantine batch reaches exit_clean=2
+        t4 = sched.submit("sync_message", [_item(good)], origin="peer:evil")
+        assert t4.lane == "quarantine"
+        sched.flush(30.0)
+        assert t4.ok is True
+        assert not sched.reputation.is_quarantined("peer:evil")
+        t5 = sched.submit("sync_message", [_item(good)], origin="peer:evil")
+        assert t5.lane == "sync_message"
+        sched.flush(30.0)
+    finally:
+        sched.stop()
+
+    assert m.verify_quarantine_batches.value == 2
+    quarantined_recs = [
+        r for r in fl.snapshot(kind=BATCH) if r.quarantined
+    ]
+    assert len(quarantined_recs) == 2
+    assert all(r.lane == "quarantine" for r in quarantined_recs)
+
+
+def test_scheduler_isolate_uses_localizer(monkeypatch):
+    """A poisoned batch settles through the on-device localizer (the
+    partition seam is dispatched, passes are counted) and every ticket
+    gets the eager-host verdict for its own items."""
+    m = Metrics()
+    n = 12
+    truth, items = _truth_and_items(n, {5})
+    kab, sched = _scheduler(truth, monkeypatch, metrics=m)
+    try:
+        tickets = [
+            sched.submit("sync_message", [it], origin=f"peer:{i}")
+            for i, it in enumerate(items)
+        ]
+        sched.flush(30.0)
+    finally:
+        sched.stop()
+    for i, tk in enumerate(tickets):
+        assert tk.done() and tk.ok is (i != 5)
+    assert kab.partitions, "localizer never dispatched the partition seam"
+    assert m.verify_isolation_passes.value("g2_subgroup") >= 1
+    assert m.verify_isolation_passes.value("rlc_partition") >= 1
+    # only the forged item's origin was quarantined
+    assert sched.reputation.is_quarantined("peer:5")
+    assert not sched.reputation.is_quarantined("peer:4")
+
+
+def test_scheduler_no_isolation_falls_back_to_bisection(monkeypatch):
+    """--no-isolation: the legacy host bisection still settles poisoned
+    batches correctly and never touches the partition seam."""
+    good = b"fb-good!" + b"\x00" * 24
+    bad = b"fb-bad!!" + b"\x00" * 24
+    truth = {good: True, bad: False}
+    kab = KnownAnswerBackend(truth)
+    sched = vs.VerifyScheduler(
+        backend=kab, use_device=True, use_isolation=False,
+    )
+    monkeypatch.setattr(
+        vs, "host_check_item",
+        lambda item: truth.get(bytes(item.message), False),
+    )
+    try:
+        t_good = sched.submit("sync_message", [_item(good)])
+        t_bad = sched.submit("sync_message", [_item(bad)])
+        sched.flush(30.0)
+        assert t_good.ok is True and t_bad.ok is False
+    finally:
+        sched.stop()
+    assert kab.partitions == []

@@ -564,11 +564,23 @@ def test_scheduled_gossip_matches_eager_on_every_object_kind(genesis):
         assert list(agg_s.sync_committee_bits.array) == list(
             agg_e.sync_committee_bits.array
         )
-        # the scheduled plane really carried every lane
-        for lane in ("sync_message", "sync_contribution", "slashing",
-                     "bls_change", "exit"):
-            assert sched.stats[lane]["submitted"] >= 1, lane
-            assert sched.stats[lane]["rejected"] >= 1, lane
+        # the scheduled plane really carried every lane. The whole test
+        # gossips through ONE peer, so the first invalid specimen
+        # quarantines it and LATER sheddable-lane traffic may reroute
+        # into the quarantine lane (a race against batch settling) —
+        # count rerouted submissions with their source lanes.
+        assert sched.stats["sync_message"]["submitted"] >= 1
+        assert sched.stats["sync_contribution"]["submitted"] >= 1
+        reroutable = ("slashing", "bls_change", "exit")
+        direct = sum(sched.stats[ln]["submitted"] for ln in reroutable)
+        q = sched.stats["quarantine"]
+        # 2 proposer + 2 attester slashings, 2 bls changes, 2 exits
+        assert direct + q["submitted"] == 8
+        lanes = ("sync_message", "sync_contribution") + reroutable
+        total_rejected = (
+            sum(sched.stats[ln]["rejected"] for ln in lanes) + q["rejected"]
+        )
+        assert total_rejected == 6  # one invalid specimen per kind
     finally:
         sched.stop()
         ctrl_a.stop()

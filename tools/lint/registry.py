@@ -5,6 +5,7 @@ from __future__ import annotations
 
 
 def all_rules():
+    from tools.lint.rules.drop_counter_reuse import DropCounterReuseRule
     from tools.lint.rules.host_sync import HostSyncRule
     from tools.lint.rules.jit_purity import JitPurityRule
     from tools.lint.rules.lock_order import LockOrderRule
@@ -22,6 +23,7 @@ def all_rules():
 
     return [
         NoInlineGossipVerifyRule(),
+        DropCounterReuseRule(),
         HostSyncRule(),
         LockOrderRule(),
         MeshTopologyRule(),

@@ -1,0 +1,154 @@
+"""Rule: one drop counter — quarantine shed reuses it, nobody forks it.
+
+`verify_lane_dropped_total{lane}` is the verify plane's single source of
+truth for "work shed under pressure": overload sheds, shutdown drains,
+and the quarantine lane's sheds all land there (the quarantine lane is
+just a lane — its label value distinguishes it). Dashboards and the SLO
+math alert on that one family; a second dropped/shed family would split
+the signal and silently halve every rate() the moment someone points a
+panel at the wrong one.
+
+Three checks:
+
+- declaration: no Counter/Gauge/Histogram family (labeled or plain) in
+  grandine_tpu may be declared whose metric NAME contains "dropped" or
+  "shed" other than the canonical `verify_lane_dropped_total`.
+- single inc site: `verify_lane_dropped` is incremented only inside the
+  scheduler's `_count_shed` helper, so every shed path — including the
+  quarantine lane's — funnels through one accounting point.
+- quarantine sheds: the `quarantine` LaneConfig (when present) must be
+  declared with `shed=True`, which is what routes its overflow through
+  `_count_shed` instead of a bespoke counter.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.lint.core import Context, Finding, Rule
+
+CANONICAL = "verify_lane_dropped_total"
+CANONICAL_ATTR = "verify_lane_dropped"
+SHED_HELPER = "_count_shed"
+SCHEDULER = "grandine_tpu/runtime/verify_scheduler.py"
+
+_DROP_NAME_RE = re.compile(r"dropp?ed|shed", re.IGNORECASE)
+_FACTORIES = {
+    "Counter", "Gauge", "Histogram",
+    "LabeledCounter", "LabeledGauge", "LabeledHistogram",
+}
+
+
+class DropCounterReuseRule(Rule):
+    name = "drop-counter-reuse"
+    description = (
+        "verify_lane_dropped_total is the only dropped/shed metric "
+        "family, incremented only via the scheduler's _count_shed; the "
+        "quarantine lane sheds through it (shed=True), never through a "
+        "forked counter"
+    )
+    default_paths = (
+        "grandine_tpu/metrics.py",
+        SCHEDULER,
+        "grandine_tpu/runtime/isolation.py",
+        "grandine_tpu/runtime/flight.py",
+        "grandine_tpu/p2p/network.py",
+    )
+
+    def check(self, ctx: Context, files):
+        out: "list[Finding]" = []
+        for path in files:
+            tree = ctx.tree(path)
+            if tree is None:
+                continue
+            out.extend(self._forked_declarations(path, tree))
+            if not path.endswith("metrics.py"):  # declaration site
+                out.extend(self._inc_sites(path, tree))
+            if path.endswith("verify_scheduler.py"):
+                out.extend(self._quarantine_lane(path, tree))
+        return out
+
+    # ------------------------------------------------------- declarations
+
+    def _forked_declarations(self, path: str, tree: ast.AST):
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            fn = node.func
+            factory = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else None
+            )
+            if factory not in _FACTORIES:
+                continue
+            first = node.args[0]
+            if not (isinstance(first, ast.Constant)
+                    and isinstance(first.value, str)):
+                continue
+            metric = first.value
+            if _DROP_NAME_RE.search(metric) and metric != CANONICAL:
+                yield Finding(
+                    self.name, path, node.lineno,
+                    f"forked drop counter {metric!r} — shed/drop "
+                    f"accounting must reuse {CANONICAL} (label the lane, "
+                    "don't mint a family)",
+                )
+
+    # ----------------------------------------------------------- inc sites
+
+    def _inc_sites(self, path: str, tree: ast.AST):
+        """`...verify_lane_dropped...` usage outside _count_shed."""
+        helper_spans = [
+            (n.lineno, max(
+                (c.lineno for c in ast.walk(n) if hasattr(c, "lineno")),
+                default=n.lineno,
+            ))
+            for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and n.name == SHED_HELPER
+        ]
+        saw_canonical_inc = False
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Attribute)
+                    and node.attr == CANONICAL_ATTR):
+                continue
+            inside = any(a <= node.lineno <= b for a, b in helper_spans)
+            if inside:
+                saw_canonical_inc = True
+                continue
+            yield Finding(
+                self.name, path, node.lineno,
+                f"{CANONICAL_ATTR} touched outside {SHED_HELPER} — every "
+                "shed path (quarantine included) funnels through the one "
+                "helper so the drop signal stays whole",
+            )
+        if path == SCHEDULER and helper_spans and not saw_canonical_inc:
+            yield Finding(
+                self.name, path, helper_spans[0][0],
+                f"{SHED_HELPER} no longer increments {CANONICAL_ATTR} — "
+                "sheds have lost their canonical counter",
+            )
+
+    # ------------------------------------------------------ quarantine lane
+
+    def _quarantine_lane(self, path: str, tree: ast.AST):
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "LaneConfig" and node.args):
+                continue
+            first = node.args[0]
+            if not (isinstance(first, ast.Constant)
+                    and first.value == "quarantine"):
+                continue
+            shed = next(
+                (kw.value for kw in node.keywords if kw.arg == "shed"),
+                node.args[5] if len(node.args) > 5 else None,
+            )
+            if not (isinstance(shed, ast.Constant) and shed.value is True):
+                yield Finding(
+                    self.name, path, node.lineno,
+                    "quarantine LaneConfig must be shed=True so its "
+                    f"overflow drops through {CANONICAL} like every "
+                    "other shed",
+                )

@@ -58,6 +58,7 @@ class HostSyncRule(Rule):
         "grandine_tpu/runtime/verify_scheduler.py",
         "grandine_tpu/runtime/health.py",
         "grandine_tpu/runtime/replay.py",
+        "grandine_tpu/runtime/isolation.py",
     )
 
     def check(self, ctx: Context, files):

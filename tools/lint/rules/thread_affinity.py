@@ -51,6 +51,7 @@ class ThreadAffinityRule(Rule):
         "grandine_tpu/runtime/flight.py",
         "grandine_tpu/runtime/replay.py",
         "grandine_tpu/runtime/warmup.py",
+        "grandine_tpu/runtime/isolation.py",
         "grandine_tpu/runtime/thread_pool.py",
         "grandine_tpu/metrics.py",
         "grandine_tpu/tpu/registry.py",

@@ -61,6 +61,7 @@ REGISTRY_PATH = "grandine_tpu/tpu/registry.py"
 VERIFIER_PATH = "grandine_tpu/runtime/attestation_verifier.py"
 SCHEDULER_PATH = "grandine_tpu/runtime/verify_scheduler.py"
 REPLAY_PATH = "grandine_tpu/runtime/replay.py"
+ISOLATION_PATH = "grandine_tpu/runtime/isolation.py"
 
 TPU_FILES = (
     BLS_PATH,
@@ -69,7 +70,8 @@ TPU_FILES = (
     "grandine_tpu/tpu/pairing.py",
     REGISTRY_PATH,
 )
-RUNTIME_FILES = (VERIFIER_PATH, SCHEDULER_PATH, REPLAY_PATH)
+RUNTIME_FILES = (VERIFIER_PATH, SCHEDULER_PATH, REPLAY_PATH,
+                 ISOLATION_PATH)
 DEFAULT_FILES = TPU_FILES + RUNTIME_FILES
 
 #: named jit factories: call sites register a kernel under a literal name
@@ -213,6 +215,11 @@ class Analysis:
             ("multi_verify", (64, 256, 1024, 4096), "policy:block-replay"),
             ("sign", (64, 512), "policy:signer"),
             ("subgroup", tuple(ladder), derived),
+            # fault localization dispatches every bucket with its fixed
+            # group ladder (runtime/isolation.ladder); warmup expands
+            # each bucket here into its (bucket, groups) variants so an
+            # adversarial incident never compiles at localization time
+            ("rlc_partition", tuple(ladder), derived),
         ]
         # bulk replay stacks a WINDOW of blocks into one multi_verify
         # dispatch (the multi_verify policy ladder above already covers
