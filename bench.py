@@ -620,6 +620,12 @@ def bench_chaos() -> None:
     attribution rule agrees with, and the breaker records must trace a
     legal CLOSED→OPEN→HALF_OPEN→CLOSED walk.
 
+    A second, fault-free soak segment replays part of the workload
+    through the scheduler's FUSED single-dispatch path (the backend
+    advertises `fuse_subgroup`) and asserts the fusion contract: zero
+    standalone subgroup dispatches, zero post-warmup recompiles, fused
+    kernel labels in flight, exact verdicts. `soak_ok` covers both.
+
     Knobs: BENCH_CHAOS_SEED, BENCH_CHAOS_JOBS, BENCH_CHAOS_RATE (total
     fault probability split evenly over the five kinds)."""
     import threading
@@ -834,8 +840,68 @@ def bench_chaos() -> None:
     for fault_kind in FAULT_KINDS:
         probe_kind(fault_kind)
 
-    vs.host_check_item = real_host_check
     recompiles = B.post_warmup_recompiles()
+
+    # ---- fused-path soak: the same truth-table plane through the
+    # scheduler's FUSED single-dispatch path (backend advertises
+    # fuse_subgroup). Asserts the fusion contract under soak: zero
+    # standalone subgroup dispatches, zero post-warmup recompiles on
+    # the fused path, fused kernel labels in flight, verdicts exact.
+    fused_problems: "list[str]" = []
+    kab_fused = KnownAnswerBackend(truth)
+    kab_fused.fuse_subgroup = True
+    sub_dispatches: "list[int]" = []
+    _plain_sub = kab_fused.g2_subgroup_check_batch_async
+
+    def _counting_sub(points):
+        sub_dispatches.append(len(points))
+        return _plain_sub(points)
+
+    kab_fused.g2_subgroup_check_batch_async = _counting_sub
+    B.reset_shape_tracking()
+    B.declare_warmup_complete()
+    fl_fused = FlightRecorder(capacity=4096)
+    s_fused = vs.VerifyScheduler(
+        backend=kab_fused, use_device=True, flight=fl_fused
+    )
+    fused_tickets: "list[tuple]" = []
+    try:
+        for lane, msgs in job_specs[:128]:
+            f_items = [
+                vs.VerifyItem(m, sig_bytes, public_keys=(pk,)) for m in msgs
+            ]
+            fused_tickets.append(
+                (s_fused.submit(lane, f_items), all(truth[m] for m in msgs))
+            )
+        s_fused.flush(60.0)
+    finally:
+        s_fused.stop()
+    fused_recompiles = B.post_warmup_recompiles()
+    fused_mismatches = sum(
+        1 for tk, expected in fused_tickets
+        if not tk.done() or tk.dropped or tk.ok is not expected
+    )
+    fused_labels = {r.kernel for r in fl_fused.snapshot(kind=BATCH)}
+    if sub_dispatches:
+        fused_problems.append(
+            f"fused path dispatched {len(sub_dispatches)} standalone "
+            f"subgroup checks"
+        )
+    if fused_recompiles:
+        fused_problems.append(
+            f"fused path recompiled {fused_recompiles}x post-warmup"
+        )
+    if fused_mismatches:
+        fused_problems.append(
+            f"fused path verdict mismatches: {fused_mismatches}"
+        )
+    if fused_labels - {"fast_aggregate_fused"}:
+        fused_problems.append(
+            f"non-fused kernel labels on fused path: {sorted(fused_labels)}"
+        )
+    fused_ok = not fused_problems
+
+    vs.host_check_item = real_host_check
 
     # ---- soak flight audit: the recorder must EXPLAIN the random soak
     batches = flight.snapshot(kind=BATCH)
@@ -885,7 +951,8 @@ def bench_chaos() -> None:
     flight_ok = not problems
 
     soak_ok = (
-        unsettled == 0 and mismatches == 0 and recompiles == 0 and flight_ok
+        unsettled == 0 and mismatches == 0 and recompiles == 0
+        and flight_ok and fused_ok
     )
     print(
         json.dumps({
@@ -910,6 +977,14 @@ def bench_chaos() -> None:
             "verify_recompiles_total": recompiles,
             "flight_ok": flight_ok,
             "flight_problems": problems,
+            "fused_path": {
+                "jobs": len(fused_tickets),
+                "subgroup_dispatches": len(sub_dispatches),
+                "verify_recompiles_total": fused_recompiles,
+                "verdict_mismatches": fused_mismatches,
+                "ok": fused_ok,
+                "problems": fused_problems,
+            },
             "soak_ok": soak_ok,
         })
     )
@@ -923,10 +998,13 @@ def bench_chaos() -> None:
         f"# chaos soak: {sum(plan.injected.values())} faults over "
         f"{plan.calls} seam calls; breaker opened {br['opens']}x, "
         f"re-closed {br['closes']}x; {recompiles} steady-state "
-        f"recompiles; flight timeline "
+        f"recompiles; fused path {fused_recompiles} recompiles / "
+        f"{len(sub_dispatches)} subgroup dispatches over "
+        f"{len(fused_tickets)} jobs; flight timeline "
         + ("consistent; OK" if soak_ok else
-           f"problems={problems}; FAILED (see verdict_mismatches / "
-           "verify_recompiles_total / flight_problems)"),
+           f"problems={problems + fused_problems}; FAILED (see "
+           "verdict_mismatches / verify_recompiles_total / "
+           "flight_problems / fused_path)"),
         file=sys.stderr,
     )
     if not soak_ok:
@@ -1493,6 +1571,214 @@ def bench_multichip_child(n_devices: int) -> None:
     print(json.dumps(report))
 
 
+def bench_fused_kernels() -> None:
+    """`--fused` / BENCH_FUSED=1: lever-by-lever fused-verify bench.
+
+    Prints one parseable `verify_fused_kernels` JSON line per lever
+    configuration plus a summary line. Backend levers (subgroup fusion,
+    buffer donation) measure the multi_verify path end to end: an
+    UNFUSED config pays the honest two-pass cost (RLC verify + the
+    standalone ψ-ladder subgroup dispatch) while a fused config folds
+    membership into the single pairing dispatch; per-batch device
+    dispatch counts come from the backend's own kernel-call counters.
+    The merge lever runs the real scheduler over two lanes with
+    identical workloads and counts seam dispatches with the merge
+    window closed vs open (job/batch shapes chosen so both land in the
+    same compile bucket — the lever isolates DISPATCH count, not shape
+    changes).
+
+    Honesty notes: buffer donation is a no-op on the CPU backend (XLA
+    declines it; `donation_effective` reports the truth), and the
+    throughput target is a TPU figure — on CPU the summary reports
+    `target_met` honestly alongside `dispatches_halved`, which is the
+    CPU-checkable half of the claim. BENCH_FUSED_N sizes the backend
+    lever batch (default 64; the driver runs 32768 on the chip)."""
+    _lint_preflight()
+    import warnings
+
+    import jax
+
+    _enable_compilation_cache()
+    from grandine_tpu.crypto import bls as A
+    from grandine_tpu.metrics import Metrics
+    from grandine_tpu.runtime import verify_scheduler as vs
+    from grandine_tpu.runtime.thread_pool import Priority
+    from grandine_tpu.tpu.bls import TpuBlsBackend
+
+    n = int(os.environ.get("BENCH_FUSED_N", "64"))
+    platform = jax.devices()[0].platform
+    target_sigs_per_sec = 1.3 * 83_300.0  # 1.3x the BENCH_r05 headline
+
+    # host prep (off the clock): n distinct keys/messages, valid sigs
+    sks = [A.SecretKey(0x1357_0000_DEAD_BEEF + 0x2468_ACE1 * i)
+           for i in range(n)]
+    msgs = [b"fused-bench-%d" % i for i in range(n)]
+    pks = [sk.public_key() for sk in sks]
+    sigs = [sk.sign(m) for sk, m in zip(sks, msgs)]
+    sig_pts = [s.point for s in sigs]
+
+    def measure(fn, warm=1, budget_s=5.0, min_iters=3):
+        for _ in range(warm):
+            assert fn()
+        lat = []
+        t0 = time.time()
+        while len(lat) < min_iters or (
+            time.time() - t0 < budget_s and len(lat) < 30
+        ):
+            t1 = time.time()
+            assert fn()
+            lat.append(time.time() - t1)
+        return sorted(lat)[len(lat) // 2]
+
+    def total_kernel_calls(m):
+        return sum(
+            c.value for c in m.device_kernel_calls.children().values()
+        )
+
+    results = {}
+    for fused, donate in ((False, False), (True, False), (True, True)):
+        m = Metrics()
+        batches = [0]
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")  # donate-on-cpu warning
+            backend = TpuBlsBackend(
+                fuse_subgroup=fused, donate_buffers=donate, metrics=m
+            )
+
+            if fused:
+                def one_batch(backend=backend, batches=batches):
+                    batches[0] += 1
+                    return bool(backend.multi_verify(msgs, sigs, pks))
+            else:
+                def one_batch(backend=backend, batches=batches):
+                    batches[0] += 1
+                    ok = bool(backend.multi_verify(msgs, sigs, pks))
+                    return ok and bool(
+                        backend.g2_subgroup_check_batch(sig_pts).all()
+                    )
+
+            p50 = measure(one_batch)
+            calls = total_kernel_calls(m)
+        dispatches_per_batch = calls / max(1, batches[0])
+        lever = {
+            "fused": fused, "donate": donate, "merge": False,
+            "sigs_per_sec": round(n / p50, 1),
+            "p50_batch_latency_ms": round(p50 * 1000, 2),
+            "dispatches_per_batch": round(dispatches_per_batch, 2),
+            "donation_effective": donate and platform != "cpu",
+        }
+        results[(fused, donate)] = lever
+        print(json.dumps({
+            "metric": "verify_fused_kernels", "unit": "sigs/s",
+            "value": lever["sigs_per_sec"], "n": n,
+            "platform": platform, **lever,
+        }))
+
+    # merge lever: real fused+donating backend behind the scheduler;
+    # same workload with the merge window closed then open. Jobs are
+    # 2 items with max_batch=2, so an unmerged batch (2 items) and a
+    # merged pair (4 items) bucket identically to 4 — one compiled
+    # shape, and the dispatch-count delta is purely the merge.
+    class _CountingSeam:
+        def __init__(self, inner):
+            self._inner = inner
+            self.dispatches = 0
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+        def fast_aggregate_verify_batch_async(self, *a, **kw):
+            self.dispatches += 1
+            return self._inner.fast_aggregate_verify_batch_async(*a, **kw)
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        merge_backend = _CountingSeam(
+            TpuBlsBackend(fuse_subgroup=True, donate_buffers=True)
+        )
+        n_jobs = int(os.environ.get("BENCH_FUSED_MERGE_JOBS", "8"))
+        sched_items = [
+            vs.VerifyItem(m, s.to_bytes(), public_keys=(pk,))
+            for m, s, pk in zip(msgs, sigs, pks)
+        ]
+
+        for merge_on in (False, True):
+            lanes = (
+                vs.LaneConfig("attestation", Priority.LOW, 2, 0.05,
+                              4096, False),
+                vs.LaneConfig("sync_message", Priority.LOW, 2, 0.08,
+                              4096, False),
+            )
+            sched = vs.VerifyScheduler(
+                backend=merge_backend, lanes=lanes, use_device=True,
+                merge_window_s=5.0 if merge_on else 0.0,
+            )
+            d0 = merge_backend.dispatches
+            tickets = []
+            t0 = time.time()
+            try:
+                for j in range(n_jobs):
+                    pair = sched_items[(2 * j) % n:(2 * j) % n + 2]
+                    tickets.append(sched.submit("attestation", pair))
+                    tickets.append(sched.submit("sync_message", pair))
+                sched.flush(600.0)
+            finally:
+                sched.stop()
+            wall = time.time() - t0
+            assert all(t.done() and t.ok for t in tickets), \
+                "merge lever: a valid batch failed"
+            merged = sum(
+                st["merged"] for st in sched.stats.values()
+            )
+            lever = {
+                "fused": True, "donate": True, "merge": merge_on,
+                "sigs_per_sec": round(4 * n_jobs / wall, 1),
+                "seam_dispatches": merge_backend.dispatches - d0,
+                "merged_batches": merged,
+                "jobs": 2 * n_jobs,
+                "donation_effective": platform != "cpu",
+            }
+            results[("merge", merge_on)] = lever
+            print(json.dumps({
+                "metric": "verify_fused_kernels", "unit": "sigs/s",
+                "value": lever["sigs_per_sec"], "n": 4 * n_jobs,
+                "platform": platform, **lever,
+            }))
+
+    best = results[(True, True)]["sigs_per_sec"]
+    halved = (
+        results[(True, False)]["dispatches_per_batch"]
+        <= results[(False, False)]["dispatches_per_batch"] / 2
+    )
+    merge_reduced = (
+        results[("merge", True)]["seam_dispatches"]
+        < results[("merge", False)]["seam_dispatches"]
+    )
+    print(json.dumps({
+        "metric": "verify_fused_kernels_summary", "unit": "sigs/s",
+        "value": best, "n": n, "platform": platform,
+        "target_sigs_per_sec": round(target_sigs_per_sec, 1),
+        "target_met": best >= target_sigs_per_sec,
+        "dispatches_halved": halved,
+        "merge_reduces_dispatches": merge_reduced,
+    }))
+    print(
+        f"# fused levers: unfused "
+        f"{results[(False, False)]['sigs_per_sec']} -> fused "
+        f"{results[(True, False)]['sigs_per_sec']} -> fused+donate "
+        f"{best} sigs/s at n={n}; dispatches/batch "
+        f"{results[(False, False)]['dispatches_per_batch']} -> "
+        f"{results[(True, False)]['dispatches_per_batch']}; merge "
+        f"{results[('merge', False)]['seam_dispatches']} -> "
+        f"{results[('merge', True)]['seam_dispatches']} dispatches "
+        f"for the same two-lane workload ({platform}; the throughput "
+        f"target is a TPU figure)",
+        file=sys.stderr,
+    )
+    if not (halved and merge_reduced):
+        raise SystemExit(1)
+
+
 def bench_multichip() -> None:
     """`--devices`: per-device-count scaling sweep over {1, 2, 4, 8}
     (BENCH_MC_DEVICES overrides), one fresh child process per count,
@@ -1612,6 +1898,8 @@ if __name__ == "__main__":
         bench_coldstart()
     elif "--fuzz-schedules" in sys.argv or os.environ.get("BENCH_FUZZ") == "1":
         bench_fuzz_schedules()
+    elif "--fused" in sys.argv or os.environ.get("BENCH_FUSED") == "1":
+        bench_fused_kernels()
     elif "--chaos" in sys.argv or os.environ.get("BENCH_CHAOS") == "1":
         bench_chaos()
         if os.environ.get("BENCH_SKIP_ADVERSARIAL") != "1":

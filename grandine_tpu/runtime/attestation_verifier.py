@@ -459,12 +459,18 @@ class AttestationVerifier:
             return lambda: False
         if any(p.is_infinity() for p in points):
             return lambda: False
-        # stack both dispatches before any readback: subgroup ladder and
-        # verify kernel queue back-to-back on the device. Verifying a
-        # not-yet-subgroup-checked (but on-curve) point is safe — if the
-        # subgroup check fails the batch verdict is False and the items
-        # fall to bisection, whose singular path is fully checked.
-        sub_settle = backend.g2_subgroup_check_batch_async(points)
+        # Fused backends fold the ψ-ladder membership check into the
+        # verify kernel itself (check_subgroup static): ONE device
+        # dispatch per batch. Two-pass backends stack both dispatches
+        # before any readback: subgroup ladder and verify kernel queue
+        # back-to-back on the device. Verifying a not-yet-subgroup-
+        # checked (but on-curve) point is safe either way — if the
+        # membership check fails the batch verdict is False and the
+        # items fall to bisection, whose singular path is fully checked.
+        fused = getattr(backend, "fuse_subgroup", False)
+        sub_settle = (
+            None if fused else backend.g2_subgroup_check_batch_async(points)
+        )
         sigs = [A.Signature(p) for p in points]
         if self.metrics is not None:
             self.metrics.device_batch_sigs.inc(len(sigs))
@@ -479,7 +485,7 @@ class AttestationVerifier:
             )
 
         def settle() -> bool:
-            if not bool(sub_settle().all()):
+            if sub_settle is not None and not bool(sub_settle().all()):
                 return False
             return bool(ver_settle())
 
@@ -879,8 +885,11 @@ class AttestationVerifier:
             return False
         if any(p.is_infinity() for p in points):
             return False
-        if not bool(backend.g2_subgroup_check_batch(points).all()):
-            return False
+        # fused backends check membership inside the verify kernel —
+        # no separate subgroup dispatch
+        if not getattr(backend, "fuse_subgroup", False):
+            if not bool(backend.g2_subgroup_check_batch(points).all()):
+                return False
         sigs = [A.Signature(p) for p in points]
         if self.metrics is not None:
             self.metrics.device_batch_sigs.inc(len(sigs))

@@ -71,6 +71,19 @@ DEFAULT_WINDOW_S = 1.0
 DEFAULT_MAX_SHARE = 0.5
 DEFAULT_MIN_QUOTA = 256
 
+#: reputation-fed admission: an origin whose attributed failure rate is
+#: at or under this (over ≥ TRUST_MIN_OBSERVED submitted jobs) has
+#: PROVEN itself honest — its quota is not share-clamped, so a busy
+#: honest aggregator is never throttled for being busy. Above it, the
+#: quota shrinks toward the floor as the rate climbs.
+DEFAULT_TRUST_FAILURE_RATE = 0.05
+#: minimum submitted jobs before a failure rate is trusted at all — an
+#: unknown or low-volume origin stays on the plain share quota
+TRUST_MIN_OBSERVED = 32
+#: rolling-rate horizon: both traffic counters halve when `submitted`
+#: reaches this, so the rate tracks recent behaviour, not ancient sins
+_TRAFFIC_HALF_AT = 4096
+
 
 def _bucket(n: int, lo: int = 4) -> int:
     """The pow-2 device bucket a batch of n pads into — must mirror
@@ -336,6 +349,52 @@ class ReputationTable:
         self._lock = threading.Lock()
         #: origin -> [failures, consecutive_clean, last_bad_t]
         self._entries: "dict[str, list]" = {}
+        #: origin -> [submitted, failed] rolling job counters feeding
+        #: `failure_rate` (admission quotas key off the RATE, not raw
+        #: submission share — a high-volume honest aggregator stays
+        #: unclamped). Bounded like _entries; at capacity the lowest-
+        #: volume origin is evicted, so sybil churn cannot displace the
+        #: heavy hitters whose rates matter.
+        self._traffic: "dict[str, list]" = {}
+
+    def _traffic_entry(self, origin: str) -> list:
+        # caller holds self._lock
+        t = self._traffic.get(origin)
+        if t is None:
+            if len(self._traffic) >= self.capacity:
+                victim = min(
+                    self._traffic, key=lambda o: self._traffic[o][0]
+                )
+                del self._traffic[victim]
+            t = self._traffic[origin] = [0, 0]
+        return t
+
+    def note_submitted(self, origin: "Optional[str]",
+                       jobs: int = 1) -> None:
+        """One (or `jobs`) verify job(s) submitted by `origin` — the
+        denominator of its failure rate."""
+        if not origin:
+            return
+        with self._lock:
+            t = self._traffic_entry(str(origin))
+            t[0] += max(1, int(jobs))
+            if t[0] >= _TRAFFIC_HALF_AT:
+                t[0] //= 2
+                t[1] //= 2
+
+    def failure_rate(self, origin: "Optional[str]",
+                     min_observed: int = TRUST_MIN_OBSERVED
+                     ) -> "Optional[float]":
+        """Attributed-failure fraction of `origin`'s submitted jobs, or
+        None while the origin has fewer than `min_observed` submissions
+        (too little evidence to trust the rate either way)."""
+        if not origin:
+            return None
+        with self._lock:
+            t = self._traffic.get(str(origin))
+            if t is None or t[0] < min_observed:
+                return None
+            return min(1.0, t[1] / t[0])
 
     def note_failure(self, origin: "Optional[str]") -> None:
         if not origin:
@@ -343,6 +402,7 @@ class ReputationTable:
         origin = str(origin)
         now = self.clock()
         with self._lock:
+            self._traffic_entry(origin)[1] += 1
             ent = self._entries.get(origin)
             if ent is not None:
                 ent[0] += 1
@@ -405,19 +465,33 @@ class AdmissionController:
     (origin None — local work, tests) are always admitted. The per-origin
     window map is bounded: at `capacity` tracked origins a NEW origin is
     admitted but untracked (it is necessarily under the floor), so sybil
-    churn cannot grow the table or evict the heavy hitters."""
+    churn cannot grow the table or evict the heavy hitters.
+
+    With a `reputation` table wired, the quota keys off the origin's
+    attributed FAILURE RATE rather than raw submission share: an origin
+    whose rate is at or under `trust_failure_rate` (over enough observed
+    jobs) bypasses the share clamp entirely — a high-rate honest
+    aggregator is never clamped for being busy — while a high-failure
+    origin's quota shrinks toward `min_quota` as its rate climbs.
+    Unknown / low-volume origins stay on the plain share quota, and
+    `reputation=None` is exactly the legacy share-only behaviour."""
 
     def __init__(self, window_s: float = DEFAULT_WINDOW_S,
                  max_share: float = DEFAULT_MAX_SHARE,
                  min_quota: int = DEFAULT_MIN_QUOTA,
                  capacity: int = 1024,
-                 metrics=None, clock=time.monotonic) -> None:
+                 metrics=None, clock=time.monotonic,
+                 reputation: "Optional[ReputationTable]" = None,
+                 trust_failure_rate: float = DEFAULT_TRUST_FAILURE_RATE,
+                 ) -> None:
         self.window_s = float(window_s)
         self.max_share = float(max_share)
         self.min_quota = max(1, int(min_quota))
         self.capacity = max(1, int(capacity))
         self.metrics = metrics
         self.clock = clock
+        self.reputation = reputation
+        self.trust_failure_rate = float(trust_failure_rate)
         self._lock = threading.Lock()
         #: origin -> list[(t, items)] (window entries, oldest first)
         self._windows: "dict[str, list]" = {}
@@ -453,13 +527,27 @@ class AdmissionController:
         origin = str(origin)
         items = max(1, int(items))
         now = self.clock()
+        clamped = True
+        if self.reputation is not None:
+            rate = self.reputation.failure_rate(origin)
+            if rate is not None and rate <= self.trust_failure_rate:
+                # proven honest over enough jobs: no share clamp. The
+                # submission still lands in the window so OTHER origins'
+                # fair shares stay computed against true load.
+                clamped = False
+        else:
+            rate = None
         with self._lock:
             self._prune(now)
             quota = max(
                 self.min_quota, int(self.max_share * self._global_total)
             )
+            if rate is not None and rate > self.trust_failure_rate:
+                # distrusted: quota shrinks toward the floor as the
+                # attributed failure rate climbs
+                quota = max(self.min_quota, int(quota * (1.0 - rate)))
             used = self._totals.get(origin, 0)
-            if used + items > quota:
+            if clamped and used + items > quota:
                 rejected = True
             else:
                 rejected = False
@@ -506,4 +594,6 @@ __all__ = [
     "DEFAULT_WINDOW_S",
     "DEFAULT_MAX_SHARE",
     "DEFAULT_MIN_QUOTA",
+    "DEFAULT_TRUST_FAILURE_RATE",
+    "TRUST_MIN_OBSERVED",
 ]

@@ -71,7 +71,12 @@ class InProcessNode:
         #: node (runtime/isolation.py): the scheduler quarantines by it,
         #: the gossip plane (p2p/network.py `admission=`) sheds by it
         self.reputation = ReputationTable()
-        self.admission = AdmissionController(metrics=metrics)
+        # admission keys quotas off per-origin FAILURE RATES from the
+        # shared reputation table (not raw submission share): a busy
+        # honest aggregator is never clamped, a high-failure origin is
+        self.admission = AdmissionController(
+            metrics=metrics, reputation=self.reputation
+        )
         self.verify_scheduler = None
         if use_verify_scheduler:
             from grandine_tpu.runtime.verify_scheduler import VerifyScheduler

@@ -248,12 +248,26 @@ class VerifyScheduler:
         mesh=None,
         reputation: "Optional[_isolation.ReputationTable]" = None,
         use_isolation: bool = True,
+        merge_window_s: float = 0.0,
+        merge_max_items: int = 128,
     ) -> None:
         from grandine_tpu.tpu.mesh import mesh_or_none
 
         self.metrics = metrics
         self.tracer = tracer or NULL_TRACER
         self.use_device = use_device
+        #: cross-lane batch merging: when > 0, a due lane's flush also
+        #: collects other lanes whose head deadline falls within the
+        #: window, collapsing them into ONE RLC dispatch (one Miller
+        #: loop, one final exp) with per-lane verdict slices and
+        #: per-lane flight records. 0 disables (per-lane batches only).
+        #: The quarantine lane never merges — either side — so forgeries
+        #: cannot share a batch (nor a localization descent) with
+        #: honest traffic.
+        self.merge_window_s = float(merge_window_s)
+        #: cap on a merged dispatch's total items, keeping merged
+        #: batches inside the pow-2 buckets the warmup manifest compiled
+        self.merge_max_items = int(merge_max_items)
         #: injected VerifyMesh (tpu/mesh.py) threaded into every per-lane
         #: backend; None / 1-device collapses to the single-chip plane
         self.mesh = mesh_or_none(mesh)
@@ -313,7 +327,7 @@ class VerifyScheduler:
                 "submitted": 0, "batches": 0, "accepted": 0,
                 "rejected": 0, "shed": 0, "device_faults": 0,
                 "breaker_skips": 0, "retries": 0,
-                "max_batch_items": 0,
+                "max_batch_items": 0, "merged": 0,
             }
             for n in self.lanes
         }
@@ -350,6 +364,10 @@ class VerifyScheduler:
         localization descent) with honest traffic; HIGH lanes are never
         rerouted — block import correctness beats isolation."""
         lane = self.lanes[lane_name]
+        # feed the failure-rate denominator: admission quotas trust an
+        # origin by its attributed-failure RATE, which needs the
+        # submission count alongside _deliver's failure count
+        self.reputation.note_submitted(origin)
         if (
             origin is not None and lane.shed
             and lane_name != "quarantine" and "quarantine" in self.lanes
@@ -431,23 +449,56 @@ class VerifyScheduler:
             return None
         return max(soonest, 0.0)
 
-    def _pop_batch(self, lane: LaneConfig) -> "list[_Job]":
+    def _pop_batch(self, lane: LaneConfig, cap: "Optional[int]" = None,
+                   allow_oversize: bool = True) -> "list[_Job]":
         q = self._queues[lane.name]
         jobs, n_items = [], 0
+        limit = lane.max_batch if cap is None else min(lane.max_batch, cap)
         # peek before popping: taking a job that would push the batch
         # past max_batch overflows into the NEXT pow-2 device bucket —
         # a shape outside the warmed manifest, i.e. a mid-slot XLA
         # recompile. An oversized single job still goes alone (the
-        # backend chunks it).
-        while q and n_items + len(q[0].items) <= lane.max_batch:
+        # backend chunks it) — except under a merge cap, where it stays
+        # queued for its own flush instead.
+        while q and n_items + len(q[0].items) <= limit:
             jobs.append(q.popleft())
             n_items += len(jobs[-1].items)
-        if q and not jobs:
+        if q and not jobs and allow_oversize:
             jobs.append(q.popleft())
             n_items += len(jobs[-1].items)
         self._item_counts[lane.name] -= n_items
         self._set_depth(lane.name)
         return jobs
+
+    def _collect_merge(self, primary: LaneConfig, n_primary: int,
+                       now: float) -> "list[tuple]":
+        """Cross-lane batch merging (runs under _cond, dispatcher thread
+        only): other non-quarantine lanes whose OLDEST job's deadline
+        falls inside the merge window join the primary lane's dispatch —
+        their Miller loops and the shared final exponentiation ride one
+        device pass instead of flushing separately moments later.
+        Returns [(lane, jobs), ...]; per-lane verdict slices and flight
+        records are preserved downstream (_deliver_segments)."""
+        merged: "list[tuple]" = []
+        if self.merge_window_s <= 0 or primary.name == "quarantine":
+            return merged
+        room = self.merge_max_items - n_primary
+        for name, lane in self.lanes.items():
+            if room <= 0:
+                break
+            if name == primary.name or name == "quarantine":
+                continue
+            q = self._queues[name]
+            if not q:
+                continue
+            deadline = q[0].ticket.enqueued_at + lane.max_wait_s
+            if deadline > now + self.merge_window_s:
+                continue
+            jobs = self._pop_batch(lane, cap=room, allow_oversize=False)
+            if jobs:
+                merged.append((lane, jobs))
+                room -= sum(len(j.items) for j in jobs)
+        return merged
 
     def _dispatch_loop(self) -> None:
         """Runs ONLY on the dispatcher thread: owns lane queues (under
@@ -457,6 +508,7 @@ class VerifyScheduler:
             # dispatcher — resolve its tickets dropped, account the
             # failure, keep scheduling (thread-crash-containment rule)
             jobs: "list[_Job]" = []
+            merged: "list[tuple]" = []
             try:
                 with self._cond:
                     while not self._stop:
@@ -482,6 +534,12 @@ class VerifyScheduler:
                         to_drop = None
                         lane = self.lanes[name]
                         jobs = self._pop_batch(lane)
+                        if jobs:
+                            merged = self._collect_merge(
+                                lane,
+                                sum(len(j.items) for j in jobs),
+                                time.monotonic(),
+                            )
                         # wake HIGH-lane submitters blocked on a full
                         # queue
                         self._cond.notify_all()
@@ -499,10 +557,12 @@ class VerifyScheduler:
                         self._cond.notify_all()
                     return
                 if jobs:
-                    self._flush(lane, jobs)
+                    self._flush(lane, jobs, merged)
             except Exception:
                 self._count_daemon_failure("verify-scheduler")
-                self._abandon_jobs(jobs)
+                self._abandon_jobs(
+                    jobs + [j for _, mjobs in merged for j in mjobs]
+                )
 
     def _abandon_jobs(self, jobs: "list[_Job]") -> None:
         """Containment cleanup: resolve a failed batch's unsettled
@@ -604,28 +664,47 @@ class VerifyScheduler:
             if fl is not None:
                 fl.note_device(time.perf_counter() - t0)
 
-    def _flush(self, lane: LaneConfig, jobs: "list[_Job]") -> None:
-        items = [it for j in jobs for it in j.items]
+    def _flush(self, lane: LaneConfig, jobs: "list[_Job]",
+               merged: "list[tuple]" = ()) -> None:
         now = time.monotonic()
-        if self.metrics is not None:
-            waits = self.metrics.verify_lane_wait_seconds.labels(lane.name)
-            for j in jobs:
-                waits.observe(now - j.ticket.enqueued_at)
+        # segments: the primary lane's batch first, then any merged
+        # lanes' batches. Each keeps its own flight record so per-lane
+        # SLO/failure attribution survives the shared device pass.
+        segments = []
+        for seg_lane, seg_jobs in [(lane, jobs)] + list(merged):
+            seg_items = [it for j in seg_jobs for it in j.items]
+            if self.metrics is not None:
+                waits = self.metrics.verify_lane_wait_seconds.labels(
+                    seg_lane.name
+                )
+                for j in seg_jobs:
+                    waits.observe(now - j.ticket.enqueued_at)
+            with self._stats_lock:
+                st = self.stats[seg_lane.name]
+                st["batches"] += 1
+                st["max_batch_items"] = max(
+                    st["max_batch_items"], len(seg_items)
+                )
+                if merged:
+                    st["merged"] += 1
+            # jobs pop FIFO, so jobs[0] is the oldest: its wait is the
+            # batch's queue_wait component for SLO attribution
+            seg_fl = self.flight.begin_batch(
+                seg_lane.name, "", len(seg_items),
+                queue_wait_s=now - seg_jobs[0].ticket.enqueued_at,
+                breaker_state=self.health.state if self.use_device else "",
+                devices=(
+                    self.mesh.device_count if self.mesh is not None else 1
+                ),
+                quarantined=(seg_lane.name == "quarantine"),
+            )
+            if seg_lane.name == "quarantine" and self.metrics is not None:
+                self.metrics.verify_quarantine_batches.inc()
+            segments.append((seg_lane, seg_jobs, seg_items, seg_fl))
+        items = [it for _, _, seg_items, _ in segments for it in seg_items]
+        fl = segments[0][3]
         with self._stats_lock:
             st = self.stats[lane.name]
-            st["batches"] += 1
-            st["max_batch_items"] = max(st["max_batch_items"], len(items))
-        # jobs pop FIFO, so jobs[0] is the oldest: its wait is the
-        # batch's queue_wait component for SLO attribution
-        fl = self.flight.begin_batch(
-            lane.name, "", len(items),
-            queue_wait_s=now - jobs[0].ticket.enqueued_at,
-            breaker_state=self.health.state if self.use_device else "",
-            devices=self.mesh.device_count if self.mesh is not None else 1,
-            quarantined=(lane.name == "quarantine"),
-        )
-        if lane.name == "quarantine" and self.metrics is not None:
-            self.metrics.verify_quarantine_batches.inc()
         settle = None
         device_allowed = False
         with self.tracer.span(
@@ -657,28 +736,39 @@ class VerifyScheduler:
                 # graceful degradation: breaker-open, no device/async
                 # seam, or a faulted dispatch → the eager host path
                 if self.use_device:
-                    self._count_batch(
-                        lane,
-                        "degraded" if device_allowed else "breaker_open",
-                    )
+                    for seg_lane, _, _, _ in segments:
+                        self._count_batch(
+                            seg_lane,
+                            "degraded" if device_allowed else "breaker_open",
+                        )
                 t0 = time.perf_counter()
                 verdicts = self._host_check_all(lane, items)
                 fl.note_host(time.perf_counter() - t0)
                 if not self.use_device:
-                    self._count_batch(
-                        lane, "ok" if all(verdicts) else "invalid"
-                    )
-                self._deliver(lane, jobs, verdicts)
-                fl.finish(all(verdicts))
+                    i = 0
+                    for seg_lane, _, seg_items, _ in segments:
+                        seg_v = verdicts[i:i + len(seg_items)]
+                        i += len(seg_items)
+                        self._count_batch(
+                            seg_lane, "ok" if all(seg_v) else "invalid"
+                        )
+                self._deliver_segments(segments, verdicts)
                 return
             ctx = self.tracer.capture()
-        fl.record.kernel = "fast_aggregate"
+        backend = self._backend_for(lane)
+        kernel = (
+            "fast_aggregate_fused"
+            if getattr(backend, "fuse_subgroup", False)
+            else "fast_aggregate"
+        )
+        for _, _, _, seg_fl in segments:
+            seg_fl.record.kernel = kernel
         # two-deep pipelined handoff (backpressure bounds device
         # residency); the slot is released on the settle thread in
         # _complete's finally, so a `with` cannot express it
         self._sem.acquire()  # lint: disable=thread-affinity
         self.flight.device_enter()
-        self._completion.put((lane, jobs, items, settle, ctx, fl))
+        self._completion.put((lane, segments, items, settle, ctx, fl))
 
     def _device_dispatch(self, lane: LaneConfig, items):
         """Host prep + async device dispatch of one coalesced batch;
@@ -717,7 +807,13 @@ class VerifyScheduler:
         except SignatureInvalid:
             # a keyless/malformed item: fail the batch, bisection isolates
             return lambda: False
-        sub_settle = backend.g2_subgroup_check_batch_async(points)
+        # fused backends fold the ψ-ladder membership check into the
+        # verify kernel (one dispatch per batch); two-pass backends stack
+        # the subgroup ladder ahead of the verify dispatch
+        fused = getattr(backend, "fuse_subgroup", False)
+        sub_settle = (
+            None if fused else backend.g2_subgroup_check_batch_async(points)
+        )
         sigs = [A.Signature(p) for p in points]
         if self.metrics is not None:
             self.metrics.device_batch_sigs.inc(len(sigs))
@@ -737,7 +833,7 @@ class VerifyScheduler:
             ))
 
         def settle() -> bool:
-            if not bool(sub_settle().all()):
+            if sub_settle is not None and not bool(sub_settle().all()):
                 return False
             return all(bool(s()) for s in settles)
 
@@ -775,21 +871,23 @@ class VerifyScheduler:
             entry = self._completion.get()
             if entry is None:
                 return
-            lane, jobs, items, settle, ctx, fl = entry
+            lane, segments, items, settle, ctx, fl = entry
             try:
                 with self.tracer.attach(ctx):
-                    self._settle_batch(lane, jobs, items, settle, fl)
+                    self._settle_batch(lane, segments, items, settle, fl)
             except Exception:
                 # the settle thread must survive anything; no ticket may
                 # hang — degrade the whole batch to the host path
                 try:
-                    self._deliver(
-                        lane, jobs, self._host_check_all(lane, items)
+                    self._deliver_segments(
+                        segments, self._host_check_all(lane, items)
                     )
                 except Exception:
-                    for j in jobs:
-                        j.ticket._resolve(False, dropped=True)
-                fl.finish(None)
+                    for _, seg_jobs, _, _ in segments:
+                        for j in seg_jobs:
+                            j.ticket._resolve(False, dropped=True)
+                for _, _, _, seg_fl in segments:
+                    seg_fl.finish(None)
             finally:
                 self.flight.device_exit()
                 self._sem.release()
@@ -822,7 +920,8 @@ class VerifyScheduler:
                 self.stats[lane.name]["device_faults"] += 1
         return outcome
 
-    def _settle_batch(self, lane, jobs, items, settle, fl=None) -> None:
+    def _settle_batch(self, lane, segments, items, settle,
+                      fl=None) -> None:
         if fl is None:
             fl = self.flight.begin_batch(lane.name, "", len(items))
         outcome = self._guarded_settle(lane, settle, fl)
@@ -835,17 +934,17 @@ class VerifyScheduler:
                 outcome = self._guarded_settle(lane, retry, fl,
                                                count_stats=False)
         if outcome.status != _health.OK:
-            self._count_batch(lane, "degraded")
+            for seg_lane, _, _, _ in segments:
+                self._count_batch(seg_lane, "degraded")
             t0 = time.perf_counter()
             verdicts = self._host_check_all(lane, items)
             fl.note_host(time.perf_counter() - t0)
-            self._deliver(lane, jobs, verdicts)
-            fl.finish(all(verdicts))
+            self._deliver_segments(segments, verdicts)
             return
         if bool(outcome.value):
-            self._count_batch(lane, "ok")
-            self._deliver(lane, jobs, [True] * len(items))
-            fl.finish(True)
+            for seg_lane, _, _, _ in segments:
+                self._count_batch(seg_lane, "ok")
+            self._deliver_segments(segments, [True] * len(items))
             return
         with self._stage(lane, "fallback", items=len(items)):
             # the bisection shares ONE watchdog budget so a failed
@@ -860,9 +959,12 @@ class VerifyScheduler:
             # catch at re-promotion time
             self.health.record_fault("verdict")
             fl.note_fault("verdict")
-        self._count_batch(lane, "ok" if all(verdicts) else "invalid")
-        self._deliver(lane, jobs, verdicts)
-        fl.finish(all(verdicts))
+        i = 0
+        for seg_lane, _, seg_items, _ in segments:
+            seg_v = verdicts[i:i + len(seg_items)]
+            i += len(seg_items)
+            self._count_batch(seg_lane, "ok" if all(seg_v) else "invalid")
+        self._deliver_segments(segments, verdicts)
 
     def _isolate(self, lane: LaneConfig, items,
                  deadline: "Optional[float]" = None,
@@ -940,6 +1042,18 @@ class VerifyScheduler:
     def _host_check_all(self, lane: LaneConfig, items) -> "list[bool]":
         with self._stage(lane, "execute", path="host", items=len(items)):
             return [host_check_item(it) for it in items]
+
+    def _deliver_segments(self, segments, verdicts) -> None:
+        """Slice one merged dispatch's verdict vector back into its
+        per-lane segments: each lane's jobs settle against its own
+        slice and its own flight record — attribution is never blurred
+        by the shared device pass."""
+        i = 0
+        for seg_lane, seg_jobs, seg_items, seg_fl in segments:
+            seg_v = verdicts[i:i + len(seg_items)]
+            i += len(seg_items)
+            self._deliver(seg_lane, seg_jobs, seg_v)
+            seg_fl.finish(all(seg_v))
 
     def _deliver(self, lane: LaneConfig, jobs, verdicts) -> None:
         i = 0

@@ -160,6 +160,11 @@ def warm_all(
 
     if enable_cache:
         enable_persistent_cache()
+    # pre-load the MSM autotune table (tools/shapes/msm_tune.json) so the
+    # window widths baked into the warmed plans are the MEASURED ones —
+    # a table loaded after warmup would re-plan, and re-compile, mid-slot
+    if B.load_msm_tuning() and progress:
+        progress("msm autotune table loaded (%s)" % B.msm_tune_path())
     mesh_backend = (
         backend if getattr(backend, "mesh", None) is not None else None
     )

@@ -402,6 +402,100 @@ def test_admission_capacity_churn_cannot_evict_heavy_hitters():
     assert not adm.admit("peer:tracked", 2)
 
 
+# ---------------------------------- reputation-fed admission quotas
+
+
+def test_reputation_failure_rate_needs_observations():
+    """Below TRUST_MIN_OBSERVED submitted jobs the rate is None — an
+    origin must EARN trust (and distrust) with volume, so a burst of
+    two clean jobs cannot unlock an unclamped firehose."""
+    rep = iso.ReputationTable()
+    rep.note_submitted("peer:new", jobs=iso.TRUST_MIN_OBSERVED - 1)
+    assert rep.failure_rate("peer:new") is None
+    rep.note_submitted("peer:new")
+    assert rep.failure_rate("peer:new") == 0.0
+    assert rep.failure_rate(None) is None
+    assert rep.failure_rate("peer:never-seen") is None
+
+
+def test_reputation_failure_rate_tracking():
+    rep = iso.ReputationTable()
+    rep.note_submitted("peer:mixed", jobs=90)
+    for _ in range(10):
+        rep.note_failure("peer:mixed")
+    # note_failure counts toward failures only; denominator is submitted
+    assert abs(rep.failure_rate("peer:mixed") - 10 / 90) < 1e-9
+
+
+def test_admission_honest_high_rate_aggregator_not_clamped():
+    """The ISSUE's scenario: a high-rate HONEST aggregator (big share of
+    traffic, near-zero failures) must never be clamped by raw share —
+    with reputation wired its clean record bypasses the share quota,
+    while the same traffic without reputation is rejected."""
+    rep = iso.ReputationTable()
+    rep.note_submitted("peer:agg", jobs=1000)  # long clean history
+    t, clock = _fake_clock()
+    adm = iso.AdmissionController(
+        window_s=10.0, max_share=0.25, min_quota=4, clock=clock,
+        reputation=rep,
+    )
+    t2, clock2 = _fake_clock()
+    plain = iso.AdmissionController(
+        window_s=10.0, max_share=0.25, min_quota=4, clock=clock2,
+    )
+    # grow the global window so share quotas bind
+    for i in range(8):
+        adm.admit(f"peer:bg-{i}", 4)
+        plain.admit(f"peer:bg-{i}", 4)
+    for _ in range(6):  # way past 25% share
+        assert adm.admit("peer:agg", 4)
+        rep.note_submitted("peer:agg", jobs=4)
+    assert not all(plain.admit("peer:agg", 4) for _ in range(6))
+
+
+def test_admission_high_failure_origin_clamped_toward_floor():
+    """A high-failure origin's quota scales DOWN by its failure rate:
+    distrust earns a tighter clamp than raw share alone."""
+    rep = iso.ReputationTable()
+    rep.note_submitted("peer:bad", jobs=100)
+    for _ in range(80):
+        rep.note_failure("peer:bad")
+    assert rep.failure_rate("peer:bad") == 0.8
+    t, clock = _fake_clock()
+    adm = iso.AdmissionController(
+        window_s=10.0, max_share=0.5, min_quota=8, clock=clock,
+        reputation=rep,
+    )
+    for i in range(10):
+        assert adm.admit(f"peer:bg-{i}", 8)  # global window = 80
+    # plain share quota would be ~40; 80% failures scale it to the floor
+    got = 0
+    for _ in range(40):
+        if adm.admit("peer:bad", 1):
+            got += 1
+    assert got <= 8
+    # an untracked origin at the same rate keeps the plain share quota
+    got_plain = sum(1 for _ in range(40) if adm.admit("peer:plain", 1))
+    assert got_plain > got
+
+
+def test_reputation_traffic_counters_halve():
+    """Rolling halving keeps the rate an EWMA-ish recent-window figure:
+    an origin that stops failing recovers, instead of dragging a
+    lifetime tally forever."""
+    rep = iso.ReputationTable()
+    rep.note_submitted("peer:x", jobs=iso._TRAFFIC_HALF_AT - 1)
+    for _ in range(100):
+        rep.note_failure("peer:x")
+    before = rep.failure_rate("peer:x")
+    rep.note_submitted("peer:x")  # crosses the halving threshold
+    after = rep.failure_rate("peer:x")
+    assert after is not None and abs(after - before) < 0.01  # rate kept
+    # clean traffic now decays the rate twice as fast as pre-halving
+    rep.note_submitted("peer:x", jobs=iso._TRAFFIC_HALF_AT // 2)
+    assert rep.failure_rate("peer:x") < after / 1.5
+
+
 # ------------------------------------------- scheduler integration
 
 

@@ -280,6 +280,90 @@ class Network:
 """, "no-inline-gossip-verify") == 1
 
 
+_DONATE_FIXTURE = """
+class Backend:
+    def dispatch(self, sig_x, sig_y):
+        fn = self._jitted("k", _body, donate=(0, 1))
+        args = self._upload((sig_x, sig_y))
+        out = self._run_kernel(fn, args, kernel="k")
+
+        def settle():
+            return out() and %s
+        return settle
+"""
+
+
+def test_donated_buffer_reuse_flags_settle_read(tmp_path):
+    """Reading a donated operand inside the settle closure — the exact
+    bug class: the closure runs after XLA owns (and deleted) the
+    buffer."""
+    assert lint(
+        tmp_path, _DONATE_FIXTURE % "sig_x.sum() > 0",
+        "donated-buffer-reuse",
+    ) == 1
+
+
+def test_donated_buffer_reuse_allows_output_reads(tmp_path):
+    assert lint(
+        tmp_path, _DONATE_FIXTURE % "True", "donated-buffer-reuse"
+    ) == 0
+
+
+def test_donated_buffer_reuse_flags_args_var_too(tmp_path):
+    """The upload-result tuple itself is donated memory: re-dispatching
+    it is as fatal as touching an element."""
+    assert lint(tmp_path, """
+class Backend:
+    def dispatch(self, sig_x):
+        fn = self._jitted("k", _body, donate=(0,))
+        args = self._upload((sig_x,))
+        out = self._run_kernel(fn, args)
+        return self._run_kernel(fn, args), out
+""", "donated-buffer-reuse") == 1
+
+
+def test_donated_buffer_reuse_rebind_ends_lifetime(tmp_path):
+    assert lint(tmp_path, """
+class Backend:
+    def dispatch(self, sig_x):
+        fn = self._jitted("k", _body, donate=(0,))
+        args = self._upload((sig_x,))
+        out = self._run_kernel(fn, args)
+        sig_x = out()
+        return sig_x + 1
+""", "donated-buffer-reuse") == 0
+
+
+def test_donated_buffer_reuse_ignores_undonated_kernels(tmp_path):
+    assert lint(tmp_path, """
+class Backend:
+    def dispatch(self, sig_x):
+        fn = self._jitted("k", _body, donate=())
+        args = self._upload((sig_x,))
+        out = self._run_kernel(fn, args)
+        return out() and sig_x.sum() > 0
+""", "donated-buffer-reuse") == 0
+
+
+def test_donated_buffer_reuse_is_flow_sensitive(tmp_path):
+    """An early UNDONATED dispatch through a variable name that is
+    LATER rebound to a donated factory must not be treated as donated
+    (the bls.py sharded-branch pattern): operand reads between the two
+    dispatches are legal."""
+    assert lint(tmp_path, """
+class Backend:
+    def dispatch(self, sig_x, use_sharded):
+        if use_sharded:
+            fn = self._jitted("s", _body, donate=())
+            args = self._upload_sharded((sig_x,))
+            return self._run_kernel(fn, args)
+        fn = self._jitted("k", _body, donate=(0,))
+        args = self._upload((sig_x,))
+        out = self._run_kernel(fn, args)
+        return out()
+""", "donated-buffer-reuse") == 0
+
+
 def test_thread_crash_containment_flags_uncontained_loop(tmp_path):
     assert lint(tmp_path, """
 import threading

@@ -5,6 +5,7 @@ from __future__ import annotations
 
 
 def all_rules():
+    from tools.lint.rules.donated_buffer_reuse import DonatedBufferReuseRule
     from tools.lint.rules.drop_counter_reuse import DropCounterReuseRule
     from tools.lint.rules.host_sync import HostSyncRule
     from tools.lint.rules.jit_purity import JitPurityRule
@@ -23,6 +24,7 @@ def all_rules():
 
     return [
         NoInlineGossipVerifyRule(),
+        DonatedBufferReuseRule(),
         DropCounterReuseRule(),
         HostSyncRule(),
         LockOrderRule(),

@@ -1,0 +1,223 @@
+"""Rule: no reuse of donated device buffers after dispatch.
+
+`jax.jit(..., donate_argnums=...)` hands the operand's device memory to
+XLA for in-place reuse: after the dispatch call the donated buffer is
+DELETED, and touching it again raises (TPU) or silently reads garbage
+through a stale host mirror (some backends). The verify plane donates
+every per-batch operand of its pipelined kernels, so the async seams
+must never read an uploaded operand — nor the upload-result tuple —
+once `_run_kernel` has taken it.
+
+Mechanics: inside any function that builds a jitted kernel with a
+non-empty `donate=` (via the `_jitted` / `_jitted_msm` / `_jitted_global`
+factories), the operands are the elements of the tuple passed to
+`self._upload(...)` / `self._upload_sharded(...)` whose result variable
+feeds the dispatch (`_run_kernel`). Registry operands prepended at the
+dispatch site (`(reg_x, reg_y, *args)` with `skip=2`) are NOT part of
+the upload tuple and so are naturally exempt — they outlive the batch
+by design.
+
+Any Name load of a donated operand (or the args variable itself) after
+the dispatch call is flagged — INCLUDING loads inside nested settle
+closures and lambdas, which run after the kernel owns the memory. A
+re-assignment of the name after dispatch ends its donated lifetime
+(the old buffer is unreachable; the new binding is a fresh object).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint.core import Context, Finding, Rule, walk_functions
+
+#: jit-factory call names (attribute or bare) whose `donate=` kwarg
+#: marks the produced kernel's operands as donated
+FACTORY_NAMES = {"_jitted", "_jitted_msm", "_jitted_global"}
+#: upload call names whose tuple argument is the per-batch operand set
+UPLOAD_NAMES = {"_upload", "_upload_sharded"}
+#: the dispatch call consuming the uploaded operands
+DISPATCH_NAMES = {"_run_kernel"}
+
+
+def _call_name(call: ast.Call) -> "str | None":
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return None
+
+
+def _is_empty_donate(node: ast.AST) -> bool:
+    """donate=() or donate=[] — explicit no-donation."""
+    return isinstance(node, (ast.Tuple, ast.List)) and not node.elts
+
+
+def _names_loaded(node: ast.AST, names: "set[str]"):
+    for sub in ast.walk(node):
+        if (
+            isinstance(sub, ast.Name)
+            and isinstance(sub.ctx, ast.Load)
+            and sub.id in names
+        ):
+            yield sub
+
+
+class DonatedBufferReuseRule(Rule):
+    name = "donated-buffer-reuse"
+    description = (
+        "no read of a donate_argnums operand (upload tuple element or "
+        "the uploaded args variable) after the dispatch call — donated "
+        "device buffers are deleted by XLA at dispatch"
+    )
+    default_paths = (
+        "grandine_tpu/tpu/bls.py",
+        "grandine_tpu/tpu/mesh.py",
+        "grandine_tpu/tpu/registry.py",
+        "grandine_tpu/runtime/attestation_verifier.py",
+        "grandine_tpu/runtime/verify_scheduler.py",
+    )
+
+    def check(self, ctx: Context, files):
+        out: "list[Finding]" = []
+        for path in files:
+            tree = ctx.tree(path)
+            if tree is None:
+                continue
+            seen: "set[int]" = set()
+            for cls, fn in walk_functions(tree):
+                if id(fn) in seen:
+                    continue
+                # claim nested defs so they are analyzed exactly once,
+                # as part of their enclosing dispatch function
+                for sub in ast.walk(fn):
+                    if sub is not fn and isinstance(
+                        sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        seen.add(id(sub))
+                where = f"{cls}.{fn.name}" if cls else fn.name
+                out.extend(self._check_fn(path, where, fn))
+        return out
+
+    def _check_fn(self, path: str, where: str, fn) -> "list[Finding]":
+        # flow-sensitive bindings, by line: a dispatch call binds to the
+        # LATEST preceding assignment of each variable it references (a
+        # function may rebuild fn/args per branch — the sharded branch's
+        # undonated kernel must not taint the donated branch below it)
+        factory_binds: "dict[str, list]" = {}  # var -> [(line, donated)]
+        upload_binds: "dict[str, list]" = {}   # var -> [(line, operands)]
+        stmts = list(ast.walk(fn))
+        for node in stmts:
+            if not isinstance(node, ast.Assign) or not isinstance(
+                node.value, ast.Call
+            ):
+                continue
+            call = node.value
+            cname = _call_name(call)
+            targets = [
+                t.id for t in node.targets if isinstance(t, ast.Name)
+            ]
+            if not targets:
+                continue
+            if cname in FACTORY_NAMES:
+                donated = any(
+                    kw.arg == "donate" and not _is_empty_donate(kw.value)
+                    for kw in call.keywords
+                )
+                for t in targets:
+                    factory_binds.setdefault(t, []).append(
+                        (node.lineno, donated)
+                    )
+            elif cname in UPLOAD_NAMES and call.args:
+                operands: "set[str]" = set()
+                first = call.args[0]
+                if isinstance(first, (ast.Tuple, ast.List)):
+                    for el in first.elts:
+                        if isinstance(el, ast.Name):
+                            operands.add(el.id)
+                for t in targets:
+                    upload_binds.setdefault(t, []).append(
+                        (node.lineno, operands)
+                    )
+            else:
+                # any other rebinding shadows earlier factory/upload
+                # bindings of the same name
+                for t in targets:
+                    if t in factory_binds:
+                        factory_binds[t].append((node.lineno, False))
+                    if t in upload_binds:
+                        upload_binds[t].append((node.lineno, set()))
+        if not any(d for binds in factory_binds.values()
+                   for _, d in binds):
+            return []
+
+        def latest(binds, line):
+            best = None
+            for ln, payload in binds:
+                if ln < line and (best is None or ln > best[0]):
+                    best = (ln, payload)
+            return None if best is None else best[1]
+
+        findings: "list[Finding]" = []
+        for node in stmts:
+            if not isinstance(node, ast.Call):
+                continue
+            if _call_name(node) not in DISPATCH_NAMES:
+                continue
+            # the dispatch must take a kernel whose LIVE binding donated
+            takes_donated = any(
+                isinstance(a, ast.Name)
+                and latest(factory_binds.get(a.id, []), node.lineno)
+                for a in node.args
+            )
+            if not takes_donated:
+                continue
+            # operand names: every uploaded args var the dispatch
+            # references (directly or via star-unpack) plus its tuple
+            # elements — all donated memory after this call
+            donated_names: "set[str]" = set()
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Name) and sub.id in upload_binds:
+                    operands = latest(
+                        upload_binds[sub.id], node.lineno + 1
+                    )
+                    if operands is not None:
+                        donated_names.add(sub.id)
+                        donated_names.update(operands)
+            if not donated_names:
+                continue
+            end = getattr(node, "end_lineno", node.lineno)
+            findings.extend(
+                self._reuse_after(path, where, fn, donated_names, end)
+            )
+        return findings
+
+    def _reuse_after(self, path, where, fn, names: "set[str]",
+                     dispatch_end: int) -> "list[Finding]":
+        # a post-dispatch re-assignment ends the donated lifetime
+        rebound_at: "dict[str, int]" = {}
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Name) and isinstance(
+                sub.ctx, (ast.Store, ast.Del)
+            ) and sub.id in names and sub.lineno > dispatch_end:
+                rebound_at[sub.id] = min(
+                    rebound_at.get(sub.id, sub.lineno), sub.lineno
+                )
+        out = []
+        flagged: "set[str]" = set()
+        for load in _names_loaded(fn, names):
+            if load.lineno <= dispatch_end:
+                continue
+            if load.lineno >= rebound_at.get(load.id, 1 << 60):
+                continue
+            if load.id in flagged:
+                continue
+            flagged.add(load.id)
+            out.append(Finding(
+                self.name, path, load.lineno,
+                f"{where} reads donated operand {load.id!r} after "
+                f"dispatch — the buffer is deleted at dispatch; read "
+                f"kernel OUTPUTS in the settle closure instead",
+                key=f"{self.name}:{path}:{where}:{load.id}",
+            ))
+        return out

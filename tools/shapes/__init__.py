@@ -81,6 +81,10 @@ _FACTORY_JIT_PARTIAL = {"_jitted_msm"}
 #: the implementation of registration, not a second entry point
 _FACTORY_IMPLS = _FACTORY_JIT | _FACTORY_JIT_PARTIAL
 
+#: factory keywords that are jit OPTIONS, not kernel statics — `donate`
+#: picks donate_argnums and never changes the traced shape universe
+_NON_STATIC_KW = {"donate"}
+
 #: pow-2 padders: assignment from one of these proves the name bucketed.
 #: value = default bucket floor when no explicit `lo` is passed.
 _BUCKET_FNS = {"_bucket": 4, "_next_pow2": 16}
@@ -467,7 +471,9 @@ def _collect_entries(scan: _FileScan, findings: "list[Finding]"):
                     and dotted(dec.args[0]) in _JIT_NAMES
                 ):
                     static = tuple(sorted(
-                        kw.arg for kw in dec.keywords if kw.arg is not None
+                        kw.arg for kw in dec.keywords
+                        if kw.arg is not None
+                        and kw.arg not in _NON_STATIC_KW
                     ))
                     entries.append(KernelEntry(
                         kernel=fn.name, qualname=_qual(cls, fn.name),
@@ -498,7 +504,8 @@ def _collect_entries(scan: _FileScan, findings: "list[Finding]"):
                 continue
             kernel = node.args[0].value
             static = tuple(sorted(
-                kw.arg for kw in node.keywords if kw.arg is not None
+                kw.arg for kw in node.keywords
+                if kw.arg is not None and kw.arg not in _NON_STATIC_KW
             ))
             entries.append(KernelEntry(
                 kernel=kernel,

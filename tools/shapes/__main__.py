@@ -39,7 +39,24 @@ def main(argv: "list[str] | None" = None) -> int:
         "--list", action="store_true", dest="list_manifest",
         help="print the derived manifest text and exit",
     )
+    parser.add_argument(
+        "--autotune", action="store_true",
+        help="run the MSM window calibration sweep and persist the "
+             "winning table next to the manifest (msm_tune.json); "
+             "compile-bound — expect minutes per probed shape",
+    )
+    parser.add_argument(
+        "--autotune-repeats", type=int, default=3,
+        help="timing repeats per (shape, window) cell (default 3)",
+    )
     args = parser.parse_args(argv)
+
+    if args.autotune:
+        # imports jax + compiles kernels: only on explicit request
+        from grandine_tpu.tpu.autotune import autotune
+
+        table = autotune(repeats=args.autotune_repeats)
+        return 0 if table else 1
 
     root = args.root or os.path.dirname(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
