@@ -236,7 +236,7 @@ def _node_once(args, cfg) -> int:
     from grandine_tpu.slasher import Slasher
 
     operation_pool = OperationPool(cfg)
-    slasher = Slasher(db)
+    slasher = Slasher(db, metrics=metrics)
     mesh = None
     if getattr(args, "devices", None):
         if not args.use_device:
@@ -252,6 +252,7 @@ def _node_once(args, cfg) -> int:
         metrics=metrics, tracer=tracer,
         mesh=mesh,
         use_isolation=not getattr(args, "no_isolation", False),
+        database=db,
     )
     if getattr(args, "quarantine_exit_clean", None):
         node.reputation.exit_clean = max(1, args.quarantine_exit_clean)
@@ -572,7 +573,16 @@ def cmd_replay(args) -> int:
             cur = custom_state_transition(cur, blk, cfg, verifier)
         n, sigsets, hits = len(blocks), 0, 0
     else:
-        slasher = None if getattr(args, "no_slasher", False) else Slasher()
+        if getattr(args, "no_slasher", False):
+            slasher = None
+        elif args.use_device:
+            # device replay: span updates for the window's solo
+            # validators merge into one grid dispatch per window
+            from grandine_tpu.tpu.spans import SpanPlane
+
+            slasher = Slasher(span_plane=SpanPlane())
+        else:
+            slasher = Slasher()
         pipeline = BulkReplayPipeline(
             cfg, use_device=args.use_device,
             window_size=getattr(args, "window", None) or DEFAULT_WINDOW_BLOCKS,

@@ -645,6 +645,53 @@ class Metrics:
             "replay_pipeline_depth",
             "replay windows in flight (dispatched, not settled)",
         )
+        # slasher span plane (slasher.py): bounded LRU chunk-cache
+        # traffic, batched span-update latency, and attesting indices
+        # folded into the span store — the keep-up numerator the
+        # --mainnet soak gates against the derived attestation arrival
+        # rate. The event label is a closed set.
+        self.slasher_chunk_cache_events = LabeledCounter(
+            "slasher_chunk_cache_events_total",
+            "slasher span-chunk cache lookups and evictions, by event "
+            "(hit/miss/evict)",
+            ("event",),
+        )
+        self.slasher_chunk_cache_size = Gauge(
+            "slasher_chunk_cache_size",
+            "span chunks held in the slasher's bounded LRU cache",
+        )
+        self.slasher_span_update_seconds = Histogram(
+            "slasher_span_update_seconds",
+            "batched slasher span-update duration, per aggregate or "
+            "bulk window",
+            buckets=(
+                0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+            ),
+        )
+        self.slasher_span_indices = Counter(
+            "slasher_span_indices_total",
+            "attesting indices folded into the slasher span store",
+        )
+        # pubkey registry memory accounting (tpu/registry.py): the
+        # mainnet-capacity audit's observables — allocated vs occupied
+        # rows, host-mirror footprint, and device bytes total/per shard
+        self.pubkey_registry_capacity = Gauge(
+            "pubkey_registry_capacity",
+            "allocated pubkey-registry rows (pow-2 device capacity)",
+        )
+        self.pubkey_registry_host_bytes = Gauge(
+            "pubkey_registry_host_bytes",
+            "host-mirror bytes held by the pubkey registry",
+        )
+        self.pubkey_registry_device_bytes = Gauge(
+            "pubkey_registry_device_bytes",
+            "device bytes held by the pubkey registry across all shards",
+        )
+        self.pubkey_registry_shard_bytes = Gauge(
+            "pubkey_registry_shard_bytes",
+            "device bytes per mesh shard in the pubkey registry",
+        )
 
     def collect_system_stats(self, data_dir: "str | None" = None) -> None:
         """Refresh the /proc-sourced gauges (metrics/src/service.rs

@@ -727,6 +727,8 @@ class AttestationVerifier:
             return
         try:
             with self._slasher_lock:
+                # pass 1: evidence-window bookkeeping + normalization
+                batch = []  # (attestation, indices, source, target, root)
                 for attestation, valid in accepted_pairs:
                     data = attestation.data
                     source = int(data.source.epoch)
@@ -754,9 +756,18 @@ class AttestationVerifier:
                     if not any(idx_set <= set(i) for _a, i in entries):
                         entries.append((attestation, indices))
                         del entries[:-4]
-                    hits = self.slasher.on_attestation(
-                        indices, source, target, data_root
+                    batch.append(
+                        (attestation, indices, source, target, data_root)
                     )
+                # pass 2: one bulk slasher call for the whole accepted
+                # batch — span updates merge across aggregates instead
+                # of walking chunks per attesting index
+                hit_lists = self.slasher.on_attestations_bulk(
+                    [(ix, s, t, r) for _a, ix, s, t, r in batch]
+                )
+                for (attestation, indices, _s, _t, _r), hits in zip(
+                    batch, hit_lists
+                ):
                     # a committee-wide equivocation yields one hit per
                     # validator with (usually) shared evidence: skip a
                     # hit only when an ALREADY-BUILT op's index

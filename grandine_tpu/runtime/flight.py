@@ -86,6 +86,10 @@ DEFAULT_SLO_BUDGETS = {
     "quarantine": 5.0,
     "attestation": 4.0,
     "replay": 120.0,
+    # slasher span ingestion: keep-up is throughput-gated (span-update
+    # rate ≥ attestation arrival rate), but any single batch blowing the
+    # gossip window means detections lag the chain
+    "slasher": 4.0,
 }
 DEFAULT_SLO_BUDGET_S = 4.0  # unknown lanes
 

@@ -453,6 +453,69 @@ class ReputationTable:
         with self._lock:
             return len(self._entries)
 
+    # ------------------------------------------------------- persistence
+
+    #: K-V row holding the serialized table (storage.Database seam)
+    DB_KEY = b"rep:table"
+
+    def save(self, db) -> None:
+        """Serialize quarantine + traffic state through the node's K-V
+        store so a reboot does not hand every quarantined origin a clean
+        slate. `last_bad_t` rides as AGE (the clock is time.monotonic,
+        meaningless across processes) and is rebased on load."""
+        import json
+
+        now = self.clock()
+        with self._lock:
+            blob = {
+                "v": 1,
+                "entries": {
+                    o: [e[0], e[1], round(now - e[2], 3)]
+                    for o, e in self._entries.items()
+                },
+                "traffic": {o: list(t) for o, t in self._traffic.items()},
+            }
+        db.put(self.DB_KEY, json.dumps(blob, sort_keys=True).encode())
+
+    def load(self, db) -> int:
+        """Restore state saved by `save`; returns the number of
+        quarantine entries restored (0 on missing/corrupt rows — a fresh
+        table, never a crash at node start). Ages past `decay_s` are
+        dropped on the spot rather than resurrected."""
+        import json
+
+        raw = db.get(self.DB_KEY)
+        if raw is None:
+            return 0
+        try:
+            blob = json.loads(bytes(raw).decode())
+            entries = blob.get("entries", {})
+            traffic = blob.get("traffic", {})
+        except (ValueError, AttributeError):
+            return 0
+        now = self.clock()
+        restored = 0
+        with self._lock:
+            for o, row in entries.items():
+                try:
+                    failures, clean, age = (
+                        int(row[0]), int(row[1]), float(row[2])
+                    )
+                except (TypeError, ValueError, IndexError):
+                    continue
+                if age > self.decay_s or len(self._entries) >= self.capacity:
+                    continue
+                self._entries[str(o)] = [failures, clean, now - age]
+                restored += 1
+            for o, row in traffic.items():
+                if len(self._traffic) >= self.capacity:
+                    break
+                try:
+                    self._traffic[str(o)] = [int(row[0]), int(row[1])]
+                except (TypeError, ValueError, IndexError):
+                    continue
+        return restored
+
 
 class AdmissionController:
     """Sliding-window per-origin fair-share quotas at submit time.

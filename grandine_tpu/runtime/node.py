@@ -38,6 +38,7 @@ class InProcessNode:
         tracer=None,
         mesh=None,
         use_isolation: bool = True,
+        database=None,
     ) -> None:
         from grandine_tpu.consensus.verifier import MultiVerifier
 
@@ -69,8 +70,16 @@ class InProcessNode:
         )
         #: ONE reputation table + admission controller for the whole
         #: node (runtime/isolation.py): the scheduler quarantines by it,
-        #: the gossip plane (p2p/network.py `admission=`) sheds by it
+        #: the gossip plane (p2p/network.py `admission=`) sheds by it.
+        #: Persisted through the node's K-V store (when one is given) so
+        #: an attacker cannot reset quarantine by waiting out a reboot.
+        self.database = database
         self.reputation = ReputationTable()
+        if database is not None:
+            try:
+                self.reputation.load(database)
+            except Exception:
+                pass  # a corrupt reputation row must never stop the node
         # admission keys quotas off per-origin FAILURE RATES from the
         # shared reputation table (not raw submission share): a busy
         # honest aggregator is never clamped, a high-failure origin is
@@ -272,6 +281,11 @@ class InProcessNode:
         return self.controller.snapshot()
 
     def stop(self) -> None:
+        if self.database is not None:
+            try:
+                self.reputation.save(self.database)
+            except Exception:
+                pass  # shutdown persistence is best-effort
         self.attestation_verifier.stop()
         if self.verify_scheduler is not None:
             self.verify_scheduler.stop()

@@ -452,30 +452,45 @@ class BulkReplayPipeline:
         if self.slasher is None or upto == 0 or not window.slasher_feed:
             return
         with self._stage("commit", blocks=upto):
+            # block headers stay per-block (double-proposal checks are a
+            # single K-V probe each); the window's attestations feed the
+            # slasher in ONE bulk call so span updates merge into a
+            # handful of vectorized chunk passes — or one device grid
+            # dispatch — instead of a Python walk per attesting index
+            flat: "list[tuple]" = []   # (slot, att) per attestation
             for proposer, slot, root, atts in window.slasher_feed[:upto]:
                 try:
                     if self.slasher.on_block(proposer, slot, root):
                         self.stats["slasher_hits"] += 1
-                    for indices, source, target, data_root in atts:
-                        hits = self.slasher.on_attestation(
-                            indices, source, target, data_root
-                        )
-                        self.stats["slasher_attestations"] += 1
-                        self.stats["slasher_hits"] += len(hits)
-                        for hit in hits:
-                            rec = self.slasher.record_for(
-                                hit.validator_index, target
-                            )
-                            logger.warning(
-                                "historical %s by validator %d at slot %d"
-                                " (recorded vote: %s)", hit.kind,
-                                hit.validator_index, slot,
-                                rec and (rec[0], rec[1].hex()[:16]),
-                            )
                 except Exception:
                     # surveillance is best-effort: a slasher fault must
                     # not abort an otherwise verified replay
                     self.stats["slasher_errors"] += 1
+                for att in atts:
+                    flat.append((slot, att))
+            if not flat:
+                return
+            try:
+                hit_lists = self.slasher.on_attestations_bulk(
+                    [att for _slot, att in flat]
+                )
+            except Exception:
+                self.stats["slasher_errors"] += 1
+                return
+            for (slot, att), hits in zip(flat, hit_lists):
+                target = att[2]
+                self.stats["slasher_attestations"] += 1
+                self.stats["slasher_hits"] += len(hits)
+                for hit in hits:
+                    rec = self.slasher.record_for(
+                        hit.validator_index, target
+                    )
+                    logger.warning(
+                        "historical %s by validator %d at slot %d"
+                        " (recorded vote: %s)", hit.kind,
+                        hit.validator_index, slot,
+                        rec and (rec[0], rec[1].hex()[:16]),
+                    )
 
 
 class _StageTimer:

@@ -46,7 +46,8 @@ SUBGROUP_BUCKETS = (4, 8, 16, 32, 64, 128)
 #: restart never pays it mid-chain.
 WARM_KINDS = ("aggregate", "aggregate_idx", "multi_verify", "sign",
               "subgroup", "rlc_partition", "sharded_multi_verify",
-              "sharded_multi_verify_msm")
+              "sharded_multi_verify_msm", "span_update",
+              "registry_capacity")
 
 
 def _repo_root() -> str:
@@ -251,6 +252,49 @@ def warm_all(
                     [b"warm-%d" % (i % n_groups) for i in range(b)],
                     [sig] * b,
                     [pk] * b,
+                )
+            elif kind == "span_update":
+                # slasher bulk-replay span grid (tpu/spans.py): buckets
+                # are row widths; the epoch axis is fixed, so one merge
+                # per bucket compiles the whole kernel surface
+                import numpy as np
+
+                from grandine_tpu.tpu import spans as SP
+
+                plane = SP.SpanPlane(metrics=metrics)
+                plane.update(
+                    np.full(
+                        (b, SP.SPAN_GRID_EPOCHS), SP.INT32_UNSET, np.int32
+                    ),
+                    np.zeros((b, SP.SPAN_GRID_EPOCHS), np.int32),
+                    np.full((b,), 8, np.int32),
+                    np.full((b,), 9, np.int32),
+                    0,
+                )
+            elif kind == "registry_capacity":
+                # the registry arrays' row count is part of the indexed
+                # gather kernel's jit signature: one small dispatch
+                # against a zeros shim at mainnet capacity compiles the
+                # 2^20-row gather without holding a million real keys
+                import jax
+                import numpy as np
+
+                from grandine_tpu.tpu import limbs as L
+
+                zx = jax.device_put(np.zeros((b, L.NLIMBS), np.int32))
+                zy = jax.device_put(np.zeros((b, L.NLIMBS), np.int32))
+                cap_rows = b
+
+                class _ShimRegistry:
+                    @staticmethod
+                    def arrays():
+                        return zx, zy, cap_rows
+
+                backend.fast_aggregate_verify_batch_indexed(
+                    [b"warm-%d" % i for i in range(4)],
+                    [sig] * 4,
+                    [[0]] * 4,
+                    _ShimRegistry(),
                 )
         except Exception as e:  # a failed warm is a lost optimization only
             if progress:

@@ -45,6 +45,12 @@ from grandine_tpu.tpu import limbs as L
 #: stable floor avoids recompiling the gather kernels for tiny devnets
 MIN_CAPACITY = 16
 
+#: the mainnet operating point: ≥1M active validators. A manifest bound
+#: and warmup-ladder row (tools/shapes), so the 2^20 gather-kernel
+#: capacity pre-warms like any other contract instead of compiling the
+#: first time a mainnet-sized state walks in.
+MAINNET_CAPACITY = 1 << 20
+
 
 def _next_pow2(n: int, lo: int = MIN_CAPACITY) -> int:
     b = lo
@@ -77,16 +83,21 @@ class DevicePubkeyRegistry:
         #: were built from (identity-compared against head-state columns)
         self._pubkeys: "Optional[tuple]" = None
         self._stale = False
-        #: host rest-format rows (count, NLIMBS) — kept so capacity growth
-        #: re-uploads without re-decompressing the whole set
+        #: host rest-format rows, preallocated at power-of-two capacity
+        #: with `_hcount` occupied — kept so capacity growth re-uploads
+        #: without re-decompressing the whole set. Growth is geometric:
+        #: at 2^20 rows a per-append `np.concatenate` would copy 200+ MB
+        #: of mirror per deposit batch; in-place writes make churn O(new)
+        #: with O(log n) reallocations over the set's lifetime.
         self._hx: "Optional[np.ndarray]" = None
         self._hy: "Optional[np.ndarray]" = None
+        self._hcount = 0
         #: device arrays, (capacity, NLIMBS) int32 Montgomery limbs
         self._x = None
         self._y = None
         self.stats = {
             "hits": 0, "misses": 0, "appends": 0, "refreshes": 0,
-            "uploaded_bytes": 0,
+            "uploaded_bytes": 0, "host_grows": 0,
         }
 
     # --------------------------------------------------------------- state
@@ -124,8 +135,19 @@ class DevicePubkeyRegistry:
             self.metrics.pubkey_registry_events.labels(event).inc()
 
     def _sync_gauges(self) -> None:
-        if self.metrics is not None:
-            self.metrics.pubkey_registry_size.set(self.count)
+        if self.metrics is None:
+            return
+        self.metrics.pubkey_registry_size.set(self.count)
+        cap = self.capacity
+        self.metrics.pubkey_registry_capacity.set(cap)
+        host = 0 if self._hx is None else int(
+            self._hx.nbytes + self._hy.nbytes
+        )
+        self.metrics.pubkey_registry_host_bytes.set(host)
+        dev = cap * L.NLIMBS * 4 * 2
+        self.metrics.pubkey_registry_device_bytes.set(dev)
+        shards = 1 if self.mesh is None else max(1, self.mesh.device_count)
+        self.metrics.pubkey_registry_shard_bytes.set(dev // shards)
 
     def _count_upload(self, nbytes: int) -> None:
         self.stats["uploaded_bytes"] += nbytes
@@ -152,6 +174,7 @@ class DevicePubkeyRegistry:
         with self._lock:
             self._pubkeys = None
             self._hx = self._hy = None
+            self._hcount = 0
             self._x = self._y = None
             self._stale = False
             self._event("invalidate")
@@ -205,14 +228,31 @@ class DevicePubkeyRegistry:
         assert not inf.any(), "identity pubkey can not enter the registry"
         return x, y
 
+    def _host_reserve(self, rows: int) -> None:
+        """Grow the host mirror to hold `rows`, geometrically — appends
+        within capacity are pure in-place writes."""
+        cur = 0 if self._hx is None else int(self._hx.shape[0])
+        if rows <= cur:
+            return
+        cap = _next_pow2(rows)
+        nx = np.zeros((cap, L.NLIMBS), np.int32)
+        ny = np.zeros((cap, L.NLIMBS), np.int32)
+        if self._hx is not None and self._hcount:
+            nx[: self._hcount] = self._hx[: self._hcount]
+            ny[: self._hcount] = self._hy[: self._hcount]
+        self._hx, self._hy = nx, ny
+        self.stats["host_grows"] += 1
+
     def _append(self, pubkeys: tuple, start: int) -> None:
         import jax
         import jax.numpy as jnp
 
         nx, ny = self._rows_for(pubkeys[start:])
-        self._hx = np.concatenate([self._hx, nx], axis=0)
-        self._hy = np.concatenate([self._hy, ny], axis=0)
         end = len(pubkeys)
+        self._host_reserve(end)
+        self._hx[start:end] = nx
+        self._hy[start:end] = ny
+        self._hcount = end
         if end <= self.capacity:
             # in-place device scatter: uploads O(new) bytes
             self._x = self._x.at[start:end].set(jnp.asarray(nx))
@@ -232,7 +272,13 @@ class DevicePubkeyRegistry:
         self._event("append")
 
     def _refresh(self, pubkeys: tuple) -> None:
-        self._hx, self._hy = self._rows_for(pubkeys)
+        x, y = self._rows_for(pubkeys)
+        self._hx = self._hy = None
+        self._hcount = 0
+        self._host_reserve(len(pubkeys))
+        self._hx[: len(pubkeys)] = x
+        self._hy[: len(pubkeys)] = y
+        self._hcount = len(pubkeys)
         self._pubkeys = pubkeys
         self._upload_full(len(pubkeys))
         self.stats["refreshes"] += 1
@@ -250,8 +296,8 @@ class DevicePubkeyRegistry:
             cap = max(cap, _next_pow2(self.mesh.device_count))
         px = np.zeros((cap, L.NLIMBS), np.int32)
         py = np.zeros((cap, L.NLIMBS), np.int32)
-        px[:count] = self._hx
-        py[:count] = self._hy
+        px[:count] = self._hx[:count]
+        py[:count] = self._hy[:count]
         if self.mesh is not None:
             # row-sharded residency: the indexed kernels gather rows
             # on-device and XLA routes cross-shard lookups over the mesh
@@ -264,4 +310,4 @@ class DevicePubkeyRegistry:
         self._count_upload(int(px.nbytes + py.nbytes))
 
 
-__all__ = ["DevicePubkeyRegistry", "MIN_CAPACITY"]
+__all__ = ["DevicePubkeyRegistry", "MIN_CAPACITY", "MAINNET_CAPACITY"]

@@ -263,6 +263,7 @@ def keys():
 
 
 @pytest.mark.kernel
+@pytest.mark.slow
 def test_fused_multi_verify_differential(fused_backend, unfused_backend,
                                          keys, fused_metrics):
     """Fused verdict == two-pass verdict (unfused RLC AND the standalone
@@ -304,6 +305,7 @@ def test_fused_multi_verify_differential(fused_backend, unfused_backend,
 
 
 @pytest.mark.kernel
+@pytest.mark.slow
 def test_donation_pipeline_aliasing_regression(fused_backend, keys):
     """Two donated batches in flight (the two-deep pipeline) settle to
     independent, correct verdicts: no donated operand is read after its

@@ -306,6 +306,7 @@ def keyring():
 
 
 @kernel
+@pytest.mark.slow
 def test_indexed_flat_verify_agrees_with_upload_path(
     backend, metrics, keyring
 ):
@@ -465,3 +466,104 @@ def test_one_compile_per_bucket(backend, keyring):
         assert sizes("multi_verify_msm") == baseline, (
             f"batch size {n} inside one bucket triggered a recompile"
         )
+
+
+# --------------------------------------------- churn at registry scale
+
+
+def _fake_rows_for(pkbs):
+    """Synthetic limb rows keyed off the pubkey bytes — stands in for
+    the G1 decompression so churn tests scale to mainnet row counts."""
+    import grandine_tpu.tpu.limbs as L
+
+    ids = np.frombuffer(
+        b"".join(bytes(b)[-4:] for b in pkbs), dtype=">u4"
+    ).astype(np.int64)
+    x = np.zeros((len(pkbs), L.NLIMBS), np.int32)
+    x[:, 0] = (ids & 0x7FFF_FFFF).astype(np.int32)
+    return x, x + 1
+
+
+def _churn(reg, keys_all, base_count, batch, batches):
+    """Deposit-batch churn: `batches` prefix-appends of `batch` rows on
+    top of `base_count`, returning (appended_rows, stats deltas)."""
+    assert reg.ensure(keys_all[:base_count])
+    cap0 = reg.capacity
+    grows0 = reg.stats["host_grows"]
+    up0 = reg.stats["uploaded_bytes"]
+    refr0 = reg.stats["refreshes"]
+    end = base_count
+    for _ in range(batches):
+        end += batch
+        assert reg.ensure(keys_all[:end])
+    return (
+        end - base_count,
+        cap0,
+        reg.stats["host_grows"] - grows0,
+        reg.stats["uploaded_bytes"] - up0,
+        reg.stats["refreshes"] - refr0,
+    )
+
+
+def test_registry_churn_within_capacity_is_o_new(monkeypatch):
+    """Fast witness for the mainnet churn invariant: prefix appends
+    inside capacity upload exactly the new rows' bytes, never regrow
+    the host mirror, and never rebuild the device arrays."""
+    import grandine_tpu.tpu.limbs as L
+
+    m = Metrics()
+    reg = DevicePubkeyRegistry(metrics=m)
+    monkeypatch.setattr(reg, "_rows_for", _fake_rows_for)
+    keys_all = tuple(i.to_bytes(48, "big") for i in range(1024))
+    appended, cap0, grows, uploaded, refreshes = _churn(
+        reg, keys_all, base_count=1024 - 64, batch=8, batches=8
+    )
+    assert appended == 64
+    assert reg.capacity == cap0 == 1024
+    assert grows == 0, "within-capacity churn regrew the host mirror"
+    assert refreshes == 0
+    assert uploaded == appended * 2 * L.NLIMBS * 4, (
+        "append upload is not O(new rows)"
+    )
+    assert m.pubkey_registry_host_bytes.value == (
+        reg._hx.nbytes + reg._hy.nbytes
+    )
+    assert m.pubkey_registry_capacity.value == 1024
+
+
+def test_registry_host_mirror_growth_is_geometric(monkeypatch):
+    """Growing 4 → 4096 rows in 64-row appends must reallocate the host
+    mirror O(log n) times, not O(appends)."""
+    reg = DevicePubkeyRegistry()
+    monkeypatch.setattr(reg, "_rows_for", _fake_rows_for)
+    keys_all = tuple(i.to_bytes(48, "big") for i in range(4096))
+    assert reg.ensure(keys_all[:4])
+    for end in range(64, 4097, 64):
+        assert reg.ensure(keys_all[:end])
+    assert reg.stats["host_grows"] <= 12  # log2(4096) = 12
+    assert reg.count == 4096
+
+
+@pytest.mark.slow
+def test_registry_churn_at_mainnet_capacity(monkeypatch):
+    """The 2^20 bucket itself: build the mainnet-size registry (synthetic
+    limb rows), then run deposit-batch churn and hold the O(new)
+    invariants at full scale. `test_registry_churn_within_capacity_is_
+    o_new` is the fast witness for this path."""
+    import grandine_tpu.tpu.limbs as L
+    from grandine_tpu.tpu.registry import MAINNET_CAPACITY
+
+    m = Metrics()
+    reg = DevicePubkeyRegistry(metrics=m)
+    monkeypatch.setattr(reg, "_rows_for", _fake_rows_for)
+    n = MAINNET_CAPACITY
+    keys_all = tuple(i.to_bytes(48, "big") for i in range(n))
+    appended, cap0, grows, uploaded, refreshes = _churn(
+        reg, keys_all, base_count=n - 512, batch=64, batches=8
+    )
+    assert appended == 512
+    assert reg.capacity == cap0 == n
+    assert grows == 0 and refreshes == 0
+    assert uploaded == appended * 2 * L.NLIMBS * 4
+    assert reg.count == n
+    assert m.pubkey_registry_device_bytes.value == n * L.NLIMBS * 4 * 2

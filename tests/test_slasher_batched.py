@@ -1,0 +1,234 @@
+"""Differential tests: the batched slasher paths against the
+per-validator reference loop.
+
+`on_attestation_reference` is the oracle (the original scalar walk,
+byte-for-byte the reference semantics). Every test here drives two
+fresh Slasher instances over the same input and requires:
+
+* identical detections — kind, validator, evidence dict, in order;
+* identical final database state, byte for byte, across every
+  slasher prefix (span chunks, records, prune indexes).
+
+The byte-identity check is the strong one: it proves the vectorized
+range updates stop at exactly the chunk the scalar early exit would
+have stopped at (a lazier walk would write extra chunks; an eager exit
+would miss writes).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from grandine_tpu.slasher import (
+    CHUNK_EPOCHS,
+    VALIDATORS_PER_CHUNK,
+    Slasher,
+)
+
+
+def _dump(db):
+    """Full slasher keyspace as sorted (key, value) bytes."""
+    return [(bytes(k), bytes(v)) for k, v in db.iterate_prefix(b"sl:")]
+
+
+def _hits_key(hits):
+    return [(h.kind, h.validator_index, h.evidence) for h in hits]
+
+
+def _assert_same(ref, new, ref_hits, new_hits):
+    assert _hits_key(new_hits) == _hits_key(ref_hits)
+    assert _dump(new.db) == _dump(ref.db)
+
+
+def _random_aggregates(seed, n_aggs, max_validator=1024, max_epoch=200,
+                       unique_within=True):
+    """A randomized mix that exercises every detection kind: a few data
+    roots (collisions → double votes), random (s, t) spans (nesting →
+    surround / surrounded), random index subsets."""
+    rng = random.Random(seed)
+    roots = [bytes([r]) * 32 for r in (0xAA, 0xBB, 0xCC)]
+    aggs = []
+    for _ in range(n_aggs):
+        k = rng.randint(1, 48)
+        if unique_within:
+            ids = rng.sample(range(max_validator), k)
+        else:
+            ids = [rng.randrange(max_validator) for _ in range(k)]
+        s = rng.randint(0, max_epoch - 1)
+        t = rng.randint(s + 1, min(s + 40, max_epoch))
+        aggs.append((ids, s, t, rng.choice(roots)))
+    return aggs
+
+
+# ------------------------------------------------- per-aggregate batched
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_batched_matches_reference_randomized(seed):
+    ref, new = Slasher(), Slasher()
+    ref_hits, new_hits = [], []
+    for ids, s, t, root in _random_aggregates(seed, 40):
+        ref_hits.extend(ref.on_attestation_reference(ids, s, t, root))
+        new_hits.extend(new.on_attestation(ids, s, t, root))
+    _assert_same(ref, new, ref_hits, new_hits)
+    assert _hits_key(new.drain()) == _hits_key(ref.drain())
+
+
+def test_batched_directed_kinds():
+    """One directed aggregate per detection kind through the batched
+    path, with the evidence dict checked explicitly."""
+    sl = Slasher()
+    base = list(range(0, 300))  # spans two vchunks
+    assert sl.on_attestation(base, 10, 20, b"\xaa" * 32) == []
+
+    # surround: (5, 30) surrounds the recorded (10, 20)
+    hits = sl.on_attestation([7, 290], 5, 30, b"\xbb" * 32)
+    assert [(h.kind, h.validator_index) for h in hits] == [
+        ("surround_vote", 7), ("surround_vote", 290),
+    ]
+    assert hits[0].evidence == {"existing": [10, 20], "new": [5, 30]}
+
+    # surrounded: (12, 15) is surrounded by the recorded (10, 20)
+    hits = sl.on_attestation([8], 12, 15, b"\xcc" * 32)
+    assert [(h.kind, h.validator_index) for h in hits] == [
+        ("surrounded_vote", 8),
+    ]
+    assert hits[0].evidence == {"existing": [10, 20], "new": [12, 15]}
+
+    # double vote: same target, different root
+    hits = sl.on_attestation([9, 11], 11, 20, b"\xdd" * 32)
+    assert [(h.kind, h.validator_index) for h in hits] == [
+        ("double_vote", 9), ("double_vote", 11),
+    ]
+    assert hits[0].evidence["target_epoch"] == 20
+    assert hits[0].evidence["roots"] == [
+        (b"\xaa" * 32).hex(), (b"\xdd" * 32).hex(),
+    ]
+
+    # clean: disjoint validators, fresh span
+    assert sl.on_attestation([500, 501], 10, 20, b"\xaa" * 32) == []
+
+
+def test_duplicate_indices_fall_back_to_sequential():
+    """A repeated index inside one aggregate is order-dependent; the
+    batched entry point must produce reference semantics (first
+    occurrence records, second sees it)."""
+    ref, new = Slasher(), Slasher()
+    aggs = [
+        ([3, 4, 3], 1, 5, b"\xaa" * 32),
+        ([4, 4], 2, 5, b"\xbb" * 32),
+    ]
+    ref_hits, new_hits = [], []
+    for ids, s, t, root in aggs:
+        ref_hits.extend(ref.on_attestation_reference(ids, s, t, root))
+        new_hits.extend(new.on_attestation(ids, s, t, root))
+    _assert_same(ref, new, ref_hits, new_hits)
+
+
+@pytest.mark.parametrize("history", [8, 24])
+def test_batched_small_history_floor(history):
+    """Tiny history windows put the floor inside (or above) the walk's
+    first chunk — the vectorized walk must clamp exactly like the
+    scalar one."""
+    ref = Slasher(history_epochs=history)
+    new = Slasher(history_epochs=history)
+    ref_hits, new_hits = [], []
+    for ids, s, t, root in _random_aggregates(7, 30, max_epoch=64):
+        ref_hits.extend(ref.on_attestation_reference(ids, s, t, root))
+        new_hits.extend(new.on_attestation(ids, s, t, root))
+    _assert_same(ref, new, ref_hits, new_hits)
+
+
+def test_batched_deep_history_walk():
+    """Deep fresh-history ingest (the bench diagnostic's shape): the
+    min walk crosses hundreds of chunks; every touched chunk must match
+    the scalar walk byte for byte."""
+    ref, new = Slasher(), Slasher()
+    ids = list(range(300))
+    ref_hits = ref.on_attestation_reference(ids, 4000, 4001, b"\xaa" * 32)
+    new_hits = new.on_attestation(ids, 4000, 4001, b"\xaa" * 32)
+    _assert_same(ref, new, ref_hits, new_hits)
+    # second aggregate one epoch up: the monotone early exit now stops
+    # the walk almost immediately — still byte-identical
+    ref_hits = ref.on_attestation_reference(ids, 4001, 4002, b"\xbb" * 32)
+    new_hits = new.on_attestation(ids, 4001, 4002, b"\xbb" * 32)
+    _assert_same(ref, new, ref_hits, new_hits)
+
+
+# ------------------------------------------------------ bulk-replay feed
+
+
+@pytest.mark.parametrize("seed", [11, 12])
+def test_bulk_matches_sequential_reference(seed):
+    """A replay window through `on_attestations_bulk` (solo validators
+    ride the merged epoch grid, repeats take the scalar path) against
+    aggregate-at-a-time reference ingestion."""
+    aggs = _random_aggregates(seed, 25, max_validator=768,
+                              unique_within=False)
+    ref = Slasher()
+    ref_out = [
+        ref.on_attestation_reference(ids, s, t, root)
+        for ids, s, t, root in aggs
+    ]
+    new = Slasher()
+    new_out = new.on_attestations_bulk(aggs)
+    assert [_hits_key(h) for h in new_out] == [_hits_key(h) for h in ref_out]
+    assert _dump(new.db) == _dump(ref.db)
+
+
+def test_bulk_grid_vs_span_plane():
+    """The same window with and without the device SpanPlane wired —
+    `tpu.spans.grid_merge_host` is the kernel's numpy twin, so the final
+    state must be identical (and match the reference)."""
+    from grandine_tpu.tpu.spans import SpanPlane
+
+    aggs = _random_aggregates(21, 12, max_validator=512, max_epoch=120,
+                              unique_within=False)
+    host = Slasher()
+    host_out = host.on_attestations_bulk(aggs)
+    dev = Slasher(span_plane=SpanPlane())
+    dev_out = dev.on_attestations_bulk(aggs)
+    ref = Slasher()
+    ref_out = [
+        ref.on_attestation_reference(ids, s, t, root)
+        for ids, s, t, root in aggs
+    ]
+    assert [_hits_key(h) for h in host_out] == [_hits_key(h) for h in ref_out]
+    assert [_hits_key(h) for h in dev_out] == [_hits_key(h) for h in ref_out]
+    assert _dump(host.db) == _dump(ref.db)
+    assert _dump(dev.db) == _dump(ref.db)
+
+
+def test_bulk_fallback_rows_off_grid():
+    """Rows whose update range doesn't fit the device grid (history
+    floor above the grid base) must take the host walk and still match
+    the reference exactly."""
+    aggs = [
+        (list(range(64)), 4000, 4001, b"\xaa" * 32),   # deep: grid row
+        (list(range(64, 96)), 2, 4001, b"\xbb" * 32),  # source below grid
+    ]
+    ref = Slasher(history_epochs=64)
+    ref_out = [
+        ref.on_attestation_reference(ids, s, t, root)
+        for ids, s, t, root in aggs
+    ]
+    new = Slasher(history_epochs=64)
+    new_out = new.on_attestations_bulk(aggs)
+    assert [_hits_key(h) for h in new_out] == [_hits_key(h) for h in ref_out]
+    assert _dump(new.db) == _dump(ref.db)
+
+
+# ------------------------------------------------------- prune coherence
+
+
+def test_prune_after_batched_matches_reference():
+    """Pruning after batched ingest drops exactly the rows the
+    reference-path slasher would drop."""
+    ref, new = Slasher(history_epochs=64), Slasher(history_epochs=64)
+    aggs = _random_aggregates(31, 20, max_validator=512, max_epoch=150)
+    for ids, s, t, root in aggs:
+        ref.on_attestation_reference(ids, s, t, root)
+        new.on_attestation(ids, s, t, root)
+    assert new.prune(150) == ref.prune(150)
+    assert _dump(new.db) == _dump(ref.db)

@@ -28,6 +28,7 @@ def triples():
     return msgs, sigs, pks
 
 
+@pytest.mark.slow
 def test_grouped_path_taken_and_accepts(backend, triples, monkeypatch):
     msgs, sigs, pks = triples
     called = {}
@@ -44,6 +45,7 @@ def test_grouped_path_taken_and_accepts(backend, triples, monkeypatch):
     assert A.multi_verify(msgs, sigs, pks)
 
 
+@pytest.mark.slow
 def test_grouped_rejects_bad_signature(backend, triples):
     msgs, sigs, pks = triples
     bad = list(sigs)
@@ -53,6 +55,7 @@ def test_grouped_rejects_bad_signature(backend, triples):
     assert not backend.multi_verify(msgs, bad, pks)
 
 
+@pytest.mark.slow
 def test_grouped_rejects_cross_group_swap(backend, triples):
     msgs, sigs, pks = triples
     # swap two signatures across DIFFERENT message groups
@@ -101,4 +104,26 @@ def test_distinct_messages_route_flat_without_kernel(backend, monkeypatch):
     monkeypatch.setattr(backend, "_grouped_multi_verify_async", boom)
     monkeypatch.setattr(backend, "_jitted_msm", flat_seam)
     with pytest.raises(_FlatDispatch):
+        backend.multi_verify(msgs, sigs, pks)
+
+
+def test_duplicate_messages_route_grouped_without_kernel(
+    backend, triples, monkeypatch
+):
+    """Fast routing witness for the slow grouped-verdict tests above:
+    a duplicate-message batch must take the grouped path — asserted by
+    intercepting the grouped seam before any kernel is built, so no
+    compile is paid."""
+
+    class _GroupedDispatch(Exception):
+        pass
+
+    def grouped_seam(*a, **kw):
+        raise _GroupedDispatch
+
+    msgs, sigs, pks = triples
+    monkeypatch.setattr(
+        backend, "_grouped_multi_verify_async", grouped_seam
+    )
+    with pytest.raises(_GroupedDispatch):
         backend.multi_verify(msgs, sigs, pks)

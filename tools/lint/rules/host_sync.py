@@ -59,6 +59,8 @@ class HostSyncRule(Rule):
         "grandine_tpu/runtime/health.py",
         "grandine_tpu/runtime/replay.py",
         "grandine_tpu/runtime/isolation.py",
+        "grandine_tpu/slasher.py",
+        "grandine_tpu/tpu/spans.py",
     )
 
     def check(self, ctx: Context, files):

@@ -55,6 +55,8 @@ class ThreadAffinityRule(Rule):
         "grandine_tpu/runtime/thread_pool.py",
         "grandine_tpu/metrics.py",
         "grandine_tpu/tpu/registry.py",
+        "grandine_tpu/slasher.py",
+        "grandine_tpu/tpu/spans.py",
     )
 
     def check(self, ctx: Context, files):

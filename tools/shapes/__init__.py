@@ -58,6 +58,7 @@ MANIFEST_PATH = "tools/shapes/manifest.txt"
 
 BLS_PATH = "grandine_tpu/tpu/bls.py"
 REGISTRY_PATH = "grandine_tpu/tpu/registry.py"
+SPANS_PATH = "grandine_tpu/tpu/spans.py"
 VERIFIER_PATH = "grandine_tpu/runtime/attestation_verifier.py"
 SCHEDULER_PATH = "grandine_tpu/runtime/verify_scheduler.py"
 REPLAY_PATH = "grandine_tpu/runtime/replay.py"
@@ -69,6 +70,7 @@ TPU_FILES = (
     "grandine_tpu/tpu/msm.py",
     "grandine_tpu/tpu/pairing.py",
     REGISTRY_PATH,
+    SPANS_PATH,
 )
 RUNTIME_FILES = (VERIFIER_PATH, SCHEDULER_PATH, REPLAY_PATH,
                  ISOLATION_PATH)
@@ -253,6 +255,27 @@ class Analysis:
                     rows.append((
                         kind, (64, 256, 1024, 4096), "policy:mesh-replay",
                     ))
+        # the slasher's bulk-replay span-update grid (tpu/spans.py):
+        # row buckets from the kernel's device floor up through a
+        # mainnet-scale window's solo-validator count
+        if any(e.kernel == "span_update_grid" for e in self.entries):
+            rows.append((
+                "span_update", (256, 1024, 4096),
+                "policy:bulk-replay(slasher)",
+            ))
+        # registry capacity ladder: the registry arrays' row count is
+        # part of the indexed gather kernels' jit signature, so the
+        # mainnet (2^20) capacity pre-warms like any other contract
+        # instead of compiling the first time a mainnet-sized state
+        # walks in (warmup skips the row below that scale)
+        mainnet_cap = self.bounds.get("registry.MAINNET_CAPACITY")
+        if mainnet_cap and any(
+            e.kernel == "agg_fast_verify_msm_idx" for e in self.entries
+        ):
+            rows.append((
+                "registry_capacity", (mainnet_cap,),
+                "policy:mainnet-registry",
+            ))
         return rows
 
 
@@ -817,6 +840,14 @@ def _parse_bounds(ctx: Context, files, analysis, findings) -> None:
         val = _module_int(tree, "MIN_CAPACITY") if tree else None
         if val is not None:
             analysis.bounds["registry.MIN_CAPACITY"] = val
+        val = _module_int(tree, "MAINNET_CAPACITY") if tree else None
+        if val is not None:
+            analysis.bounds["registry.MAINNET_CAPACITY"] = val
+    if SPANS_PATH in files:
+        tree = ctx.tree(SPANS_PATH)
+        val = _module_int(tree, "SPAN_GRID_EPOCHS") if tree else None
+        if val is not None:
+            analysis.bounds["spans.SPAN_GRID_EPOCHS"] = val
     if SCHEDULER_PATH in files:
         tree = ctx.tree(SCHEDULER_PATH)
         lanes = _parse_lanes(tree) if tree else None
