@@ -2323,6 +2323,178 @@ def bench_multichip() -> None:
     )
 
 
+def bench_schemes() -> None:
+    """`--schemes` / BENCH_SCHEMES=1: the multi-scheme device plane —
+    BLS, ed25519, and blob-KZG batches through their table-built
+    backends on a sealed shape ledger, one `multi_scheme_plane` line.
+
+    Knobs: BENCH_SCHEMES_N (ed25519 items/batch, default 15 — kernel
+    point rows 1+2n land on the bucket-32 ladder), BENCH_SCHEMES_BLOBS
+    (blobs/batch, default 4), BENCH_SCHEMES_WIDTH (field elements per
+    blob, default 8), BENCH_SCHEMES_ITERS (timed rounds, default 3).
+
+    All material prep happens BEFORE the ledger seals: computing a KZG
+    commitment or proof dispatches the kzg_msm kernel, so blob
+    generation is itself warmup. After the seal each lane runs a good
+    and a forged batch per round — same shapes, opposite verdicts —
+    and every device verdict must match the scheme's host twin. Zero
+    post-warmup recompiles is the gate.
+    """
+    _lint_preflight()
+
+    import statistics
+
+    from grandine_tpu.crypto import ed25519 as HE
+    from grandine_tpu.kzg import eip4844 as KZ
+    from grandine_tpu.kzg.setup import dev_setup
+    from grandine_tpu.metrics import Metrics
+    from grandine_tpu.runtime.verify_scheduler import VerifyItem
+    from grandine_tpu.tpu import bls as B
+    from grandine_tpu.tpu import schemes
+    from grandine_tpu.transition.genesis import interop_secret_key
+
+    n_ed = int(os.environ.get("BENCH_SCHEMES_N", "15"))
+    n_blobs = int(os.environ.get("BENCH_SCHEMES_BLOBS", "4"))
+    width = int(os.environ.get("BENCH_SCHEMES_WIDTH", "8"))
+    iters = int(os.environ.get("BENCH_SCHEMES_ITERS", "3"))
+    n_bls = 4  # smallest aggregate bucket: coexistence, not BLS perf
+
+    metrics = Metrics()
+    bls_be = schemes.get("bls").make_backend(metrics=metrics)
+    ed_be = schemes.get("ed25519").make_backend(metrics=metrics)
+    kzg_be = schemes.get("blob_kzg").make_backend(metrics=metrics)
+
+    # the ledger resets BEFORE material prep — warm shapes must stay on
+    # it, or their first live dispatch would count as a recompile
+    B.reset_shape_tracking()
+
+    sk = interop_secret_key(0)
+    pk = sk.public_key()
+    bls_msgs = [b"schemes-bls-%d" % i for i in range(n_bls)]
+    bls_sigs = [sk.sign(m) for m in bls_msgs]
+    bls_keys = [[pk]] * n_bls
+    bls_items = [
+        VerifyItem(m, s.to_bytes(), public_keys=(pk,))
+        for m, s in zip(bls_msgs, bls_sigs)
+    ]
+
+    ed_good = []
+    for i in range(n_ed):
+        esk = bytes([i + 1]) * 32
+        msg = b"schemes-ed-%04d" % i
+        ed_good.append(VerifyItem(
+            msg, HE.sign(esk, msg),
+            public_keys=(HE.secret_to_public(esk),),
+        ))
+    mid = ed_good[n_ed // 2]
+    ed_forged = list(ed_good)
+    ed_forged[n_ed // 2] = VerifyItem(
+        mid.message + b"!", mid.signature, public_keys=mid.public_keys
+    )
+
+    setup = dev_setup(width)
+    rng = np.random.default_rng(14)
+    kzg_good = []
+    for _ in range(n_blobs):
+        blob = b"".join(
+            int(rng.integers(0, 2**61)).to_bytes(32, "big")
+            for _ in range(width)
+        )
+        c = KZ.blob_to_kzg_commitment(blob, setup)  # kzg_msm dispatch
+        p = KZ.compute_blob_kzg_proof(blob, c, setup)
+        kzg_good.append(VerifyItem(blob, p, public_keys=(c,)))
+    tampered = bytearray(kzg_good[-1].message)
+    tampered[-1] ^= 1  # low byte of the last field element: stays canonical
+    kzg_forged = list(kzg_good)
+    kzg_forged[-1] = VerifyItem(
+        bytes(tampered), kzg_good[-1].signature,
+        public_keys=kzg_good[-1].public_keys,
+    )
+
+    def ed_run(items) -> bool:
+        status, prep = ed_be.prepare(items)
+        if status != "ok":
+            raise SystemExit(f"ed25519 prepare: {status}")
+        return ed_be.verify_batch_async(prep)()
+
+    def kzg_run(items) -> bool:
+        status, prep = kzg_be.prepare(items)
+        if status != "ok":
+            raise SystemExit(f"blob_kzg prepare: {status}")
+        return kzg_be.verify_blobs_async(prep)()
+
+    def bls_run(forged: bool) -> bool:
+        msgs = ([b"forged-" + m for m in bls_msgs] if forged else bls_msgs)
+        return bls_be.fast_aggregate_verify_batch(msgs, bls_sigs, bls_keys)
+
+    # one good dispatch per lane compiles every timed shape, then seal
+    if not (bls_run(False) and ed_run(ed_good) and kzg_run(kzg_good)):
+        raise SystemExit("multi-scheme warmup batch rejected")
+    B.declare_warmup_complete()
+
+    lanes: "dict[str, dict]" = {}
+    verdicts_ok = True
+    for name, n_items, good, forged in (
+        ("bls", n_bls, lambda: bls_run(False), lambda: bls_run(True)),
+        ("ed25519", n_ed, lambda: ed_run(ed_good),
+         lambda: ed_run(ed_forged)),
+        ("blob_kzg", n_blobs, lambda: kzg_run(kzg_good),
+         lambda: kzg_run(kzg_forged)),
+    ):
+        walls = []
+        for _ in range(iters):
+            t0 = time.time()
+            ok = good()
+            walls.append(time.time() - t0)
+            verdicts_ok = verdicts_ok and ok is True
+            verdicts_ok = verdicts_ok and forged() is False
+        p50 = statistics.median(walls)
+        lanes[name] = {
+            "items": n_items,
+            "p50_s": round(p50, 4),
+            "items_per_s": round(n_items / p50, 2),
+        }
+
+    # the host twins must agree with every post-seal device verdict
+    host = {
+        "bls": schemes.get("bls").host_check,
+        "ed25519": schemes.get("ed25519").host_check,
+        "blob_kzg": schemes.get("blob_kzg").host_check,
+    }
+    host_agreement = (
+        all(host["bls"](it) for it in bls_items)
+        and all(host["ed25519"](it) for it in ed_good)
+        and not all(host["ed25519"](it) for it in ed_forged)
+        and all(host["blob_kzg"](it) for it in kzg_good)
+        and not all(host["blob_kzg"](it) for it in kzg_forged)
+    )
+
+    recompiles = B.post_warmup_recompiles()
+    plane_ok = verdicts_ok and host_agreement and recompiles == 0
+    print(json.dumps({
+        "metric": "multi_scheme_plane",
+        "unit": "ed25519 verifications/s post-warmup",
+        "value": lanes["ed25519"]["items_per_s"],
+        "iters": iters,
+        "lanes": lanes,
+        "verdicts_ok": verdicts_ok,
+        "host_agreement": host_agreement,
+        "post_warmup_recompiles": recompiles,
+        "plane_ok": plane_ok,
+    }))
+    print(
+        f"# multi-scheme plane: bls {lanes['bls']['items_per_s']}/s, "
+        f"ed25519 {lanes['ed25519']['items_per_s']}/s, "
+        f"blob_kzg {lanes['blob_kzg']['items_per_s']} blobs/s over "
+        f"{iters} rounds; host agreement "
+        f"{'yes' if host_agreement else 'NO'}, {recompiles} recompiles; "
+        + ("OK" if plane_ok else "FAILED"),
+        file=sys.stderr,
+    )
+    if not plane_ok:
+        raise SystemExit(1)
+
+
 if __name__ == "__main__":
     if "--devices-child" in sys.argv:
         bench_multichip_child(
@@ -2348,6 +2520,8 @@ if __name__ == "__main__":
         bench_replay()
     elif "--mainnet" in sys.argv or os.environ.get("BENCH_MAINNET") == "1":
         bench_mainnet()
+    elif "--schemes" in sys.argv or os.environ.get("BENCH_SCHEMES") == "1":
+        bench_schemes()
     elif os.environ.get("BENCH_SCHED_ONLY") == "1":
         bench_verify_scheduler()
     else:

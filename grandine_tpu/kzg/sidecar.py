@@ -78,6 +78,22 @@ def validate_blob_sidecar(
 ) -> None:
     """Full sidecar validation: index bound, inclusion proof against the
     signed header's body root, then the KZG proof. Raises KzgError."""
+    validate_blob_sidecar_structure(sidecar, body_cls, p)
+    if not eip4844.verify_blob_kzg_proof(
+        bytes(sidecar.blob),
+        bytes(sidecar.kzg_commitment),
+        bytes(sidecar.kzg_proof),
+        setup,
+    ):
+        raise eip4844.KzgError("blob KZG proof invalid")
+
+
+def validate_blob_sidecar_structure(sidecar, body_cls, p) -> None:
+    """The host-only legs of sidecar validation — index bound and the
+    commitment inclusion proof — WITHOUT the KZG proof check, so callers
+    with a verify-scheduler `blob_kzg` lane can run the proof leg as a
+    device batch (runtime/controller.py) and keep this part on the
+    gossip pool. Raises KzgError."""
     if int(sidecar.index) >= p.MAX_BLOBS_PER_BLOCK:
         raise eip4844.KzgError("sidecar index out of range")
     header = sidecar.signed_block_header.message
@@ -91,13 +107,6 @@ def validate_blob_sidecar(
     )
     if not ok:
         raise eip4844.KzgError("commitment inclusion proof invalid")
-    if not eip4844.verify_blob_kzg_proof(
-        bytes(sidecar.blob),
-        bytes(sidecar.kzg_commitment),
-        bytes(sidecar.kzg_proof),
-        setup,
-    ):
-        raise eip4844.KzgError("blob KZG proof invalid")
 
 
 def make_blob_sidecars(

@@ -500,9 +500,9 @@ class AttestationVerifier:
         with self._backend_lock:
             backend = self.backend
             if backend is None:
-                from grandine_tpu.tpu.bls import TpuBlsBackend
+                from grandine_tpu.tpu import schemes
 
-                backend = self.backend = TpuBlsBackend(
+                backend = self.backend = schemes.get("bls").make_backend(
                     metrics=self.metrics, tracer=self.tracer, mesh=self.mesh
                 )
                 self.health.ensure_probe(_health.make_canary_probe(

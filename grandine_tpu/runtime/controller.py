@@ -235,28 +235,87 @@ class Controller:
         """Untrusted sidecar: inclusion-proof + KZG validation on the
         low-priority pool, then into the mutator's blob cache (dedup by
         (block_root, index)); completes any block delayed on its blobs.
-        Reference: BlobSidecarTask (fork_choice_control/src/tasks.rs) +
-        mutator delayed_until_blobs."""
+        The KZG proof leg rides the scheduler's `blob_kzg` lane (device
+        batch) when available, with the host check as the degradation
+        target — see _check_sidecar_kzg. Reference: BlobSidecarTask
+        (fork_choice_control/src/tasks.rs) + mutator delayed_until_blobs."""
         header_root = sidecar.signed_block_header.message.hash_tree_root()
         if (header_root, int(sidecar.index)) in self._blob_seen:
             return  # cheap racy pre-check; the mutator dedups authoritatively
 
         def task() -> None:
-            from grandine_tpu.kzg.sidecar import validate_blob_sidecar
+            from grandine_tpu.kzg.sidecar import (
+                validate_blob_sidecar_structure,
+            )
             from grandine_tpu.types.containers import spec_types
 
             ns = spec_types(self.cfg.preset).deneb
             try:
-                validate_blob_sidecar(
-                    sidecar, ns.BeaconBlockBody, self.cfg.preset,
-                    self.kzg_setup,
+                validate_blob_sidecar_structure(
+                    sidecar, ns.BeaconBlockBody, self.cfg.preset
                 )
                 self._check_sidecar_header(sidecar)
             except Exception:
                 return  # invalid sidecar: drop (gossip penalty is P2P-level)
+            if not self._check_sidecar_kzg(sidecar):
+                return  # proof definitively false on SOME path: drop
             self._send(("blob_sidecar", (header_root, sidecar)))
 
         self.pool.spawn(task, Priority.LOW)
+
+    def _check_sidecar_kzg(self, sidecar) -> bool:
+        """The sidecar's KZG proof verdict. Routed through the verify
+        scheduler's `blob_kzg` lane (device-batched with other in-flight
+        sidecars) when one is attached; the host proof check is the
+        degradation target. A device/lane FAULT — timeout, shed ticket,
+        scheduler exception — never drops a sidecar: only a definitive
+        False verdict (from either path) rejects. Origin/quarantine
+        plumbing is untouched: sidecar jobs carry no origin, so they are
+        never rerouted into the quarantine lane."""
+        blob = bytes(sidecar.blob)
+        commitment = bytes(sidecar.kzg_commitment)
+        proof = bytes(sidecar.kzg_proof)
+        sched = self.verify_scheduler
+        if sched is not None and "blob_kzg" in getattr(sched, "lanes", {}):
+            route = True
+            if self.kzg_setup is not None:
+                # the lane resolves its trusted setup by blob width; only
+                # route when that resolution lands on the injected setup
+                try:
+                    from grandine_tpu.kzg.eip4844 import (
+                        BYTES_PER_FIELD_ELEMENT,
+                        _setup_for_width,
+                    )
+
+                    width = len(blob) // BYTES_PER_FIELD_ELEMENT
+                    route = _setup_for_width(width) is self.kzg_setup
+                except Exception:
+                    route = False
+            if route:
+                try:
+                    from grandine_tpu.runtime.verify_scheduler import (
+                        VerifyItem,
+                    )
+
+                    ticket = sched.submit(
+                        "blob_kzg",
+                        [VerifyItem(blob, proof, public_keys=(commitment,))],
+                    )
+                    ok = ticket.result(30.0)
+                    if not ticket.dropped:
+                        return bool(ok)
+                except Exception:
+                    pass  # lane fault: degrade to the host check below
+        from grandine_tpu.kzg import eip4844
+
+        try:
+            return bool(
+                eip4844.verify_blob_kzg_proof(
+                    blob, commitment, proof, self.kzg_setup
+                )
+            )
+        except eip4844.KzgError:
+            return False
 
     def _check_sidecar_header(self, sidecar) -> None:
         """The inclusion proof binds the commitment to the header, but

@@ -146,10 +146,11 @@ class BulkReplayPipeline:
         self.cfg = cfg
         self.use_device = use_device
         if use_device and backend is None:
-            from grandine_tpu.tpu.bls import TpuBlsBackend
+            from grandine_tpu.tpu import schemes
 
-            backend = TpuBlsBackend(metrics=metrics, tracer=tracer,
-                                    lane="replay")
+            backend = schemes.get("bls").make_backend(
+                metrics=metrics, tracer=tracer, lane="replay"
+            )
         self.backend = backend
         #: flight recorder: one record per window in the "replay" lane
         self.flight = (

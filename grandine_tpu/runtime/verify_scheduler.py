@@ -33,6 +33,14 @@ split + p2p/src/attestation_verifier.rs's accumulate→deadline→batch):
 `DeferredVerifier` adapts the scheduler to the existing `Verifier` seam
 (consensus/verifier.py), so transition/fork-choice code can route block
 signature batches through a lane with zero changes.
+
+Schemes: a lane serves ONE verification scheme (`LaneConfig.scheme`),
+resolved through the tpu/schemes.py dispatch table — BLS for the
+consensus lanes, Ed25519 for execution-layer/non-Ethereum traffic,
+blob_kzg for the EIP-4844 sidecar proof check. Backend construction,
+device dispatch, the bisection leaf, and the host degradation pass all
+route through the table; cross-lane merging only combines same-scheme
+lanes.
 """
 
 from __future__ import annotations
@@ -54,6 +62,7 @@ from grandine_tpu.runtime import flight as _flight
 from grandine_tpu.runtime import health as _health
 from grandine_tpu.runtime import isolation as _isolation
 from grandine_tpu.runtime.thread_pool import Priority
+from grandine_tpu.tpu import schemes as _schemes
 from grandine_tpu.tracing import NULL_TRACER
 
 
@@ -61,10 +70,11 @@ class LaneConfig:
     """One lane's flush/backpressure policy."""
 
     __slots__ = ("name", "priority", "max_batch", "max_wait_s",
-                 "max_queue", "shed")
+                 "max_queue", "shed", "scheme")
 
     def __init__(self, name: str, priority: Priority, max_batch: int,
-                 max_wait_s: float, max_queue: int, shed: bool) -> None:
+                 max_wait_s: float, max_queue: int, shed: bool,
+                 scheme: str = "bls") -> None:
         self.name = name
         self.priority = priority
         self.max_batch = int(max_batch)
@@ -73,6 +83,10 @@ class LaneConfig:
         #: LOW lanes shed oldest-first at max_queue; HIGH lanes block
         #: the submitter (bounded producer) and never drop
         self.shed = bool(shed)
+        #: verification scheme served by this lane — a key into the
+        #: tpu/schemes.py dispatch table (backend factory, device
+        #: dispatch, host twin, kernel label all resolve through it)
+        self.scheme = str(scheme)
 
 
 #: the lane table (README "Verify scheduler" section mirrors this)
@@ -88,6 +102,15 @@ DEFAULT_LANES = (
     # quarantined-origin traffic: small batches so one forgery poisons
     # little, sheddable so a hostile origin only backpressures itself
     LaneConfig("quarantine", Priority.LOW, 8, 0.050, 512, shed=True),
+    # non-BLS schemes (tpu/schemes.py): execution-layer / non-Ethereum
+    # Ed25519 traffic and the blob-sidecar KZG-proof gossip check.
+    # max_batch 63 keeps the Ed25519 MSM inside the 128-point ladder
+    # bucket (2·63+1 = 127); sheddable — a dropped ticket degrades the
+    # caller to its host path, it never loses the object.
+    LaneConfig("ed25519", Priority.LOW, 63, 0.050, 2048, shed=True,
+               scheme="ed25519"),
+    LaneConfig("blob_kzg", Priority.LOW, 8, 0.025, 1024, shed=True,
+               scheme="blob_kzg"),
 )
 
 
@@ -488,6 +511,11 @@ class VerifyScheduler:
                 break
             if name == primary.name or name == "quarantine":
                 continue
+            # cross-SCHEME merging is meaningless: the batches run on
+            # different kernels (an Ed25519 lane cannot ride a BLS RLC
+            # dispatch) — only same-scheme lanes share a device pass
+            if lane.scheme != primary.scheme:
+                continue
             q = self._queues[name]
             if not q:
                 continue
@@ -617,6 +645,9 @@ class VerifyScheduler:
         if self.metrics is not None:
             self.metrics.daemon_loop_failures.inc(thread)
 
+    def _scheme_for(self, lane: LaneConfig) -> "_schemes.Scheme":
+        return _schemes.get(getattr(lane, "scheme", "bls"))
+
     def _backend_for(self, lane: LaneConfig):
         if self._shared_backend is not None:
             return self._shared_backend
@@ -626,18 +657,19 @@ class VerifyScheduler:
         with self._backend_lock:
             backend = self._backends.get(lane.name)
             if backend is None:
-                from grandine_tpu.tpu.bls import TpuBlsBackend
-
-                backend = self._backends[lane.name] = TpuBlsBackend(
+                scheme = self._scheme_for(lane)
+                backend = self._backends[lane.name] = scheme.make_backend(
                     metrics=self.metrics, tracer=self.tracer, lane=lane.name,
                     mesh=self.mesh,
                 )
-                # the first real backend also answers canary probes for
-                # HALF_OPEN re-promotion (injected backends keep whatever
-                # probe the caller wired — tests drive their own canaries)
-                self.health.ensure_probe(_health.make_canary_probe(
-                    backend, timeout_s=self.health.settle_timeout_s
-                ))
+                # the first real canary-capable backend also answers
+                # probes for HALF_OPEN re-promotion (injected backends
+                # keep whatever probe the caller wired — tests drive
+                # their own canaries)
+                if scheme.canary:
+                    self.health.ensure_probe(_health.make_canary_probe(
+                        backend, timeout_s=self.health.settle_timeout_s
+                    ))
         return backend
 
     def _retry_dispatch(self, lane: LaneConfig, items, fl=None):
@@ -756,11 +788,7 @@ class VerifyScheduler:
                 return
             ctx = self.tracer.capture()
         backend = self._backend_for(lane)
-        kernel = (
-            "fast_aggregate_fused"
-            if getattr(backend, "fuse_subgroup", False)
-            else "fast_aggregate"
-        )
+        kernel = self._scheme_for(lane).kernel_label(backend)
         for _, _, _, seg_fl in segments:
             seg_fl.record.kernel = kernel
         # two-deep pipelined handoff (backpressure bounds device
@@ -773,71 +801,13 @@ class VerifyScheduler:
     def _device_dispatch(self, lane: LaneConfig, items):
         """Host prep + async device dispatch of one coalesced batch;
         returns a zero-arg settle callable (the batch verdict) or None
-        when no async device seam is available. Mirrors the attestation
-        pipeline: decompress signatures WITHOUT the per-item host
-        subgroup scalar-mul, stack the device ψ-ladder subgroup check
-        and the verify kernel(s), read back nothing yet."""
-        backend = self._backend_for(lane)
-        if backend is None or not (
-            hasattr(backend, "fast_aggregate_verify_batch_async")
-            and hasattr(backend, "g2_subgroup_check_batch_async")
-        ):
-            return None
-        try:
-            with self._stage(lane, "host_prep", op="g2_decompress",
-                             items=len(items)):
-                points = [
-                    A.g2_from_bytes(it.signature, subgroup_check=False)
-                    for it in items
-                ]
-        except A.BlsError:
-            return lambda: False
-        if any(p.is_infinity() for p in points):
-            return lambda: False
-        registry = self._sync_registry(lane, items)
-        indexed, keyed = [], []
-        for i, it in enumerate(items):
-            if registry is not None and it.member_indices is not None:
-                indexed.append(i)
-            else:
-                keyed.append(i)
-        try:
-            with self._stage(lane, "host_prep", op="resolve_keys"):
-                keyed_keys = [items[i].resolve_keys() for i in keyed]
-        except SignatureInvalid:
-            # a keyless/malformed item: fail the batch, bisection isolates
-            return lambda: False
-        # fused backends fold the ψ-ladder membership check into the
-        # verify kernel (one dispatch per batch); two-pass backends stack
-        # the subgroup ladder ahead of the verify dispatch
-        fused = getattr(backend, "fuse_subgroup", False)
-        sub_settle = (
-            None if fused else backend.g2_subgroup_check_batch_async(points)
+        when no async device seam is available. The per-scheme body
+        lives in the tpu/schemes.py dispatch table (`_dispatch_bls` is
+        the former body of this method, moved verbatim); this method is
+        only the lane → scheme route."""
+        return self._scheme_for(lane).device_dispatch(
+            self, lane, self._backend_for(lane), items
         )
-        sigs = [A.Signature(p) for p in points]
-        if self.metrics is not None:
-            self.metrics.device_batch_sigs.inc(len(sigs))
-        settles = []
-        if indexed:
-            settles.append(backend.fast_aggregate_verify_batch_indexed_async(
-                [items[i].message for i in indexed],
-                [sigs[i] for i in indexed],
-                [list(items[i].member_indices) for i in indexed],
-                registry,
-            ))
-        if keyed:
-            settles.append(backend.fast_aggregate_verify_batch_async(
-                [items[i].message for i in keyed],
-                [sigs[i] for i in keyed],
-                keyed_keys,
-            ))
-
-        def settle() -> bool:
-            if sub_settle is not None and not bool(sub_settle().all()):
-                return False
-            return all(bool(s()) for s in settles)
-
-        return settle
 
     def _sync_registry(self, lane: LaneConfig, items):
         """The shared device pubkey registry, brought up to date against
@@ -976,6 +946,10 @@ class VerifyScheduler:
         legacy recursive host bisection."""
         if (
             self._localizer is not None and self.use_device
+            # the RLC-partition localizer is a BLS seam (its host leaves
+            # are SingleVerifier semantics); other schemes bisect, with
+            # their own host twin at the leaf
+            and self._scheme_for(lane).name == "bls"
             and self.health.allow_device()
         ):
             backend = self._backend_for(lane)
@@ -994,7 +968,7 @@ class VerifyScheduler:
         if fl is not None:
             fl.note_bisect(0.0, depth)
         if len(items) == 1:
-            return [host_check_item(items[0])]
+            return [self._scheme_for(lane).host_check(items[0])]
         mid = len(items) // 2
         out: "list[bool]" = []
         for half in (items[:mid], items[mid:]):
@@ -1037,11 +1011,13 @@ class VerifyScheduler:
                     else:
                         self.health.record_fault("settle")
                     # fall through: host verdict for this half
-        return all(host_check_item(it) for it in items)
+        hc = self._scheme_for(lane).host_check
+        return all(hc(it) for it in items)
 
     def _host_check_all(self, lane: LaneConfig, items) -> "list[bool]":
+        hc = self._scheme_for(lane).host_check
         with self._stage(lane, "execute", path="host", items=len(items)):
-            return [host_check_item(it) for it in items]
+            return [hc(it) for it in items]
 
     def _deliver_segments(self, segments, verdicts) -> None:
         """Slice one merged dispatch's verdict vector back into its

@@ -47,7 +47,7 @@ SUBGROUP_BUCKETS = (4, 8, 16, 32, 64, 128)
 WARM_KINDS = ("aggregate", "aggregate_idx", "multi_verify", "sign",
               "subgroup", "rlc_partition", "sharded_multi_verify",
               "sharded_multi_verify_msm", "span_update",
-              "registry_capacity")
+              "registry_capacity", "ed25519_verify", "kzg_blob")
 
 
 def _repo_root() -> str:
@@ -157,6 +157,7 @@ def warm_all(
     from grandine_tpu.crypto.curves import G1
     from grandine_tpu.crypto.hash_to_curve import hash_to_g2
     from grandine_tpu.tpu import bls as B
+    from grandine_tpu.tpu import schemes
     from grandine_tpu.tpu.mesh import mesh_or_none
 
     if enable_cache:
@@ -170,13 +171,18 @@ def warm_all(
         backend if getattr(backend, "mesh", None) is not None else None
     )
     if mesh_backend is None and mesh_or_none(mesh) is not None:
-        mesh_backend = B.TpuBlsBackend(metrics=metrics, mesh=mesh)
+        mesh_backend = schemes.get("bls").make_backend(
+            metrics=metrics, mesh=mesh
+        )
     if backend is None:
-        backend = B.TpuBlsBackend(metrics=metrics)
+        backend = schemes.get("bls").make_backend(metrics=metrics)
     pk = A.PublicKey(G1)
     h = hash_to_g2(b"warmup")
     sig = A.Signature(h)
     sk = A.SecretKey(0x1234_5678)
+    #: lazily-built non-BLS scheme backends (tpu/schemes.py table),
+    #: shared across that scheme's warm rows so each gets one jit cache
+    scheme_backends: "dict[str, object]" = {}
     done = 0
     for kind, b in buckets if buckets is not None else manifest():
         t0 = time.time()
@@ -296,6 +302,62 @@ def warm_all(
                     [[0]] * 4,
                     _ShimRegistry(),
                 )
+            elif kind == "ed25519_verify":
+                # the manifest bucket is the KERNEL batch (point rows
+                # m = 1 + 2n for n items, pow-4 ladder): n = b//2 - 1
+                # items land exactly on bucket b
+                from grandine_tpu.crypto import ed25519 as ED
+                from grandine_tpu.runtime.verify_scheduler import (
+                    VerifyItem,
+                )
+
+                ed_backend = scheme_backends.get("ed25519")
+                if ed_backend is None:
+                    ed_backend = scheme_backends["ed25519"] = schemes.get(
+                        "ed25519"
+                    ).make_backend(metrics=metrics)
+                ed_sk = b"\x42" * 32
+                ed_pk = ED.secret_to_public(ed_sk)
+                ed_sig = ED.sign(ed_sk, b"warmup")
+                n_items = max(1, b // 2 - 1)
+                status, prep = ed_backend.prepare([
+                    VerifyItem(b"warmup", ed_sig, public_keys=(ed_pk,))
+                ] * n_items)
+                if status != "ok":
+                    raise RuntimeError(f"ed25519 warm prep: {status}")
+                ed_backend.verify_batch_async(prep)()
+            elif kind == "kzg_blob":
+                # bucket = _bucket(n_blobs, lo=4, hi=8); the kernel
+                # shape is blob-width independent (width only sizes the
+                # host barycentric prep), so the small dev setup warms
+                # the same executable mainnet blobs dispatch to
+                from grandine_tpu.kzg import eip4844 as KZ
+                from grandine_tpu.kzg.setup import dev_setup
+                from grandine_tpu.runtime.verify_scheduler import (
+                    VerifyItem,
+                )
+
+                kzg_backend = scheme_backends.get("blob_kzg")
+                if kzg_backend is None:
+                    kzg_backend = scheme_backends["blob_kzg"] = (
+                        schemes.get("blob_kzg").make_backend(
+                            metrics=metrics
+                        )
+                    )
+                kzg_setup = dev_setup(8)
+                blob = b"\x00" * (
+                    8 * KZ.BYTES_PER_FIELD_ELEMENT
+                )
+                commitment = KZ.blob_to_kzg_commitment(blob, kzg_setup)
+                proof = KZ.compute_blob_kzg_proof(
+                    blob, commitment, kzg_setup
+                )
+                status, prep = kzg_backend.prepare([
+                    VerifyItem(blob, proof, public_keys=(commitment,))
+                ] * b)
+                if status != "ok":
+                    raise RuntimeError(f"kzg warm prep: {status}")
+                kzg_backend.verify_blobs_async(prep)()
         except Exception as e:  # a failed warm is a lost optimization only
             if progress:
                 progress(f"warm {kind}/{b} FAILED: {e!r}")

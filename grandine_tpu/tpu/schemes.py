@@ -1,0 +1,334 @@
+"""Scheme-generic kernel dispatch table: the verify plane's registry of
+verification schemes.
+
+A *scheme* is everything the scheduler needs to serve one signature /
+proof system from a lane: field/curve parameters (documentation-grade —
+the kernels own the arithmetic), a backend factory, the host scalar
+twin (bisection leaf + degradation target), the device dispatch
+function, the backend's ASYNC_SEAM members, the warmup kinds its
+kernels pre-compile under, and the flight-record kernel label. BLS is
+the first registered entry — `_dispatch_bls` below is the former
+`VerifyScheduler._device_dispatch` body, moved verbatim so no kernel
+name, verdict, or persistent-cache/shape-ledger behavior changed — and
+a new curve is a table entry, not a fork of `tpu/bls.py`.
+
+Lane → scheme binding lives in `LaneConfig.scheme`
+(runtime/verify_scheduler.py); every scheduler seam that used to
+hardcode BLS (`_backend_for`, `_device_dispatch`, the bisection leaf,
+the host degradation pass, the flush kernel label, cross-lane merge
+eligibility) resolves through `get(lane.scheme)` instead.
+
+Import discipline: this module must import NO jax and NO kernel module
+at top level — schemes register lazily so a `use_device=False`
+scheduler (pure host path) never pays a kernel import. The lint rule
+`scheme-dispatch` (tools/lint/rules/scheme_dispatch.py) enforces the
+other direction: runtime/ code reaches kernel factories only through
+this table.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional, Sequence
+
+from grandine_tpu.consensus.verifier import SignatureInvalid
+from grandine_tpu.crypto import bls as A
+
+
+class Scheme:
+    """One registered verification scheme (see module docstring)."""
+
+    __slots__ = (
+        "name", "field_bits", "curve", "make_backend", "host_check",
+        "device_dispatch", "async_seam", "warm_kinds", "kernel_label",
+        "canary",
+    )
+
+    def __init__(
+        self,
+        name: str,
+        *,
+        field_bits: int,
+        curve: str,
+        make_backend: Callable,
+        host_check: Callable,
+        device_dispatch: Callable,
+        async_seam: "Sequence[str]" = (),
+        warm_kinds: "Sequence[str]" = (),
+        kernel_label: "Optional[Callable]" = None,
+        canary: bool = False,
+    ) -> None:
+        self.name = name
+        #: base-field modulus bit length (381 for BLS12-381, 255 for
+        #: curve25519) — shape-contract documentation, not compute state
+        self.field_bits = int(field_bits)
+        self.curve = curve
+        #: make_backend(metrics=, tracer=, lane=, mesh=) → backend
+        self.make_backend = make_backend
+        #: host_check(item) → bool: the scalar twin — bisection leaf and
+        #: degradation target; must agree bit-for-bit with the device
+        #: verdict on every input
+        self.host_check = host_check
+        #: device_dispatch(sched, lane, backend, items) → zero-arg
+        #: settle callable, or None when no async device seam applies
+        #: (the scheduler then degrades the batch to host_check)
+        self.device_dispatch = device_dispatch
+        #: backend method names the warmup/shape tooling treats as the
+        #: async kernel seam (mirrors TpuBlsBackend.ASYNC_SEAM)
+        self.async_seam = tuple(async_seam)
+        #: runtime/warmup.py WARM_KINDS entries owned by this scheme
+        self.warm_kinds = tuple(warm_kinds)
+        #: kernel_label(backend) → flight-record kernel name
+        self.kernel_label = (
+            kernel_label if kernel_label is not None
+            else (lambda backend: f"{name}_verify")
+        )
+        #: only the scheme whose backend answers breaker canary probes
+        #: (BLS — the health supervisor's specimens are BLS triples)
+        self.canary = bool(canary)
+
+
+_REGISTRY: "dict[str, Scheme]" = {}
+_LOCK = threading.Lock()
+
+
+def register(scheme: Scheme) -> Scheme:
+    """Register a scheme. Re-registering a name replaces the entry (the
+    seam tests use this to shadow a scheme with an instrumented twin)."""
+    with _LOCK:
+        _REGISTRY[scheme.name] = scheme
+    return scheme
+
+
+def get(name: str) -> Scheme:
+    with _LOCK:
+        try:
+            return _REGISTRY[name]
+        except KeyError:
+            raise KeyError(
+                f"unknown verification scheme {name!r}; "
+                f"registered: {sorted(_REGISTRY)}"
+            ) from None
+
+
+def names() -> "list[str]":
+    with _LOCK:
+        return sorted(_REGISTRY)
+
+
+# --- BLS12-381 (the founding entry) ----------------------------------------
+
+
+def _make_bls_backend(*, metrics=None, tracer=None, lane="attestation",
+                      mesh=None):
+    from grandine_tpu.tpu.bls import TpuBlsBackend
+
+    return TpuBlsBackend(metrics=metrics, tracer=tracer, lane=lane,
+                         mesh=mesh)
+
+
+def _host_check_bls(item) -> bool:
+    # resolved through the scheduler module AT CALL TIME so tests that
+    # monkeypatch verify_scheduler.host_check_item keep reaching every
+    # leaf (bisection, degradation, localization) exactly as before
+    from grandine_tpu.runtime import verify_scheduler as _vs
+
+    return _vs.host_check_item(item)
+
+
+def _bls_kernel_label(backend) -> str:
+    return (
+        "fast_aggregate_fused"
+        if getattr(backend, "fuse_subgroup", False)
+        else "fast_aggregate"
+    )
+
+
+def _dispatch_bls(sched, lane, backend, items):
+    """Host prep + async device dispatch of one coalesced BLS batch;
+    returns a zero-arg settle callable (the batch verdict) or None when
+    no async device seam is available. Mirrors the attestation pipeline:
+    decompress signatures WITHOUT the per-item host subgroup scalar-mul,
+    stack the device ψ-ladder subgroup check and the verify kernel(s),
+    read back nothing yet. (Moved verbatim from
+    VerifyScheduler._device_dispatch — the scheduler now routes here
+    through the scheme table.)"""
+    if backend is None or not (
+        hasattr(backend, "fast_aggregate_verify_batch_async")
+        and hasattr(backend, "g2_subgroup_check_batch_async")
+    ):
+        return None
+    try:
+        with sched._stage(lane, "host_prep", op="g2_decompress",
+                          items=len(items)):
+            points = [
+                A.g2_from_bytes(it.signature, subgroup_check=False)
+                for it in items
+            ]
+    except A.BlsError:
+        return lambda: False
+    if any(p.is_infinity() for p in points):
+        return lambda: False
+    registry = sched._sync_registry(lane, items)
+    indexed, keyed = [], []
+    for i, it in enumerate(items):
+        if registry is not None and it.member_indices is not None:
+            indexed.append(i)
+        else:
+            keyed.append(i)
+    try:
+        with sched._stage(lane, "host_prep", op="resolve_keys"):
+            keyed_keys = [items[i].resolve_keys() for i in keyed]
+    except SignatureInvalid:
+        # a keyless/malformed item: fail the batch, bisection isolates
+        return lambda: False
+    # fused backends fold the ψ-ladder membership check into the
+    # verify kernel (one dispatch per batch); two-pass backends stack
+    # the subgroup ladder ahead of the verify dispatch
+    fused = getattr(backend, "fuse_subgroup", False)
+    sub_settle = (
+        None if fused else backend.g2_subgroup_check_batch_async(points)
+    )
+    sigs = [A.Signature(p) for p in points]
+    if sched.metrics is not None:
+        sched.metrics.device_batch_sigs.inc(len(sigs))
+    settles = []
+    if indexed:
+        settles.append(backend.fast_aggregate_verify_batch_indexed_async(
+            [items[i].message for i in indexed],
+            [sigs[i] for i in indexed],
+            [list(items[i].member_indices) for i in indexed],
+            registry,
+        ))
+    if keyed:
+        settles.append(backend.fast_aggregate_verify_batch_async(
+            [items[i].message for i in keyed],
+            [sigs[i] for i in keyed],
+            keyed_keys,
+        ))
+
+    def settle() -> bool:
+        if sub_settle is not None and not bool(sub_settle().all()):
+            return False
+        return all(bool(s()) for s in settles)
+
+    return settle
+
+
+register(Scheme(
+    "bls",
+    field_bits=381,
+    curve="BLS12-381",
+    make_backend=_make_bls_backend,
+    host_check=_host_check_bls,
+    device_dispatch=_dispatch_bls,
+    async_seam=(
+        "fast_aggregate_verify_batch_async",
+        "g2_subgroup_check_batch_async",
+        "fast_aggregate_verify_batch_indexed_async",
+        "multi_verify_async",
+        "rlc_partition_verify_async",
+    ),
+    warm_kinds=("aggregate", "aggregate_idx", "subgroup", "multi_verify",
+                "rlc_partition"),
+    kernel_label=_bls_kernel_label,
+    canary=True,
+))
+
+
+# --- Ed25519 (RFC 8032, cofactored batch) ----------------------------------
+
+
+def _make_ed25519_backend(*, metrics=None, tracer=None, lane="ed25519",
+                          mesh=None):
+    from grandine_tpu.tpu.ed25519 import Ed25519Backend
+
+    return Ed25519Backend(metrics=metrics, tracer=tracer, lane=lane)
+
+
+def _host_check_ed25519(item) -> bool:
+    from grandine_tpu.crypto import ed25519 as _he
+
+    return _he.check_item(item)
+
+
+def _dispatch_ed25519(sched, lane, backend, items):
+    """Host prep (point decode, malleability bound, RLC scalars) + one
+    async batched-verify dispatch. Malformed encodings fail the batch
+    (bisection isolates against the host twin); an over-bucket batch
+    returns None so the scheduler degrades it to the host path."""
+    if backend is None or not hasattr(backend, "verify_batch_async"):
+        return None
+    with sched._stage(lane, "host_prep", op="ed25519_decode",
+                      items=len(items)):
+        status, prep = backend.prepare(items)
+    if status == "invalid":
+        return lambda: False
+    if status != "ok":
+        return None
+    if sched.metrics is not None:
+        sched.metrics.device_batch_sigs.inc(len(items))
+    return backend.verify_batch_async(prep)
+
+
+register(Scheme(
+    "ed25519",
+    field_bits=255,
+    curve="curve25519",
+    make_backend=_make_ed25519_backend,
+    host_check=_host_check_ed25519,
+    device_dispatch=_dispatch_ed25519,
+    async_seam=("verify_batch_async",),
+    warm_kinds=("ed25519_verify",),
+))
+
+
+# --- KZG blob proofs (EIP-4844, deneb) -------------------------------------
+
+
+def _make_blob_kzg_backend(*, metrics=None, tracer=None, lane="blob_kzg",
+                           mesh=None):
+    from grandine_tpu.kzg.eip4844 import KzgDeviceBackend
+
+    return KzgDeviceBackend(metrics=metrics, tracer=tracer, lane=lane)
+
+
+def _host_check_blob_kzg(item) -> bool:
+    from grandine_tpu.kzg import eip4844 as _kz
+
+    return _kz.host_check_item(item)
+
+
+def _dispatch_blob_kzg(sched, lane, backend, items):
+    """Host prep (commitment/proof decode, Fiat–Shamir challenges,
+    barycentric evaluations, batch-RLC scalars) + ONE device pass: two
+    shape-contracted MSMs and a width-2 pairing check. Mixed blob widths
+    or an over-bucket batch return None (host degradation — per-item
+    verdicts stay correct); undecodable bytes fail the batch for the
+    bisection to isolate."""
+    if backend is None or not hasattr(backend, "verify_blobs_async"):
+        return None
+    with sched._stage(lane, "host_prep", op="kzg_prep", items=len(items)):
+        status, prep = backend.prepare(items)
+    if status == "invalid":
+        return lambda: False
+    if status != "ok":
+        return None
+    if sched.metrics is not None:
+        sched.metrics.device_batch_sigs.inc(len(items))
+    return backend.verify_blobs_async(prep)
+
+
+register(Scheme(
+    "blob_kzg",
+    field_bits=381,
+    curve="BLS12-381",
+    make_backend=_make_blob_kzg_backend,
+    host_check=_host_check_blob_kzg,
+    device_dispatch=_dispatch_blob_kzg,
+    async_seam=("verify_blobs_async",),
+    warm_kinds=("kzg_blob",),
+))
+
+
+__all__ = ["Scheme", "register", "get", "names"]

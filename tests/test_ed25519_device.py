@@ -1,0 +1,280 @@
+"""Ed25519 device plane: RFC 8032 differential tests for the batched
+cofactored verify kernel (tpu/ed25519.py) against the host scalar twin
+(crypto/ed25519.py), plus the scheduler's `ed25519` lane round-trip.
+
+The host twin is COFACTORED ([8](SB - R - kA) == identity) to match the
+device batch equation, so the two paths are byte-identical on every
+input — including the small-torsion specimens where cofactored and
+cofactorless verifiers legitimately disagree. Malleable encodings
+(S >= L) are rejected in `prepare` before either equation runs.
+
+Kernel-compiling cells are marked slow+kernel and keep every batch at
+n <= 3 items (ladder rows m = 1 + 2n <= 7 -> one bucket-8 compile for
+the whole module); the fast unmarked cells exercise the host twin, the
+prepare statuses, and the scheduler lane's host degradation path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from grandine_tpu.crypto import ed25519 as HE
+
+# RFC 8032 test-vector secret keys (TEST 1 / TEST 3)
+SK1 = bytes.fromhex(
+    "9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60"
+)
+SK3 = bytes.fromhex(
+    "c5aa8df43f9f837bedb7442f31dcb7b166d38535076f094b85ce3a2e0b4458f7"
+)
+MSG3 = bytes.fromhex("af82")
+
+
+class Item:
+    """Scheduler-geometry item: ed25519 rides the (message, signature,
+    public_keys) slots exactly like a BLS VerifyItem."""
+
+    def __init__(self, pk: bytes, msg: bytes, sig: bytes) -> None:
+        self.public_keys = (pk,)
+        self.message = msg
+        self.signature = sig
+
+
+class FixedRng:
+    """Deterministic stand-in for the backend's RLC-coefficient rng."""
+
+    def __init__(self, seed: int = 7) -> None:
+        self._r = np.random.default_rng(seed)
+
+    def randbits(self, n: int) -> int:
+        return int.from_bytes(self._r.bytes(n // 8), "little")
+
+
+def _backend():
+    from grandine_tpu.tpu.ed25519 import Ed25519Backend
+
+    return Ed25519Backend(rng=FixedRng())
+
+
+def _run_batch(items) -> bool:
+    be = _backend()
+    status, prep = be.prepare(items)
+    assert status == "ok", status
+    return be.verify_batch_async(prep)()
+
+
+def _torsion_signature(sk: bytes, msg: bytes) -> "tuple[bytes, bytes]":
+    """A signature whose R carries a 2-torsion component: accepted by
+    cofactored verification, rejected cofactorless."""
+    a, prefix = HE.secret_expand(sk)
+    pk = HE.secret_to_public(sk)
+    r = int.from_bytes(HE.sha512(prefix + msg), "little") % HE.L
+    r_tor = HE.point_add(HE.point_mul(r, HE.BASE), HE.ORDER2)
+    r_enc = HE.point_compress(r_tor)
+    k = int.from_bytes(HE.sha512(r_enc + pk + msg), "little") % HE.L
+    s = (r + k * a) % HE.L
+    return pk, r_enc + s.to_bytes(32, "little")
+
+
+# ------------------------------------------------- host twin (fast)
+
+
+def test_host_twin_rfc8032_vectors():
+    pk1 = HE.secret_to_public(SK1)
+    assert pk1 == bytes.fromhex(
+        "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a"
+    )
+    sig1 = HE.sign(SK1, b"")
+    assert sig1 == bytes.fromhex(
+        "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e06522490155"
+        "5fb8821590a33bacc61e39701cf9b46bd25bf5f0595bbe24655141438e7a100b"
+    )
+    assert HE.verify(pk1, b"", sig1) is True
+    pk3 = HE.secret_to_public(SK3)
+    sig3 = HE.sign(SK3, MSG3)
+    assert HE.verify(pk3, MSG3, sig3) is True
+    assert HE.verify(pk3, MSG3 + b"\x00", sig3) is False
+    assert HE.verify(pk1, b"", sig3) is False
+
+
+def test_host_twin_is_cofactored():
+    pk, sig = _torsion_signature(SK1, b"torsion")
+    assert HE.verify(pk, b"torsion", sig) is True
+
+
+def test_host_twin_rejects_malleable_s():
+    sig1 = HE.sign(SK1, b"")
+    s_mall = int.from_bytes(sig1[32:], "little") + HE.L
+    assert HE.verify(
+        HE.secret_to_public(SK1), b"", sig1[:32] + s_mall.to_bytes(32, "little")
+    ) is False
+
+
+# --------------------------------------------- prepare statuses (fast)
+
+
+def test_prepare_rejects_malleable_and_malformed():
+    be = _backend()
+    pk1 = HE.secret_to_public(SK1)
+    sig1 = HE.sign(SK1, b"")
+    s_mall = int.from_bytes(sig1[32:], "little") + HE.L
+    mall = sig1[:32] + s_mall.to_bytes(32, "little")
+    assert be.prepare([Item(pk1, b"", mall)])[0] == "invalid"
+    assert be.prepare([Item(b"\xff" * 32, b"", sig1)])[0] == "invalid"
+    assert be.prepare([Item(pk1, b"", sig1[:-1])])[0] == "invalid"
+
+
+def test_prepare_oversize_and_empty():
+    be = _backend()
+    pk1 = HE.secret_to_public(SK1)
+    sig1 = HE.sign(SK1, b"")
+    assert be.prepare([Item(pk1, b"", sig1)] * 64)[0] == "oversize"
+    status, prep = be.prepare([])
+    assert status == "ok"
+    # empty batch settles True without any kernel dispatch
+    assert be.verify_batch_async(prep)() is True
+
+
+# -------------------------------------------- field/point plane (fast)
+
+
+def test_field_montmul_matches_host_ints():
+    import jax.numpy as jnp
+
+    from grandine_tpu.tpu import ed25519 as DE
+
+    rng = np.random.default_rng(0)
+    for _ in range(6):
+        a = int(rng.integers(0, 2**63)) * int(rng.integers(0, 2**63)) % HE.P
+        b = int(rng.integers(0, 2**63)) ** 3 % HE.P
+        prod = DE.montmul(
+            jnp.asarray(DE.to_mont(a)), jnp.asarray(DE.to_mont(b))
+        )
+        assert DE.from_mont(np.asarray(prod)) == a * b % HE.P
+    z = DE.montmul(jnp.asarray(DE.to_mont(0)), jnp.asarray(DE.ONE_MONT))
+    assert bool(DE.is_zero_val(z))
+    nz = DE.montmul(jnp.asarray(DE.to_mont(5)), jnp.asarray(DE.ONE_MONT))
+    assert not bool(DE.is_zero_val(nz))
+
+
+def test_unified_add_matches_host_double():
+    import jax.numpy as jnp
+
+    from grandine_tpu.tpu import ed25519 as DE
+
+    def to_dev(p):
+        x, y, z, _t = p
+        zinv = pow(z, HE.P - 2, HE.P)
+        xa, ya = x * zinv % HE.P, y * zinv % HE.P
+        return (
+            jnp.asarray(DE.to_mont(xa)),
+            jnp.asarray(DE.to_mont(ya)),
+            jnp.asarray(DE.ONE_MONT),
+            jnp.asarray(DE.to_mont(xa * ya % HE.P)),
+        )
+
+    got = DE.ed_add(to_dev(HE.BASE), to_dev(HE.BASE))
+    x, y, z, _t = (DE.from_mont(np.asarray(c)) for c in got)
+    zinv = pow(z, HE.P - 2, HE.P)
+    b2 = HE.point_add(HE.BASE, HE.BASE)
+    b2zinv = pow(b2[2], HE.P - 2, HE.P)
+    assert (x * zinv % HE.P, y * zinv % HE.P) == (
+        b2[0] * b2zinv % HE.P,
+        b2[1] * b2zinv % HE.P,
+    )
+
+
+# -------------------------------------- device kernel (slow+kernel)
+
+
+@pytest.mark.kernel
+@pytest.mark.slow
+def test_device_batch_differential():
+    """Every verdict class through ONE bucket-8 kernel compile: valid
+    RFC 8032 batch, forged message, forged S, the torsion specimen
+    (cofactored twin and device must both accept), and a seeded random
+    sweep where the batch verdict equals the AND of host verdicts."""
+    pk1 = HE.secret_to_public(SK1)
+    sig1 = HE.sign(SK1, b"")
+    pk3 = HE.secret_to_public(SK3)
+    sig3 = HE.sign(SK3, MSG3)
+
+    assert _run_batch([Item(pk1, b"", sig1), Item(pk3, MSG3, sig3)]) is True
+    assert _run_batch(
+        [Item(pk1, b"", sig1), Item(pk3, b"\x00" + MSG3, sig3)]
+    ) is False
+    s_bad = (int.from_bytes(sig1[32:], "little") + 1) % HE.L
+    assert _run_batch(
+        [Item(pk1, b"", sig1[:32] + s_bad.to_bytes(32, "little"))]
+    ) is False
+
+    pk_t, sig_t = _torsion_signature(SK1, b"torsion")
+    assert HE.verify(pk_t, b"torsion", sig_t) is True
+    assert _run_batch([Item(pk_t, b"torsion", sig_t)]) is True
+
+    rng = np.random.default_rng(42)
+    for trial in range(4):
+        items, expect = [], True
+        for _ in range(int(rng.integers(1, 4))):  # n <= 3: same bucket
+            sk = rng.bytes(32)
+            pk = HE.secret_to_public(sk)
+            msg = rng.bytes(int(rng.integers(0, 40)))
+            sig = HE.sign(sk, msg)
+            if rng.random() < 0.3:
+                msg = msg + b"!"
+            it = Item(pk, msg, sig)
+            expect = expect and HE.check_item(it)
+            items.append(it)
+        assert _run_batch(items) == expect, trial
+
+
+@pytest.mark.kernel
+@pytest.mark.slow
+def test_scheduler_ed25519_lane_device_roundtrip():
+    """The `ed25519` lane end to end on the real device backend: a good
+    batch accepts, a forged item fails its batch and bisection isolates
+    it against the host twin — with zero device faults (rejection is a
+    verdict, not a fault)."""
+    from grandine_tpu.runtime import verify_scheduler as vs
+
+    sched = vs.VerifyScheduler(use_device=True, settle_timeout_s=300.0)
+    try:
+        sks = [bytes([i]) * 32 for i in range(1, 4)]
+        pks = [HE.secret_to_public(sk) for sk in sks]
+        msgs = [b"msg-%d" % i for i in range(3)]
+        sigs = [HE.sign(sk, m) for sk, m in zip(sks, msgs)]
+        items = [
+            vs.VerifyItem(m, s, public_keys=(pk,))
+            for m, s, pk in zip(msgs, sigs, pks)
+        ]
+        assert sched.submit("ed25519", items).result(300.0) is True
+        forged = vs.VerifyItem(b"other", sigs[0], public_keys=(pks[0],))
+        assert sched.submit("ed25519", [items[0], forged]).result(
+            300.0
+        ) is False
+        stats = dict(sched.stats.get("ed25519", {}))
+        assert stats.get("device_faults", 0) == 0
+    finally:
+        sched.stop()
+
+
+# ------------------------------------ scheduler host path (fast)
+
+
+def test_scheduler_ed25519_lane_host_path():
+    """use_device=False: the lane resolves verdicts through the host
+    twin — no kernel, same byte-identical answers."""
+    from grandine_tpu.runtime import verify_scheduler as vs
+
+    sched = vs.VerifyScheduler(use_device=False)
+    try:
+        sk = bytes([9]) * 32
+        pk = HE.secret_to_public(sk)
+        sig = HE.sign(sk, b"host-path")
+        good = vs.VerifyItem(b"host-path", sig, public_keys=(pk,))
+        assert sched.submit("ed25519", [good]).result(60.0) is True
+        bad = vs.VerifyItem(b"forged", sig, public_keys=(pk,))
+        assert sched.submit("ed25519", [good, bad]).result(60.0) is False
+    finally:
+        sched.stop()

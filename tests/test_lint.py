@@ -436,6 +436,68 @@ class W:
 """, "thread-crash-containment") == 0
 
 
+def test_scheme_dispatch_flags_direct_backend_construction(tmp_path):
+    """runtime/ building a device backend class behind the scheme
+    table's back — through any import alias — is the seed violation."""
+    assert lint(tmp_path, """
+from grandine_tpu.tpu import bls as B
+
+def make_verifier(metrics):
+    return B.TpuBlsBackend(metrics=metrics)
+""", "scheme-dispatch") == 1
+    assert lint(tmp_path, """
+def lane_backend():
+    from grandine_tpu.kzg.eip4844 import KzgDeviceBackend
+
+    return KzgDeviceBackend(metrics=None)
+""", "scheme-dispatch") == 1
+
+
+def test_scheme_dispatch_flags_kernel_entry_imports(tmp_path):
+    """Cross-scheme kernel entry points (``*_kernel``, the jit-cache
+    factory) must not leak into runtime/ imports."""
+    assert lint(tmp_path, """
+from grandine_tpu.tpu.ed25519 import verify_kernel
+
+def check(prep):
+    return verify_kernel(*prep)
+""", "scheme-dispatch") == 1
+    assert lint(tmp_path, """
+from grandine_tpu.tpu.bls import _jitted_global
+""", "scheme-dispatch") == 1
+
+
+def test_scheme_dispatch_allows_table_and_host_helpers(tmp_path):
+    """The sanctioned idioms: schemes.get(...).make_backend(...), host
+    verdict twins, and constants/setup helpers from kernel modules."""
+    assert lint(tmp_path, """
+from grandine_tpu.kzg.eip4844 import (
+    BYTES_PER_FIELD_ELEMENT,
+    _setup_for_width,
+)
+from grandine_tpu.tpu import schemes
+
+
+def make_verifier(metrics, tracer):
+    return schemes.get("bls").make_backend(metrics=metrics, tracer=tracer)
+
+
+def host_leaf(item):
+    return schemes.get("blob_kzg").host_check(item)
+""", "scheme-dispatch") == 0
+
+
+def test_scheme_dispatch_clean_on_runtime():
+    """The repo's runtime/ package itself satisfies the rule (default
+    path set = grandine_tpu/runtime/*.py)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.lint",
+         "--rules", "scheme-dispatch"],
+        cwd=REPO, capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stderr + proc.stdout
+
+
 # ------------------------------------------------ suppression + baseline
 
 

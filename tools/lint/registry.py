@@ -16,6 +16,7 @@ def all_rules():
         NoInlineGossipVerifyRule,
     )
     from tools.lint.rules.no_per_batch_upload import NoPerBatchUploadRule
+    from tools.lint.rules.scheme_dispatch import SchemeDispatchRule
     from tools.lint.rules.shape_contract import ShapeContractRule
     from tools.lint.rules.thread_affinity import ThreadAffinityRule
     from tools.lint.rules.thread_crash_containment import (
@@ -32,6 +33,7 @@ def all_rules():
         MetricsCardinalityRule(),
         JitPurityRule(),
         NoPerBatchUploadRule(),
+        SchemeDispatchRule(),
         ThreadCrashContainmentRule(),
         ThreadAffinityRule(),
         ShapeContractRule(),

@@ -61,6 +61,9 @@ class HostSyncRule(Rule):
         "grandine_tpu/runtime/isolation.py",
         "grandine_tpu/slasher.py",
         "grandine_tpu/tpu/spans.py",
+        "grandine_tpu/tpu/schemes.py",
+        "grandine_tpu/tpu/ed25519.py",
+        "grandine_tpu/kzg/eip4844.py",
     )
 
     def check(self, ctx: Context, files):

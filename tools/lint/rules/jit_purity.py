@@ -70,10 +70,16 @@ class JitPurityRule(Rule):
         if targets:
             return [t for t in targets if ctx.source(t) is not None]
         pattern = os.path.join(ctx.root, "grandine_tpu", "tpu", "*.py")
-        return sorted(
+        files = sorted(
             os.path.relpath(p, ctx.root).replace(os.sep, "/")
             for p in glob.glob(pattern)
         )
+        # the KZG device plane jits kernels outside tpu/ — same purity
+        # contract (kernels reach jit through bls._jitted_global)
+        extra = "grandine_tpu/kzg/eip4844.py"
+        if ctx.source(extra) is not None:
+            files.append(extra)
+        return files
 
     def check(self, ctx: Context, files):
         out: "list[Finding]" = []

@@ -1,0 +1,123 @@
+"""Rule: runtime/ reaches device-kernel factories only through the
+scheme table (grandine_tpu/tpu/schemes.py), never by constructing a
+backend class or importing a kernel entry point directly.
+
+The multi-scheme device plane keys every scheduler seam — backend
+construction, async dispatch, bisection leaf, warmup kinds, flight
+labels — off `schemes.get(name)`. A runtime module that builds
+`TpuBlsBackend(...)` (or `Ed25519Backend` / `KzgDeviceBackend`) behind
+the table's back forks the kernel wiring: its backend misses the
+canary-probe gate, its kernels dodge the scheme's warm-kind manifest
+rows, and adding a scheme stops being "one table entry". Likewise a
+runtime import of a kernel entry point (`*_kernel`, `_jitted_global`)
+couples scheduler code to one scheme's kernel internals — the exact
+cross-scheme leakage the table exists to prevent.
+
+Detections, over `grandine_tpu/runtime/*.py`:
+
+1. Any call whose target resolves to a device backend class name
+   (`TpuBlsBackend`, `Ed25519Backend`, `KzgDeviceBackend`), through any
+   import alias (`B.TpuBlsBackend(...)` included) — construct via
+   `schemes.get(<scheme>).make_backend(...)`.
+2. `from <kernel module> import <entry point>` where the kernel modules
+   are grandine_tpu.tpu.bls / grandine_tpu.tpu.ed25519 /
+   grandine_tpu.kzg.eip4844 and an entry point is a backend class,
+   a `*_kernel` function, or `_jitted_global`. Host-side helpers
+   (verdict twins, constants, setup resolvers) stay importable.
+"""
+
+from __future__ import annotations
+
+import ast
+import glob
+import os
+
+from tools.lint.core import Context, Finding, Rule, dotted
+
+#: device backend classes — one per registered scheme
+BACKEND_CLASSES = {"TpuBlsBackend", "Ed25519Backend", "KzgDeviceBackend"}
+
+#: modules whose kernel entry points runtime/ must not import
+KERNEL_MODULES = {
+    "grandine_tpu.tpu.bls",
+    "grandine_tpu.tpu.ed25519",
+    "grandine_tpu.kzg.eip4844",
+}
+
+
+def _is_kernel_entry(name: str) -> bool:
+    """Backend classes, jitted kernel functions, and the global jit-cache
+    factory are kernel entry points; everything else in the kernel
+    modules (host twins, constants, width/setup helpers) is fair game."""
+    return (
+        name in BACKEND_CLASSES
+        or name == "_jitted_global"
+        or name.endswith("_kernel")
+    )
+
+
+class SchemeDispatchRule(Rule):
+    name = "scheme-dispatch"
+    description = (
+        "runtime/ constructs device backends only via "
+        "schemes.get(<scheme>).make_backend and imports no kernel "
+        "entry points from kernel modules"
+    )
+
+    def files(self, ctx: Context, targets):
+        if targets:
+            return [t for t in targets if ctx.source(t) is not None]
+        pattern = os.path.join(
+            ctx.root, "grandine_tpu", "runtime", "*.py"
+        )
+        return sorted(
+            os.path.relpath(p, ctx.root).replace(os.sep, "/")
+            for p in glob.glob(pattern)
+        )
+
+    def check(self, ctx: Context, files):
+        out: "list[Finding]" = []
+        for path in files:
+            tree = ctx.tree(path)
+            if tree is None:
+                continue
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Call):
+                    name = dotted(node.func)
+                    leaf = name.rsplit(".", 1)[-1] if name else None
+                    if leaf in BACKEND_CLASSES:
+                        out.append(Finding(
+                            self.name, path, node.lineno,
+                            f"constructs {leaf} directly — go through "
+                            f"schemes.get(<scheme>).make_backend(...) so "
+                            f"the backend stays inside the scheme "
+                            f"table's canary/warmup/label wiring",
+                            key=f"{self.name}:{path}:construct:{leaf}",
+                        ))
+                    elif leaf == "_jitted_global":
+                        out.append(Finding(
+                            self.name, path, node.lineno,
+                            "calls the kernel jit-cache factory "
+                            "_jitted_global from runtime/ — kernel "
+                            "compilation belongs to the scheme's "
+                            "backend, not scheduler code",
+                            key=f"{self.name}:{path}:jitcache",
+                        ))
+                elif isinstance(node, ast.ImportFrom):
+                    if node.level or node.module not in KERNEL_MODULES:
+                        continue
+                    for alias in node.names:
+                        if _is_kernel_entry(alias.name):
+                            out.append(Finding(
+                                self.name, path, node.lineno,
+                                f"imports kernel entry point "
+                                f"{alias.name} from {node.module} — "
+                                f"runtime/ reaches kernels only through "
+                                f"the scheme table "
+                                f"(grandine_tpu/tpu/schemes.py)",
+                                key=(
+                                    f"{self.name}:{path}:import:"
+                                    f"{node.module}.{alias.name}"
+                                ),
+                            ))
+        return out

@@ -59,6 +59,9 @@ MANIFEST_PATH = "tools/shapes/manifest.txt"
 BLS_PATH = "grandine_tpu/tpu/bls.py"
 REGISTRY_PATH = "grandine_tpu/tpu/registry.py"
 SPANS_PATH = "grandine_tpu/tpu/spans.py"
+ED25519_PATH = "grandine_tpu/tpu/ed25519.py"
+KZG_PATH = "grandine_tpu/kzg/eip4844.py"
+SCHEMES_PATH = "grandine_tpu/tpu/schemes.py"
 VERIFIER_PATH = "grandine_tpu/runtime/attestation_verifier.py"
 SCHEDULER_PATH = "grandine_tpu/runtime/verify_scheduler.py"
 REPLAY_PATH = "grandine_tpu/runtime/replay.py"
@@ -71,10 +74,14 @@ TPU_FILES = (
     "grandine_tpu/tpu/pairing.py",
     REGISTRY_PATH,
     SPANS_PATH,
+    ED25519_PATH,
 )
+#: modules registering kernels through bls._jitted_global and declaring
+#: their own backend ASYNC_SEAM — one per non-BLS scheme
+SCHEME_FILES = (ED25519_PATH, KZG_PATH, SCHEMES_PATH)
 RUNTIME_FILES = (VERIFIER_PATH, SCHEDULER_PATH, REPLAY_PATH,
                  ISOLATION_PATH)
-DEFAULT_FILES = TPU_FILES + RUNTIME_FILES
+DEFAULT_FILES = TPU_FILES + (KZG_PATH, SCHEMES_PATH) + RUNTIME_FILES
 
 #: named jit factories: call sites register a kernel under a literal name
 _FACTORY_JIT = {"_jitted_global", "_jitted"}
@@ -275,6 +282,21 @@ class Analysis:
             rows.append((
                 "registry_capacity", (mainnet_cap,),
                 "policy:mainnet-registry",
+            ))
+        # the non-BLS schemes' lanes (tpu/schemes.py): the ed25519
+        # batch-verify kernel buckets on a sparse pow-4 ladder
+        # (tpu/ed25519._ladder_bucket) up to its 63-item lane cap; the
+        # KZG blob kernel pads the item count with the bls _bucket
+        # helper (lo=4) up to its 8-item lane cap — the flat point
+        # array is 4 groups of that bucket, so two rungs cover the
+        # whole dispatch universe
+        if any(e.kernel == "ed25519_verify" for e in self.entries):
+            rows.append((
+                "ed25519_verify", (8, 32, 128), "policy:ed25519-lane",
+            ))
+        if any(e.kernel == "kzg_blob_verify" for e in self.entries):
+            rows.append((
+                "kzg_blob", (4, 8), "policy:blob-kzg-lane",
             ))
         return rows
 
@@ -906,23 +928,32 @@ def _parse_lanes(tree: ast.AST):
 
 
 def _parse_async_seam(ctx: Context) -> "set[str] | None":
-    tree = ctx.tree(BLS_PATH)
-    if tree is None:
-        return None
-    for node in ast.walk(tree):
-        if (
-            isinstance(node, ast.Assign)
-            and len(node.targets) == 1
-            and isinstance(node.targets[0], ast.Name)
-            and node.targets[0].id == "ASYNC_SEAM"
-            and isinstance(node.value, (ast.Tuple, ast.List))
-        ):
-            return {
-                str(e.value)
-                for e in node.value.elts
-                if isinstance(e, ast.Constant)
-            }
-    return None
+    """The UNION of every scheme backend's ASYNC_SEAM declaration
+    (tpu/bls.py, tpu/ed25519.py, kzg/eip4844.py): the scheduler's
+    `_device_dispatch` and the scheme table's `_dispatch_*` functions
+    may only cross the device seam through a declared member, whichever
+    scheme the batch belongs to."""
+    seam: "set[str]" = set()
+    found = False
+    for path in (BLS_PATH, ED25519_PATH, KZG_PATH):
+        tree = ctx.tree(path)
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "ASYNC_SEAM"
+                and isinstance(node.value, (ast.Tuple, ast.List))
+            ):
+                found = True
+                seam |= {
+                    str(e.value)
+                    for e in node.value.elts
+                    if isinstance(e, ast.Constant)
+                }
+    return seam if found else None
 
 
 def _check_seam(ctx, scan: _FileScan, findings: "list[Finding]") -> None:
@@ -930,7 +961,9 @@ def _check_seam(ctx, scan: _FileScan, findings: "list[Finding]") -> None:
     if seam is None:
         return
     for cls, fn in scan.functions:
-        if fn.name != "_device_dispatch":
+        if fn.name != "_device_dispatch" and not fn.name.startswith(
+            "_dispatch_"
+        ):
             continue
         for node in scan.scope_statements(fn):
             if (
@@ -943,9 +976,9 @@ def _check_seam(ctx, scan: _FileScan, findings: "list[Finding]") -> None:
                 findings.append(Finding(
                     RULE, scan.path, node.lineno,
                     f"{qual} crosses the device seam through "
-                    f"{node.func.attr}, which bls.py does not declare "
-                    "in ASYNC_SEAM — fault injection and shape warmup "
-                    "cannot see it",
+                    f"{node.func.attr}, which no scheme backend "
+                    "declares in ASYNC_SEAM — fault injection and "
+                    "shape warmup cannot see it",
                     key=f"{RULE}:{scan.path}:{qual}:"
                         f"off-seam:{node.func.attr}",
                 ))
@@ -985,7 +1018,7 @@ def analyze(
             analysis.sites.extend(
                 _check_dispatch_fn(scan, cls, fn, scopes[fn], findings)
             )
-        if path in RUNTIME_FILES:
+        if path in RUNTIME_FILES or path == SCHEMES_PATH:
             _check_seam(ctx, scan, findings)
 
     registered = {e.kernel for e in analysis.entries}
