@@ -113,6 +113,15 @@ def build_parser() -> argparse.ArgumentParser:
                      help="append finished spans to this JSONL file (the "
                           "live ring buffer also serves "
                           "/eth/v1/debug/grandine/trace)")
+    run.add_argument("--profile-dir", default=None,
+                     help="root directory for on-demand device profile "
+                          "captures (GET /eth/v1/debug/grandine/profile"
+                          "?action=start); unset = annotation-only "
+                          "capture sessions")
+    run.add_argument("--profile-on-start", action="store_true",
+                     help="open a profiler capture session at node start "
+                          "(stop it via /eth/v1/debug/grandine/profile"
+                          "?action=stop)")
     run.add_argument("--listen-port", type=int, default=None,
                      help="serve p2p (TCP gossip + req/resp) on this port "
                           "(0 = pick a free port)")
@@ -256,6 +265,18 @@ def _node_once(args, cfg) -> int:
     )
     if getattr(args, "quarantine_exit_clean", None):
         node.reputation.exit_clean = max(1, args.quarantine_exit_clean)
+    if getattr(args, "profile_dir", None):
+        node.profiler.trace_root = args.profile_dir
+        print(f"profile captures -> {args.profile_dir}")
+    if getattr(args, "profile_on_start", False):
+        node.profiler.start(note="cli --profile-on-start")
+        # One-shot: the restart supervisor re-runs _node_once after a
+        # crash, and on a saturated host the open trace can be what
+        # starved the node — never re-open a session over the crashed
+        # one (its global jax trace may still be running).
+        args.profile_on_start = False
+        print("profiler capture session open "
+              "(GET /eth/v1/debug/grandine/profile?action=stop closes it)")
     if getattr(args, "admission_max_share", None):
         node.admission.max_share = args.admission_max_share
     if args.use_device and not getattr(args, "no_warm", False):
@@ -419,6 +440,7 @@ def _node_once(args, cfg) -> int:
             data_dir=args.data_dir,
             tracer=tracer,
             flight=node.flight,
+            profiler=node.profiler,
         )
         server, _thread = serve(ctx, port=args.http_port)
         print(f"Beacon API on http://127.0.0.1:{args.http_port}")

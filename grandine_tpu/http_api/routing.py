@@ -49,6 +49,7 @@ class ApiContext:
         data_dir: "Optional[str]" = None,
         tracer=None,
         flight=None,
+        profiler=None,
     ) -> None:
         self.controller = controller
         self.cfg = cfg
@@ -73,6 +74,10 @@ class ApiContext:
         #: runtime.flight.FlightRecorder backing
         #: /eth/v1/debug/grandine/flight (verify-plane batch timeline)
         self.flight = flight
+        #: runtime.profiler.KernelProfiler backing
+        #: /eth/v1/debug/grandine/profile (device-time attribution +
+        #: capture session control)
+        self.profiler = profiler
         #: pubkey-hex -> SignedValidatorRegistrationV1 JSON (builder flow)
         self.validator_registrations: "dict[str, dict]" = {}
         #: validator index -> fee recipient (prepare_beacon_proposer)
@@ -636,6 +641,47 @@ def get_debug_flight(ctx, params, query, body):
             "slo": ctx.flight.slo_misses(),
             "origins": ctx.flight.origins.snapshot(),
         }
+    }
+
+
+def get_debug_profile(ctx, params, query, body):
+    """Kernel-profiler view + capture control. Default GET serves the
+    always-on estimator (per-kernel device seconds, dispatch counts,
+    the finished-session ring, HBM family bytes, coverage vs the flight
+    recorder); `?kernel=` / `?scheme=` filter the estimator rows,
+    `?n=` bounds the session list. `?action=start[&trace_dir=...]`
+    opens a capture session (409 when one is active), `?action=stop`
+    closes it and returns the finished session record."""
+    if ctx.profiler is None:
+        raise ApiError(503, "profiler not wired")
+    action = str(query.get("action", "")).lower()
+    if action == "start":
+        trace_dir = query.get("trace_dir") or None
+        try:
+            sess = ctx.profiler.start(trace_dir=trace_dir)
+        except RuntimeError as exc:
+            raise ApiError(409, str(exc)) from None
+        return {"data": {"session": sess}}
+    if action == "stop":
+        try:
+            sess = ctx.profiler.stop()
+        except RuntimeError as exc:
+            raise ApiError(409, str(exc)) from None
+        return {"data": {"session": sess}}
+    if action:
+        raise ApiError(400, "action must be start or stop")
+    kernel = query.get("kernel") or None
+    scheme = query.get("scheme") or None
+    try:
+        n = int(query.get("n", 32))
+    except ValueError:
+        raise ApiError(400, "n must be an integer") from None
+    if n < 0:
+        raise ApiError(400, "n must be non-negative")
+    return {
+        "data": ctx.profiler.summary(
+            kernel=kernel, scheme=scheme, n_sessions=n, flight=ctx.flight
+        )
     }
 
 
@@ -1586,6 +1632,7 @@ def build_router() -> Router:
     r.add("GET", "/metrics", get_metrics)
     r.add("GET", "/eth/v1/debug/grandine/trace", get_debug_trace)
     r.add("GET", "/eth/v1/debug/grandine/flight", get_debug_flight)
+    r.add("GET", "/eth/v1/debug/grandine/profile", get_debug_profile)
     # state breadth (routing.rs:341-369)
     r.add(
         "GET", "/eth/v1/beacon/states/{state_id}/committees",

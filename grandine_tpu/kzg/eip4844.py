@@ -161,7 +161,10 @@ def _msm_device(setup: TrustedSetup, scalars: "Sequence[int]") -> Point:
     bits = C.scalars_to_bits_msb([s % BLS_MODULUS for s in scalars], 255)
     args = (xs, ys, inf, bits)
     note_dispatch_shapes("kzg_msm", args)
-    X, Y, Z = fn(*args)
+    from grandine_tpu.tpu.bls import _node_profiler
+
+    with _node_profiler().annotate("kzg_msm", len(scalars)):
+        X, Y, Z = fn(*args)
     import numpy as np
 
     return C.dev_to_g1_point(np.asarray(X), np.asarray(Y), np.asarray(Z))
@@ -564,14 +567,19 @@ class KzgDeviceBackend:
         args = (px, py, pinf, bits, q2x, q2y)
         note_dispatch_shapes("kzg_blob_verify", args, self.metrics)
         self._count_kernel("kzg_blob_verify", n)
+        from grandine_tpu.tpu.bls import _node_profiler
+
+        prof_scope = _node_profiler().annotate("kzg_blob_verify", n)
         if self.tracer is not None:
             with self.tracer.span(
                 "device_dispatch",
                 {"kernel": "kzg_blob_verify", "lane": self.lane},
             ):
-                out = fn(*args)
+                with prof_scope:
+                    out = fn(*args)
         else:
-            out = fn(*args)
+            with prof_scope:
+                out = fn(*args)
 
         def settle() -> bool:
             return bool(np.asarray(out).all())

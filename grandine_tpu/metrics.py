@@ -620,6 +620,27 @@ class Metrics:
             "time-weighted mean verify batches in flight (the two-deep "
             "overlap's real depth)",
         )
+        # device-time profiling plane (runtime/profiler.py): dispatch→
+        # settle deltas reconciled from committed flight records, live
+        # device bytes by array family, and capture-session churn.
+        # Labels are the CLOSED kernel/scheme/family sets — never
+        # session ids (lint: metrics-cardinality)
+        self.verify_device_seconds = LabeledCounter(
+            "verify_device_seconds_total",
+            "estimated device seconds attributed per kernel and scheme "
+            "(flight-record dispatch-to-settle deltas)",
+            ("kernel", "scheme"),
+        )
+        self.verify_device_hbm_bytes = LabeledGauge(
+            "verify_device_hbm_bytes",
+            "live device bytes by array family (jax.live_arrays "
+            "snapshot, taken at session close or on demand)",
+            ("family",),
+        )
+        self.verify_profile_sessions = Counter(
+            "verify_profile_sessions_total",
+            "profiler capture sessions started",
+        )
         # bulk replay pipeline (runtime/replay.py): whole-window wall
         # time (transition+collect through settle), cross-block
         # signature sets and blocks verified, and how many windows are

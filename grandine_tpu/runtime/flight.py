@@ -326,6 +326,11 @@ class FlightRecorder:
             )
         self.default_budget_s = float(default_budget_s)
         self.origins = OriginTable(origin_top_k)
+        #: runtime.profiler.KernelProfiler hook: every committed record
+        #: carrying a kernel feeds its dispatch→settle device seconds to
+        #: the profiler's always-on estimator (node.py wires the node's
+        #: profiler here; None = no attribution, recording unchanged)
+        self.profiler = None
         #: ring storage: preallocated slots, one short-hold lock around
         #: index bumps and duty-cycle accounting — record assembly and
         #: SLO attribution happen outside it
@@ -398,6 +403,9 @@ class FlightRecorder:
                 m.verify_padding_waste.inc(
                     rec.kernel, amount=rec.bucket - rec.items
                 )
+        prof = self.profiler
+        if prof is not None and rec.kernel:
+            prof.on_batch(rec)
         waste = rec.bucket - rec.items
         with self._lock:
             self._batches += 1
@@ -498,6 +506,16 @@ class FlightRecorder:
     def duty_cycle(self) -> float:
         with self._lock:
             return self._duty_locked(self.clock())
+
+    def busy_seconds(self) -> float:
+        """Total wall seconds with at least one batch on the device —
+        the denominator of the profiler's coverage metric."""
+        now = self.clock()
+        with self._lock:
+            busy = self._busy_total
+            if self._inflight > 0:
+                busy += now - self._busy_since
+        return busy
 
     def occupancy(self) -> float:
         with self._lock:

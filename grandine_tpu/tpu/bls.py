@@ -40,6 +40,7 @@ from __future__ import annotations
 import json
 import os
 import secrets
+import sys
 import threading
 import time
 from collections import OrderedDict
@@ -1301,6 +1302,18 @@ def _shape_key(kernel: str, args: tuple):
     ))
 
 
+def _node_profiler():
+    """runtime.profiler.get_profiler, resolved lazily: the kernel layer
+    must not pull the runtime package in at module import time. The
+    profiler's annotate() is a dict bump when no capture session is
+    active; during a session it opens the TraceAnnotation scope keyed
+    (scheme, kernel, bucket)."""
+    mod = sys.modules.get("grandine_tpu.runtime.profiler")
+    if mod is None:
+        from grandine_tpu.runtime import profiler as mod
+    return mod.get_profiler()
+
+
 def note_dispatch_shapes(kernel: str, args: tuple, metrics=None) -> bool:
     """Record a dispatch signature; True when it is novel this process.
 
@@ -1573,13 +1586,15 @@ class TpuBlsBackend:
         cache cannot round-trip, so the call runs cache-bypassed."""
         self._count_kernel(kernel, sigs)
         note_dispatch_shapes(kernel, args, self.metrics)
+        prof = _node_profiler()
         if mesh_operands and self.mesh is not None:
             inner = fn
 
             def fn(*a):
                 return _cache_bypassed_call(inner, *a)
         if not self._observed():
-            return fn(*args)
+            with prof.annotate(kernel, sigs):
+                return fn(*args)
         shapes = tuple(
             (tuple(a.shape), str(a.dtype)) if hasattr(a, "shape") else repr(a)
             for a in args
@@ -1587,10 +1602,12 @@ class TpuBlsBackend:
         key = (kernel, shapes)
         if key not in self._seen_shapes:
             with self._stage("compile", kernel=kernel):
-                out = fn(*args)
+                with prof.annotate(kernel, sigs):
+                    out = fn(*args)
             self._seen_shapes.add(key)
         else:
-            out = fn(*args)
+            with prof.annotate(kernel, sigs):
+                out = fn(*args)
         if block:
             with self._stage("execute", kernel=kernel):
                 self._block(out)

@@ -415,7 +415,8 @@ class Ed25519Backend:
         with self.tracer.span(
             "device_dispatch", {"kernel": "ed25519_verify", "lane": self.lane}
         ):
-            out = fn(*args)
+            with B._node_profiler().annotate("ed25519_verify", n):
+                out = fn(*args)
 
         def settle() -> bool:
             return bool(np.asarray(out))

@@ -82,7 +82,8 @@ class SpanPlane:
 
         self._count_kernel(kernel)
         B.note_dispatch_shapes(kernel, args, self.metrics)
-        out = fn(*args)
+        with B._node_profiler().annotate(kernel, len(args[0])):
+            out = fn(*args)
         for leaf in out:
             if hasattr(leaf, "block_until_ready"):
                 leaf.block_until_ready()

@@ -64,6 +64,7 @@ class HostSyncRule(Rule):
         "grandine_tpu/tpu/schemes.py",
         "grandine_tpu/tpu/ed25519.py",
         "grandine_tpu/kzg/eip4844.py",
+        "grandine_tpu/runtime/profiler.py",
     )
 
     def check(self, ctx: Context, files):

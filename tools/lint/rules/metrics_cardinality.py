@@ -55,6 +55,9 @@ _FORBIDDEN_CONVERSIONS = {"str", "repr", "hex", "format", "bin", "oct"}
 _IDENTITY_LABELS = {
     "peer", "peer_id", "origin", "sender", "remote",
     "validator", "validator_index", "pubkey", "node_id",
+    # profiler capture sessions are monotonically numbered — a
+    # session-id label would grow one series per start()
+    "session", "session_id", "sid",
 }
 #: family attr -> (label name, canonical module, enum constant name):
 #: literal values of that label must be members of the tuple constant.

@@ -1,0 +1,55 @@
+"""CLI for the perf ledger.
+
+    python -m tools.perf              # print the per-metric report
+    python -m tools.perf --check      # regression gate: nonzero exit +
+                                      # the regressed metric named on
+                                      # stderr when the newest row falls
+                                      # outside its tolerance band
+
+Wired into bench.py's preflight next to lint/shapes/fuzz
+(BENCH_SKIP_PERF_CHECK=1 overrides there).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from tools.perf import check_ledger
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.perf",
+        description="perf-ledger report / regression gate",
+    )
+    parser.add_argument("--check", action="store_true",
+                        help="exit nonzero when the newest row of any "
+                             "metric regresses past its tolerance band")
+    parser.add_argument("--ledger", default=None,
+                        help="ledger path (default tools/perf/ledger.jsonl;"
+                             " BENCH_LEDGER_PATH also overrides)")
+    parser.add_argument("--window", type=int, default=8,
+                        help="rolling-median window of prior rows")
+    parser.add_argument("--tolerance", type=float, default=None,
+                        help="override every metric's tolerance band")
+    args = parser.parse_args(argv)
+
+    failures, report = check_ledger(
+        path=args.ledger, window=args.window, tolerance=args.tolerance
+    )
+    for entry in report:
+        print(json.dumps(entry))
+    for line in failures:
+        print(line, file=sys.stderr)
+    if failures:
+        return 1
+    if args.check:
+        print(f"perf check: {len(report)} metric(s), no regressions",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,7 +1,9 @@
 """Stage-level timing of the GROUPED multi_verify kernel at the bench shape.
 
-Times each pipeline stage jit'd in isolation, forcing a host fetch per
-measurement (the axon runtime's block_until_ready does not wait):
+Times each pipeline stage jit'd in isolation through the node
+profiler's shared `time_jit` primitive (grandine_tpu.runtime.profiler),
+forcing a host fetch per measurement (the axon runtime's
+block_until_ready does not wait):
   G1 GLV ladders, G2 GLV ladders, G2 sum tree, G1 grouped sum,
   miller loops (M+1), final exp alone, and the fused grouped kernel.
 
@@ -13,8 +15,6 @@ import sys
 import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
-
-import numpy as np
 
 
 def main() -> None:
@@ -41,19 +41,10 @@ def main() -> None:
     k = n // m
     print(f"prep {time.time() - t0:.1f}s", file=sys.stderr)
 
+    from grandine_tpu.runtime.profiler import time_jit
+
     def timed(name, fn, *xs, iters=4):
-        f = jax.jit(fn)
-        t0 = time.time()
-        out = f(*xs)
-        np.asarray(jax.tree.leaves(out)[0])  # force execution
-        compile_s = time.time() - t0
-        t0 = time.time()
-        for _ in range(iters):
-            out = f(*xs)
-            np.asarray(jax.tree.leaves(out)[0])
-        wall = (time.time() - t0) / iters
-        print(f"{name:26s} compile={compile_s:7.1f}s run={wall * 1000:9.2f}ms",
-              file=sys.stderr)
+        time_jit(name, fn, *xs, iters=iters)
 
     def g1_ladders(pk_x, pk_y, pk_inf, r_bits):
         pk = B._g1_in(B._flat_km(pk_x, m, k), B._flat_km(pk_y, m, k))

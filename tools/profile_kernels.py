@@ -1,10 +1,12 @@
 """Stage-level timing of the multi_verify kernel on the current device.
 
-Times each pipeline stage separately (jit'd in isolation) with HONEST
-methodology — every measurement forces a host fetch, because the axon
-runtime's block_until_ready does not wait for execution:
-  scalar_mul G1 (rlc), scalar_mul G2, G2 rlc+sum tree, miller_loop,
-  miller+tree+final_exp, and the fused multi_verify_kernel.
+Times each pipeline stage separately (jit'd in isolation) through the
+node profiler's shared `time_jit` primitive (grandine_tpu.runtime
+.profiler) — HONEST methodology: every measurement forces a host
+fetch, because the axon runtime's block_until_ready does not wait for
+execution. Stages: scalar_mul G1 (rlc), scalar_mul G2, G2 rlc+sum
+tree, miller_loop, miller+tree+final_exp, and the fused
+multi_verify_kernel.
 
 Usage: [BENCH_N=2048] python tools/profile_kernels.py
 """
@@ -14,8 +16,6 @@ import sys
 import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
-
-import numpy as np
 
 
 def main() -> None:
@@ -39,19 +39,7 @@ def main() -> None:
      msg_x, msg_y, msg_inf, r_bits) = args
     print(f"prep {time.time() - t0:.1f}s", file=sys.stderr)
 
-    def timed(name, fn, *xs, iters=5):
-        f = jax.jit(fn)
-        t0 = time.time()
-        out = f(*xs)
-        np.asarray(jax.tree.leaves(out)[0])  # force execution
-        compile_s = time.time() - t0
-        t0 = time.time()
-        for _ in range(iters):
-            out = f(*xs)
-        np.asarray(jax.tree.leaves(out)[0])
-        wall = (time.time() - t0) / iters
-        print(f"{name:26s} compile={compile_s:7.1f}s run={wall * 1000:9.2f}ms",
-              file=sys.stderr)
+    from grandine_tpu.runtime.profiler import time_jit as timed
 
     def g1_rlc(pk_x, pk_y, pk_inf, r_bits):
         qx, qy = L.split(jnp.asarray(pk_x)), L.split(jnp.asarray(pk_y))

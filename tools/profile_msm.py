@@ -1,5 +1,7 @@
 """Stage timing for the MSM-backed grouped verify at the bench shape:
-host plan build, G1 grouped MSM, G2 MSM, and the fused kernel.
+host plan build, G1 grouped MSM, G2 MSM, and the fused kernel — all
+measured through the node profiler's shared `time_jit` primitive
+(grandine_tpu.runtime.profiler).
 
 Usage: [BENCH_N=16384] [BENCH_MSGS=64] python tools/profile_msm.py
 """
@@ -47,18 +49,11 @@ def main() -> None:
     print(f"host plan build (both): {(time.time()-t0)/iters*1000:.0f}ms",
           file=sys.stderr)
 
+    from grandine_tpu.runtime.profiler import time_jit
+
     def timed(name, f, *xs, iters=4):
-        t0 = time.time()
-        out = f(*xs)
-        np.asarray(jax.tree.leaves(out)[0])
-        compile_s = time.time() - t0
-        t0 = time.time()
-        for _ in range(iters):
-            out = f(*xs)
-            np.asarray(jax.tree.leaves(out)[0])
-        wall = (time.time() - t0) / iters
-        print(f"{name:26s} compile={compile_s:7.1f}s run={wall*1000:9.2f}ms",
-              file=sys.stderr)
+        # callables arrive pre-jitted here, so jit=False
+        time_jit(name, f, *xs, iters=iters, jit=False)
 
     def g1_kernel(pk_x, pk_y, pk_inf, *arrs):
         pk = B._g1_in(B._flat_km(pk_x, m, k), B._flat_km(pk_y, m, k))

@@ -56,6 +56,12 @@ from tools.lint.rules.jit_purity import (
 RULE = "shape-contract"
 MANIFEST_PATH = "tools/shapes/manifest.txt"
 
+#: profiler-scope contract: every kernel the manifest registers must
+#: have a KERNEL_SCHEMES entry in the node profiler, so capture
+#: sessions annotate it under its real scheme (not the "other" bucket)
+PROFILER_RULE = "profiler-scope"
+PROFILER_PATH = "grandine_tpu/runtime/profiler.py"
+
 BLS_PATH = "grandine_tpu/tpu/bls.py"
 REGISTRY_PATH = "grandine_tpu/tpu/registry.py"
 SPANS_PATH = "grandine_tpu/tpu/spans.py"
@@ -987,6 +993,31 @@ def _check_seam(ctx, scan: _FileScan, findings: "list[Finding]") -> None:
 # -------------------------------------------------------------- driver
 
 
+def _profiler_keys(ctx: "Context") -> "set[str] | None":
+    """The kernel names the node profiler's KERNEL_SCHEMES dict maps,
+    AST-parsed from grandine_tpu/runtime/profiler.py — never imported.
+    None when the file is absent (fixture roots skip the check)."""
+    tree = ctx.tree(PROFILER_PATH)
+    if tree is None:
+        return None
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        for target in node.targets:
+            if (
+                isinstance(target, ast.Name)
+                and target.id == "KERNEL_SCHEMES"
+                and isinstance(node.value, ast.Dict)
+            ):
+                return {
+                    k.value
+                    for k in node.value.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)
+                }
+    return None
+
+
 def analyze(
     root: "str | None" = None,
     ctx: "Context | None" = None,
@@ -1068,6 +1099,17 @@ def analyze(
                 "`python -m tools.shapes --write-manifest`",
                 key=f"{RULE}:{manifest_path}:stale",
             ))
+        profiler_keys = _profiler_keys(ctx)
+        if profiler_keys is not None:
+            for kernel in sorted(registered - profiler_keys):
+                findings.append(Finding(
+                    PROFILER_RULE, PROFILER_PATH, 1,
+                    f"manifest kernel {kernel!r} has no KERNEL_SCHEMES "
+                    "entry — capture sessions would annotate it under "
+                    "the catch-all 'other' scheme; add it to "
+                    "grandine_tpu/runtime/profiler.py",
+                    key=f"{PROFILER_RULE}:{PROFILER_PATH}:{kernel}",
+                ))
     return findings, analysis
 
 
@@ -1078,6 +1120,8 @@ __all__ = [
     "DispatchSite",
     "RULE",
     "MANIFEST_PATH",
+    "PROFILER_RULE",
+    "PROFILER_PATH",
     "DEFAULT_FILES",
     "TPU_FILES",
     "RUNTIME_FILES",

@@ -1,17 +1,15 @@
 """Capture a device profile of multi_verify_kernel and print the top HLO
-ops by self time (parsed from the Chrome-trace JSON the JAX profiler
-emits — no TensorBoard needed).
+ops by self time — a thin shim over the node profiler's capture API
+(grandine_tpu.runtime.profiler.capture_trace / summarize_trace): the
+same session machinery GET /eth/v1/debug/grandine/profile drives,
+parsed from the Chrome-trace JSON the JAX profiler emits (no
+TensorBoard needed).
 
 Usage: [BENCH_N=2048] python tools/trace_kernel.py
 """
 
-import glob
-import gzip
-import json
 import os
 import sys
-import time
-from collections import defaultdict
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
@@ -23,6 +21,7 @@ def main() -> None:
     import jax
 
     import bench
+    from grandine_tpu.runtime.profiler import capture_trace, summarize_trace
     from grandine_tpu.tpu.bls import (
         grouped_multi_verify_kernel,
         multi_verify_kernel,
@@ -36,34 +35,14 @@ def main() -> None:
     print("compiling…", file=sys.stderr)
     jax.block_until_ready(fn(*args))
 
-    trace_dir = "/tmp/gt_trace"
-    os.system(f"rm -rf {trace_dir}")
-    with jax.profiler.trace(trace_dir):
-        for _ in range(2):
-            out = fn(*args)
-        jax.block_until_ready(out)
-
-    files = glob.glob(f"{trace_dir}/**/*.trace.json.gz", recursive=True)
-    if not files:
+    trace_dir = capture_trace(lambda: fn(*args), "/tmp/gt_trace", runs=2)
+    total, top = summarize_trace(trace_dir, top=40)
+    if total <= 0.0 and not top:
         print("no trace file found", file=sys.stderr)
         return
-    with gzip.open(files[0], "rt") as f:
-        trace = json.load(f)
-
-    # Aggregate complete events by name on device tracks
-    durations = defaultdict(float)
-    counts = defaultdict(int)
-    for ev in trace.get("traceEvents", []):
-        if ev.get("ph") != "X":
-            continue
-        name = ev.get("name", "")
-        dur = ev.get("dur", 0)
-        durations[name] += dur
-        counts[name] += 1
-    total = sum(durations.values())
-    print(f"n={n}; total traced op-time {total / 1e6:.3f}s (2 runs)")
-    for name, dur in sorted(durations.items(), key=lambda kv: -kv[1])[:40]:
-        print(f"{dur / 1e3:10.1f}ms  x{counts[name]:<6d} {name[:110]}")
+    print(f"n={n}; total traced op-time {total:.3f}s (2 runs)")
+    for name, seconds, count in top:
+        print(f"{seconds * 1e3:10.1f}ms  x{count:<6d} {name[:110]}")
 
 
 if __name__ == "__main__":
