@@ -2577,6 +2577,113 @@ def bench_schemes() -> None:
         raise SystemExit(1)
 
 
+def bench_compressed() -> None:
+    """`--compressed` / BENCH_COMPRESSED=1: compressed-ingest e2e bench.
+
+    Measures the PREP-INCLUSIVE wall rate from raw 96-byte wire
+    signatures to a settled verdict, for both ingest paths:
+
+      host leg:       per-item pure-Python G2 decompress (the
+                      BENCH_r05 host-prep bottleneck: ~47.6s of Fq2
+                      sqrt against 12.5s of device time) + the
+                      uncompressed multi_verify kernel;
+      compressed leg: raw bytes straight into multi_verify_compressed —
+                      decompression happens inside the fused kernel,
+                      host prep is a (b, 96) row stack.
+
+    The ledger-gated metric is `bls_compressed_e2e_throughput` (the
+    compressed leg, sigs/s); the host leg and the speedup ride along as
+    fields. The host parse skips its redundant subgroup check (the
+    fused kernel performs membership either way), so the reported
+    speedup is a floor. Zero post-warmup recompiles is part of the
+    verdict: both legs must run entirely on the warm manifest.
+
+    Knobs: BENCH_COMPRESSED_N (batch, default 64),
+    BENCH_COMPRESSED_ITERS (timed rounds, default 3)."""
+    _lint_preflight()
+
+    import statistics
+
+    from grandine_tpu.crypto import bls as A
+    from grandine_tpu.metrics import Metrics
+    from grandine_tpu.tpu import bls as B
+    from grandine_tpu.tpu import schemes
+
+    n = int(os.environ.get("BENCH_COMPRESSED_N", "64"))
+    iters = int(os.environ.get("BENCH_COMPRESSED_ITERS", "3"))
+
+    metrics = Metrics()
+    backend = schemes.get("bls").make_backend(metrics=metrics)
+    B.reset_shape_tracking()
+
+    sks = [A.SecretKey(0x5EED_0001 + 0x1111 * i) for i in range(n)]
+    pks = [sk.public_key() for sk in sks]
+    msgs = [b"compressed-bench-%d" % i for i in range(n)]
+    sig_bytes = [A.g2_to_bytes(sk.sign(m).point)
+                 for sk, m in zip(sks, msgs)]
+    forged = list(sig_bytes)
+    forged[n // 2] = sig_bytes[(n // 2 + 1) % n]
+
+    def host_leg() -> bool:
+        sigs = [A.Signature(A.g2_from_bytes(sb, subgroup_check=False))
+                for sb in sig_bytes]
+        return bool(backend.multi_verify(msgs, sigs, pks))
+
+    def compressed_leg() -> bool:
+        return bool(backend.multi_verify_compressed(msgs, sig_bytes, pks))
+
+    # one dispatch per leg compiles every timed shape, then seal
+    if not (host_leg() and compressed_leg()):
+        raise SystemExit("compressed-ingest warmup batch rejected")
+    B.declare_warmup_complete()
+
+    legs = {}
+    verdicts_ok = True
+    for name, fn in (("host", host_leg), ("compressed", compressed_leg)):
+        walls = []
+        for _ in range(iters):
+            t0 = time.time()
+            ok = fn()
+            walls.append(time.time() - t0)
+            verdicts_ok = verdicts_ok and ok is True
+        p50 = statistics.median(walls)
+        legs[name] = {
+            "p50_s": round(p50, 4),
+            "sigs_per_sec": round(n / p50, 1),
+        }
+    # forged batch must fail on the compressed path (same warm shape)
+    verdicts_ok = verdicts_ok and (
+        backend.multi_verify_compressed(msgs, forged, pks) is False
+    )
+
+    recompiles = B.post_warmup_recompiles()
+    speedup = (
+        legs["compressed"]["sigs_per_sec"] / legs["host"]["sigs_per_sec"]
+    )
+    plane_ok = verdicts_ok and recompiles == 0
+    emit_bench_line({
+        "metric": "bls_compressed_e2e_throughput",
+        "unit": "sigs/s",
+        "value": legs["compressed"]["sigs_per_sec"],
+        "n": n,
+        "iters": iters,
+        "legs": legs,
+        "speedup_vs_host_prep": round(speedup, 2),
+        "verdicts_ok": verdicts_ok,
+        "post_warmup_recompiles": recompiles,
+        "plane_ok": plane_ok,
+    }, config={"n": n, "iters": iters})
+    print(
+        f"# compressed ingest: {legs['compressed']['sigs_per_sec']} "
+        f"sigs/s e2e vs host-prep {legs['host']['sigs_per_sec']} sigs/s "
+        f"({speedup:.2f}x), {recompiles} post-warmup recompiles; "
+        + ("OK" if plane_ok else "FAILED"),
+        file=sys.stderr,
+    )
+    if not plane_ok:
+        raise SystemExit(1)
+
+
 if __name__ == "__main__":
     if "--devices-child" in sys.argv:
         bench_multichip_child(
@@ -2604,6 +2711,11 @@ if __name__ == "__main__":
         bench_mainnet()
     elif "--schemes" in sys.argv or os.environ.get("BENCH_SCHEMES") == "1":
         bench_schemes()
+    elif (
+        "--compressed" in sys.argv
+        or os.environ.get("BENCH_COMPRESSED") == "1"
+    ):
+        bench_compressed()
     elif os.environ.get("BENCH_SCHED_ONLY") == "1":
         bench_verify_scheduler()
     else:

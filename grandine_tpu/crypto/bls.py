@@ -23,6 +23,7 @@ from __future__ import annotations
 import hashlib
 import hmac as hmac_mod
 import secrets
+import threading
 from typing import Iterable, Optional, Sequence
 
 from grandine_tpu.crypto import constants
@@ -225,21 +226,33 @@ class PublicKey:
 
 
 class CachedPublicKey:
-    """Bytes + lazily-decompressed point (reference: bls/src/cached_public_key.rs)."""
+    """Bytes + lazily-decompressed point (reference: bls/src/cached_public_key.rs).
 
-    __slots__ = ("_bytes", "_decompressed")
+    `decompress` is reachable from the scheduler's completion thread and
+    from block-replay workers at once, so the first-use fill holds a
+    per-instance lock: an unlocked check-then-set would let two threads
+    decompress the same key concurrently (wasted work) and, worse, let a
+    reader observe the attribute mid-publication. All access to
+    `_decompressed` stays inside the lock — no bare fast-path read — so
+    the lock-coverage lints can prove the attribute consistently
+    protected (schedule-fuzz scenario: cached_pubkey).
+    """
+
+    __slots__ = ("_bytes", "_decompressed", "_lock")
 
     def __init__(self, data: bytes) -> None:
         self._bytes = bytes(data)
         self._decompressed: Optional[PublicKey] = None
+        self._lock = threading.Lock()
 
     def as_bytes(self) -> bytes:
         return self._bytes
 
     def decompress(self) -> PublicKey:
-        if self._decompressed is None:
-            self._decompressed = PublicKey.from_bytes(self._bytes)
-        return self._decompressed
+        with self._lock:
+            if self._decompressed is None:
+                self._decompressed = PublicKey.from_bytes(self._bytes)
+            return self._decompressed
 
 
 class Signature:

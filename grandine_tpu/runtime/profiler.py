@@ -69,6 +69,10 @@ KERNEL_SCHEMES = {
     # tpu/bls.py jit entry points (TpuBlsBackend ASYNC_SEAM + sync)
     "agg_fast_verify_msm": "bls",
     "agg_fast_verify_msm_idx": "bls",
+    "agg_fast_verify_msm_comp": "bls",
+    "agg_fast_verify_msm_idx_comp": "bls",
+    "multi_verify_msm_comp": "bls",
+    "g1_decompress": "bls",
     "batch_sign": "bls",
     "g2_subgroup_check": "bls",
     "grouped_multi_verify_msm": "bls",

@@ -47,7 +47,9 @@ SUBGROUP_BUCKETS = (4, 8, 16, 32, 64, 128)
 WARM_KINDS = ("aggregate", "aggregate_idx", "multi_verify", "sign",
               "subgroup", "rlc_partition", "sharded_multi_verify",
               "sharded_multi_verify_msm", "span_update",
-              "registry_capacity", "ed25519_verify", "kzg_blob")
+              "registry_capacity", "ed25519_verify", "kzg_blob",
+              "aggregate_comp", "aggregate_idx_comp", "multi_verify_comp",
+              "g1_decompress")
 
 
 def _repo_root() -> str:
@@ -107,6 +109,11 @@ def manifest() -> "list[tuple[str, int]]":
     # sharded rows are no-ops without a mesh (skipped with a note)
     out += [("sharded_multi_verify", b) for b in MULTI_VERIFY_BUCKETS]
     out += [("sharded_multi_verify_msm", b) for b in MULTI_VERIFY_BUCKETS]
+    # compressed-ingest twins ride the same dispatch-bound ladders
+    out += [("aggregate_comp", b) for b in FIREHOSE_BUCKETS]
+    out += [("aggregate_idx_comp", b) for b in FIREHOSE_BUCKETS]
+    out += [("multi_verify_comp", b) for b in MULTI_VERIFY_BUCKETS]
+    out += [("g1_decompress", b) for b in (16, 64, 256, 1024)]
     return out
 
 
@@ -179,6 +186,7 @@ def warm_all(
     pk = A.PublicKey(G1)
     h = hash_to_g2(b"warmup")
     sig = A.Signature(h)
+    sig_c = A.g2_to_bytes(h)  # compressed wire bytes (compressed-ingest)
     sk = A.SecretKey(0x1234_5678)
     #: lazily-built non-BLS scheme backends (tpu/schemes.py table),
     #: shared across that scheme's warm rows so each gets one jit cache
@@ -302,6 +310,42 @@ def warm_all(
                     [[0]] * 4,
                     _ShimRegistry(),
                 )
+            elif kind == "aggregate_comp":
+                # compressed-ingest firehose twin: signatures stay raw
+                # 96-byte wire rows, decompressed inside the kernel
+                backend.fast_aggregate_verify_batch_compressed(
+                    [b"warm-%d" % i for i in range(b)],
+                    [sig_c] * b,
+                    [[pk]] * b,
+                )
+            elif kind == "aggregate_idx_comp":
+                if registry is None or registry.arrays()[0] is None:
+                    if progress:
+                        progress(
+                            f"warm {kind}/{b} skipped: no device registry"
+                        )
+                    continue
+                backend.fast_aggregate_verify_batch_indexed_compressed(
+                    [b"warm-%d" % i for i in range(b)],
+                    [sig_c] * b,
+                    [[0]] * b,
+                    registry,
+                )
+            elif kind == "multi_verify_comp":
+                backend.multi_verify_compressed(
+                    [b"warm-%d" % i for i in range(b)],
+                    [sig_c] * b,
+                    [pk] * b,
+                )
+            elif kind == "g1_decompress":
+                # the registry's device decompress runs at append buckets
+                # and capacity shapes (tpu/registry.py _decompress_dev) —
+                # warm the jit entry directly against dummy rows
+                import numpy as np
+
+                rows = np.zeros((b, 48), np.uint8)
+                rows[:, 0] = 0xC0  # canonical infinity: valid, neutral
+                B.g1_decompress_rows(rows, metrics)
             elif kind == "ed25519_verify":
                 # the manifest bucket is the KERNEL batch (point rows
                 # m = 1 + 2n for n items, pow-4 ladder): n = b//2 - 1

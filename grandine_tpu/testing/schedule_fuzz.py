@@ -620,11 +620,61 @@ def scenario_registry_lifecycle(seed: int, **kw) -> dict:
     return _invariant(res, "registry_lifecycle", bad)
 
 
+def scenario_cached_pubkey(seed: int, **kw) -> dict:
+    """CachedPublicKey first-use fill race: concurrent decompress()
+    callers on one shared key must decompress exactly once and all
+    observe the same object. The pre-lock code's unlocked check-then-set
+    let two threads both see None and both pay the pure-Python G1
+    decompress — this scenario preempts between the check and the set
+    and fails on any duplicate fill or torn read."""
+    import grandine_tpu.crypto.bls as cb
+
+    fz = ScheduleFuzzer(seed, watched=[cb.__file__], **kw)
+    key = cb.CachedPublicKey(b"\x99" * 48)
+    key._lock = fz.lock("cached_pubkey._lock")
+
+    calls = [0]
+    sentinel = object()
+    real_from_bytes = cb.PublicKey.from_bytes
+
+    def counting_from_bytes(data: bytes):
+        calls[0] += 1
+        return sentinel
+
+    seen: "list[object]" = []
+
+    def reader() -> None:
+        for _ in range(3):
+            seen.append(key.decompress())
+
+    cb.PublicKey.from_bytes = staticmethod(counting_from_bytes)
+    try:
+        fz.add_worker("reader_a", reader)
+        fz.add_worker("reader_b", reader)
+        fz.add_worker("reader_c", reader)
+        res = fz.run()
+    finally:
+        cb.PublicKey.from_bytes = real_from_bytes
+
+    bad: "list[str]" = []
+    if calls[0] != 1:
+        bad.append(
+            f"from_bytes ran {calls[0]} times (want 1) — unlocked "
+            "check-then-set let two fills race"
+        )
+    if any(obj is not sentinel for obj in seen):
+        bad.append("a reader observed a torn/foreign decompressed value")
+    if key._decompressed is not sentinel:
+        bad.append("cached value lost after the fill")
+    return _invariant(res, "cached_pubkey", bad)
+
+
 SCENARIOS: "dict[str, Callable[..., dict]]" = {
     "ticket_verdict": scenario_ticket_verdict,
     "flight_ring": scenario_flight_ring,
     "breaker_walk": scenario_breaker_walk,
     "registry_lifecycle": scenario_registry_lifecycle,
+    "cached_pubkey": scenario_cached_pubkey,
 }
 
 #: every `# lint: atomic=<attr>:` annotation in the runtime sources maps

@@ -554,17 +554,18 @@ def grouped_multi_verify_msm_packed_kernel(
 
 
 def _flat_msm_verify_tail(
-    pk, pk_inf, sig_x, sig_y, sig_inf, msg_x, msg_y, msg_inf, r_bits,
+    pk, pk_inf, sig, sig_inf, msg_x, msg_y, msg_inf, r_bits,
     g2_pidx, g2_valid, g2_flush, g2_gidx, g2_gvalid,
     g2_windows: int, g2_wbits: int, check_subgroup: int = 0,
 ):
     """Shared tail of the flat MSM verify kernels: per-signature G1 GLV
     ladders (each rᵢ·pkᵢ feeds its own Miller loop), Σ rᵢ·sigᵢ as one
     Pippenger sum, then the RLC pairing check. `pk` arrives as a limb-list
-    pair — built either from uploaded coords or a registry gather. With
-    `check_subgroup` the ψ-ladder membership of the signature plane runs
-    fused in the same pass and ANDs into the verdict."""
-    sig = _g2_in(sig_x, sig_y)
+    pair — built either from uploaded coords or a registry gather; `sig`
+    arrives as a split Fp2 (x, y) pair — built from uploaded coords or the
+    on-device decompressor. With `check_subgroup` the ψ-ladder membership
+    of the signature plane runs fused in the same pass and ANDs into the
+    verdict."""
     msg = _g2_in(msg_x, msg_y)
     pk_inf = jnp.asarray(pk_inf)
     sig_inf = jnp.asarray(sig_inf)
@@ -599,7 +600,7 @@ def multi_verify_msm_kernel(
     while Σ rᵢ·sigᵢ is a single Pippenger sum."""
     return _flat_msm_verify_tail(
         _g1_in(pk_x, pk_y), pk_inf,
-        sig_x, sig_y, sig_inf, msg_x, msg_y, msg_inf, r_bits,
+        _g2_in(sig_x, sig_y), sig_inf, msg_x, msg_y, msg_inf, r_bits,
         g2_pidx, g2_valid, g2_flush, g2_gidx, g2_gvalid,
         g2_windows=g2_windows, g2_wbits=g2_wbits,
         check_subgroup=check_subgroup,
@@ -625,7 +626,7 @@ def multi_verify_msm_idx_kernel(
     )
     return _flat_msm_verify_tail(
         pk, pk_inf,
-        sig_x, sig_y, sig_inf, msg_x, msg_y, msg_inf, r_bits,
+        _g2_in(sig_x, sig_y), sig_inf, msg_x, msg_y, msg_inf, r_bits,
         g2_pidx, g2_valid, g2_flush, g2_gidx, g2_gvalid,
         g2_windows=g2_windows, g2_wbits=g2_wbits,
         check_subgroup=check_subgroup,
@@ -681,7 +682,7 @@ def aggregate_fast_verify_kernel(
 
 def _aggregate_msm_verify_tail(
     mem, mem_inf_f, m, k, slot_pad,
-    sig_x, sig_y, sig_inf, msg_x, msg_y, msg_inf, r_bits,
+    sig, sig_inf, msg_x, msg_y, msg_inf, r_bits,
     g2_pidx, g2_valid, g2_flush, g2_gidx, g2_gvalid,
     g2_windows: int, g2_wbits: int, check_subgroup: int = 0,
 ):
@@ -689,7 +690,8 @@ def _aggregate_msm_verify_tail(
     identity-forgery rejection, per-aggregate G1 ladder, Σ rᵢ·sigᵢ as one
     MSM, then the RLC pairing check. `mem` arrives as a k-major flat
     limb-list pair — built either from uploaded coords or a registry
-    gather."""
+    gather; `sig` as a split Fp2 (x, y) pair — from uploaded coords or
+    the on-device decompressor."""
     one = C.FP_OPS.one_like(mem[0])
     zero = C.FP_OPS.zeros_like(mem[0])
     mem_jac = (
@@ -701,7 +703,6 @@ def _aggregate_msm_verify_tail(
     agg_inf = L.is_zero_val(agg_pk[2])
     slot_pad = jnp.asarray(slot_pad)
     forged = jnp.any(jnp.logical_and(jnp.logical_not(slot_pad), agg_inf))
-    sig = _g2_in(sig_x, sig_y)
     msg = _g2_in(msg_x, msg_y)
     sig_inf = jnp.asarray(sig_inf)
     msg_inf = jnp.asarray(msg_inf)
@@ -737,7 +738,7 @@ def aggregate_fast_verify_msm_kernel(
     mem = _g1_in(_flat_km(mem_x, m, k), _flat_km(mem_y, m, k))
     return _aggregate_msm_verify_tail(
         mem, _flat_km(mem_inf, m, k), m, k, slot_pad,
-        sig_x, sig_y, sig_inf, msg_x, msg_y, msg_inf, r_bits,
+        _g2_in(sig_x, sig_y), sig_inf, msg_x, msg_y, msg_inf, r_bits,
         g2_pidx, g2_valid, g2_flush, g2_gidx, g2_gvalid,
         g2_windows=g2_windows, g2_wbits=g2_wbits,
         check_subgroup=check_subgroup,
@@ -766,11 +767,130 @@ def aggregate_fast_verify_msm_idx_kernel(
     )
     return _aggregate_msm_verify_tail(
         mem, _flat_km(mem_inf, m, k), m, k, slot_pad,
-        sig_x, sig_y, sig_inf, msg_x, msg_y, msg_inf, r_bits,
+        _g2_in(sig_x, sig_y), sig_inf, msg_x, msg_y, msg_inf, r_bits,
         g2_pidx, g2_valid, g2_flush, g2_gidx, g2_gvalid,
         g2_windows=g2_windows, g2_wbits=g2_wbits,
         check_subgroup=check_subgroup,
     )
+
+
+def _g2_compressed_in(sig_rows):
+    """(B, 96) uint8 compressed signature rows → on-device decompression
+    (tpu/curve.py): split Fp2 (x, y) in Montgomery form, the decoded
+    infinity mask, and a per-row validity mask covering all three failure
+    classes (non-canonical encoding, non-residue/off-curve x,
+    infinity-with-payload). Invalid rows come back zeroed under ok=False —
+    the caller masks them out of the group law and ANDs `ok.all()` into
+    the verdict so a malformed item fails its batch without ever being
+    batch-fatal on the host."""
+    x, y, inf, ok, _be, _bc, _bi = C.g2_decompress_dev(sig_rows)
+    return (x, y), inf, ok
+
+
+def multi_verify_msm_comp_kernel(
+    pk_x, pk_y, pk_inf, sig_rows, sig_inf, msg_x, msg_y, msg_inf, r_bits,
+    g2_pidx, g2_valid, g2_flush, g2_gidx, g2_gvalid,
+    g2_windows: int, g2_wbits: int, check_subgroup: int = 0,
+):
+    """multi_verify_msm_kernel with the SIGNATURE plane arriving as raw
+    compressed wire bytes ((B, 96) uint8 — the gossip format itself):
+    decompression runs as part of the same device pass, replacing the
+    per-item pure-Python Fq2.sqrt host stage that made BENCH_r05
+    prep-bound (47.6s host vs 12.54s device). `sig_inf` is the host's
+    padding ∪ infinity-flag mask (padding rows carry the canonical
+    infinity encoding, so they decompress valid); a row the decompressor
+    rejects is masked out of the MSM and fails the batch via ok.all()."""
+    sig, dec_inf, dec_ok = _g2_compressed_in(sig_rows)
+    sig_inf = jnp.asarray(sig_inf) | dec_inf | jnp.logical_not(dec_ok)
+    ok = _flat_msm_verify_tail(
+        _g1_in(pk_x, pk_y), pk_inf,
+        sig, sig_inf, msg_x, msg_y, msg_inf, r_bits,
+        g2_pidx, g2_valid, g2_flush, g2_gidx, g2_gvalid,
+        g2_windows=g2_windows, g2_wbits=g2_wbits,
+        check_subgroup=check_subgroup,
+    )
+    return jnp.logical_and(ok, dec_ok.all())
+
+
+def aggregate_fast_verify_msm_comp_kernel(
+    mem_x, mem_y, mem_inf, slot_pad,
+    sig_rows, sig_inf, msg_x, msg_y, msg_inf, r_bits,
+    g2_pidx, g2_valid, g2_flush, g2_gidx, g2_gvalid,
+    g2_windows: int, g2_wbits: int, check_subgroup: int = 0,
+):
+    """aggregate_fast_verify_msm_kernel with compressed-bytes signature
+    ingest ((M, 96) uint8). Same rejection semantics as the uncompressed
+    twin plus the decompressor's per-row validity classes ANDed into the
+    verdict."""
+    m, k = mem_inf.shape
+    mem = _g1_in(_flat_km(mem_x, m, k), _flat_km(mem_y, m, k))
+    sig, dec_inf, dec_ok = _g2_compressed_in(sig_rows)
+    sig_inf = jnp.asarray(sig_inf) | dec_inf | jnp.logical_not(dec_ok)
+    ok = _aggregate_msm_verify_tail(
+        mem, _flat_km(mem_inf, m, k), m, k, slot_pad,
+        sig, sig_inf, msg_x, msg_y, msg_inf, r_bits,
+        g2_pidx, g2_valid, g2_flush, g2_gidx, g2_gvalid,
+        g2_windows=g2_windows, g2_wbits=g2_wbits,
+        check_subgroup=check_subgroup,
+    )
+    return jnp.logical_and(ok, dec_ok.all())
+
+
+def aggregate_fast_verify_msm_idx_comp_kernel(
+    reg_x, reg_y, mem_idx, mem_inf, slot_pad,
+    sig_rows, sig_inf, msg_x, msg_y, msg_inf, r_bits,
+    g2_pidx, g2_valid, g2_flush, g2_gidx, g2_gvalid,
+    g2_windows: int, g2_wbits: int, check_subgroup: int = 0,
+):
+    """aggregate_fast_verify_msm_idx_kernel with compressed-bytes
+    signature ingest: member pubkeys gathered on-device from the resident
+    registry AND signatures decompressed on-device. The per-batch upload
+    collapses to 96 B/aggregate of wire bytes + 4 B/member of indices —
+    nothing in the hot path is host-converted any more."""
+    m, k = mem_inf.shape
+    idx_f = _flat_km(mem_idx, m, k)
+    mem = _g1_in(
+        jnp.take(jnp.asarray(reg_x), idx_f, axis=0),
+        jnp.take(jnp.asarray(reg_y), idx_f, axis=0),
+    )
+    sig, dec_inf, dec_ok = _g2_compressed_in(sig_rows)
+    sig_inf = jnp.asarray(sig_inf) | dec_inf | jnp.logical_not(dec_ok)
+    ok = _aggregate_msm_verify_tail(
+        mem, _flat_km(mem_inf, m, k), m, k, slot_pad,
+        sig, sig_inf, msg_x, msg_y, msg_inf, r_bits,
+        g2_pidx, g2_valid, g2_flush, g2_gidx, g2_gvalid,
+        g2_windows=g2_windows, g2_wbits=g2_wbits,
+        check_subgroup=check_subgroup,
+    )
+    return jnp.logical_and(ok, dec_ok.all())
+
+
+def g1_decompress_kernel(rows):
+    """Batched on-device G1 decompression for the pubkey registry's
+    deposit-churn path: (B, 48) uint8 compressed rows → rest-format
+    (B, 26) Montgomery affine coords plus infinity/validity masks and the
+    three per-row failure classes. Invalid rows come back zeroed (NOT
+    batch-fatal); the registry scatter keeps them as zero rows and the
+    host mirror (which validated the same bytes) is authoritative for
+    naming the bad deposit."""
+    x, y, inf, ok, bad_enc, bad_curve, bad_inf = C.g1_decompress_dev(rows)
+    return (
+        L.merge(x), L.merge(y), inf, ok,
+        bad_enc, bad_curve, bad_inf,
+    )
+
+
+def g1_decompress_rows(rows, metrics=None):
+    """Dispatch g1_decompress_kernel on pre-padded (B, 48) uint8 rows.
+
+    The one sanctioned dispatch seam for the kernel: the registry's
+    churn path and warmup both come through here so the jit cache sees a
+    single registration site (and scheme-owned code keeps the factory
+    call out of runtime/)."""
+    fn = _jitted_global("g1_decompress", g1_decompress_kernel)
+    args = (jnp.asarray(rows),)
+    note_dispatch_shapes("g1_decompress", args, metrics)
+    return fn(*args)
 
 
 def batch_sign_kernel(msg_x, msg_y, msg_inf, sk_bits, sk_neg):
@@ -1426,6 +1546,9 @@ class TpuBlsBackend:
         "fast_aggregate_verify_batch_indexed_async",
         "multi_verify_async",
         "rlc_partition_verify_async",
+        "multi_verify_compressed_async",
+        "fast_aggregate_verify_batch_compressed_async",
+        "fast_aggregate_verify_batch_indexed_compressed_async",
     )
 
     def __init__(self, metrics=None, tracer=None,
@@ -2144,6 +2267,352 @@ class TpuBlsBackend:
         )
         return lambda: self._settle("agg_fast_verify_msm_idx", out)
 
+    # -- compressed-ingest verification ------------------------------------
+    #
+    # The *_compressed_async trio takes SIGNATURES AS RAW WIRE BYTES
+    # (48/96-byte compressed encodings) and decompresses them on device
+    # inside the verify kernel itself, replacing the per-item pure-Python
+    # Fq2.sqrt host stage (`host_prep op=g2_decompress` in tpu/schemes.py)
+    # that made the plane prep-bound. The host twin path is retained
+    # verbatim as the anchor and degradation target.
+
+    @staticmethod
+    def _pack_sig_rows(signatures, b: int):
+        """(b, 96) uint8 padded compressed signature rows + the host-side
+        sig_inf mask (padding ∪ wire infinity flag). Padding rows carry
+        the canonical infinity encoding (0xC0 ‖ 0⁹⁵) so they decompress
+        as valid neutral slots; malformed payloads are NOT screened here —
+        per-row rejection is the device kernel's job. Raises ValueError
+        on a wrong-length blob (the one structural property bytes can't
+        defer)."""
+        rows = C.compressed_rows(signatures, 96)
+        n = rows.shape[0]
+        sig_rows = np.zeros((b, 96), np.uint8)
+        sig_rows[:, 0] = C.COMPRESSED_FLAG | C.INFINITY_FLAG
+        sig_rows[:n] = rows
+        sig_inf = np.ones((b,), bool)
+        sig_inf[:n] = C.compressed_infinity_flags(rows)
+        return sig_rows, sig_inf
+
+    def multi_verify_compressed(
+        self,
+        messages: Sequence[bytes],
+        signatures: Sequence[bytes],
+        public_keys: Sequence["A.PublicKey"],
+        dst: bytes = constants.DST_SIGNATURE,
+        rng=secrets,
+    ) -> bool:
+        return self.multi_verify_compressed_async(
+            messages, signatures, public_keys, dst, rng
+        )()
+
+    def multi_verify_compressed_async(
+        self,
+        messages: Sequence[bytes],
+        signatures: Sequence[bytes],
+        public_keys: Sequence["A.PublicKey"],
+        dst: bytes = constants.DST_SIGNATURE,
+        rng=secrets,
+    ):
+        """multi_verify_async with signatures as compressed wire bytes:
+        host prep shrinks to a memcpy row-pack (no Fq2.sqrt, no Montgomery
+        lift), decompression + subgroup + pairing run as ONE device pass
+        (multi_verify_msm_comp_kernel). Always takes the flat MSM path —
+        grouping/sharding stay on the uncompressed twins."""
+        n = len(messages)
+        if not (n == len(signatures) == len(public_keys)):
+            return lambda: False
+        if n == 0:
+            return lambda: True
+        if n > MAX_BUCKET:
+            def chunk(i):
+                return self.multi_verify_compressed_async(
+                    messages[i : i + MAX_BUCKET],
+                    signatures[i : i + MAX_BUCKET],
+                    public_keys[i : i + MAX_BUCKET],
+                    dst,
+                    rng,
+                )
+
+            first = chunk(0)
+
+            def settle_chunks() -> bool:
+                pending = first
+                for i in range(MAX_BUCKET, n, MAX_BUCKET):
+                    nxt = chunk(i)
+                    if not pending():
+                        return False
+                    pending = nxt
+                return pending()
+
+            return settle_chunks
+        if any(pk.point.is_infinity() for pk in public_keys):
+            return lambda: False
+        with self._stage("host_prep", op="pack_compressed", items=n):
+            b = _bucket(n)
+            try:
+                sig_rows, sig_inf = self._pack_sig_rows(signatures, b)
+            except ValueError:
+                return lambda: False  # wrong-length blob
+            g1x, g1y, g1inf = C.g1_points_to_dev(
+                [pk.point for pk in public_keys]
+            )
+            pk_x = np.zeros((b, L.NLIMBS), np.int32)
+            pk_y = np.zeros((b, L.NLIMBS), np.int32)
+            pk_inf = np.ones((b,), bool)
+            msg_x = np.zeros((b, 2, L.NLIMBS), np.int32)
+            msg_y = np.zeros((b, 2, L.NLIMBS), np.int32)
+            msg_inf = np.ones((b,), bool)
+            pk_x[:n], pk_y[:n], pk_inf[:n] = g1x, g1y, g1inf
+            for i in range(n):
+                x, y, inf = self._hash_to_g2_dev(messages[i], dst)
+                msg_x[i], msg_y[i], msg_inf[i] = x, y, inf
+            pairs = [self._rlc_pair(rng) for _ in range(n)]
+            r_bits = rlc_bits_host(pairs, b)
+        with self._stage("host_prep", op="msm_plan", items=n):
+            g2_plan = self._g2_plan(pairs, b, sig_inf)
+        args = self._upload((
+            pk_x, pk_y, pk_inf, sig_rows, sig_inf,
+            msg_x, msg_y, msg_inf, r_bits, *g2_plan.arrays,
+        ), kernel="multi_verify_msm_comp")
+        # compressed ingest ALWAYS fuses the ψ-ladder subgroup check:
+        # the decompressed points never exist on the host, so the
+        # two-pass g2_subgroup_check_batch_async fallback cannot cover
+        # them — check_subgroup is not optional here
+        fn = self._jitted_msm(
+            "multi_verify_msm_comp", multi_verify_msm_comp_kernel,
+            donate=self._donate(len(args)),
+            g2_windows=g2_plan.windows, g2_wbits=g2_plan.window_bits,
+            check_subgroup=1,
+        )
+        result = self._run_kernel(
+            "multi_verify_msm_comp", fn, args, sigs=n, block=False
+        )
+        return lambda: self._settle("multi_verify_msm_comp", result)
+
+    def fast_aggregate_verify_batch_compressed(
+        self,
+        messages: Sequence[bytes],
+        signatures: Sequence[bytes],
+        member_keys: Sequence[Sequence["A.PublicKey"]],
+        dst: bytes = constants.DST_SIGNATURE,
+        rng=secrets,
+    ) -> bool:
+        return self.fast_aggregate_verify_batch_compressed_async(
+            messages, signatures, member_keys, dst, rng
+        )()
+
+    def fast_aggregate_verify_batch_compressed_async(
+        self,
+        messages: Sequence[bytes],
+        signatures: Sequence[bytes],
+        member_keys: Sequence[Sequence["A.PublicKey"]],
+        dst: bytes = constants.DST_SIGNATURE,
+        rng=secrets,
+    ):
+        """fast_aggregate_verify_batch_async with signatures as compressed
+        wire bytes — the gossip firehose's native format, decompressed on
+        device in the verify pass (aggregate_fast_verify_msm_comp_kernel)."""
+        m = len(messages)
+        if not (m == len(signatures) == len(member_keys)):
+            return lambda: False
+        if m == 0:
+            return lambda: True
+        if any(not ks for ks in member_keys):
+            return lambda: False
+        if m > MAX_BUCKET:
+            def chunk(i):
+                return self.fast_aggregate_verify_batch_compressed_async(
+                    messages[i : i + MAX_BUCKET],
+                    signatures[i : i + MAX_BUCKET],
+                    member_keys[i : i + MAX_BUCKET],
+                    dst,
+                    rng,
+                )
+
+            first = chunk(0)
+
+            def settle_chunks() -> bool:
+                pending = first
+                for i in range(MAX_BUCKET, m, MAX_BUCKET):
+                    nxt = chunk(i)
+                    if not pending():
+                        return False
+                    pending = nxt
+                return pending()
+
+            return settle_chunks
+        if any(pk.point.is_infinity() for ks in member_keys for pk in ks):
+            return lambda: False
+        with self._stage("host_prep", op="pack_aggregate_compressed", items=m):
+            if max(len(ks) for ks in member_keys) > MAX_BUCKET:
+                member_keys = [
+                    ks if len(ks) <= MAX_BUCKET else [A.PublicKey.aggregate(ks)]
+                    for ks in member_keys
+                ]
+            bm = _bucket(m)
+            bk = _bucket(max(len(ks) for ks in member_keys), lo=4)
+            try:
+                sig_rows, sig_inf = self._pack_sig_rows(signatures, bm)
+            except ValueError:
+                return lambda: False
+            mem_x = np.zeros((bm, bk, L.NLIMBS), np.int32)
+            mem_y = np.zeros((bm, bk, L.NLIMBS), np.int32)
+            mem_inf = np.ones((bm, bk), bool)
+            slot_pad = np.arange(bm) >= m
+            msg_x = np.zeros((bm, 2, L.NLIMBS), np.int32)
+            msg_y = np.zeros((bm, 2, L.NLIMBS), np.int32)
+            msg_inf = np.ones((bm,), bool)
+            flat_keys = [pk.point for ks in member_keys for pk in ks]
+            fx, fy, finf = C.g1_points_to_dev(flat_keys)
+            pos = 0
+            for i in range(m):
+                k = len(member_keys[i])
+                mem_x[i, :k] = fx[pos : pos + k]
+                mem_y[i, :k] = fy[pos : pos + k]
+                mem_inf[i, :k] = finf[pos : pos + k]
+                pos += k
+            for i in range(m):
+                x, y, inf = self._hash_to_g2_dev(messages[i], dst)
+                msg_x[i], msg_y[i], msg_inf[i] = x, y, inf
+            pairs = [self._rlc_pair(rng) for _ in range(m)]
+            r_bits = rlc_bits_host(pairs, bm)
+            g2_plan = self._g2_plan(pairs, bm, sig_inf)
+        args = self._upload((
+            mem_x, mem_y, mem_inf, slot_pad, sig_rows, sig_inf,
+            msg_x, msg_y, msg_inf, r_bits, *g2_plan.arrays,
+        ), kernel="agg_fast_verify_msm_comp")
+        # subgroup check always fused on compressed ingest (see
+        # multi_verify_compressed_async)
+        fn = self._jitted_msm(
+            "agg_fast_verify_msm_comp", aggregate_fast_verify_msm_comp_kernel,
+            donate=self._donate(len(args)),
+            g2_windows=g2_plan.windows, g2_wbits=g2_plan.window_bits,
+            check_subgroup=1,
+        )
+        out = self._run_kernel(
+            "agg_fast_verify_msm_comp", fn, args, sigs=m, block=False
+        )
+        return lambda: self._settle("agg_fast_verify_msm_comp", out)
+
+    def fast_aggregate_verify_batch_indexed_compressed(
+        self,
+        messages: Sequence[bytes],
+        signatures: Sequence[bytes],
+        member_indices: Sequence[Sequence[int]],
+        registry,
+        dst: bytes = constants.DST_SIGNATURE,
+        rng=secrets,
+    ) -> bool:
+        return self.fast_aggregate_verify_batch_indexed_compressed_async(
+            messages, signatures, member_indices, registry, dst, rng
+        )()
+
+    def fast_aggregate_verify_batch_indexed_compressed_async(
+        self,
+        messages: Sequence[bytes],
+        signatures: Sequence[bytes],
+        member_indices: Sequence[Sequence[int]],
+        registry,
+        dst: bytes = constants.DST_SIGNATURE,
+        rng=secrets,
+    ):
+        """The fully-device-fed firehose: member pubkeys gathered from the
+        resident registry by index AND signatures decompressed on device.
+        Per-batch upload = 96 B/aggregate of wire bytes + 4 B/member of
+        indices; host prep does no field arithmetic at all."""
+        m = len(messages)
+        if not (m == len(signatures) == len(member_indices)):
+            return lambda: False
+        if m == 0:
+            return lambda: True
+        if any(len(ix) == 0 for ix in member_indices):
+            return lambda: False
+        if m > MAX_BUCKET:
+            def chunk(i):
+                return self.fast_aggregate_verify_batch_indexed_compressed_async(
+                    messages[i : i + MAX_BUCKET],
+                    signatures[i : i + MAX_BUCKET],
+                    member_indices[i : i + MAX_BUCKET],
+                    registry,
+                    dst,
+                    rng,
+                )
+
+            first = chunk(0)
+
+            def settle_chunks() -> bool:
+                pending = first
+                for i in range(MAX_BUCKET, m, MAX_BUCKET):
+                    nxt = chunk(i)
+                    if not pending():
+                        return False
+                    pending = nxt
+                return pending()
+
+            return settle_chunks
+        reg_x, reg_y, reg_n = registry.arrays()
+        widest = max(len(ix) for ix in member_indices)
+        if reg_x is None or any(
+            not 0 <= int(i) < reg_n for ix in member_indices for i in ix
+        ):
+            return lambda: False
+        if widest > MAX_BUCKET:
+            return self.fast_aggregate_verify_batch_compressed_async(
+                messages,
+                signatures,
+                [registry.public_keys(ix) for ix in member_indices],
+                dst,
+                rng,
+            )
+        with self._stage(
+            "host_prep", op="pack_aggregate_idx_compressed", items=m
+        ):
+            bm = _bucket(m)
+            bk = _bucket(widest, lo=4)
+            try:
+                sig_rows, sig_inf = self._pack_sig_rows(signatures, bm)
+            except ValueError:
+                return lambda: False
+            mem_idx = np.zeros((bm, bk), np.int32)
+            mem_inf = np.ones((bm, bk), bool)  # True = padding slot
+            slot_pad = np.arange(bm) >= m
+            msg_x = np.zeros((bm, 2, L.NLIMBS), np.int32)
+            msg_y = np.zeros((bm, 2, L.NLIMBS), np.int32)
+            msg_inf = np.ones((bm,), bool)
+            for i, ix in enumerate(member_indices):
+                k = len(ix)
+                mem_idx[i, :k] = np.fromiter(
+                    (int(v) for v in ix), np.int32, count=k
+                )
+                mem_inf[i, :k] = False
+            for i in range(m):
+                x, y, inf = self._hash_to_g2_dev(messages[i], dst)
+                msg_x[i], msg_y[i], msg_inf[i] = x, y, inf
+            pairs = [self._rlc_pair(rng) for _ in range(m)]
+            r_bits = rlc_bits_host(pairs, bm)
+            g2_plan = self._g2_plan(pairs, bm, sig_inf)
+        # registry arrays are device-resident: passed directly, NOT through
+        # _upload, so per-batch upload accounting stays honest
+        args = self._upload((
+            mem_idx, mem_inf, slot_pad, sig_rows, sig_inf,
+            msg_x, msg_y, msg_inf, r_bits, *g2_plan.arrays,
+        ), kernel="agg_fast_verify_msm_idx_comp")
+        # subgroup check always fused on compressed ingest (see
+        # multi_verify_compressed_async)
+        fn = self._jitted_msm(
+            "agg_fast_verify_msm_idx_comp",
+            aggregate_fast_verify_msm_idx_comp_kernel,
+            donate=self._donate(len(args), skip=2),
+            g2_windows=g2_plan.windows, g2_wbits=g2_plan.window_bits,
+            check_subgroup=1,
+        )
+        out = self._run_kernel(
+            "agg_fast_verify_msm_idx_comp", fn, (reg_x, reg_y, *args),
+            sigs=m, block=False, mesh_operands=True,
+        )
+        return lambda: self._settle("agg_fast_verify_msm_idx_comp", out)
+
     def multi_verify_indexed(
         self,
         messages: Sequence[bytes],
@@ -2428,6 +2897,11 @@ __all__ = [
     "rlc_partition_verify_kernel",
     "multi_verify_msm_kernel",
     "multi_verify_msm_idx_kernel",
+    "multi_verify_msm_comp_kernel",
+    "aggregate_fast_verify_msm_comp_kernel",
+    "aggregate_fast_verify_msm_idx_comp_kernel",
+    "g1_decompress_kernel",
+    "g1_decompress_rows",
     "grouped_multi_verify_kernel",
     "grouped_multi_verify_msm_kernel",
     "grouped_multi_verify_msm_packed_kernel",

@@ -665,3 +665,179 @@ def scalar_mul_jac_glv(q, q_inf, bits_lo, bits_hi, endo, ops: FieldOps):
     Y = ops.select(q_inf, one, st[1])
     Z = ops.select(q_inf, zero, st[2])
     return (X, Y, Z)
+
+
+# --- batched on-device point decompression ---------------------------------
+#
+# Raw compressed rows (48-byte G1 / 96-byte G2, ZCash flag convention —
+# crypto/bls.py g1_from_bytes / g2_from_bytes are the anchors) decode to
+# affine Montgomery limbs entirely on device: big-endian bytes → canonical
+# limbs, y² = x³ + b, batched fixed-exponent square root (field.fq_sqrt /
+# fq2_sqrt), sign bit via the lexicographically-largest-y convention.
+# Malformed rows NEVER fault the batch: every item carries a validity mask
+# split into the three mandatory failure classes (non-canonical encoding,
+# not-on-curve/non-residue, infinity-with-payload), and invalid rows decode
+# to the zero point so downstream kernels can mask them as infinity slots.
+
+#: byte-0 flag bits of the ZCash BLS12-381 serialization convention
+COMPRESSED_FLAG = 0x80
+INFINITY_FLAG = 0x40
+SIGN_FLAG = 0x20
+
+_B_MONT_DIGITS = [int(v) for v in L.to_mont(4)]  # b = 4 (G1), 4+4u (G2)
+_ONE_DIGITS = [int(v) for v in L.int_to_limbs(1)]
+#: canonical digits of (p+1)/2 — `y ≥ (p+1)/2` ⇔ `y > p − y` for y ∈ [0,p)
+_P_HALF_UP_DIGITS = [int(v) for v in L.int_to_limbs((_P + 1) // 2)]
+_KP_DIGITS = {
+    k: [int(v) for v in L.int_to_limbs(k * _P)] for k in (1, 2, 4, 8)
+}
+
+
+def _geq_digits(a, digits) -> jnp.ndarray:
+    """value(a) ≥ value(digits) for CANONICAL limb arrays (exact digit
+    forms) — LSB→MSB sweep so the verdict is dominated by the top limb."""
+    ge = jnp.ones(a.shape[1:], bool)
+    for i in range(L.NLIMBS):
+        d = int(digits[i])
+        ge = jnp.where(a[i] > d, True, jnp.where(a[i] < d, False, ge))
+    return ge
+
+
+def _canonical_mod_p(a) -> jnp.ndarray:
+    """Exact canonical digits of value(a) mod p, for |value(a)| < 8p:
+    offset by +8p into [0, 16p), then a 4-step binary descent subtracting
+    {8,4,2,1}·p wherever it fits. Needed where the VALUE itself must be
+    compared (sign-bit convention), not just tested against 0 mod p."""
+    w = L.canonical_digits(a + L.const_fp(L.EIGHT_P_DIGITS, a.shape[1:]))
+    for k in (8, 4, 2, 1):
+        kp = _KP_DIGITS[k]
+        take = _geq_digits(w, kp)
+        sub = w - L.const_fp(kp, a.shape[1:])
+        w = L.canonical_digits(jnp.where(take[None], sub, w))
+    return w
+
+
+def _mont_to_canonical(a) -> jnp.ndarray:
+    """Montgomery limbs → exact canonical digits of the value in [0, p)."""
+    one = L.const_fp(_ONE_DIGITS, a.shape[1:])
+    return _canonical_mod_p(L.montmul(a, one))
+
+
+def _bytes_to_canonical(payload) -> jnp.ndarray:
+    """(N, 48) uint8 big-endian payload (flags pre-masked) → (26, N)
+    canonical limbs, via the packed-word unpack path (limbs.unpack_words
+    wants little-endian uint32 words)."""
+    le = payload[:, ::-1].astype(jnp.uint32)  # big-endian wire → LE bytes
+    groups = le.reshape(le.shape[0], 12, 4)
+    weights = jnp.asarray([1, 1 << 8, 1 << 16, 1 << 24], jnp.uint32)
+    w = jnp.sum(groups * weights, axis=-1, dtype=jnp.uint32)
+    w13 = jnp.concatenate(
+        [w, jnp.zeros((w.shape[0], 1), jnp.uint32)], axis=-1
+    )
+    return L.unpack_words(w13)
+
+
+def _decompress_flags(data):
+    flags = data[:, 0]
+    c_flag = (flags & COMPRESSED_FLAG) != 0
+    i_flag = (flags & INFINITY_FLAG) != 0
+    s_flag = (flags & SIGN_FLAG) != 0
+    return c_flag, i_flag, s_flag
+
+
+def g1_decompress_dev(data):
+    """(N, 48) uint8 compressed G1 rows → (x, y, inf, ok, bad_encoding,
+    bad_curve, bad_infinity); x/y are (26, N) Montgomery limbs (zeroed on
+    invalid or infinity rows). Byte-identical accept/reject semantics to
+    crypto/bls.py g1_from_bytes, but per-item: a malformed row flips its
+    masks, never the batch."""
+    data = jnp.asarray(data, jnp.uint8)
+    c_flag, i_flag, s_flag = _decompress_flags(data)
+    mask = jnp.concatenate([
+        jnp.asarray([0x1F], jnp.uint8),
+        jnp.full((47,), 0xFF, jnp.uint8),
+    ])
+    payload = data & mask[None]
+    payload_zero = jnp.all(payload == 0, axis=-1)
+    xc = _bytes_to_canonical(payload)
+    x_lt_p = ~_geq_digits(xc, L.P_DIGITS)
+    x = L.to_mont_dev(xc)
+    b = L.const_fp(_B_MONT_DIGITS, x.shape[1:])
+    y2 = L.add_mod(L.montmul(L.montsq(x), x), b)
+    y, y_ok = F.fq_sqrt(y2)
+    y_canon = _mont_to_canonical(y)
+    y_larger = _geq_digits(y_canon, _P_HALF_UP_DIGITS)
+    y = L.select(s_flag != y_larger, L.neg_mod(y), y)
+    inf = c_flag & i_flag & ~s_flag & payload_zero
+    bad_infinity = c_flag & i_flag & ~inf
+    bad_encoding = ~c_flag | (c_flag & ~i_flag & ~x_lt_p)
+    bad_curve = c_flag & ~i_flag & x_lt_p & ~y_ok
+    ok = inf | (c_flag & ~i_flag & x_lt_p & y_ok)
+    live = ok & ~inf
+    x = L.select(live, x, L.zeros_fp(x.shape[1:]))
+    y = L.select(live, y, L.zeros_fp(y.shape[1:]))
+    return x, y, inf, ok, bad_encoding, bad_curve, bad_infinity
+
+
+def g2_decompress_dev(data):
+    """(N, 96) uint8 compressed G2 rows → (x, y, inf, ok, bad_encoding,
+    bad_curve, bad_infinity); x/y are Fp2 pairs of (26, N) Montgomery
+    limbs. Anchor: crypto/bls.py g2_from_bytes (c1 travels first on the
+    wire; sign bit = lexicographically-largest-y over (c1, c0))."""
+    data = jnp.asarray(data, jnp.uint8)
+    c_flag, i_flag, s_flag = _decompress_flags(data)
+    mask = jnp.concatenate([
+        jnp.asarray([0x1F], jnp.uint8),
+        jnp.full((95,), 0xFF, jnp.uint8),
+    ])
+    payload = data & mask[None]
+    payload_zero = jnp.all(payload == 0, axis=-1)
+    x1c = _bytes_to_canonical(payload[:, :48])
+    x0c = _bytes_to_canonical(payload[:, 48:])
+    lt_p = ~_geq_digits(x0c, L.P_DIGITS) & ~_geq_digits(x1c, L.P_DIGITS)
+    x = (L.to_mont_dev(x0c), L.to_mont_dev(x1c))
+    b2 = (
+        L.const_fp(_B_MONT_DIGITS, x[0].shape[1:]),
+        L.const_fp(_B_MONT_DIGITS, x[0].shape[1:]),
+    )
+    y2 = F.fp2_add(F.fp2_mul(F.fp2_sq(x), x), b2)
+    y, y_ok = F.fq2_sqrt(y2)
+    y0_canon = _mont_to_canonical(y[0])
+    y1_canon = _mont_to_canonical(y[1])
+    y_larger = _geq_digits(y1_canon, _P_HALF_UP_DIGITS) | (
+        jnp.all(y1_canon == 0, axis=0)
+        & _geq_digits(y0_canon, _P_HALF_UP_DIGITS)
+    )
+    y = F.fp2_select(s_flag != y_larger, F.fp2_neg(y), y)
+    inf = c_flag & i_flag & ~s_flag & payload_zero
+    bad_infinity = c_flag & i_flag & ~inf
+    bad_encoding = ~c_flag | (c_flag & ~i_flag & ~lt_p)
+    bad_curve = c_flag & ~i_flag & lt_p & ~y_ok
+    ok = inf | (c_flag & ~i_flag & lt_p & y_ok)
+    live = ok & ~inf
+    zero2 = F.fp2_zero(x[0].shape[1:])
+    x = F.fp2_select(live, x, zero2)
+    y = F.fp2_select(live, y, zero2)
+    return x, y, inf, ok, bad_encoding, bad_curve, bad_infinity
+
+
+def compressed_rows(blobs, nbytes: int) -> np.ndarray:
+    """List of `nbytes`-long byte strings → (N, nbytes) uint8 upload rows.
+    No per-item bigint work — decoding happens on device. Length is the
+    ONLY property checked on host (a wrong-size blob has no row shape)."""
+    for blob in blobs:
+        if len(blob) != nbytes:
+            raise ValueError(
+                f"compressed row must be {nbytes} bytes, got {len(blob)}"
+            )
+    if not blobs:
+        return np.zeros((0, nbytes), np.uint8)
+    return np.frombuffer(b"".join(blobs), np.uint8).reshape(
+        len(blobs), nbytes
+    )
+
+
+def compressed_infinity_flags(rows: np.ndarray) -> np.ndarray:
+    """(N, W) uint8 rows → (N,) bool infinity-flag bits (host-side, one
+    vectorized byte test — the cheap prefilter MSM planning needs)."""
+    return (rows[:, 0] & INFINITY_FLAG) != 0

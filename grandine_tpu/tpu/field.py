@@ -522,3 +522,88 @@ def dev_to_fq12(a):
     from grandine_tpu.crypto.fields import Fq12
 
     return Fq12(*[dev_to_fq6(np.asarray(a)[..., i, :, :, :]) for i in range(2)])
+
+
+# --- batched square roots (compressed-point decompression) -----------------
+#
+# Fixed-exponent ladders only: p ≡ 3 (mod 4) so √a = a^((p+1)/4) in Fq, and
+# Fq2 roots come from the norm/half trick mirroring the anchor's Fq2.sqrt
+# (crypto/fields.py). Both candidates of every data-dependent branch are
+# computed and select()ed — no host-visible control flow, so one jit trace
+# serves every batch and the shapes stay manifest-bucketable. Which square
+# root (y vs −y) comes back is NOT pinned down here; decompression applies
+# the compression sign bit afterwards, which collapses the ambiguity.
+
+_SQRT_EXP = (L.P + 1) // 4
+_LEGENDRE_EXP = (L.P - 1) // 2
+_HALF_DIGITS = [int(x) for x in L.to_mont((L.P + 1) // 2)]
+
+
+def fq_is_square(a) -> jnp.ndarray:
+    """Legendre mask: value(a) is a QR mod p (0 counts as square).
+    Montgomery in; bool array of the batch shape out."""
+    ls = L.pow_fixed(a, _LEGENDRE_EXP)
+    is_one, is_zero = L.is_zero_val_many(
+        [ls - L.const_fp(L.ONE_MONT_DIGITS, a.shape[1:]), a]
+    )
+    return is_one | is_zero
+
+
+def fq_sqrt(a) -> "tuple[jnp.ndarray, jnp.ndarray]":
+    """(root, ok): root = a^((p+1)/4), ok ⇔ root² ≡ a (⇔ a is a QR).
+    Montgomery in/out; either square root may come back."""
+    s = L.pow_fixed(a, _SQRT_EXP)
+    ok = L.is_zero_val(L.montsq(s) - a)
+    return s, ok
+
+
+def fq2_sqrt(a) -> "tuple[tuple, jnp.ndarray]":
+    """((c0, c1), ok) batched Fq2 square root, mirroring the anchor's
+    norm/half algorithm (crypto/fields.py Fq2.sqrt) with every branch
+    flattened into selects.
+
+    For x = a + b·u: norm = a² + b² must be a QR in Fq (else no root);
+    with s = √norm, one of t² = (a ± s)/2 admits t ≠ 0 with candidate
+    (t, b/(2t)); the b = 0 embedding takes (√a, 0) or (0, √−a). ok is
+    the per-item solvability mask (False ⇔ non-residue)."""
+    ca, cb = a
+    batch = ca.shape[1:]
+    half = L.const_fp(_HALF_DIGITS, batch)
+    # ladder 1 (stacked): √a, √−a (b==0 embedding), √norm (general path)
+    norm = L.add_mod(L.montsq(ca), L.montsq(cb))
+    r1 = L.stack_fp([ca, L.neg_mod(ca), norm])
+    s1 = L.pow_fixed(r1, _SQRT_EXP)
+    ok1 = L.is_zero_val(L.montsq(s1) - r1)
+    sa, sna, sn = (s1[:, i] for i in range(3))
+    ok_a, ok_na, ok_n = (ok1[i] for i in range(3))
+    # ladder 2 (stacked): t = √((a ± s)/2), both signs of s
+    t2_pos = L.montmul(L.add_mod(ca, sn), half)
+    t2_neg = L.montmul(L.sub_mod(ca, sn), half)
+    r2 = L.stack_fp([t2_pos, t2_neg])
+    s2 = L.pow_fixed(r2, _SQRT_EXP)
+    ok2 = L.is_zero_val(L.montsq(s2) - r2) & ~L.is_zero_val(s2)
+    # ladder 3 (stacked): 1/(2t) for both candidates (inv_mod(0) = 0)
+    inv2t = L.inv_mod(L.double_mod(s2))
+    c1_both = L.montmul(L.stack_fp([cb, cb]), inv2t)
+    # verify each candidate squares back to the input (the anchor's
+    # acceptance test) — guards the t = 0 / wrong-sign corners
+    sq0 = L.montsq(s2) - L.montsq(c1_both)
+    sq1 = L.double_mod(L.montmul(s2, c1_both))
+    cand_ok = ok2 & (
+        L.is_zero_val(sq0 - L.stack_fp([ca, ca]))
+        & L.is_zero_val(sq1 - L.stack_fp([cb, cb]))
+    )
+    use_pos = cand_ok[0]
+    gen_c0 = L.select(use_pos, s2[:, 0], s2[:, 1])
+    gen_c1 = L.select(use_pos, c1_both[:, 0], c1_both[:, 1])
+    gen_ok = ok_n & (cand_ok[0] | cand_ok[1])
+    # b == 0 embedding: (√a, 0) when a is a QR, else (0, √−a)
+    zero = L.zeros_fp(batch)
+    emb_c0 = L.select(ok_a, sa, zero)
+    emb_c1 = L.select(ok_a, zero, sna)
+    emb_ok = ok_a | ok_na
+    b_zero = L.is_zero_val(cb)
+    c0 = L.select(b_zero, emb_c0, gen_c0)
+    c1 = L.select(b_zero, emb_c1, gen_c1)
+    ok = jnp.where(b_zero, emb_ok, gen_ok)
+    return (c0, c1), ok

@@ -25,9 +25,22 @@ Freshness model (the registry is an append-mostly mirror of
     `ensure` from the identity fast path to the full prefix check;
     `invalidate()` drops everything and forces a cold rebuild.
 
-Rows are guaranteed non-identity: `keys.decompress_pubkey` raises on the
-identity encoding, so indexed kernels need no per-row infinity handling
-beyond the batch padding mask the caller supplies.
+Ingest is the compressed-ingest path (PR 17): deposit-batch churn uploads
+the RAW 48-byte compressed rows (48 B/row instead of 208 B/row of affine
+limbs — ~4.3× less per-row traffic) and decompresses them on device with
+the batched `g1_decompress` kernel (tpu/curve.py sqrt ladders), so the
+per-key pure-Python `Fq2`-style host sqrt disappears from registry builds
+too. The host mirror holds the same raw bytes, so capacity growth
+re-uploads without re-decompressing anything anywhere.
+
+Rows are guaranteed non-identity: `_raw_rows` rejects the infinity
+encoding (and any wire-malformed blob) before it can enter the mirror, so
+indexed kernels need no per-row infinity handling beyond the batch
+padding mask the caller supplies. A payload that is wire-well-formed but
+off-curve/non-canonical (possible only for corrupted input — registry
+bytes passed KeyValidate at deposit time) is zeroed by the device
+decompressor's validity mask: fail-closed, any verification naming that
+row fails, and the host mirror stays authoritative for naming it.
 """
 
 from __future__ import annotations
@@ -38,6 +51,7 @@ from typing import Optional, Sequence
 import numpy as np
 
 from grandine_tpu.consensus import keys
+from grandine_tpu.crypto import bls as A
 from grandine_tpu.tpu import curve as C
 from grandine_tpu.tpu import limbs as L
 
@@ -83,14 +97,14 @@ class DevicePubkeyRegistry:
         #: were built from (identity-compared against head-state columns)
         self._pubkeys: "Optional[tuple]" = None
         self._stale = False
-        #: host rest-format rows, preallocated at power-of-two capacity
-        #: with `_hcount` occupied — kept so capacity growth re-uploads
-        #: without re-decompressing the whole set. Growth is geometric:
-        #: at 2^20 rows a per-append `np.concatenate` would copy 200+ MB
-        #: of mirror per deposit batch; in-place writes make churn O(new)
-        #: with O(log n) reallocations over the set's lifetime.
-        self._hx: "Optional[np.ndarray]" = None
-        self._hy: "Optional[np.ndarray]" = None
+        #: host raw-bytes rows ((capacity, 48) uint8, `_hcount` occupied)
+        #: — the compressed wire encoding itself, kept so capacity growth
+        #: re-uploads without re-decompressing (the device kernel redoes
+        #: the sqrt, the host never does). Growth is geometric: at 2^20
+        #: rows a per-append `np.concatenate` would copy the whole mirror
+        #: per deposit batch; in-place writes make churn O(new) with
+        #: O(log n) reallocations over the set's lifetime.
+        self._hraw: "Optional[np.ndarray]" = None
         self._hcount = 0
         #: device arrays, (capacity, NLIMBS) int32 Montgomery limbs
         self._x = None
@@ -140,9 +154,7 @@ class DevicePubkeyRegistry:
         self.metrics.pubkey_registry_size.set(self.count)
         cap = self.capacity
         self.metrics.pubkey_registry_capacity.set(cap)
-        host = 0 if self._hx is None else int(
-            self._hx.nbytes + self._hy.nbytes
-        )
+        host = 0 if self._hraw is None else int(self._hraw.nbytes)
         self.metrics.pubkey_registry_host_bytes.set(host)
         dev = cap * L.NLIMBS * 4 * 2
         self.metrics.pubkey_registry_device_bytes.set(dev)
@@ -173,7 +185,7 @@ class DevicePubkeyRegistry:
         a cold rebuild."""
         with self._lock:
             self._pubkeys = None
-            self._hx = self._hy = None
+            self._hraw = None
             self._hcount = 0
             self._x = self._y = None
             self._stale = False
@@ -218,45 +230,71 @@ class DevicePubkeyRegistry:
 
     # ------------------------------------------------------------ internals
 
-    def _rows_for(self, pubkey_bytes: "Sequence[bytes]"):
-        """Compressed bytes → ((n, NLIMBS) x, (n, NLIMBS) y) rest-format
-        rows. Raises BlsError on an invalid/identity encoding — registry
-        bytes passed KeyValidate at deposit time, so this only fires on
-        corrupted input (and then the caller keeps the upload path)."""
-        pks = keys.decompress_pubkeys(pubkey_bytes, trusted=True)
-        x, y, inf = C.g1_points_to_dev([pk.point for pk in pks])
-        assert not inf.any(), "identity pubkey can not enter the registry"
+    def _raw_rows(self, pubkey_bytes: "Sequence[bytes]") -> "np.ndarray":
+        """Compressed bytes → (n, 48) uint8 raw rows for device-side
+        decompression. Raises BlsError on what the WIRE alone can
+        answer: wrong length, missing compressed flag, or the identity
+        encoding (identity keys never enter the registry — the indexed
+        kernels rely on it). Off-curve/non-canonical payloads pass
+        through and are zeroed per-row by the device decompressor's
+        validity mask (fail-closed; see module docstring)."""
+        try:
+            rows = C.compressed_rows(pubkey_bytes, 48)
+        except ValueError as e:
+            raise A.BlsError(str(e)) from None
+        if rows.shape[0]:
+            flags = rows[:, 0]
+            if ((flags & C.COMPRESSED_FLAG) == 0).any():
+                raise A.BlsError("uncompressed pubkey in registry input")
+            if ((flags & C.INFINITY_FLAG) != 0).any():
+                raise A.BlsError("identity pubkey can not enter the registry")
+        return rows
+
+    def _decompress_dev(self, raw: "np.ndarray"):
+        """Upload (b, 48) uint8 raw rows and run the batched
+        g1_decompress kernel: returns device ((b, NLIMBS) x, (b, NLIMBS)
+        y) Montgomery rows. Rows the decompressor rejects (and zero
+        padding rows) come back zeroed — never batch-fatal."""
+        from grandine_tpu.tpu import bls as B
+
+        x, y, _inf, _ok, _be, _bc, _bi = B.g1_decompress_rows(
+            raw, self.metrics
+        )
         return x, y
 
     def _host_reserve(self, rows: int) -> None:
         """Grow the host mirror to hold `rows`, geometrically — appends
         within capacity are pure in-place writes."""
-        cur = 0 if self._hx is None else int(self._hx.shape[0])
+        cur = 0 if self._hraw is None else int(self._hraw.shape[0])
         if rows <= cur:
             return
         cap = _next_pow2(rows)
-        nx = np.zeros((cap, L.NLIMBS), np.int32)
-        ny = np.zeros((cap, L.NLIMBS), np.int32)
-        if self._hx is not None and self._hcount:
-            nx[: self._hcount] = self._hx[: self._hcount]
-            ny[: self._hcount] = self._hy[: self._hcount]
-        self._hx, self._hy = nx, ny
+        nraw = np.zeros((cap, 48), np.uint8)
+        if self._hraw is not None and self._hcount:
+            nraw[: self._hcount] = self._hraw[: self._hcount]
+        self._hraw = nraw
         self.stats["host_grows"] += 1
 
     def _append(self, pubkeys: tuple, start: int) -> None:
         import jax
-        import jax.numpy as jnp
 
-        nx, ny = self._rows_for(pubkeys[start:])
+        raw = self._raw_rows(pubkeys[start:])
         end = len(pubkeys)
+        n_new = end - start
         self._host_reserve(end)
-        self._hx[start:end] = nx
-        self._hy[start:end] = ny
+        self._hraw[start:end] = raw
         self._hcount = end
         if end <= self.capacity:
-            # in-place device scatter: uploads O(new) bytes
-            self._x = self._x.at[start:end].set(jnp.asarray(nx))
-            self._y = self._y.at[start:end].set(jnp.asarray(ny))
+            # in-place device scatter of O(new) rows: upload the RAW
+            # 48-byte rows (bucketed so the decompress kernel's dispatch
+            # shapes stay on the warm ladder) and decompress on device —
+            # 48 B/row of traffic instead of 208 B/row of affine limbs
+            b = _next_pow2(n_new)
+            pad = np.zeros((b, 48), np.uint8)
+            pad[:n_new] = raw
+            dx, dy = self._decompress_dev(pad)
+            self._x = self._x.at[start:end].set(dx[:n_new])
+            self._y = self._y.at[start:end].set(dy[:n_new])
             if self.mesh is not None:
                 # re-pin the row sharding: the eager scatter's output
                 # layout is XLA's choice, and the shard-per-device
@@ -264,7 +302,7 @@ class DevicePubkeyRegistry:
                 sharding = self.mesh.batch_sharding()
                 self._x = jax.device_put(self._x, sharding)
                 self._y = jax.device_put(self._y, sharding)
-            self._count_upload(int(nx.nbytes + ny.nbytes))
+            self._count_upload(int(pad.nbytes))
         else:
             self._upload_full(end)
         self._pubkeys = pubkeys
@@ -272,12 +310,11 @@ class DevicePubkeyRegistry:
         self._event("append")
 
     def _refresh(self, pubkeys: tuple) -> None:
-        x, y = self._rows_for(pubkeys)
-        self._hx = self._hy = None
+        raw = self._raw_rows(pubkeys)
+        self._hraw = None
         self._hcount = 0
         self._host_reserve(len(pubkeys))
-        self._hx[: len(pubkeys)] = x
-        self._hy[: len(pubkeys)] = y
+        self._hraw[: len(pubkeys)] = raw
         self._hcount = len(pubkeys)
         self._pubkeys = pubkeys
         self._upload_full(len(pubkeys))
@@ -286,7 +323,11 @@ class DevicePubkeyRegistry:
 
     def _upload_full(self, count: int) -> None:
         """(Re)build the device arrays at power-of-two capacity from the
-        host mirror; zero rows pad count..capacity."""
+        host mirror: ONE raw-bytes upload + ONE batched decompress at
+        capacity shape (the same bucket the gather kernels compile
+        against, so warmup's capacity row covers it). Zero rows pad
+        count..capacity — the decompressor zeroes them under an invalid
+        mask, which is exactly the padding the gather kernels expect."""
         import jax
 
         cap = _next_pow2(count)
@@ -294,20 +335,19 @@ class DevicePubkeyRegistry:
             # a power-of-two mesh must divide the power-of-two capacity;
             # MIN_CAPACITY floors the row count above any sane mesh width
             cap = max(cap, _next_pow2(self.mesh.device_count))
-        px = np.zeros((cap, L.NLIMBS), np.int32)
-        py = np.zeros((cap, L.NLIMBS), np.int32)
-        px[:count] = self._hx[:count]
-        py[:count] = self._hy[:count]
+        praw = np.zeros((cap, 48), np.uint8)
+        praw[:count] = self._hraw[:count]
+        dx, dy = self._decompress_dev(praw)
         if self.mesh is not None:
             # row-sharded residency: the indexed kernels gather rows
             # on-device and XLA routes cross-shard lookups over the mesh
             sharding = self.mesh.batch_sharding()
-            self._x = jax.device_put(px, sharding)
-            self._y = jax.device_put(py, sharding)
+            self._x = jax.device_put(dx, sharding)
+            self._y = jax.device_put(dy, sharding)
         else:
-            self._x = jax.device_put(px)
-            self._y = jax.device_put(py)
-        self._count_upload(int(px.nbytes + py.nbytes))
+            self._x = dx
+            self._y = dy
+        self._count_upload(int(praw.nbytes))
 
 
 __all__ = ["DevicePubkeyRegistry", "MIN_CAPACITY", "MAINNET_CAPACITY"]

@@ -28,6 +28,7 @@ this table.
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Callable, Optional, Sequence
 
@@ -144,7 +145,97 @@ def _bls_kernel_label(backend) -> str:
     )
 
 
+def _device_decompress_enabled() -> bool:
+    """GRANDINE_TPU_DEVICE_DECOMPRESS gates the compressed-ingest path
+    (default ON). Read at dispatch time so an operator can flip a live
+    process back to the host-decompress anchor without a restart."""
+    return os.environ.get(
+        "GRANDINE_TPU_DEVICE_DECOMPRESS", "1"
+    ).lower() not in ("0", "false", "no")
+
+
 def _dispatch_bls(sched, lane, backend, items):
+    """Route one coalesced BLS batch to the device. Default: the
+    compressed-ingest path — signatures stay raw 96-byte wire encodings
+    all the way into the verify kernel, where decompression, the fused
+    ψ-ladder subgroup check, and the pairing run as ONE device pass
+    (no per-item host Fq2.sqrt — the `host_prep op=g2_decompress` stage
+    that made BENCH_r05 prep-bound disappears). The host-decompress twin
+    below is retained verbatim as the anchor and degradation target:
+    GRANDINE_TPU_DEVICE_DECOMPRESS=0 or a backend without the compressed
+    seam falls back to it."""
+    if _device_decompress_enabled():
+        settle = _dispatch_bls_compressed(sched, lane, backend, items)
+        if settle is not None:
+            return settle
+    return _dispatch_bls_host_decompress(sched, lane, backend, items)
+
+
+def _dispatch_bls_compressed(sched, lane, backend, items):
+    """Compressed-ingest dispatch: forward raw signature bytes to the
+    backend's *_compressed_async seam. Host-side wire screening is
+    limited to what bytes alone answer with the same verdict as the host
+    twin: a wrong-length blob or an infinity-flagged signature fails the
+    batch (the twin's BlsError / is_infinity() gates). Non-canonical,
+    off-curve, and non-residue payloads are rejected PER ROW by the
+    device decompressor's validity masks and fail the batch for the
+    bisection to isolate — never batch-fatally on the host. Returns None
+    when the backend lacks the compressed seam (host-decompress twin
+    takes over)."""
+    if backend is None or not (
+        hasattr(backend, "fast_aggregate_verify_batch_compressed_async")
+        and hasattr(
+            backend, "fast_aggregate_verify_batch_indexed_compressed_async"
+        )
+    ):
+        return None
+    with sched._stage(lane, "host_prep", op="sig_bytes", items=len(items)):
+        sig_bytes = [bytes(it.signature) for it in items]
+        if any(len(sb) != 96 for sb in sig_bytes):
+            return lambda: False  # twin: BlsError on bad length
+        if any(sb[0] & 0x40 for sb in sig_bytes):
+            # infinity flag: the twin rejects an infinity signature
+            # (canonical payload) or raises BlsError (junk payload) —
+            # both verdicts are False
+            return lambda: False
+    registry = sched._sync_registry(lane, items)
+    indexed, keyed = [], []
+    for i, it in enumerate(items):
+        if registry is not None and it.member_indices is not None:
+            indexed.append(i)
+        else:
+            keyed.append(i)
+    try:
+        with sched._stage(lane, "host_prep", op="resolve_keys"):
+            keyed_keys = [items[i].resolve_keys() for i in keyed]
+    except SignatureInvalid:
+        return lambda: False
+    if sched.metrics is not None:
+        sched.metrics.device_batch_sigs.inc(len(items))
+    settles = []
+    if indexed:
+        settles.append(
+            backend.fast_aggregate_verify_batch_indexed_compressed_async(
+                [items[i].message for i in indexed],
+                [sig_bytes[i] for i in indexed],
+                [list(items[i].member_indices) for i in indexed],
+                registry,
+            )
+        )
+    if keyed:
+        settles.append(backend.fast_aggregate_verify_batch_compressed_async(
+            [items[i].message for i in keyed],
+            [sig_bytes[i] for i in keyed],
+            keyed_keys,
+        ))
+
+    def settle() -> bool:
+        return all(bool(s()) for s in settles)
+
+    return settle
+
+
+def _dispatch_bls_host_decompress(sched, lane, backend, items):
     """Host prep + async device dispatch of one coalesced BLS batch;
     returns a zero-arg settle callable (the batch verdict) or None when
     no async device seam is available. Mirrors the attestation pipeline:
@@ -152,7 +243,8 @@ def _dispatch_bls(sched, lane, backend, items):
     stack the device ψ-ladder subgroup check and the verify kernel(s),
     read back nothing yet. (Moved verbatim from
     VerifyScheduler._device_dispatch — the scheduler now routes here
-    through the scheme table.)"""
+    through the scheme table. Retained as the compressed-ingest path's
+    anchor and degradation target.)"""
     if backend is None or not (
         hasattr(backend, "fast_aggregate_verify_batch_async")
         and hasattr(backend, "g2_subgroup_check_batch_async")
@@ -228,9 +320,13 @@ register(Scheme(
         "fast_aggregate_verify_batch_indexed_async",
         "multi_verify_async",
         "rlc_partition_verify_async",
+        "multi_verify_compressed_async",
+        "fast_aggregate_verify_batch_compressed_async",
+        "fast_aggregate_verify_batch_indexed_compressed_async",
     ),
     warm_kinds=("aggregate", "aggregate_idx", "subgroup", "multi_verify",
-                "rlc_partition"),
+                "rlc_partition", "aggregate_comp", "aggregate_idx_comp",
+                "multi_verify_comp", "g1_decompress"),
     kernel_label=_bls_kernel_label,
     canary=True,
 ))

@@ -161,15 +161,38 @@ def test_registry_append_uploads_only_new_rows():
     assert reg.ensure(pkb[:4])
     base = reg.stats["uploaded_bytes"]
     assert reg.ensure(pkb)  # +2 rows, within MIN_CAPACITY
-    import grandine_tpu.tpu.limbs as L
+    from grandine_tpu.tpu.registry import _next_pow2
 
-    assert reg.stats["uploaded_bytes"] - base == 2 * 2 * L.NLIMBS * 4
+    # compressed ingest: the append ships the RAW 48-byte rows (padded
+    # to the decompress kernel's bucket), not decompressed limb planes
+    assert reg.stats["uploaded_bytes"] - base == _next_pow2(2) * 48
     assert m.device_upload_bytes.value("pubkey_registry") == (
         reg.stats["uploaded_bytes"]
     )
     # host mirror serves the fallback path
     pks = reg.public_keys([5, 0])
     assert pks[0].to_bytes() == pkb[5] and pks[1].to_bytes() == pkb[0]
+
+
+def test_registry_compressed_ingest_upload_ratio():
+    """The compressed-ingest plane's traffic win, pinned: a registry
+    build moves 48 B/row of wire bytes where the host-decompress path
+    moved the 2 × NLIMBS × 4 B affine limb planes — a ≥ 3× (208/48 ≈
+    4.3×) per-row drop in device_upload_bytes_total."""
+    import grandine_tpu.tpu.limbs as L
+
+    m = Metrics()
+    reg = DevicePubkeyRegistry(metrics=m)
+    _, pkb = _fresh_keypairs(6)
+    assert reg.ensure(pkb)
+    cap = reg.capacity
+    raw_bytes = m.device_upload_bytes.value("pubkey_registry")
+    assert raw_bytes == cap * 48  # one full raw upload at capacity
+    limb_bytes = cap * 2 * L.NLIMBS * 4  # what the limb plane would move
+    assert limb_bytes >= 3 * raw_bytes, (
+        f"per-row upload {raw_bytes / cap:.0f} B is not a >=3x drop from "
+        f"the {limb_bytes / cap:.0f} B limb plane"
+    )
 
 
 def test_verifier_wires_registry_staleness_hook():
@@ -471,17 +494,26 @@ def test_one_compile_per_bucket(backend, keyring):
 # --------------------------------------------- churn at registry scale
 
 
-def _fake_rows_for(pkbs):
-    """Synthetic limb rows keyed off the pubkey bytes — stands in for
-    the G1 decompression so churn tests scale to mainnet row counts."""
+def _fake_decompress_dev(raw):
+    """Synthetic device decompress keyed off the raw wire bytes — stands
+    in for the G1 sqrt kernel so churn tests scale to mainnet row counts
+    without compiling (or running) the real decompressor."""
+    import jax.numpy as jnp
+
     import grandine_tpu.tpu.limbs as L
 
-    ids = np.frombuffer(
-        b"".join(bytes(b)[-4:] for b in pkbs), dtype=">u4"
-    ).astype(np.int64)
-    x = np.zeros((len(pkbs), L.NLIMBS), np.int32)
+    ids = raw[:, -4:].astype(np.int64)
+    ids = (ids[:, 0] << 24) | (ids[:, 1] << 16) | (ids[:, 2] << 8) | ids[:, 3]
+    x = np.zeros((raw.shape[0], L.NLIMBS), np.int32)
     x[:, 0] = (ids & 0x7FFF_FFFF).astype(np.int32)
-    return x, x + 1
+    return jnp.asarray(x), jnp.asarray(x + 1)
+
+
+def _synthetic_keys(n: int) -> tuple:
+    """Wire-well-formed compressed pubkeys (flag byte 0x80, distinct
+    payloads) — they pass `_raw_rows`'s flag screen; the fake device
+    decompress above supplies the limb rows."""
+    return tuple(b"\x80" + i.to_bytes(47, "big") for i in range(n))
 
 
 def _churn(reg, keys_all, base_count, batch, batches):
@@ -507,14 +539,15 @@ def _churn(reg, keys_all, base_count, batch, batches):
 
 def test_registry_churn_within_capacity_is_o_new(monkeypatch):
     """Fast witness for the mainnet churn invariant: prefix appends
-    inside capacity upload exactly the new rows' bytes, never regrow
-    the host mirror, and never rebuild the device arrays."""
-    import grandine_tpu.tpu.limbs as L
+    inside capacity upload exactly the new rows' raw bytes (bucketed to
+    the decompress kernel's warm ladder), never regrow the host mirror,
+    and never rebuild the device arrays."""
+    from grandine_tpu.tpu.registry import _next_pow2
 
     m = Metrics()
     reg = DevicePubkeyRegistry(metrics=m)
-    monkeypatch.setattr(reg, "_rows_for", _fake_rows_for)
-    keys_all = tuple(i.to_bytes(48, "big") for i in range(1024))
+    monkeypatch.setattr(reg, "_decompress_dev", _fake_decompress_dev)
+    keys_all = _synthetic_keys(1024)
     appended, cap0, grows, uploaded, refreshes = _churn(
         reg, keys_all, base_count=1024 - 64, batch=8, batches=8
     )
@@ -522,12 +555,10 @@ def test_registry_churn_within_capacity_is_o_new(monkeypatch):
     assert reg.capacity == cap0 == 1024
     assert grows == 0, "within-capacity churn regrew the host mirror"
     assert refreshes == 0
-    assert uploaded == appended * 2 * L.NLIMBS * 4, (
-        "append upload is not O(new rows)"
+    assert uploaded == 8 * _next_pow2(8) * 48, (
+        "append upload is not O(new raw rows)"
     )
-    assert m.pubkey_registry_host_bytes.value == (
-        reg._hx.nbytes + reg._hy.nbytes
-    )
+    assert m.pubkey_registry_host_bytes.value == reg._hraw.nbytes
     assert m.pubkey_registry_capacity.value == 1024
 
 
@@ -535,8 +566,8 @@ def test_registry_host_mirror_growth_is_geometric(monkeypatch):
     """Growing 4 → 4096 rows in 64-row appends must reallocate the host
     mirror O(log n) times, not O(appends)."""
     reg = DevicePubkeyRegistry()
-    monkeypatch.setattr(reg, "_rows_for", _fake_rows_for)
-    keys_all = tuple(i.to_bytes(48, "big") for i in range(4096))
+    monkeypatch.setattr(reg, "_decompress_dev", _fake_decompress_dev)
+    keys_all = _synthetic_keys(4096)
     assert reg.ensure(keys_all[:4])
     for end in range(64, 4097, 64):
         assert reg.ensure(keys_all[:end])
@@ -551,19 +582,19 @@ def test_registry_churn_at_mainnet_capacity(monkeypatch):
     invariants at full scale. `test_registry_churn_within_capacity_is_
     o_new` is the fast witness for this path."""
     import grandine_tpu.tpu.limbs as L
-    from grandine_tpu.tpu.registry import MAINNET_CAPACITY
+    from grandine_tpu.tpu.registry import MAINNET_CAPACITY, _next_pow2
 
     m = Metrics()
     reg = DevicePubkeyRegistry(metrics=m)
-    monkeypatch.setattr(reg, "_rows_for", _fake_rows_for)
+    monkeypatch.setattr(reg, "_decompress_dev", _fake_decompress_dev)
     n = MAINNET_CAPACITY
-    keys_all = tuple(i.to_bytes(48, "big") for i in range(n))
+    keys_all = _synthetic_keys(n)
     appended, cap0, grows, uploaded, refreshes = _churn(
         reg, keys_all, base_count=n - 512, batch=64, batches=8
     )
     assert appended == 512
     assert reg.capacity == cap0 == n
     assert grows == 0 and refreshes == 0
-    assert uploaded == appended * 2 * L.NLIMBS * 4
+    assert uploaded == 8 * _next_pow2(64) * 48
     assert reg.count == n
     assert m.pubkey_registry_device_bytes.value == n * L.NLIMBS * 4 * 2

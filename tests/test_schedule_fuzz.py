@@ -117,6 +117,48 @@ def test_lock_prevents_the_tear(tmp_path):
         assert c.n == 20
 
 
+def test_unlocked_cached_pubkey_fill_is_caught(tmp_path):
+    """The pre-fix CachedPublicKey.decompress (unlocked check-then-set,
+    crypto/bls.py) must double-decompress under some seed — proving the
+    cached_pubkey scenario's single-fill invariant has teeth."""
+    path, mod = _load_toy(tmp_path, "toy_cached_key", (
+        "class CachedKey:\n"
+        "    def __init__(self, fill):\n"
+        "        self._fill = fill\n"
+        "        self._decompressed = None\n"
+        "    def decompress(self):\n"
+        "        if self._decompressed is None:\n"
+        "            self._decompressed = self._fill()\n"
+        "        return self._decompressed\n"
+    ))
+    raced = None
+    for seed in range(20):
+        fz = sf.ScheduleFuzzer(seed, watched=[path], max_quantum=3)
+        calls = [0]
+
+        def fill():
+            calls[0] += 1
+            return object()
+
+        key = mod.CachedKey(fill)
+        fz.add_worker("a", key.decompress)
+        fz.add_worker("b", key.decompress)
+        res = fz.run()
+        assert res["violations"] == []
+        if calls[0] != 1:
+            raced = seed
+            break
+    assert raced is not None, "no seed raced the unlocked fill"
+
+
+def test_cached_pubkey_scenario_clean():
+    """The locked implementation survives every seed: exactly one fill,
+    one shared object, across adversarial interleavings."""
+    for seed in range(5):
+        res = sf.scenario_cached_pubkey(seed)
+        assert res["violations"] == [], res["violations"]
+
+
 def test_deadlock_is_detected(tmp_path):
     """Opposite-order acquisition on two FuzzLocks must deadlock under
     some seed, and the harness must report it (not hang)."""

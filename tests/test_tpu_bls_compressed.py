@@ -1,0 +1,141 @@
+"""Differential tests: the compressed-ingest verify kernels vs the
+uncompressed device path and the pure-Python anchor.
+
+The compressed-entry kernels (`*_comp` in tpu/bls.py) take the raw
+96-byte wire signatures as the operand and decompress inside the fused
+program, so the per-item host `Fq2.sqrt` disappears from prep. The
+contract: identical verdicts to the host-decompress twin on every input,
+including per-row invalid encodings (which must fail the BATCH verdict
+without poisoning the group math — invalid rows fold into the infinity
+mask).
+
+Everything here compiles pairing kernels (minutes each on the CPU
+backend), so the module is slow-tier; the cheap wire-screen policies
+live in test_schemes_scheduler-level tests and the decompress masks in
+test_tpu_decompress.py.
+"""
+
+import random
+
+import pytest
+
+pytestmark = [pytest.mark.kernel, pytest.mark.slow]
+
+from grandine_tpu.crypto import bls as A
+from grandine_tpu.tpu.bls import TpuBlsBackend
+
+rng = random.Random(0xC0DE)
+
+
+def _rng_bytes(n: int) -> bytes:
+    return bytes(rng.randrange(256) for _ in range(n))
+
+
+@pytest.fixture(scope="module")
+def backend():
+    return TpuBlsBackend()
+
+
+@pytest.fixture(scope="module")
+def keys():
+    return [A.SecretKey.keygen(_rng_bytes(32)) for _ in range(5)]
+
+
+def test_multi_verify_compressed_matches_host_twin(backend, keys):
+    pks = [sk.public_key() for sk in keys]
+    msgs = [b"comp-%d" % i for i in range(5)]
+    sigs = [sk.sign(m) for sk, m in zip(keys, msgs)]
+    sig_bytes = [A.g2_to_bytes(s.point) for s in sigs]
+    # (the uncompressed twin's verdicts are pinned by test_tpu_bls.py;
+    # compiling it again here would double the slow-tier wall time)
+    assert backend.multi_verify_compressed(msgs, sig_bytes, pks) is True
+
+    # swapped signature: both paths reject
+    bad = list(sig_bytes)
+    bad[2] = sig_bytes[3]
+    assert backend.multi_verify_compressed(msgs, bad, pks) is False
+
+    # per-row invalid encodings fail the batch, never crash it
+    mal = list(sig_bytes)
+    b0 = bytearray(mal[1])
+    b0[0] &= 0x7F  # compressed flag cleared
+    mal[1] = bytes(b0)
+    assert backend.multi_verify_compressed(msgs, mal, pks) is False
+
+    wl = list(sig_bytes)
+    wl[0] = wl[0][:95]  # wire length — host twin raises BlsError: False
+    assert backend.multi_verify_compressed(msgs, wl, pks) is False
+
+    nr = list(sig_bytes)
+    z = bytearray(96)
+    z[0] = 0x80
+    z[95] = 1  # x = 1: rhs is a non-residue, no curve point
+    nr[4] = bytes(z)
+    assert backend.multi_verify_compressed(msgs, nr, pks) is False
+
+
+def test_aggregate_compressed_matches_host_twin(backend, keys):
+    pks = [sk.public_key() for sk in keys]
+    msgs = [b"att-%d" % i for i in range(3)]
+    committees = [[0, 1], [2, 3, 4], [1, 4]]
+    aggs = [
+        A.Signature.aggregate([keys[j].sign(m) for j in c])
+        for m, c in zip(msgs, committees)
+    ]
+    agg_bytes = [A.g2_to_bytes(s.point) for s in aggs]
+    member_keys = [[pks[j] for j in c] for c in committees]
+
+    assert backend.fast_aggregate_verify_batch_compressed(
+        msgs, agg_bytes, member_keys
+    ) is True
+
+    bad = list(agg_bytes)
+    bad[1] = agg_bytes[0]
+    assert backend.fast_aggregate_verify_batch_compressed(
+        msgs, bad, member_keys
+    ) is False
+
+
+def test_aggregate_indexed_compressed_matches_registry_path(backend, keys):
+    from grandine_tpu.tpu.registry import DevicePubkeyRegistry
+
+    pkb = tuple(sk.public_key().to_bytes() for sk in keys)
+    reg = DevicePubkeyRegistry()
+    assert reg.ensure(pkb)
+
+    msgs = [b"idx-%d" % i for i in range(2)]
+    committees = [[0, 1, 2], [3, 4]]
+    aggs = [
+        A.Signature.aggregate([keys[j].sign(m) for j in c])
+        for m, c in zip(msgs, committees)
+    ]
+    agg_bytes = [A.g2_to_bytes(s.point) for s in aggs]
+    assert backend.fast_aggregate_verify_batch_indexed_compressed(
+        msgs, agg_bytes, committees, reg
+    ) is True
+    # wrong committee fails like the uncompressed indexed path
+    assert backend.fast_aggregate_verify_batch_indexed_compressed(
+        msgs, agg_bytes, [committees[0][:2], committees[1]], reg
+    ) is False
+
+
+def test_compressed_subgroup_check_is_always_fused(backend, keys):
+    """Security invariant: a compressed batch must reject a signature in
+    the wrong subgroup even on a backend configured for the two-pass
+    host fallback — the decompressed point never exists host-side, so
+    the fused check is the ONLY subgroup gate on this path."""
+    from grandine_tpu.crypto.hash_to_curve import (
+        hash_to_field_fq2,
+        map_to_curve_g2,
+    )
+
+    # an on-curve G2 point OUTSIDE the prime-order subgroup: passes
+    # decompression's curve checks, must fail membership (same
+    # construction as test_fused_verify's _nonsubgroup_sig)
+    pt = map_to_curve_g2(hash_to_field_fq2(b"rogue", b"SGT", 1)[0])
+    assert not pt.in_subgroup_slow()
+    rogue = A.g2_to_bytes(pt)
+    pks = [keys[0].public_key()]
+    assert backend.multi_verify_compressed(
+        [b"rogue"], [rogue], pks
+    ) is False

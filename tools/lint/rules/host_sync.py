@@ -65,6 +65,7 @@ class HostSyncRule(Rule):
         "grandine_tpu/tpu/ed25519.py",
         "grandine_tpu/kzg/eip4844.py",
         "grandine_tpu/runtime/profiler.py",
+        "grandine_tpu/tpu/curve.py",
     )
 
     def check(self, ctx: Context, files):

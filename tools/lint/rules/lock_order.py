@@ -88,6 +88,7 @@ class LockOrderRule(Rule):
         "grandine_tpu/runtime/replay.py",
         "grandine_tpu/runtime/flight.py",
         "grandine_tpu/tpu/registry.py",
+        "grandine_tpu/crypto/bls.py",
     )
 
     def check(self, ctx: Context, files):

@@ -61,6 +61,7 @@ class ThreadAffinityRule(Rule):
         "grandine_tpu/tpu/ed25519.py",
         "grandine_tpu/kzg/eip4844.py",
         "grandine_tpu/runtime/profiler.py",
+        "grandine_tpu/crypto/bls.py",
     )
 
     def check(self, ctx: Context, files):

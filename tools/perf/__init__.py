@@ -47,6 +47,10 @@ TOLERANCES = {
     "bls_multi_verify_throughput": 0.40,
     "verify_scheduler_throughput": 0.40,
     "replay_throughput": 0.40,
+    # compressed-ingest e2e (bench.py --compressed): prep-inclusive wall
+    # rate — regressing it means the host-prep bottleneck is creeping
+    # back in, the exact thing the compressed plane exists to kill
+    "bls_compressed_e2e_throughput": 0.40,
 }
 
 #: a metric needs this many PRIOR rows before the gate engages

@@ -124,6 +124,18 @@ _PLAN_SUFFIXES = ("plan_msm", "_g2_plan", "msm_plans")
 
 _CONST_NAME_RE = re.compile(r"[A-Z_][A-Z0-9_]*\Z")
 
+#: compressed-entry kernels -> the warm kind that precompiles each.
+#: These kernels take raw wire bytes and decompress on device, so the
+#: host twin's warm rows do NOT cover them; a compressed kernel
+#: registered without its own warm row compiles on the first live
+#: compressed batch — exactly the mid-slot stall this plane removes.
+COMPRESSED_WARM_KINDS = {
+    "multi_verify_msm_comp": "multi_verify_comp",
+    "agg_fast_verify_msm_comp": "aggregate_comp",
+    "agg_fast_verify_msm_idx_comp": "aggregate_idx_comp",
+    "g1_decompress": "g1_decompress",
+}
+
 
 def _qual(cls: "str | None", fn: "str | None") -> str:
     name = fn or "<module>"
@@ -296,6 +308,28 @@ class Analysis:
         # helper (lo=4) up to its 8-item lane cap — the flat point
         # array is 4 groups of that bucket, so two rungs cover the
         # whole dispatch universe
+        # compressed-ingest twins (tpu/bls.py *_comp kernels) take raw
+        # wire bytes as the signature operand and decompress on device;
+        # they ride the same ladders as their uncompressed anchors so a
+        # node flipping between host- and device-decompress paths never
+        # meets a cold shape.  g1_decompress warms the registry's
+        # append ladder (_next_pow2 floor up through churn-batch scale)
+        if any(e.kernel == "agg_fast_verify_msm_comp" for e in self.entries):
+            rows.append(("aggregate_comp", tuple(ladder), derived))
+        if any(
+            e.kernel == "agg_fast_verify_msm_idx_comp" for e in self.entries
+        ):
+            rows.append(("aggregate_idx_comp", tuple(ladder), derived))
+        if any(e.kernel == "multi_verify_msm_comp" for e in self.entries):
+            rows.append((
+                "multi_verify_comp", (64, 256, 1024, 4096),
+                "policy:block-replay",
+            ))
+        if any(e.kernel == "g1_decompress" for e in self.entries):
+            rows.append((
+                "g1_decompress", (16, 64, 256, 1024),
+                "policy:registry-append",
+            ))
         if any(e.kernel == "ed25519_verify" for e in self.entries):
             rows.append((
                 "ed25519_verify", (8, 32, 128), "policy:ed25519-lane",
@@ -1082,6 +1116,18 @@ def analyze(
 
     _parse_bounds(ctx, files, analysis, findings)
 
+    warm_kinds = {kind for kind, _, _ in analysis.warm_rows()}
+    for kernel, kind in sorted(COMPRESSED_WARM_KINDS.items()):
+        if kernel in registered and kind not in warm_kinds:
+            findings.append(Finding(
+                RULE, BLS_PATH, 1,
+                f"compressed-entry kernel {kernel!r} has no {kind!r} "
+                "warm row — the first live compressed batch would "
+                "compile at dispatch time; add the warm policy row in "
+                "tools/shapes",
+                key=f"{RULE}:{BLS_PATH}:warm-missing:{kernel}",
+            ))
+
     if check_manifest:
         want = analysis.manifest_text()
         have = ctx.source(manifest_path)
@@ -1122,6 +1168,7 @@ __all__ = [
     "MANIFEST_PATH",
     "PROFILER_RULE",
     "PROFILER_PATH",
+    "COMPRESSED_WARM_KINDS",
     "DEFAULT_FILES",
     "TPU_FILES",
     "RUNTIME_FILES",
