@@ -33,6 +33,7 @@ Honesty notes (VERDICT r3 #10):
 from __future__ import annotations
 
 import functools
+import hashlib
 import json
 import os
 import sys
@@ -2684,6 +2685,221 @@ def bench_compressed() -> None:
         raise SystemExit(1)
 
 
+def bench_signing() -> None:
+    """`--signing` / BENCH_SIGNING=1: device signing plane duty bench.
+
+    Per-slot duty load for an operator with BENCH_SIGNING_KEYS (default
+    4096) keys: every key signs one attestation, a sync-committee
+    subset signs the head root, and the slot's committee aggregates are
+    constructed on device (`g2_aggregate_groups` + the G1 pubkey
+    twin) — all through the SigningPlane with the release gate ON.
+
+    The ledger-gated metric is `signing_plane` (released signatures/s
+    through the gated plane). The gate asserts the subsystem's promise,
+    not just its speed: every released signature byte-identical to the
+    host `sk.sign` anchor, a scripted wrong-signature device fault
+    (ChaosBackend) releasing ZERO bad signatures (the batch degrades to
+    host re-sign and the breaker hears a verdict fault), zero missed
+    deadlines (no dropped tickets), and zero post-warmup recompiles.
+
+    Knobs: BENCH_SIGNING_KEYS (default 4096, rounded down to a full
+    lane batch), BENCH_SIGNING_ITERS (timed rounds, default 3)."""
+    _lint_preflight()
+
+    import statistics
+
+    from grandine_tpu.crypto import bls as A
+    from grandine_tpu.metrics import Metrics
+    from grandine_tpu.runtime.sign_plane import (
+        SignLaneConfig,
+        SigningPlane,
+    )
+    from grandine_tpu.runtime.thread_pool import Priority
+    from grandine_tpu.testing.chaos import ChaosBackend, FaultPlan
+    from grandine_tpu.tpu import bls as B
+    from grandine_tpu.tpu import schemes
+
+    n_keys = int(os.environ.get("BENCH_SIGNING_KEYS", "4096"))
+    iters = int(os.environ.get("BENCH_SIGNING_ITERS", "3"))
+    if n_keys >= 512:
+        batch = 512
+        n_keys = (n_keys // batch) * batch
+    else:
+        batch = max(4, 1 << (max(4, n_keys).bit_length() - 1))
+        n_keys = (max(4, n_keys) // batch) * batch
+    n_sync = min(batch, n_keys)
+    span = min(64, n_keys)  # committee width for aggregate construction
+
+    metrics = Metrics()
+    backend = schemes.get("bls").make_backend(metrics=metrics)
+    B.reset_shape_tracking()
+
+    # full-batch lane policy: a long deadline makes every flush a FULL
+    # bucket (n_keys is a batch multiple), so the timed rounds replay
+    # exactly the warmed shapes
+    lanes = (
+        SignLaneConfig("attestation", Priority.HIGH, batch, 2.0,
+                       2 * n_keys + 16, shed=False),
+        SignLaneConfig("sync_message", Priority.HIGH, batch, 2.0,
+                       2 * n_keys + 16, shed=False),
+        SignLaneConfig("other", Priority.LOW, batch, 2.0,
+                       2 * n_keys + 16, shed=True),
+    )
+    sks = [A.SecretKey(0x51c_0001 + 0x2222 * i) for i in range(n_keys)]
+    pks = [sk.public_key() for sk in sks]
+    att_roots = [
+        hashlib.sha256(b"att-duty-%d" % i).digest() for i in range(n_keys)
+    ]
+    sync_root = hashlib.sha256(b"sync-duty-head-root").digest()
+
+    # host anchors (the differential twin) — timed as the host leg
+    t0 = time.time()
+    anchors = [sk.sign(r).to_bytes() for sk, r in zip(sks, att_roots)]
+    sync_anchors = [
+        sks[i].sign(sync_root).to_bytes() for i in range(n_sync)
+    ]
+    host_wall = time.time() - t0
+    host_rate = (n_keys + n_sync) / host_wall
+
+    def duty_round(plane) -> "tuple[list, list, int]":
+        tickets = [
+            plane.submit(r, sk, duty_kind="attestation", public_key=pk)
+            for r, sk, pk in zip(att_roots, sks, pks)
+        ]
+        sync_tickets = [
+            plane.submit(sync_root, sks[i], duty_kind="sync_message",
+                         public_key=pks[i])
+            for i in range(n_sync)
+        ]
+        missed = 0
+        out, sync_out = [], []
+        for bucket, src in ((out, tickets), (sync_out, sync_tickets)):
+            for t in src:
+                try:
+                    bucket.append(t.result(600.0))
+                except (TimeoutError, RuntimeError):
+                    missed += 1
+                    bucket.append(None)
+        return out, sync_out, missed
+
+    plane = SigningPlane(
+        backend=backend, lanes=lanes, metrics=metrics,
+        settle_timeout_s=600.0,
+    )
+    # warm round compiles every timed shape (sign bucket + release-gate
+    # multi_verify), then the aggregate-construction kernels, then seal
+    warm_out, warm_sync, warm_missed = duty_round(plane)
+    sig_groups = [
+        [A.Signature(A.g2_from_bytes(sb, subgroup_check=False))
+         for sb in anchors[i:i + span]]
+        for i in range(0, n_keys, span)
+    ]
+    pk_groups = [pks[i:i + span] for i in range(0, n_keys, span)]
+    B.g2_aggregate_groups(sig_groups, metrics)
+    B.g1_aggregate_groups(pk_groups, metrics)
+    B.declare_warmup_complete()
+
+    identical = warm_out == anchors and warm_sync == sync_anchors
+    missed_total = warm_missed
+
+    walls = []
+    for _ in range(iters):
+        t0 = time.time()
+        out, sync_out, missed = duty_round(plane)
+        walls.append(time.time() - t0)
+        identical = identical and out == anchors and (
+            sync_out == sync_anchors
+        )
+        missed_total += missed
+    p50 = statistics.median(walls)
+    plane_rate = (n_keys + n_sync) / p50
+
+    # aggregate-construction leg: device vs host twin, byte-identical
+    t0 = time.time()
+    dev_aggs = B.g2_aggregate_groups(sig_groups, metrics)
+    dev_pk_aggs = B.g1_aggregate_groups(pk_groups, metrics)
+    agg_wall = time.time() - t0
+    agg_ok = (
+        [a.to_bytes() for a in dev_aggs]
+        == [A.Signature.aggregate(g).to_bytes() for g in sig_groups]
+        and [a.to_bytes() for a in dev_pk_aggs]
+        == [A.PublicKey.aggregate(g).to_bytes() for g in pk_groups]
+    )
+
+    # release-gate overhead: one ungated round against the same warm
+    # shapes (the gate is the only difference)
+    ungated = SigningPlane(
+        backend=backend, lanes=lanes, metrics=metrics,
+        settle_timeout_s=600.0, release_gate=False,
+    )
+    t0 = time.time()
+    out, sync_out, missed = duty_round(ungated)
+    ungated_wall = time.time() - t0
+    identical = identical and out == anchors and sync_out == sync_anchors
+    missed_total += missed
+    gate_overhead = max(0.0, p50 / max(ungated_wall, 1e-9) - 1.0)
+
+    # scripted wrong-signature device fault: the FIRST batch of this
+    # plane's dispatches is corrupted; the release gate must degrade it
+    # to host re-sign — zero bad signatures released
+    chaos_plane = SigningPlane(
+        backend=ChaosBackend(
+            backend, FaultPlan(script=["wrong_signature"])
+        ),
+        lanes=lanes, metrics=metrics, settle_timeout_s=600.0,
+    )
+    out, sync_out, missed = duty_round(chaos_plane)
+    chaos_ok = out == anchors and sync_out == sync_anchors
+    missed_total += missed
+    chaos_stats = chaos_plane.stats()
+    gate_failures = sum(
+        st["gate_failures"] for st in chaos_stats.values()
+    )
+    chaos_ok = chaos_ok and gate_failures >= 1
+
+    for p in (plane, ungated, chaos_plane):
+        p.stop()
+
+    recompiles = B.post_warmup_recompiles()
+    plane_ok = (
+        identical and agg_ok and chaos_ok
+        and missed_total == 0 and recompiles == 0
+    )
+    emit_bench_line({
+        "metric": "signing_plane",
+        "unit": "sigs/s",
+        "value": round(plane_rate, 1),
+        "keys": n_keys,
+        "sync_members": n_sync,
+        "iters": iters,
+        "p50_s": round(p50, 4),
+        "host_sigs_per_sec": round(host_rate, 1),
+        "device_vs_host": round(plane_rate / host_rate, 2),
+        "release_gate_overhead": round(gate_overhead, 3),
+        "aggregate_groups": len(sig_groups),
+        "aggregate_wall_s": round(agg_wall, 4),
+        "aggregates_ok": agg_ok,
+        "chaos_gate_failures": gate_failures,
+        "chaos_ok": chaos_ok,
+        "missed_deadlines": missed_total,
+        "signatures_identical": identical,
+        "post_warmup_recompiles": recompiles,
+        "plane_ok": plane_ok,
+    }, config={"keys": n_keys, "iters": iters})
+    print(
+        f"# signing plane: {plane_rate:.1f} sigs/s gated "
+        f"(host {host_rate:.1f}, {plane_rate / host_rate:.2f}x), "
+        f"gate overhead {gate_overhead * 100:.1f}%, "
+        f"{gate_failures} chaos gate catch(es), "
+        f"{missed_total} missed deadlines, "
+        f"{recompiles} post-warmup recompiles; "
+        + ("OK" if plane_ok else "FAILED"),
+        file=sys.stderr,
+    )
+    if not plane_ok:
+        raise SystemExit(1)
+
+
 if __name__ == "__main__":
     if "--devices-child" in sys.argv:
         bench_multichip_child(
@@ -2716,6 +2932,8 @@ if __name__ == "__main__":
         or os.environ.get("BENCH_COMPRESSED") == "1"
     ):
         bench_compressed()
+    elif "--signing" in sys.argv or os.environ.get("BENCH_SIGNING") == "1":
+        bench_signing()
     elif os.environ.get("BENCH_SCHED_ONLY") == "1":
         bench_verify_scheduler()
     else:

@@ -501,6 +501,55 @@ class Metrics:
             "verify-scheduler jobs shed under overload, by lane",
             ("lane",),
         )
+        # device signing plane (runtime/sign_plane.py): per-lane queue
+        # occupancy, flushed batches by outcome (device = released
+        # through the gate / degraded = gate or device fault re-signed
+        # on the host anchor / host = breaker-open host signing),
+        # enqueue→release wait, release-gate latency, and slashing-
+        # interlock refusals. Labels are CLOSED sets — lane names and
+        # refusal reasons are fixed enums, never per-key values.
+        self.sign_lane_depth = LabeledGauge(
+            "sign_lane_depth",
+            "signing-plane requests queued, by lane",
+            ("lane",),
+        )
+        self.sign_lane_batches = LabeledCounter(
+            "sign_lane_batches_total",
+            "signing-plane batches released, by lane and result "
+            "(device/degraded/host)",
+            ("lane", "result"),
+        )
+        self.sign_lane_wait_seconds = LabeledHistogram(
+            "sign_lane_wait_seconds",
+            "enqueue-to-release wait of signing-plane requests, by lane",
+            ("lane",),
+            buckets=(
+                0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+            ),
+        )
+        # sheds/drops reuse verify_lane_dropped_total (the ONE drop
+        # family — drop-counter-reuse lint): sign lanes carry their own
+        # label values in it
+        self.sign_release_gate_seconds = Histogram(
+            "sign_release_gate_seconds",
+            "release-gate batch-verify latency per signing batch",
+            buckets=(
+                0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+            ),
+        )
+        self.sign_refused = LabeledCounter(
+            "sign_refused_total",
+            "signing requests refused by the slashing interlock before "
+            "reaching a kernel, by reason "
+            "(block_regression/attestation_regression)",
+            ("reason",),
+        )
+        self.sign_pipeline_depth = Gauge(
+            "sign_pipeline_depth",
+            "signing batches in flight (dispatched, not released)",
+        )
         # device health supervisor (runtime/health.py): breaker state
         # machine, canary re-promotion probes, settle watchdog, bounded
         # transient retries, and daemon-loop crash containment

@@ -74,6 +74,8 @@ KERNEL_SCHEMES = {
     "multi_verify_msm_comp": "bls",
     "g1_decompress": "bls",
     "batch_sign": "bls",
+    "g2_aggregate": "bls",
+    "g1_aggregate": "bls",
     "g2_subgroup_check": "bls",
     "grouped_multi_verify_msm": "bls",
     "multi_verify_msm": "bls",

@@ -1,0 +1,798 @@
+"""Device-resident signing plane: the sign-side mirror of the verify
+scheduler (runtime/verify_scheduler.py).
+
+Every validator duty signature funnels into one multi-lane batch-signing
+plane: `submit` coalesces (pubkey, signing_root, duty_kind) requests
+from all validators under a deadline-or-max_batch policy onto
+pow-2-bucketed `batch_sign` dispatches (tpu/bls.py — one G2 GLV
+dual-ladder pass for the whole batch), with ticket futures handed back
+to callers and pipeline_depth worker threads overlapping host prep with
+device execution, two deep.
+
+Two properties the verify side never needed:
+
+  release gate — a faulty device must never EMIT a bad signature (a
+      wrong block signature is a missed proposal; a wrong attestation
+      loses rewards network-wide for the operator). Before any caller
+      sees a device-produced batch, the plane batch-*verifies* it
+      against the registered public keys in one RLC pass
+      (`SigningDescriptor.release_verify`). Gate failure re-signs that
+      batch on the host anchor and files a `verdict` fault with the
+      health supervisor — zero bad signatures are ever released.
+
+  slashing interlock — a per-pubkey monotonic (duty_kind, slot/epoch)
+      low-watermark (`SignInterlock`, persisted via storage.Database
+      like the reputation table) refuses a regressing block or
+      attestation signing request BEFORE it reaches a kernel, counted
+      in `sign_refused_total{reason}`.
+
+Degradation: a breaker-open device, a watchdog-timed-out dispatch, or a
+failed release gate all fall back to the host `sk.sign` anchor
+(byte-identical by contract), so a device fault never misses a duty
+deadline. Scheme resolution goes through the tpu/schemes.py table only
+(`Scheme.signing` — the sign-side descriptor), never a kernel import.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional, Sequence
+
+from grandine_tpu.runtime import flight as _flight
+from grandine_tpu.runtime import health as _health
+from grandine_tpu.runtime.thread_pool import Priority
+from grandine_tpu.tpu import schemes as _schemes
+
+#: slashing-interlock refusal reasons — the CLOSED label set of
+#: `sign_refused_total{reason}` (metrics-cardinality lint)
+REFUSAL_REASONS = ("block_regression", "attestation_regression")
+
+#: duty kinds the interlock watermark applies to → refusal reason.
+#: Everything else (randao, sync messages, selection proofs, aggregate
+#: proofs) is not slashable and passes through uncounted.
+SLASHABLE_KINDS = {
+    "block": "block_regression",
+    "attestation": "attestation_regression",
+}
+
+
+class SignRefused(Exception):
+    """The slashing interlock refused this request (regressing block
+    slot / attestation target epoch for the pubkey's watermark)."""
+
+    def __init__(self, reason: str, duty_kind: str, index: int) -> None:
+        super().__init__(
+            f"signing refused ({reason}): {duty_kind} at {index} does "
+            f"not advance the pubkey's low-watermark"
+        )
+        self.reason = reason
+        self.duty_kind = duty_kind
+        self.index = index
+
+
+class SignInterlock:
+    """Minimal slashing-protection interlock in front of the plane: a
+    per-pubkey monotonic (duty_kind, slot/epoch) low-watermark. A
+    request whose index does not strictly advance the watermark is
+    refused — conservatively including re-signing the SAME slot/epoch,
+    which the full SlashingProtection store would allow for identical
+    data; the plane's interlock is a last-line device-side guard, not a
+    replacement for validator/slashing_protection.py.
+
+    Watermarks persist across restarts via `storage.Database` (prefix
+    ``sgn:w:``, 8-byte little-endian index per (duty_kind, pubkey) key,
+    the reputation-table idiom), with a write-through in-memory mirror
+    so the hot path pays one dict probe. All state is guarded by
+    `_lock` (submit arrives from every validator thread at once)."""
+
+    _PREFIX = b"sgn:w:"
+
+    def __init__(self, db=None) -> None:
+        self._db = db
+        self._lock = threading.Lock()
+        self._marks: "dict[tuple[str, bytes], int]" = {}
+
+    def _key(self, duty_kind: str, pubkey: bytes) -> bytes:
+        return self._PREFIX + duty_kind.encode() + b":" + pubkey
+
+    def check_and_advance(
+        self, pubkey: bytes, duty_kind: str, index: "Optional[int]"
+    ) -> "Optional[str]":
+        """None when the request is allowed (watermark advanced and
+        persisted); the refusal reason string otherwise. Non-slashable
+        duty kinds and index-less requests always pass."""
+        reason = SLASHABLE_KINDS.get(duty_kind)
+        if reason is None or index is None:
+            return None
+        index = int(index)
+        with self._lock:
+            mark = self._marks.get((duty_kind, pubkey))
+            if mark is None and self._db is not None:
+                raw = self._db.get(self._key(duty_kind, pubkey))
+                if raw is not None:
+                    mark = int.from_bytes(raw, "little")
+            if mark is not None and index <= mark:
+                return reason
+            self._marks[(duty_kind, pubkey)] = index
+            if self._db is not None:
+                self._db.put(
+                    self._key(duty_kind, pubkey), index.to_bytes(8, "little")
+                )
+        return None
+
+    def watermark(
+        self, pubkey: bytes, duty_kind: str
+    ) -> "Optional[int]":
+        with self._lock:
+            mark = self._marks.get((duty_kind, pubkey))
+            if mark is None and self._db is not None:
+                raw = self._db.get(self._key(duty_kind, pubkey))
+                if raw is not None:
+                    mark = int.from_bytes(raw, "little")
+            return mark
+
+
+class SignLaneConfig:
+    """One signing lane's flush/backpressure policy (the sign-side
+    LaneConfig)."""
+
+    __slots__ = ("name", "priority", "max_batch", "max_wait_s",
+                 "max_queue", "shed", "scheme", "label")
+
+    def __init__(self, name: str, priority: Priority, max_batch: int,
+                 max_wait_s: float, max_queue: int, shed: bool,
+                 scheme: str = "bls") -> None:
+        self.name = name
+        #: metric label — prefixed so sign lanes stay distinguishable
+        #: from verify lanes inside shared families (one drop family:
+        #: verify_lane_dropped_total)
+        self.label = "sign_" + name
+        self.priority = priority
+        self.max_batch = int(max_batch)
+        self.max_wait_s = float(max_wait_s)
+        self.max_queue = int(max_queue)
+        #: LOW lanes shed oldest-first at max_queue (a dropped ticket
+        #: degrades the caller to its host path — the duty is never
+        #: lost); HIGH lanes block the submitter instead
+        self.shed = bool(shed)
+        self.scheme = str(scheme)
+
+
+#: the signing lane table (README "Device signing plane" mirrors this).
+#: Block/randao flush almost immediately (a proposal is one signature on
+#: a hard deadline); attestation/sync-message lanes coalesce the
+#: per-slot many-validator burst into big buckets; max_batch values sit
+#: on the warmed `sign` ladder so steady state never compiles.
+DEFAULT_SIGN_LANES = (
+    SignLaneConfig("block", Priority.HIGH, 4, 0.001, 256, shed=False),
+    SignLaneConfig("randao", Priority.HIGH, 8, 0.001, 256, shed=False),
+    SignLaneConfig("attestation", Priority.HIGH, 512, 0.020, 16384,
+                   shed=False),
+    SignLaneConfig("sync_message", Priority.HIGH, 512, 0.020, 16384,
+                   shed=False),
+    SignLaneConfig("aggregate", Priority.HIGH, 64, 0.010, 4096,
+                   shed=False),
+    SignLaneConfig("sync_contribution", Priority.HIGH, 64, 0.010, 4096,
+                   shed=False),
+    SignLaneConfig("selection_proof", Priority.LOW, 64, 0.010, 4096,
+                   shed=True),
+    SignLaneConfig("other", Priority.LOW, 64, 0.025, 4096, shed=True),
+)
+
+
+class SignTicket:
+    """Future handed back by `submit`: resolves to the wire-encoded
+    signature bytes, or `dropped=True` when the request was shed at
+    shutdown/overload (the caller degrades to its own host path)."""
+
+    __slots__ = ("lane", "enqueued_at", "settled_at", "dropped",
+                 "_sig", "_event", "_callbacks", "_lock")
+
+    def __init__(self, lane: str) -> None:
+        self.lane = lane
+        self.enqueued_at = time.monotonic()
+        self.settled_at: "Optional[float]" = None
+        self.dropped = False
+        # lint: atomic=_sig: _resolve writes it under _lock before
+        # _event.set(); readers gate on the Event — happens-before edge
+        self._sig: "Optional[bytes]" = None
+        self._event = threading.Event()
+        self._callbacks: "list[Callable]" = []
+        self._lock = threading.Lock()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: "Optional[float]" = None) -> bytes:
+        if not self._event.wait(timeout):
+            raise TimeoutError(f"{self.lane} sign ticket not settled")
+        # Event.wait() is the happens-before edge for the _sig write
+        if self._sig is None:
+            raise RuntimeError(
+                f"{self.lane} sign request dropped at shutdown"
+            )
+        return self._sig
+
+    def add_callback(self, fn: "Callable[[SignTicket], None]") -> None:
+        """Run fn(ticket) once settled (immediately if already done)."""
+        with self._lock:
+            if not self._event.is_set():
+                self._callbacks.append(fn)
+                return
+        fn(self)
+
+    def _resolve(self, sig: "Optional[bytes]",
+                 dropped: bool = False) -> None:
+        with self._lock:
+            if self._event.is_set():
+                return
+            self._sig = sig
+            self.dropped = dropped
+            self.settled_at = time.monotonic()
+            callbacks, self._callbacks = self._callbacks, []
+            self._event.set()
+        for fn in callbacks:
+            try:
+                fn(self)
+            except Exception:
+                pass  # a consumer's callback must not break settling
+
+
+class _SignJob:
+    __slots__ = ("signing_root", "secret_key", "public_key", "duty_kind",
+                 "ticket")
+
+    def __init__(self, signing_root: bytes, secret_key, public_key,
+                 duty_kind: str, ticket: SignTicket) -> None:
+        self.signing_root = bytes(signing_root)
+        self.secret_key = secret_key
+        self.public_key = public_key
+        self.duty_kind = duty_kind
+        self.ticket = ticket
+
+
+class SigningPlane:
+    """submit → coalesce → device batch_sign → release gate → release.
+
+    One dispatcher thread forms batches (HIGH lanes flush first among
+    due lanes); `pipeline_depth` worker threads run the blocking device
+    dispatch + release gate so two batches overlap (host prep of one
+    against device execute of the other). The breaker
+    (`BackendHealthSupervisor`) gates device use exactly as on the
+    verify side; every degradation lands on the host `sk.sign` anchor
+    so a duty deadline is never missed."""
+
+    def __init__(
+        self,
+        backend=None,
+        lanes: "Optional[Sequence[SignLaneConfig]]" = None,
+        use_device: bool = True,
+        pipeline_depth: int = 2,
+        metrics=None,
+        health: "Optional[_health.BackendHealthSupervisor]" = None,
+        settle_timeout_s: float = 5.0,
+        flight: "Optional[_flight.FlightRecorder]" = None,
+        interlock: "Optional[SignInterlock]" = None,
+        db=None,
+        release_gate: bool = True,
+    ) -> None:
+        self.metrics = metrics
+        self.use_device = bool(use_device)
+        #: release-gate toggle — ONLY for benches measuring the gate's
+        #: overhead; production keeps it on (the plane's core promise)
+        self.release_gate = bool(release_gate)
+        self.lanes = {
+            lane.name: lane
+            for lane in (lanes if lanes is not None else DEFAULT_SIGN_LANES)
+        }
+        self.health = (
+            health if health is not None
+            else _health.BackendHealthSupervisor(
+                metrics=metrics, settle_timeout_s=settle_timeout_s,
+                name="sign-device",
+            )
+        )
+        self.flight = (
+            flight if flight is not None
+            else _flight.FlightRecorder(metrics=metrics)
+        )
+        self.interlock = (
+            interlock if interlock is not None else SignInterlock(db=db)
+        )
+        self._injected_backend = backend
+        self._backend_lock = threading.Lock()
+        self._backends: "dict[str, object]" = {}
+        #: pubkey-by-scalar cache for submitters that pass no
+        #: public_key: deriving pk = [sk]g1 on the host costs a scalar
+        #: mul, paid once per key per process. In-process only — the
+        #: keys already live in this address space. All access stays
+        #: inside _pk_lock.
+        self._pk_lock = threading.Lock()
+        self._pk_cache: "dict[int, object]" = {}
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queues: "dict[str, deque]" = {
+            name: deque() for name in self.lanes
+        }
+        self._pending = 0
+        self._stop = False
+        self._stats_lock = threading.Lock()
+        self._stats = {
+            name: {
+                "submitted": 0, "batches": 0, "signed": 0, "refused": 0,
+                "dropped": 0, "device_batches": 0, "degraded": 0,
+                "host_batches": 0, "breaker_skips": 0, "device_faults": 0,
+                "gate_failures": 0, "max_batch_items": 0,
+            }
+            for name in self.lanes
+        }
+        self._inflight: "queue.Queue" = queue.Queue(
+            maxsize=max(1, int(pipeline_depth))
+        )
+        # threads are constructed before ANY starts so a worker can
+        # never observe a half-built plane (init-escape lint)
+        self._workers = [
+            threading.Thread(
+                target=self._worker_loop, name=f"sign-plane-worker-{i}",
+                daemon=True,
+            )
+            for i in range(max(1, int(pipeline_depth)))
+        ]
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, name="sign-plane-dispatch",
+            daemon=True,
+        )
+        self._dispatcher.start()
+        for t in self._workers:
+            t.start()
+
+    # ------------------------------------------------------------ submit
+
+    def _lane_for(self, duty_kind: str) -> SignLaneConfig:
+        lane = self.lanes.get(duty_kind)
+        if lane is None:
+            lane = self.lanes.get("other")
+        if lane is None:  # custom lane tables without a catch-all
+            lane = next(iter(self.lanes.values()))
+        return lane
+
+    def _public_key_for(self, secret_key, public_key):
+        if public_key is not None:
+            if isinstance(public_key, (bytes, bytearray)):
+                from grandine_tpu.crypto import bls as A
+
+                return A.PublicKey.from_bytes(bytes(public_key))
+            return public_key
+        scalar = secret_key.scalar
+        with self._pk_lock:
+            pk = self._pk_cache.get(scalar)
+            if pk is None:
+                pk = secret_key.public_key()
+                if len(self._pk_cache) >= 1 << 17:
+                    self._pk_cache.clear()  # bounded; refill is cheap
+                self._pk_cache[scalar] = pk
+            return pk
+
+    def submit(
+        self,
+        signing_root: bytes,
+        secret_key,
+        duty_kind: str = "other",
+        public_key=None,
+        index: "Optional[int]" = None,
+    ) -> SignTicket:
+        """Enqueue one signing request; returns a SignTicket future.
+
+        `index` is the duty's slot (block) or target epoch
+        (attestation): the slashing interlock refuses a request that
+        does not strictly advance the pubkey's watermark, raising
+        SignRefused BEFORE anything reaches a kernel."""
+        public_key = self._public_key_for(secret_key, public_key)
+        reason = self.interlock.check_and_advance(
+            public_key.to_bytes(), duty_kind, index
+        )
+        lane = self._lane_for(duty_kind)
+        if reason is not None:
+            if self.metrics is not None:
+                self.metrics.sign_refused.inc(reason)
+            with self._stats_lock:
+                self._stats[lane.name]["refused"] += 1
+            raise SignRefused(reason, duty_kind, index)
+        ticket = SignTicket(lane.name)
+        job = _SignJob(signing_root, secret_key, public_key, duty_kind,
+                       ticket)
+        shed_job = None
+        with self._lock:
+            if self._stop:
+                ticket._resolve(None, dropped=True)
+                return ticket
+            q = self._queues[lane.name]
+            if len(q) >= lane.max_queue:
+                if lane.shed:
+                    shed_job = q.popleft()
+                else:
+                    # HIGH lane backpressure: bounded producer, never
+                    # a dropped duty
+                    while len(q) >= lane.max_queue and not self._stop:
+                        self._cond.wait(0.005)
+                    if self._stop:
+                        ticket._resolve(None, dropped=True)
+                        return ticket
+            q.append(job)
+            self._pending += 1
+            depth = len(q)
+            self._cond.notify_all()
+        if shed_job is not None:
+            shed_job.ticket._resolve(None, dropped=True)
+            self._count_shed(lane, 1)
+            with self._lock:
+                self._pending -= 1
+        with self._stats_lock:
+            self._stats[lane.name]["submitted"] += 1
+        if self.metrics is not None:
+            self.metrics.sign_lane_depth.labels(lane.label).set(depth)
+        return ticket
+
+    def _count_shed(self, lane: SignLaneConfig, n: int) -> None:
+        """Every shed/drop funnels through here into the ONE drop
+        family (verify_lane_dropped_total — drop-counter-reuse lint),
+        with the sign-lane label keeping the signal separable."""
+        with self._stats_lock:
+            self._stats[lane.name]["dropped"] += n
+        if self.metrics is not None:
+            for _ in range(n):
+                self.metrics.verify_lane_dropped.labels(lane.label).inc()
+
+    def sign_many(
+        self,
+        requests: "Sequence[tuple]",
+        duty_kind: str = "other",
+        timeout: "Optional[float]" = 30.0,
+    ) -> "list[bytes]":
+        """Convenience batch submit-and-wait: requests are
+        (signing_root, secret_key) pairs; returns wire signatures in
+        order. One plane flush covers the whole slot's duty burst."""
+        tickets = [
+            self.submit(root, sk, duty_kind=duty_kind)
+            for root, sk in requests
+        ]
+        return [t.result(timeout) for t in tickets]
+
+    # --------------------------------------------------------- scheduling
+
+    def _pick_lane(self) -> "Optional[SignLaneConfig]":
+        """Called under _lock: a lane that is full or overdue — HIGH
+        priority first, then the most-overdue head."""
+        now = time.monotonic()
+        best = None
+        best_key = None
+        for lane in self.lanes.values():
+            q = self._queues[lane.name]
+            if not q:
+                continue
+            overdue = now - q[0].ticket.enqueued_at - lane.max_wait_s
+            if len(q) >= lane.max_batch or overdue >= 0.0:
+                key = (lane.priority != Priority.HIGH, -overdue)
+                if best is None or key < best_key:
+                    best, best_key = lane, key
+        return best
+
+    def _nearest_deadline(self) -> "Optional[float]":
+        """Called under _lock: seconds until the next lane flush is due,
+        or None when every queue is empty."""
+        now = time.monotonic()
+        nearest = None
+        for lane in self.lanes.values():
+            q = self._queues[lane.name]
+            if not q:
+                continue
+            due = q[0].ticket.enqueued_at + lane.max_wait_s - now
+            if nearest is None or due < nearest:
+                nearest = due
+        return nearest
+
+    def _pop_batch(self, lane: SignLaneConfig) -> "list[_SignJob]":
+        """Called under _lock."""
+        q = self._queues[lane.name]
+        out = []
+        while q and len(out) < lane.max_batch:
+            out.append(q.popleft())
+        return out
+
+    def _count_daemon_failure(self, thread: str) -> None:
+        if self.metrics is not None:
+            self.metrics.daemon_loop_failures.inc(thread)
+
+    def _dispatch_loop(self) -> None:
+        """Dispatcher daemon: coalesce queues into batches and hand them
+        to the worker pool. Crash containment per iteration — one bad
+        batch must not kill the plane."""
+        while True:
+            try:
+                if self._dispatch_once():
+                    return
+            except Exception:
+                self._count_daemon_failure("sign-plane-dispatch")
+                time.sleep(0.005)  # containment: never spin hot
+
+    def _dispatch_once(self) -> bool:
+        """One dispatcher iteration; True means stop-drain finished."""
+        to_drop = None
+        batch = None
+        lane = None
+        with self._lock:
+            # _stop is re-read under the SAME lock that guarded the
+            # queue reads: a stop() landing after release cannot be
+            # half-observed
+            if self._stop:
+                to_drop = [
+                    job for q in self._queues.values() for job in q
+                ]
+                for q in self._queues.values():
+                    q.clear()
+            else:
+                lane = self._pick_lane()
+                if lane is None:
+                    due = self._nearest_deadline()
+                    self._cond.wait(
+                        0.05 if due is None else max(0.0005, due)
+                    )
+                    return False
+                batch = self._pop_batch(lane)
+        if to_drop is not None:
+            for job in to_drop:
+                job.ticket._resolve(None, dropped=True)
+            if to_drop:
+                by_lane: "dict[str, int]" = {}
+                for job in to_drop:
+                    by_lane[job.ticket.lane] = (
+                        by_lane.get(job.ticket.lane, 0) + 1
+                    )
+                for name, n in by_lane.items():
+                    self._count_shed(self.lanes[name], n)
+                with self._lock:
+                    self._pending -= len(to_drop)
+                    self._cond.notify_all()
+            return True
+        if batch:
+            if self.metrics is not None:
+                self.metrics.sign_pipeline_depth.inc()
+            self._inflight.put((lane, batch))
+        return False
+
+    def _worker_loop(self) -> None:
+        """Worker daemon: full batch life (device sign → release gate →
+        resolve), one batch at a time; pipeline_depth workers give the
+        two-deep overlap. Crash containment: an unexpected error
+        degrades the batch to the host anchor rather than dropping it."""
+        while True:
+            handoff = self._inflight.get()
+            if handoff is None:
+                return
+            lane, jobs = handoff
+            try:
+                self._process_batch(lane, jobs)
+            except Exception:
+                try:
+                    self._resolve_on_host(lane, jobs, note_fault=True)
+                except Exception:
+                    for job in jobs:  # last resort: never hang a caller
+                        job.ticket._resolve(None, dropped=True)
+            finally:
+                if self.metrics is not None:
+                    self.metrics.sign_pipeline_depth.dec()
+                with self._lock:
+                    self._pending -= len(jobs)
+                    self._cond.notify_all()
+
+    # ---------------------------------------------------------- batch life
+
+    def _backend_for(self, lane: SignLaneConfig):
+        """Lazily build (once) the scheme backend; table-resolved only.
+        Double-checked under _backend_lock like CachedPublicKey — two
+        workers must not race a double build."""
+        if self._injected_backend is not None:
+            return self._injected_backend
+        with self._backend_lock:
+            backend = self._backends.get(lane.scheme)
+            if backend is None:
+                backend = _schemes.get(lane.scheme).make_backend(
+                    metrics=self.metrics, lane=f"sign:{lane.name}"
+                )
+                self._backends[lane.scheme] = backend
+            return backend
+
+    def _host_sign_all(self, signing, jobs: "list[_SignJob]"
+                       ) -> "list[bytes]":
+        return [
+            signing.host_sign(job.signing_root, job.secret_key)
+            for job in jobs
+        ]
+
+    def _process_batch(self, lane: SignLaneConfig,
+                       jobs: "list[_SignJob]") -> None:
+        signing = _schemes.get(lane.scheme).signing
+        now = time.monotonic()
+        queue_wait = max(
+            0.0, now - min(job.ticket.enqueued_at for job in jobs)
+        )
+        if self.metrics is not None:
+            for job in jobs:
+                self.metrics.sign_lane_wait_seconds.labels(
+                    lane.label
+                ).observe(now - job.ticket.enqueued_at)
+            self.metrics.sign_lane_depth.labels(lane.label).set(
+                len(self._queues[lane.name])
+            )
+        result = "host"
+        sigs: "Optional[list[bytes]]" = None
+        fl = self.flight.begin_batch(
+            lane.name, "batch_sign", len(jobs),
+            queue_wait_s=queue_wait, breaker_state=self.health.state,
+        )
+        device_wanted = (
+            self.use_device and signing is not None
+        )
+        if device_wanted and not self.health.allow_device():
+            device_wanted = False
+            with self._stats_lock:
+                self._stats[lane.name]["breaker_skips"] += 1
+        backend = self._backend_for(lane) if device_wanted else None
+        if backend is not None:
+            messages = [job.signing_root for job in jobs]
+            sks = [job.secret_key for job in jobs]
+            self.flight.device_enter()
+            try:
+                t0 = time.perf_counter()
+                outcome = self.health.guard_settle(
+                    lambda: signing.batch_sign(backend, messages, sks),
+                    thread_name="sign-settle-watchdog",
+                )
+                if outcome.status == _health.OK:
+                    fl.note_device(time.perf_counter() - t0)
+                    produced = outcome.value
+                    if self.release_gate:
+                        t1 = time.perf_counter()
+                        gate_ok = signing.release_verify(
+                            backend, messages, produced,
+                            [job.public_key for job in jobs],
+                        )
+                        gate_s = time.perf_counter() - t1
+                        fl.note_device(gate_s)
+                        if self.metrics is not None:
+                            self.metrics.sign_release_gate_seconds.observe(
+                                gate_s
+                            )
+                        if gate_ok:
+                            sigs = produced
+                            result = "device"
+                            self.health.record_success()
+                        else:
+                            # the core promise: a batch that fails the
+                            # gate is NEVER released — host re-sign, and
+                            # the breaker hears about the bad verdict
+                            self.health.record_fault("verdict")
+                            fl.note_fault("verdict")
+                            with self._stats_lock:
+                                self._stats[lane.name]["gate_failures"] += 1
+                                self._stats[lane.name]["device_faults"] += 1
+                            result = "degraded"
+                    else:
+                        sigs = produced
+                        result = "device"
+                        self.health.record_success()
+                elif outcome.status == _health.TIMEOUT:
+                    self.health.record_fault("watchdog")
+                    fl.note_fault("watchdog")
+                    with self._stats_lock:
+                        self._stats[lane.name]["device_faults"] += 1
+                    result = "degraded"
+                else:
+                    self.health.record_fault("dispatch")
+                    fl.note_fault("dispatch")
+                    with self._stats_lock:
+                        self._stats[lane.name]["device_faults"] += 1
+                    result = "degraded"
+            finally:
+                self.flight.device_exit()
+        if sigs is None:
+            if signing is None:
+                # no sign-side scheme row: nothing to anchor against —
+                # refuse by dropping (callers keep their own host path)
+                for job in jobs:
+                    job.ticket._resolve(None, dropped=True)
+                fl.finish(False)
+                return
+            t0 = time.perf_counter()
+            sigs = self._host_sign_all(signing, jobs)
+            fl.note_host(time.perf_counter() - t0)
+        for job, sig in zip(jobs, sigs):
+            job.ticket._resolve(sig)
+        fl.finish(True)
+        with self._stats_lock:
+            st = self._stats[lane.name]
+            st["batches"] += 1
+            st["signed"] += len(jobs)
+            st["max_batch_items"] = max(st["max_batch_items"], len(jobs))
+            if result == "device":
+                st["device_batches"] += 1
+            elif result == "degraded":
+                st["degraded"] += 1
+            else:
+                st["host_batches"] += 1
+        if self.metrics is not None:
+            self.metrics.sign_lane_batches.labels(
+                lane.label, result
+            ).inc()
+
+    def _resolve_on_host(self, lane: SignLaneConfig,
+                         jobs: "list[_SignJob]",
+                         note_fault: bool = False) -> None:
+        """Containment path: resolve every ticket on the host anchor."""
+        signing = _schemes.get(lane.scheme).signing
+        if signing is None:
+            for job in jobs:
+                job.ticket._resolve(None, dropped=True)
+            return
+        if note_fault:
+            self.health.record_fault("dispatch")
+            with self._stats_lock:
+                self._stats[lane.name]["device_faults"] += 1
+                self._stats[lane.name]["degraded"] += 1
+        for job in jobs:
+            job.ticket._resolve(
+                signing.host_sign(job.signing_root, job.secret_key)
+            )
+
+    # ------------------------------------------------------------ control
+
+    def flush(self, timeout: "Optional[float]" = None) -> bool:
+        """Block until every submitted request has settled (or timeout);
+        True when fully drained."""
+        deadline = (
+            None if timeout is None else time.monotonic() + timeout
+        )
+        with self._lock:
+            while self._pending > 0:
+                wait = 0.05
+                if deadline is not None:
+                    wait = min(wait, deadline - time.monotonic())
+                    if wait <= 0:
+                        return False
+                self._cond.wait(wait)
+        return True
+
+    def stop(self, timeout: float = 5.0) -> None:
+        """Drain in-flight batches, drop queued requests (tickets settle
+        dropped=True), and join the plane's threads."""
+        with self._lock:
+            if self._stop:
+                return
+            self._stop = True
+            self._cond.notify_all()
+        self._dispatcher.join(timeout)
+        for _ in self._workers:
+            self._inflight.put(None)
+        for t in self._workers:
+            t.join(timeout)
+
+    def stats(self) -> dict:
+        with self._stats_lock:
+            return {
+                name: dict(st) for name, st in self._stats.items()
+            }
+
+
+__all__ = [
+    "DEFAULT_SIGN_LANES",
+    "REFUSAL_REASONS",
+    "SLASHABLE_KINDS",
+    "SignInterlock",
+    "SignLaneConfig",
+    "SignRefused",
+    "SignTicket",
+    "SigningPlane",
+]

@@ -35,7 +35,9 @@ from typing import Callable, Optional
 #: attestation MAX_BATCH and the widest scheduler lane max_batch).
 FIREHOSE_BUCKETS = (4, 8, 16, 32, 64, 128)
 MULTI_VERIFY_BUCKETS = (64, 256, 1024, 4096)
-SIGN_BUCKETS = (64, 512)
+# sign-plane lanes deadline-flush at any n ≤ max_batch (512): warm the
+# full pow-2 ladder so first-duty signing never compiles at slot time
+SIGN_BUCKETS = (4, 8, 16, 32, 64, 128, 256, 512)
 SUBGROUP_BUCKETS = (4, 8, 16, 32, 64, 128)
 
 #: warm kinds the runner understands, in manifest order. The sharded_*
@@ -49,7 +51,7 @@ WARM_KINDS = ("aggregate", "aggregate_idx", "multi_verify", "sign",
               "sharded_multi_verify_msm", "span_update",
               "registry_capacity", "ed25519_verify", "kzg_blob",
               "aggregate_comp", "aggregate_idx_comp", "multi_verify_comp",
-              "g1_decompress")
+              "g1_decompress", "g2_aggregate", "g1_aggregate")
 
 
 def _repo_root() -> str:
@@ -114,6 +116,9 @@ def manifest() -> "list[tuple[str, int]]":
     out += [("aggregate_idx_comp", b) for b in FIREHOSE_BUCKETS]
     out += [("multi_verify_comp", b) for b in MULTI_VERIFY_BUCKETS]
     out += [("g1_decompress", b) for b in (16, 64, 256, 1024)]
+    # aggregate-construction sums (signing plane duty aggregation)
+    out += [("g2_aggregate", b) for b in (64, 256)]
+    out += [("g1_aggregate", b) for b in (64, 256)]
     return out
 
 
@@ -337,6 +342,24 @@ def warm_all(
                     [sig_c] * b,
                     [pk] * b,
                 )
+            elif kind in ("g2_aggregate", "g1_aggregate"):
+                # aggregate CONSTRUCTION (duty aggregation, signing
+                # plane): the kernel signature is (flat bucket n, group
+                # count g) — like rlc_partition, warm every (n, g) split
+                # the contiguous-sum dispatch can form at this bucket so
+                # slot-time committee mixes never compile
+                g = 4
+                while b // g >= 4:  # spans below the bucket floor (4)
+                    span = b // g   # re-bucket to a different n
+                    if kind == "g2_aggregate":
+                        B.g2_aggregate_groups(
+                            [[sig] * span] * g, metrics
+                        )
+                    else:
+                        B.g1_aggregate_groups(
+                            [[pk] * span] * g, metrics
+                        )
+                    g <<= 1
             elif kind == "g1_decompress":
                 # the registry's device decompress runs at append buckets
                 # and capacity shapes (tpu/registry.py _decompress_dev) —

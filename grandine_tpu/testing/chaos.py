@@ -19,6 +19,10 @@ supervisor defends against:
       probes and host bisection can catch
   slow_settle    — the settle sleeps before answering (degraded link);
       must NOT trip the breaker when within the watchdog deadline
+  wrong_signature — `batch_sign` (the SIGN-side seam) returns a batch
+      where one signature is valid-looking but wrong (signed over a
+      different message) — the kind only the signing plane's release
+      gate can catch before a caller publishes it
 
 `KnownAnswerBackend` is the truth-table stub used underneath the chaos
 wrapper by tests and `bench.py --chaos`: verdicts come from a dict
@@ -36,13 +40,15 @@ import numpy as np
 
 from grandine_tpu.runtime.health import REQUIRED_SEAM_METHODS
 
-#: injectable fault kinds, in plan-draw order
+#: injectable fault kinds, in plan-draw order ("wrong_signature" is
+#: appended so existing seeded rate plans keep their draw sequence)
 FAULT_KINDS = (
     "raise_dispatch",
     "raise_settle",
     "hang",
     "wrong_verdict",
     "slow_settle",
+    "wrong_signature",
 )
 
 
@@ -175,6 +181,35 @@ class ChaosBackend:
             lambda arr: ~np.asarray(arr),
             (messages, signatures, member_keys, groups),
         )
+
+    # ---------------------------------------------------- sign-side seam
+
+    def batch_sign(self, messages, secret_keys):
+        """The signing plane's device seam (blocking, unlike the verify
+        seams). `wrong_signature`/`wrong_verdict` corrupt the FIRST
+        signature of the batch with a structurally valid signature over
+        a different message — decodes cleanly, fails the release gate.
+        Dispatch/hang/slow faults behave as on the verify seams."""
+        with self._lock:
+            self.dispatches += 1
+        kind = self.plan.next_fault()
+        if kind in ("raise_dispatch", "raise_settle"):
+            raise ChaosFault("injected dispatch fault on batch_sign")
+        if kind == "hang":
+            ev = threading.Event()
+            with self._lock:
+                self._hung.append(ev)
+            ev.wait()
+            raise ChaosFault("released injected hang on batch_sign")
+        if kind == "slow_settle":
+            time.sleep(self.slow_s)
+        sigs = self.inner.batch_sign(messages, secret_keys)
+        if kind in ("wrong_signature", "wrong_verdict") and sigs:
+            sigs = list(sigs)
+            sigs[0] = secret_keys[0].sign(
+                b"chaos: wrong message " + bytes(messages[0])
+            )
+        return sigs
 
 
 class KnownAnswerBackend:

@@ -453,6 +453,61 @@ def scenario_ticket_verdict(seed: int, **kw) -> dict:
     return _invariant(res, "ticket_verdict", bad)
 
 
+def scenario_sign_ticket(seed: int, **kw) -> dict:
+    """Backs `atomic=_sig` on SignTicket (runtime/sign_plane.py): two
+    racing settlers (a signature vs a drop), a result() reader gated on
+    the Event, and a racing add_callback. The happens-before claim is
+    that any reader passing the Event gate sees the winning settler's
+    outcome — the signature bytes or the dropped RuntimeError — and
+    callbacks fire exactly once."""
+    import grandine_tpu.runtime.sign_plane as sp
+
+    fz = ScheduleFuzzer(seed, watched=[sp.__file__], **kw)
+    t = sp.SignTicket("attestation")
+    t._lock = fz.lock("sign_ticket._lock")
+    t._event = fz.event()
+    fired: "list[bool]" = []
+    seen: dict = {}
+
+    def settle_sig() -> None:
+        t._resolve(b"fuzz-signature")
+
+    def settle_drop() -> None:
+        t._resolve(None, dropped=True)
+
+    def reader() -> None:
+        try:
+            seen["result"] = t.result(timeout=5.0)
+        except RuntimeError:
+            seen["result"] = "dropped"
+
+    def register() -> None:
+        t.add_callback(lambda tk: fired.append(tk.dropped))
+
+    fz.add_worker("settle_sig", settle_sig)
+    fz.add_worker("settle_drop", settle_drop)
+    fz.add_worker("reader", reader)
+    fz.add_worker("register", register)
+    res = fz.run()
+
+    bad: "list[str]" = []
+    if not t.done():
+        bad.append("ticket never settled")
+    if (t._sig, t.dropped) not in {(b"fuzz-signature", False), (None, True)}:
+        bad.append(f"mixed outcome: sig={t._sig!r} dropped={t.dropped}")
+    if len(fired) != 1:
+        bad.append(f"callback fired {len(fired)} times (want 1)")
+    elif fired[0] != t.dropped:
+        bad.append(f"callback saw dropped={fired[0]}, settled {t.dropped}")
+    if "result" not in seen:
+        bad.append("reader never returned")
+    elif t.dropped and seen["result"] != "dropped":
+        bad.append(f"reader saw {seen['result']!r} on a dropped ticket")
+    elif not t.dropped and seen["result"] != t._sig:
+        bad.append(f"reader saw {seen['result']!r}, settled {t._sig!r}")
+    return _invariant(res, "sign_ticket", bad)
+
+
 def scenario_flight_ring(seed: int, **kw) -> dict:
     """FlightRecorder under concurrent commit/snapshot/duty traffic: the
     ring, aggregate counters, origin table, and occupancy integrals must
@@ -671,6 +726,7 @@ def scenario_cached_pubkey(seed: int, **kw) -> dict:
 
 SCENARIOS: "dict[str, Callable[..., dict]]" = {
     "ticket_verdict": scenario_ticket_verdict,
+    "sign_ticket": scenario_sign_ticket,
     "flight_ring": scenario_flight_ring,
     "breaker_walk": scenario_breaker_walk,
     "registry_lifecycle": scenario_registry_lifecycle,
@@ -685,6 +741,7 @@ SCENARIOS: "dict[str, Callable[..., dict]]" = {
 #: fails the suite.
 COVERAGE: "dict[str, str]" = {
     "verify_scheduler.VerifyTicket._ok": "ticket_verdict",
+    "sign_plane.SignTicket._sig": "sign_ticket",
 }
 
 

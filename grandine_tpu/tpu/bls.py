@@ -977,6 +977,155 @@ def batch_pubkey_kernel(sk_bits, sk_neg):
     return L.merge(X), L.merge(Y), L.merge(Z)
 
 
+def g2_aggregate_kernel(sig_x, sig_y, sig_inf, group_tag):
+    """Contiguous-group G2 sums for aggregate CONSTRUCTION: the batch's
+    N affine signature points split into G = group_tag.shape[0]
+    contiguous groups of N/G, each reduced to one Jacobian aggregate in
+    a single masked-roll tree pass (curve.sum_points_contiguous). This
+    is the sign-side twin of the verify plane's partition reducer: one
+    device dispatch builds every attestation / sync-contribution
+    aggregate of a slot instead of a host G2 point loop per committee.
+
+    Padding slots are infinity (the identity is neutral in complete
+    addition); an all-padding group returns infinity, matching the host
+    anchor `Signature.aggregate([])`. group_tag is a (G,)-shaped carrier
+    whose only job is making G part of the jit shape signature (and the
+    dispatch shape ledger). N and G must be powers of two with G | N.
+    Returns Jacobian (G, 2, L) coords in rest format."""
+    sig = _g2_in(sig_x, sig_y)
+    inf = jnp.asarray(sig_inf)
+    n = inf.shape[0]
+    g = group_tag.shape[0]
+    one = C.FP2_OPS.one_like(sig[0])
+    zero = C.FP2_OPS.zeros_like(sig[0])
+    p = (
+        C.FP2_OPS.select(inf, one, sig[0]),
+        C.FP2_OPS.select(inf, one, sig[1]),
+        C.FP2_OPS.select(inf, zero, one),
+    )
+    X, Y, Z = C.sum_points_contiguous(p, n // g, C.FP2_OPS)
+    return F.fp2_merge(X), F.fp2_merge(Y), F.fp2_merge(Z)
+
+
+def g1_aggregate_kernel(pk_x, pk_y, pk_inf, group_tag):
+    """G1 twin of g2_aggregate_kernel: contiguous-group sums of affine
+    public-key points → per-group Jacobian aggregate keys (the
+    fast-aggregate-verify prep and proposer-boost style key aggregation
+    run as one pass next to the registry). Same padding and group_tag
+    conventions; returns Jacobian (G, L) coords in rest format."""
+    pk = _g1_in(pk_x, pk_y)
+    inf = jnp.asarray(pk_inf)
+    n = inf.shape[0]
+    g = group_tag.shape[0]
+    one = C.FP_OPS.one_like(pk[0])
+    zero = C.FP_OPS.zeros_like(pk[0])
+    p = (
+        C.FP_OPS.select(inf, one, pk[0]),
+        C.FP_OPS.select(inf, one, pk[1]),
+        C.FP_OPS.select(inf, zero, one),
+    )
+    X, Y, Z = C.sum_points_contiguous(p, n // g, C.FP_OPS)
+    return L.merge(X), L.merge(Y), L.merge(Z)
+
+
+def g2_aggregate_groups(groups, metrics=None):
+    """Batched aggregate construction: a list of signature groups → one
+    aggregate `A.Signature` per group, reduced on device in ONE
+    contiguous-group sum pass (g2_aggregate_kernel).
+
+    The one sanctioned dispatch seam for the kernel: duty aggregation
+    (validator/duties.py), the signing plane, and warmup all come
+    through here so the jit cache sees a single registration site. The
+    group width pads to its pow-2 bucket with infinity slots (neutral)
+    and the group count pads to its own pow-2 bucket with all-padding
+    groups, so the (batch, groups) jit universe stays enumerable. Host
+    `Signature.aggregate` is the differential twin (byte-identical
+    aggregates, asserted in tests/test_sign_plane.py)."""
+    if not groups:
+        return []
+    m = len(groups)
+    s = _bucket(max(max((len(grp) for grp in groups), default=1), 1))
+    per_chunk = max(1, MAX_BUCKET // s)
+    if m > per_chunk:
+        out: list = []
+        for i in range(0, m, per_chunk):
+            out.extend(g2_aggregate_groups(groups[i : i + per_chunk],
+                                           metrics))
+        return out
+    gb = _bucket(m)
+    n = gb * s
+    x, y, inf = C.g2_points_to_dev(
+        [sig.point for grp in groups for sig in grp]
+    )
+    sx = np.zeros((n, 2, L.NLIMBS), np.int32)
+    sy = np.zeros((n, 2, L.NLIMBS), np.int32)
+    sinf = np.ones((n,), bool)
+    pos = 0
+    for gi, grp in enumerate(groups):
+        k = len(grp)
+        base = gi * s
+        sx[base : base + k] = x[pos : pos + k]
+        sy[base : base + k] = y[pos : pos + k]
+        sinf[base : base + k] = inf[pos : pos + k]
+        pos += k
+    fn = _jitted_global("g2_aggregate", g2_aggregate_kernel)
+    args = (
+        jnp.asarray(sx), jnp.asarray(sy), jnp.asarray(sinf),
+        jnp.zeros((gb,), jnp.int32),
+    )
+    note_dispatch_shapes("g2_aggregate", args, metrics)
+    X, Y, Z = fn(*args)
+    X, Y, Z = np.asarray(X), np.asarray(Y), np.asarray(Z)
+    return [
+        A.Signature(C.dev_to_g2_point(X[i], Y[i], Z[i])) for i in range(m)
+    ]
+
+
+def g1_aggregate_groups(groups, metrics=None):
+    """G1 twin seam: a list of public-key groups → one aggregate
+    `A.PublicKey` per group via g1_aggregate_kernel. Host
+    `PublicKey.aggregate` is the differential twin. Same bucketing and
+    chunking conventions as g2_aggregate_groups."""
+    if not groups:
+        return []
+    m = len(groups)
+    s = _bucket(max(max((len(grp) for grp in groups), default=1), 1))
+    per_chunk = max(1, MAX_BUCKET // s)
+    if m > per_chunk:
+        out: list = []
+        for i in range(0, m, per_chunk):
+            out.extend(g1_aggregate_groups(groups[i : i + per_chunk],
+                                           metrics))
+        return out
+    gb = _bucket(m)
+    n = gb * s
+    x, y, inf = C.g1_points_to_dev(
+        [pk.point for grp in groups for pk in grp]
+    )
+    px = np.zeros((n, L.NLIMBS), np.int32)
+    py = np.zeros((n, L.NLIMBS), np.int32)
+    pinf = np.ones((n,), bool)
+    pos = 0
+    for gi, grp in enumerate(groups):
+        k = len(grp)
+        base = gi * s
+        px[base : base + k] = x[pos : pos + k]
+        py[base : base + k] = y[pos : pos + k]
+        pinf[base : base + k] = inf[pos : pos + k]
+        pos += k
+    fn = _jitted_global("g1_aggregate", g1_aggregate_kernel)
+    args = (
+        jnp.asarray(px), jnp.asarray(py), jnp.asarray(pinf),
+        jnp.zeros((gb,), jnp.int32),
+    )
+    note_dispatch_shapes("g1_aggregate", args, metrics)
+    X, Y, Z = fn(*args)
+    X, Y, Z = np.asarray(X), np.asarray(Y), np.asarray(Z)
+    return [
+        A.PublicKey(C.dev_to_g1_point(X[i], Y[i], Z[i])) for i in range(m)
+    ]
+
+
 # --- multi-chip (SPMD over a device mesh) -----------------------------------
 
 
@@ -2902,6 +3051,10 @@ __all__ = [
     "aggregate_fast_verify_msm_idx_comp_kernel",
     "g1_decompress_kernel",
     "g1_decompress_rows",
+    "g2_aggregate_kernel",
+    "g1_aggregate_kernel",
+    "g2_aggregate_groups",
+    "g1_aggregate_groups",
     "grouped_multi_verify_kernel",
     "grouped_multi_verify_msm_kernel",
     "grouped_multi_verify_msm_packed_kernel",

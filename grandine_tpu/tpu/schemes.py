@@ -36,13 +36,45 @@ from grandine_tpu.consensus.verifier import SignatureInvalid
 from grandine_tpu.crypto import bls as A
 
 
+class SigningDescriptor:
+    """Sign-side row of a scheme: how the signing plane batches,
+    anchors, and release-gates signing for this scheme.
+
+    The four callables mirror the verify side's backend/host-twin split:
+    `batch_sign` is the device dispatch, `host_sign` the scalar anchor
+    (degradation target — byte-identical by contract), `release_verify`
+    the gate that batch-verifies every device-produced signature against
+    the caller's public keys BEFORE release (a faulty device must never
+    emit a bad signature), and `warm_kinds` the runtime/warmup.py rows
+    that pre-compile the sign kernels."""
+
+    __slots__ = ("batch_sign", "host_sign", "release_verify", "warm_kinds")
+
+    def __init__(
+        self,
+        *,
+        batch_sign: Callable,
+        host_sign: Callable,
+        release_verify: Callable,
+        warm_kinds: "Sequence[str]" = (),
+    ) -> None:
+        #: batch_sign(backend, messages, secret_keys) → list[bytes]
+        self.batch_sign = batch_sign
+        #: host_sign(message, secret_key) → bytes (the scalar anchor)
+        self.host_sign = host_sign
+        #: release_verify(backend, messages, sig_bytes, public_keys)
+        #: → bool: ALL device signatures verify against their keys
+        self.release_verify = release_verify
+        self.warm_kinds = tuple(warm_kinds)
+
+
 class Scheme:
     """One registered verification scheme (see module docstring)."""
 
     __slots__ = (
         "name", "field_bits", "curve", "make_backend", "host_check",
         "device_dispatch", "async_seam", "warm_kinds", "kernel_label",
-        "canary",
+        "canary", "signing",
     )
 
     def __init__(
@@ -58,6 +90,7 @@ class Scheme:
         warm_kinds: "Sequence[str]" = (),
         kernel_label: "Optional[Callable]" = None,
         canary: bool = False,
+        signing: "Optional[SigningDescriptor]" = None,
     ) -> None:
         self.name = name
         #: base-field modulus bit length (381 for BLS12-381, 255 for
@@ -87,6 +120,9 @@ class Scheme:
         #: only the scheme whose backend answers breaker canary probes
         #: (BLS — the health supervisor's specimens are BLS triples)
         self.canary = bool(canary)
+        #: sign-side descriptor (runtime/sign_plane.py), or None when
+        #: the scheme has no device signing path (the plane refuses it)
+        self.signing = signing
 
 
 _REGISTRY: "dict[str, Scheme]" = {}
@@ -307,6 +343,40 @@ def _dispatch_bls_host_decompress(sched, lane, backend, items):
     return settle
 
 
+def _bls_batch_sign(backend, messages, secret_keys):
+    """Device batch signing: N G2 GLV dual-ladders in one dispatch
+    (tpu/bls.py batch_sign_kernel). Returns wire-encoded signatures in
+    request order — byte-identical to the host anchor by contract."""
+    return [
+        s.to_bytes()
+        for s in backend.batch_sign(list(messages), list(secret_keys))
+    ]
+
+
+def _bls_host_sign(message, secret_key) -> bytes:
+    """The scalar anchor: `sk.sign` (crypto/bls.py). Degradation target
+    for breaker-open and release-gate-failed batches."""
+    return secret_key.sign(message).to_bytes()
+
+
+def _bls_release_verify(backend, messages, sig_bytes, public_keys) -> bool:
+    """Release gate: batch-verify the device-produced signatures against
+    the registered public keys in one RLC multi_verify pass BEFORE any
+    caller sees them. Undecodable bytes (a device fault corrupted the
+    point) fail the gate outright — the plane then re-signs the batch on
+    the host anchor and files a verdict fault with the breaker."""
+    try:
+        sigs = [
+            A.Signature(A.g2_from_bytes(sb, subgroup_check=False))
+            for sb in sig_bytes
+        ]
+    except A.BlsError:
+        return False
+    return bool(
+        backend.multi_verify(list(messages), sigs, list(public_keys))
+    )
+
+
 register(Scheme(
     "bls",
     field_bits=381,
@@ -329,6 +399,12 @@ register(Scheme(
                 "multi_verify_comp", "g1_decompress"),
     kernel_label=_bls_kernel_label,
     canary=True,
+    signing=SigningDescriptor(
+        batch_sign=_bls_batch_sign,
+        host_sign=_bls_host_sign,
+        release_verify=_bls_release_verify,
+        warm_kinds=("sign", "g2_aggregate", "g1_aggregate"),
+    ),
 ))
 
 
@@ -427,4 +503,4 @@ register(Scheme(
 ))
 
 
-__all__ = ["Scheme", "register", "get", "names"]
+__all__ = ["Scheme", "SigningDescriptor", "register", "get", "names"]

@@ -27,11 +27,40 @@ from grandine_tpu.types.primitives import Phase
 
 KeyProvider = Callable[[int], "A.SecretKey"]
 
+#: aggregate-construction seam: groups of signatures in → one aggregate
+#: per group out. None means the host anchor (`A.Signature.aggregate`
+#: per group — the differential twin); `device_aggregator()` routes all
+#: groups through ONE `g2_aggregate_groups` kernel dispatch.
+Aggregator = Callable[
+    ["Sequence[Sequence[A.Signature]]"], "list[A.Signature]"
+]
+
 
 def _interop_keys(index: int) -> "A.SecretKey":
     from grandine_tpu.transition.genesis import interop_secret_key
 
     return interop_secret_key(index)
+
+
+def host_aggregator(groups) -> "list[A.Signature]":
+    """The host twin of `device_aggregator` (same shape, loop per
+    group)."""
+    return [A.Signature.aggregate(list(g)) for g in groups]
+
+
+def device_aggregator(metrics=None) -> Aggregator:
+    """An Aggregator backed by the on-device contiguous-group G2 sum
+    (`tpu.bls.g2_aggregate_groups`): every committee of the slot lands
+    in one kernel dispatch instead of one host point-loop each."""
+    from grandine_tpu.tpu import bls as B
+
+    def _aggregate(groups):
+        groups = [list(g) for g in groups]
+        if not groups:
+            return []
+        return B.g2_aggregate_groups(groups, metrics=metrics)
+
+    return _aggregate
 
 
 # ------------------------------------------------------------- attestations
@@ -43,11 +72,14 @@ def produce_attestations(
     keys: KeyProvider = _interop_keys,
     slot: "Optional[int]" = None,
     participation: float = 1.0,
+    aggregate: "Optional[Aggregator]" = None,
 ):
     """One aggregate attestation per committee of `slot` (default: the
     state's current slot), signed by the first `participation` fraction of
     each committee. `state` must be at or past `slot` (committees and the
-    head vote are read from it)."""
+    head vote are read from it). `aggregate` routes aggregate
+    CONSTRUCTION (all committees as one batch) — None is the host
+    anchor."""
     p = cfg.preset
     if slot is None:
         slot = int(state.slot)
@@ -78,7 +110,7 @@ def produce_attestations(
     )
 
     count = accessors.get_committee_count_per_slot(state, epoch, p)
-    out = []
+    pending = []  # (data, bits, committee signature group)
     for index in range(count):
         committee = accessors.get_beacon_committee(state, slot, index, p)
         data = ns.AttestationData(
@@ -93,22 +125,31 @@ def produce_attestations(
         bits = np.zeros(len(committee), dtype=bool)
         bits[:n_sign] = True
         sigs = [keys(int(v)).sign(root) for v in committee[:n_sign]]
-        out.append(
-            ns.Attestation(
-                aggregation_bits=bits,
-                data=data,
-                signature=A.Signature.aggregate(sigs).to_bytes(),
-            )
+        pending.append((data, bits, sigs))
+    # aggregate construction: all committees of the slot in one pass
+    if aggregate is not None:
+        aggs = aggregate([sigs for _, _, sigs in pending])
+    else:
+        aggs = host_aggregator([sigs for _, _, sigs in pending])
+    return [
+        ns.Attestation(
+            aggregation_bits=bits,
+            data=data,
+            signature=agg.to_bytes(),
         )
-    return out
+        for (data, bits, _), agg in zip(pending, aggs)
+    ]
 
 
 # ----------------------------------------------------------- sync aggregate
 
 
-def produce_sync_aggregate(state, cfg, keys: KeyProvider = _interop_keys):
+def produce_sync_aggregate(state, cfg, keys: KeyProvider = _interop_keys,
+                           aggregate: "Optional[Aggregator]" = None):
     """Full-participation sync aggregate for a block built on `state`
-    (signs the previous block root under DOMAIN_SYNC_COMMITTEE)."""
+    (signs the previous block root under DOMAIN_SYNC_COMMITTEE).
+    `aggregate` routes the committee-wide G2 sum (one single-group
+    device dispatch) — None is the host anchor."""
     p = cfg.preset
     phase = state_phase(state, cfg)
     ns = getattr(spec_types(p), phase.key)
@@ -122,9 +163,13 @@ def produce_sync_aggregate(state, cfg, keys: KeyProvider = _interop_keys):
     for pk in state.current_sync_committee.pubkeys:
         index = lookup[bytes(pk)]
         sigs.append(keys(index).sign(root))
+    if aggregate is not None:
+        agg = aggregate([sigs])[0]
+    else:
+        agg = A.Signature.aggregate(sigs)
     return ns.SyncAggregate(
         sync_committee_bits=bits,
-        sync_committee_signature=A.Signature.aggregate(sigs).to_bytes(),
+        sync_committee_signature=agg.to_bytes(),
     )
 
 
@@ -292,6 +337,8 @@ __all__ = [
     "produce_attestations",
     "produce_sync_aggregate",
     "empty_sync_aggregate",
+    "host_aggregator",
+    "device_aggregator",
     "build_matching_payload",
     "produce_block_unsigned",
     "produce_block",

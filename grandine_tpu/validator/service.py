@@ -17,6 +17,7 @@ from grandine_tpu.fork_choice.store import Tick, TickKind
 from grandine_tpu.transition.fork_upgrade import state_phase
 from grandine_tpu.transition.slots import process_slots
 from grandine_tpu.types.containers import spec_types
+from grandine_tpu.runtime.sign_plane import SignRefused
 from grandine_tpu.validator.slashing_protection import (
     SlashingProtection,
     SlashingProtectionError,
@@ -45,6 +46,8 @@ class ValidatorService:
         network=None,
         subnet_service=None,
         builder_api=None,
+        sign_plane=None,
+        plane_timeout_s: float = 30.0,
     ) -> None:
         self.controller = controller
         self.signer = signer
@@ -58,8 +61,53 @@ class ValidatorService:
         self.network = network
         self.subnet_service = subnet_service
         self.builder_api = builder_api
+        #: optional runtime.sign_plane.SigningPlane: local-key duty
+        #: signings coalesce into device batches (remote keys keep the
+        #: Web3Signer path through the signer)
+        self.sign_plane = sign_plane
+        self.plane_timeout_s = float(plane_timeout_s)
         self.stats = {"proposed": 0, "attested": 0, "aggregated": 0,
                       "slashing_refusals": 0}
+
+    # -- plane routing ------------------------------------------------------
+
+    def _sign_duty(self, pubkey: bytes, signing_root: bytes,
+                   duty_kind: str, index: "Optional[int]" = None) -> bytes:
+        """One duty signature: through the signing plane when one is
+        wired and the key is local, else the signer's own path. A
+        dropped plane ticket (shutdown/shed) degrades to the signer —
+        the duty is never missed."""
+        if self.sign_plane is not None:
+            sk = self.signer.secret_key(pubkey)
+            if sk is not None:
+                ticket = self.sign_plane.submit(
+                    signing_root, sk, duty_kind=duty_kind, index=index
+                )
+                try:
+                    return ticket.result(self.plane_timeout_s)
+                except RuntimeError:
+                    pass  # dropped → host path below
+        return self.signer.sign(pubkey, signing_root)
+
+    def _sign_duty_batch(self, to_sign, duty_kind: str) -> "list[bytes]":
+        """Batch duty signatures for (pubkey, signing_root) pairs —
+        plane-coalesced when every key is local, else the signer's
+        sign_triples (which still device-batches local keys)."""
+        if self.sign_plane is not None and to_sign:
+            sks = [self.signer.secret_key(pk) for pk, _ in to_sign]
+            if all(sk is not None for sk in sks):
+                tickets = [
+                    self.sign_plane.submit(root, sk, duty_kind=duty_kind)
+                    for (_, root), sk in zip(to_sign, sks)
+                ]
+                out = []
+                for (pk, root), ticket in zip(to_sign, tickets):
+                    try:
+                        out.append(ticket.result(self.plane_timeout_s))
+                    except RuntimeError:
+                        out.append(self.signer.sign(pk, root))
+                return out
+        return self.signer.sign_triples(to_sign)
 
     # -- index resolution ---------------------------------------------------
 
@@ -139,6 +187,11 @@ class ValidatorService:
 
         try:
             signed_block = self._build_block(pre, slot, proposer_index, pubkey)
+        except SignRefused:
+            # the plane's interlock watermark (persisted) outlived this
+            # process's slashing-protection view — refuse the proposal
+            self.stats["slashing_refusals"] += 1
+            return None
         except DepositCacheError:
             # the deposit cache is behind the state's required deposits: an
             # invalid block would be worse than no block (any OTHER failure
@@ -165,8 +218,9 @@ class ValidatorService:
         ns = getattr(spec_types(self.p), phase.key)
         epoch = accessors.get_current_epoch(pre, self.p)
 
-        reveal = self.signer.sign(
-            pubkey, signing.randao_signing_root(pre, epoch, self.cfg)
+        reveal = self._sign_duty(
+            pubkey, signing.randao_signing_root(pre, epoch, self.cfg),
+            "randao",
         )
 
         attestations = (
@@ -244,8 +298,9 @@ class ValidatorService:
             NullVerifier(), state_root_policy="trust",
         )
         block = block.replace(state_root=post.hash_tree_root())
-        sig = self.signer.sign(
-            pubkey, signing.block_signing_root(pre, block, self.cfg)
+        sig = self._sign_duty(
+            pubkey, signing.block_signing_root(pre, block, self.cfg),
+            "block", index=slot,
         )
         return ns.SignedBeaconBlock(message=block, signature=sig)
 
@@ -268,8 +323,9 @@ class ValidatorService:
         )
         header = blinded_mod.header_from_bid(ns, bid["header"])
         epoch = accessors.get_current_epoch(pre, self.p)
-        reveal = self.signer.sign(
-            pubkey, signing.randao_signing_root(pre, epoch, self.cfg)
+        reveal = self._sign_duty(
+            pubkey, signing.randao_signing_root(pre, epoch, self.cfg),
+            "randao",
         )
         attestations = (
             self.attestation_pool.pack_attestations(pre, self.cfg, slot=slot)
@@ -302,8 +358,9 @@ class ValidatorService:
         # ---- point of no return: from the signature on, a failure must
         # NOT fall back to local building (equivocation risk)
         try:
-            sig = self.signer.sign(
-                pubkey, signing.block_signing_root(pre2, block, self.cfg)
+            sig = self._sign_duty(
+                pubkey, signing.block_signing_root(pre2, block, self.cfg),
+                "block", index=slot,
             )
             signed_blinded = ns.SignedBlindedBeaconBlock(
                 message=block, signature=sig
@@ -390,7 +447,7 @@ class ValidatorService:
                 to_sign.append((pubkey, root))
                 pending.append((data, committee, pos))
 
-        signatures = self.signer.sign_triples(to_sign)
+        signatures = self._sign_duty_batch(to_sign, "attestation")
         out = []
         for (data, committee, pos), sig in zip(pending, signatures):
             bits = np.zeros(len(committee), dtype=bool)
@@ -456,7 +513,7 @@ class ValidatorService:
                 sync_committee_indices=positions,
                 until_epoch=until,
             )
-        signatures = self.signer.sign_triples(to_sign)
+        signatures = self._sign_duty_batch(to_sign, "sync_message")
         for pos, sig in zip(positions, signatures):
             self.sync_pool.insert_message(slot, head_root, pos, sig)
         self.stats["sync_messages"] = (
@@ -494,9 +551,10 @@ class ValidatorService:
                 continue
             for vi in members:
                 pubkey = owned[vi]
-                proof = self.signer.sign(
+                proof = self._sign_duty(
                     pubkey,
                     signing.selection_proof_signing_root(state, slot, self.cfg),
+                    "selection_proof",
                 )
                 modulo = max(
                     1,
@@ -508,11 +566,12 @@ class ValidatorService:
                     aggregator_index=vi, aggregate=best,
                     selection_proof=proof,
                 )
-                sig = self.signer.sign(
+                sig = self._sign_duty(
                     pubkey,
                     signing.aggregate_and_proof_signing_root(
                         state, aap, self.cfg
                     ),
+                    "aggregate",
                 )
                 signed = ns.SignedAggregateAndProof(message=aap, signature=sig)
                 out.append(signed)

@@ -19,6 +19,11 @@ from grandine_tpu.crypto import bls as A
 class Signer:
     """pubkey-bytes -> local SecretKey or remote Web3Signer registry."""
 
+    #: remote fan-out concurrency — one shared pool per Signer, NOT one
+    #: per sign_triples call (a per-call pool leaked its threads when a
+    #: remote future raised before shutdown)
+    _REMOTE_WORKERS = 8
+
     def __init__(self, use_device: bool = False, backend=None,
                  web3signer: "Optional[Callable]" = None) -> None:
         self._keys: "dict[bytes, A.SecretKey]" = {}
@@ -26,6 +31,27 @@ class Signer:
         self._use_device = use_device
         self._backend = backend
         self._web3signer = web3signer
+        self._remote_pool = None  # lazy; see _remote_executor
+
+    def _remote_executor(self):
+        """The shared bounded pool for Web3Signer fan-out. Created on
+        first remote signing, reused for the Signer's lifetime, shut
+        down by close() — an exception in a remote future can no longer
+        strand a per-call pool's threads."""
+        if self._remote_pool is None:
+            import concurrent.futures
+
+            self._remote_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=self._REMOTE_WORKERS,
+                thread_name_prefix="web3signer",
+            )
+        return self._remote_pool
+
+    def close(self) -> None:
+        """Shut down the shared remote-signing pool (idempotent)."""
+        pool, self._remote_pool = self._remote_pool, None
+        if pool is not None:
+            pool.shutdown(wait=True, cancel_futures=True)
 
     # -- registry ----------------------------------------------------------
 
@@ -52,6 +78,12 @@ class Signer:
             self._remote.discard(pubkey)
             removed = True
         return removed
+
+    def secret_key(self, pubkey: bytes) -> "Optional[A.SecretKey]":
+        """The local SecretKey for `pubkey`, or None when the key is
+        remote/unknown (the signing plane needs the raw key; remote keys
+        stay on the Web3Signer path)."""
+        return self._keys.get(bytes(pubkey))
 
     def has_key(self, pubkey: bytes) -> bool:
         pubkey = bytes(pubkey)
@@ -106,35 +138,36 @@ class Signer:
                 raise KeyError(f"no key for {pubkey.hex()[:16]}…")
         remote_futures = []
         if remote_idx:
-            import concurrent.futures
-
-            pool = concurrent.futures.ThreadPoolExecutor(
-                max_workers=min(8, len(remote_idx))
-            )
+            pool = self._remote_executor()
             remote_futures = [
                 (i, pool.submit(
                     self._sign_remote, bytes(items[i][0]), items[i][1]
                 ))
                 for i in remote_idx
             ]
-        if self._use_device and len(local_idx) > 1:
-            backend = self._backend
-            if backend is None:
-                from grandine_tpu.tpu.bls import TpuBlsBackend
+        try:
+            if self._use_device and len(local_idx) > 1:
+                backend = self._backend
+                if backend is None:
+                    from grandine_tpu.tpu.bls import TpuBlsBackend
 
-                backend = self._backend = TpuBlsBackend()
-            sigs = backend.batch_sign(
-                [bytes(items[i][1]) for i in local_idx], local_sks
-            )
-            for i, s in zip(local_idx, sigs):
-                out[i] = s.to_bytes()
-        else:
-            for i, sk in zip(local_idx, local_sks):
-                out[i] = sk.sign(bytes(items[i][1])).to_bytes()
-        for i, future in remote_futures:
-            out[i] = future.result()
-        if remote_idx:
-            pool.shutdown(wait=False)
+                    backend = self._backend = TpuBlsBackend()
+                sigs = backend.batch_sign(
+                    [bytes(items[i][1]) for i in local_idx], local_sks
+                )
+                for i, s in zip(local_idx, sigs):
+                    out[i] = s.to_bytes()
+            else:
+                for i, sk in zip(local_idx, local_sks):
+                    out[i] = sk.sign(bytes(items[i][1])).to_bytes()
+            for i, future in remote_futures:
+                out[i] = future.result()
+        except BaseException:
+            # a failing remote (or device) must not leave sibling
+            # futures running against a half-built result
+            for _, future in remote_futures:
+                future.cancel()
+            raise
         return out
 
 

@@ -291,14 +291,17 @@ _FLIGHT_FAULT_OF = {
 }
 
 
-@pytest.mark.parametrize("kind", sorted(FAULT_KINDS))
+@pytest.mark.parametrize(
+    "kind", sorted(set(FAULT_KINDS) - {"wrong_signature"})
+)
 def test_flight_timeline_attributes_each_fault_kind(monkeypatch, kind):
     """One scripted injection per fault kind, on a fresh plane each
     time: the batch still settles correctly AND the flight timeline
     carries a record attributing exactly that fault (or, for
     slow_settle, a fault-free record whose device time blew the lane
     budget with cause \"device\"). The script's leading None spends the
-    subgroup-check seam call so the fault lands on the verify call."""
+    subgroup-check seam call so the fault lands on the verify call.
+    `wrong_signature` is sign-side only — it has its own cell below."""
     from grandine_tpu.runtime.flight import BATCH, FlightRecorder
 
     msg = b"flight-probe" + b"\x00" * 20
@@ -330,6 +333,57 @@ def test_flight_timeline_attributes_each_fault_kind(monkeypatch, kind):
         assert rec.fault is None
         assert rec.device_s >= 0.018  # the injected slow settle
         assert rec.slo_miss and rec.slo_cause == "device"
+
+
+def test_flight_timeline_attributes_wrong_signature():
+    """`wrong_signature` fires on the chaos batch_sign seam: the
+    signing plane's release gate catches the corrupted batch, the
+    flight timeline attributes a verdict fault, and the released
+    signature is still byte-identical to the host anchor."""
+    from grandine_tpu.runtime.flight import BATCH, FlightRecorder
+    from grandine_tpu.runtime.sign_plane import SignLaneConfig, SigningPlane
+    from grandine_tpu.runtime.thread_pool import Priority
+
+    root = b"\x5a" * 32
+    anchor = _SK.sign(root).to_bytes()
+
+    class _SignSeams(KnownAnswerBackend):
+        """Truth-table sign seams: batch_sign is the host anchor (the
+        chaos wrapper corrupts it), multi_verify is a known-answer
+        release gate — no pairings, verdict plumbing is under test."""
+
+        def batch_sign(self, messages, secret_keys):
+            return [k.sign(bytes(m)) for k, m in zip(secret_keys, messages)]
+
+        def multi_verify(self, messages, signatures, public_keys):
+            return all(
+                s.to_bytes() == _SK.sign(bytes(m)).to_bytes()
+                for m, s in zip(messages, signatures)
+            )
+
+    plan = FaultPlan(script=["wrong_signature"])
+    chaos = ChaosBackend(_SignSeams(), plan)
+    fl = FlightRecorder()
+    lanes = (
+        SignLaneConfig("attestation", Priority.HIGH, 4, 0.002, 64,
+                       shed=False),
+    )
+    plane = SigningPlane(backend=chaos, lanes=lanes, flight=fl,
+                         settle_timeout_s=30.0)
+    try:
+        tk = plane.submit(root, _SK, duty_kind="attestation")
+        assert tk.result(30.0) == anchor  # gate caught it: host bytes
+    finally:
+        plane.stop()
+        chaos.release_hangs()
+
+    assert plan.injected.get("wrong_signature", 0) == 1
+    recs = fl.snapshot(kind=BATCH)
+    assert any(r.fault == "verdict" for r in recs), (
+        f"no verdict fault in timeline: {[r.fault for r in recs]}"
+    )
+    assert fl.summary()["faults"].get("verdict", 0) >= 1
+    assert plane.stats()["attestation"]["gate_failures"] == 1
 
 
 def test_flight_breaker_walk_and_canary_share_timeline(monkeypatch):

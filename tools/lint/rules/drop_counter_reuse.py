@@ -51,6 +51,7 @@ class DropCounterReuseRule(Rule):
     default_paths = (
         "grandine_tpu/metrics.py",
         SCHEDULER,
+        "grandine_tpu/runtime/sign_plane.py",
         "grandine_tpu/runtime/isolation.py",
         "grandine_tpu/runtime/flight.py",
         "grandine_tpu/p2p/network.py",

@@ -244,7 +244,11 @@ class Analysis:
             ("aggregate", tuple(ladder), derived),
             ("aggregate_idx", tuple(ladder), derived),
             ("multi_verify", (64, 256, 1024, 4096), "policy:block-replay"),
-            ("sign", (64, 512), "policy:signer"),
+            # full pow-2 ladder: signing-plane lanes deadline-flush at
+            # any n ≤ max_batch (512), so every bucket is reachable on
+            # the slot path and must be pre-compiled
+            ("sign", (4, 8, 16, 32, 64, 128, 256, 512),
+             "policy:sign-plane-lanes"),
             ("subgroup", tuple(ladder), derived),
             # fault localization dispatches every bucket with its fixed
             # group ladder (runtime/isolation.ladder); warmup expands
@@ -329,6 +333,17 @@ class Analysis:
             rows.append((
                 "g1_decompress", (16, 64, 256, 1024),
                 "policy:registry-append",
+            ))
+        # aggregate-construction sums (signing plane duty aggregation):
+        # buckets are the FLAT point batch; the warmer fans each across
+        # its (bucket, groups) ladder like rlc_partition
+        if any(e.kernel == "g2_aggregate" for e in self.entries):
+            rows.append((
+                "g2_aggregate", (64, 256), "policy:duty-aggregation",
+            ))
+        if any(e.kernel == "g1_aggregate" for e in self.entries):
+            rows.append((
+                "g1_aggregate", (64, 256), "policy:duty-aggregation",
             ))
         if any(e.kernel == "ed25519_verify" for e in self.entries):
             rows.append((
