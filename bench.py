@@ -139,12 +139,27 @@ def _lint_preflight() -> None:
     (host sync on the dispatch path, inline gossip verify, …) or whose
     newest perf-ledger rows already regressed: the number would not
     describe the architecture this repo claims. BENCH_SKIP_LINT=1 skips
-    the lint leg, BENCH_SKIP_PERF_CHECK=1 the ledger gate; the runtime
+    the lint leg, BENCH_SKIP_PERF_CHECK=1 the ledger gate,
+    BENCH_SKIP_RANGES=1 the limb-range certification leg; the runtime
     upload audit is not run here (it compiles kernels — invoke it via
     `python -m tools.lint --rules no-per-batch-upload`)."""
     import subprocess
 
     root = os.path.dirname(os.path.abspath(__file__))
+    if os.environ.get("BENCH_SKIP_RANGES") != "1":
+        # prove the limb-range theorems (and bound-certificate freshness)
+        # before trusting any kernel number; regenerate a stale cert with
+        # `python -m tools.ranges --write-cert`
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.ranges"], cwd=root
+        )
+        if proc.returncode != 0:
+            print(
+                "# bench aborted: limb-range certification failed "
+                "(BENCH_SKIP_RANGES=1 overrides)",
+                file=sys.stderr,
+            )
+            raise SystemExit(1)
     if os.environ.get("BENCH_SKIP_LINT") != "1":
         proc = subprocess.run([sys.executable, "-m", "tools.lint"], cwd=root)
         if proc.returncode != 0:
@@ -1300,6 +1315,7 @@ def bench_coldstart() -> None:
         **os.environ,
         "GRANDINE_TPU_JIT_CACHE": cache_dir,
         "BENCH_SKIP_LINT": "1",
+        "BENCH_SKIP_RANGES": "1",  # parent preflight already certified
     }
 
     def run_child(mode: str) -> dict:
@@ -2323,7 +2339,8 @@ def bench_multichip() -> None:
         int(c)
         for c in os.environ.get("BENCH_MC_DEVICES", "1,2,4,8").split(",")
     ]
-    env = {**os.environ, "BENCH_SKIP_LINT": "1"}
+    env = {**os.environ, "BENCH_SKIP_LINT": "1",
+           "BENCH_SKIP_RANGES": "1"}  # parent preflight already certified
     results: "dict[int, dict]" = {}
     for c in counts:
         t0 = time.time()

@@ -33,7 +33,11 @@ from grandine_tpu.tpu import limbs as L
 
 def _fp_mul_many(aa, bb):
     """Multiply paired Fp lists elementwise, fused into one montmul."""
-    r = L.montmul(L.stack_fp(aa), L.stack_fp(bb))
+    # Interval worst case reaches ~23p (> 20p) through the G1 double's
+    # t = E·(D - X3) chain, whose 3D - F form carries coefficient weight
+    # ~19 over independent m·p/R terms; theorem (a) holds regardless
+    # (see tools/ranges/bounds.txt).
+    r = L.montmul(L.stack_fp(aa), L.stack_fp(bb))  # lint: disable=limb-range
     return L.unstack_fp(r, len(aa))
 
 

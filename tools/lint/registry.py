@@ -9,6 +9,7 @@ def all_rules():
     from tools.lint.rules.drop_counter_reuse import DropCounterReuseRule
     from tools.lint.rules.host_sync import HostSyncRule
     from tools.lint.rules.jit_purity import JitPurityRule
+    from tools.lint.rules.limb_range import LimbRangeRule
     from tools.lint.rules.lock_order import LockOrderRule
     from tools.lint.rules.mesh_topology import MeshTopologyRule
     from tools.lint.rules.metrics_cardinality import MetricsCardinalityRule
@@ -37,4 +38,5 @@ def all_rules():
         ThreadCrashContainmentRule(),
         ThreadAffinityRule(),
         ShapeContractRule(),
+        LimbRangeRule(),
     ]

@@ -1,0 +1,39 @@
+"""Lint rule: limb-range certification (delegates to tools/ranges).
+
+The whole-program abstract interpreter proves the three limb-plane
+theorem families — int32 digit/accumulator safety, the montmul operand
+working bound, and canonicalization preconditions — at every kernel
+call site and checks the bound certificate (tools/ranges/bounds.txt)
+against the code.  The analysis lives in tools/ranges; this adapter
+runs it under the lint framework so `# lint: disable=limb-range`,
+the baseline, and `python -m tools.lint` selection behave like any
+other rule.
+
+Restricted runs (explicit fixture targets) skip the certificate
+staleness check — a fixture file has no certificate — while full
+default-path runs enforce it.
+"""
+
+from __future__ import annotations
+
+from tools.lint.core import Context, Rule
+
+from tools import ranges
+
+
+class LimbRangeRule(Rule):
+    name = ranges.RULE
+    description = (
+        "limb kernels are proven int32-overflow-free, montmul operands "
+        "respect the |v| < 20p working bound, canonicalization points "
+        "see canonicalizable values, and tools/ranges/bounds.txt "
+        "matches the code"
+    )
+    default_paths = ranges.DEFAULT_FILES
+
+    def check(self, ctx: Context, files):
+        full = sorted(files) == sorted(self.files(ctx, None))
+        findings, _ = ranges.analyze(
+            ctx=ctx, files=list(files), check_cert=full
+        )
+        return findings

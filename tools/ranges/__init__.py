@@ -1,0 +1,390 @@
+"""Whole-program limb-range certifier (rule: limb-range).
+
+The relaxed signed-digit limb arithmetic under the verify/sign plane is
+only safe inside documented bounds: digit products and CIOS column
+accumulators must fit int32, every Montgomery-multiplication operand
+must satisfy |v| < 20p (so the relaxation round's dropped top carry is
+provably zero), and canonicalization points (equality tests, zero
+tests, host export) must only see values the abstraction can prove
+canonicalizable.  This package *proves* those three theorem families at
+every call site instead of asserting them in prose:
+
+* the kernel modules (``tpu/limbs.py``, ``field.py``, ``curve.py``,
+  ``pairing.py``, ``msm.py``, ``ed25519.py``, ``spans.py``) are
+  executed for real under an abstract-value domain
+  (:mod:`tools.ranges.domain`) with jax shimmed out
+  (:mod:`tools.ranges.engine`) and the primitive layer replaced by
+  sound transfer functions (:mod:`tools.ranges.primitives`);
+* analysis roots (:mod:`tools.ranges.roots`) drive every kernel entry
+  point with worst-case envelope inputs; scans and ladders run to
+  join/widen fixpoints;
+* every theorem violation is a lint finding (stable line-number-free
+  key, ``# lint: disable=limb-range`` and the baseline work unchanged);
+* the proven per-site bounds are rendered to a deterministic
+  certificate, ``tools/ranges/bounds.txt``, whose headroom section
+  lists every montmul site at ≤50% of the 20p precondition (the lazy-
+  reduction slack a perf PR can harvest) plus the three tightest sites
+  and any relax round proven redundant; a stale checked-in certificate
+  is itself a finding, exactly like the kernel shape manifest.
+
+Both limb planes are covered: the 26-limb BLS12-381 field and the
+18-limb curve25519 field, with LIMB_BITS/NLIMBS parsed from the kernel
+sources so the analysis cannot drift from the code.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+from tools.lint.core import Context, Finding
+from tools.ranges.domain import AnalysisError
+from tools.ranges.fields import load_field_params
+from tools.ranges.primitives import (
+    Recorder, _fmt, install_operators, make_curve_transfers,
+    make_field_transfers,
+)
+
+RULE = "limb-range"
+CERT_PATH = "tools/ranges/bounds.txt"
+
+DEFAULT_FILES = tuple(
+    f"grandine_tpu/tpu/{name}.py"
+    for name in ("limbs", "field", "curve", "pairing", "msm", "ed25519",
+                 "spans")
+)
+
+_THEOREM_RE = re.compile(r"\(theorem ([abc])\)")
+
+
+class Analysis:
+    """Result of one whole-program run: joined per-site stats, input
+    assumptions, root failures, and the coverage ledger."""
+
+    def __init__(self, fields, recorder, root_errors, uncovered):
+        self.fields = fields  # (bls, ed) FieldParams
+        self.recorder = recorder
+        self.root_errors = root_errors  # [(root_name, message)]
+        self.uncovered = uncovered  # [(path, func, line)]
+        self.rows = _ordered_rows(recorder)
+
+    # -- certificate -----------------------------------------------------
+
+    def cert_text(self) -> str:
+        lines = [
+            "# limb-range bound certificate: machine-checked per-site",
+            "# bounds of the limb-plane dataflow (theorems a/b/c; see",
+            "# tools/ranges/__init__.py).  Regenerate with",
+            "#   python -m tools.ranges --write-cert",
+            "# Site keys are line-number free:",
+            "#   <path>:<function>:<primitive>#<ordinal>",
+            "# with the ordinal counting same-named sites in source",
+            "# order.  '(root) <name>' paths are the validation probes",
+            "# of tools/ranges/roots.py, exercised at the documented",
+            "# worst-case envelopes.",
+            "#",
+        ]
+        for fp in self.fields:
+            lines.append(
+                f"# plane {fp.name}: LIMB_BITS={fp.limb_bits} "
+                f"NLIMBS={fp.nlimbs} LMAX={fp.lmax} "
+                f"p_bits={fp.p.bit_length()} "
+                f"montmul_pre={int(fp.montmul_pre)}p"
+            )
+        lines.append("#")
+        lines.append("# input assumptions:")
+        for a in sorted(self.recorder.assumptions):
+            lines.append(f"#   - {a}")
+        lines.append("")
+        lines.append("[sites]")
+        for r in self.rows:
+            lines.append(_render_row(r))
+        lines.append("")
+        lines.append("[headroom<=50%]")
+        kernel_rows = [r for r in self.rows
+                       if not r["path"].startswith("(root) ")]
+        harvest = [
+            r for r in kernel_rows
+            if r["prim"] == "montmul" and r["ratio"] is not None
+            and r["ratio"] * 2 <= 1
+        ]
+        if harvest:
+            for r in harvest:
+                lines.append(
+                    f"{r['sitekey']} in<={_fmt(r['op_hull'])}p of "
+                    f"{int(r['pre'])}p ({_pct(r['ratio'])})"
+                )
+        else:
+            lines.append("(none)")
+        lines.append("")
+        lines.append("[tightest]")
+        ranked = sorted(
+            (r for r in kernel_rows if r["ratio"] is not None),
+            key=lambda r: (-r["ratio"], r["sitekey"]),
+        )[:3]
+        for r in ranked:
+            lines.append(
+                f"{r['sitekey']} in<={_fmt(r['op_hull'])}p of "
+                f"{int(r['pre'])}p ({_pct(r['ratio'])})"
+            )
+        lines.append("")
+        lines.append("[no-relax-needed]")
+        redundant = [
+            r for r in self.rows
+            if r["redundant"] and r["prim"] in (
+                "relax", "add_mod", "sub_mod", "neg_mod", "double_mod")
+        ]
+        if redundant:
+            for r in redundant:
+                lines.append(
+                    f"{r['sitekey']}  (input proven canonical — the "
+                    f"relax round is the identity)"
+                )
+        else:
+            lines.append(
+                "(none — every relax round is load-bearing at the "
+                "analyzed envelopes)"
+            )
+        return "\n".join(lines) + "\n"
+
+
+def _pct(ratio) -> str:
+    return f"{float(ratio) * 100:.1f}%"
+
+
+def _ordered_rows(recorder):
+    groups = {}
+    for (path, func, line, prim), s in recorder.sites.items():
+        groups.setdefault((path, func, prim), []).append((line, s))
+    rows = []
+    for (path, func, prim), items in sorted(groups.items()):
+        for k, (line, s) in enumerate(sorted(items, key=lambda t: t[0])):
+            ratio = None
+            if s["pre"] is not None and s["op_hull"] is not None \
+                    and s["pre"] != 0:
+                ratio = s["op_hull"] / s["pre"]
+            rows.append({
+                "path": path, "func": func, "prim": prim, "ord": k,
+                "line": line,
+                "sitekey": f"{path}:{func}:{prim}#{k}",
+                "ratio": ratio, **s,
+            })
+    return rows
+
+
+def _render_row(r) -> str:
+    bits = [f"{r['sitekey']} fp={r['fp']} calls={r['count']}"]
+    if r["op_hull"] is not None:
+        bits.append(f"in<={_fmt(r['op_hull'])}p")
+    if r["pre"] is not None:
+        bits.append(f"pre={_fmt(r['pre'])}p")
+    if r["ratio"] is not None:
+        bits.append(f"headroom={_pct(r['ratio'])}")
+    if r["max_prod"]:
+        bits.append(f"prod<={r['max_prod']}")
+    if r["max_acc"]:
+        bits.append(f"acc<={r['max_acc']}")
+    if r["out_lo"] is not None:
+        bits.append(f"out=[{_fmt(r['out_lo'])},{_fmt(r['out_hi'])}]p")
+    if r["redundant"] is not None:
+        bits.append("relax=" + ("redundant" if r["redundant"]
+                                else "needed"))
+    if r["violations"]:
+        bits.append(f"VIOLATIONS={len(r['violations'])}")
+    return " ".join(bits)
+
+
+# --- whole-program run ------------------------------------------------------
+
+#: one-slot cache: the abstract interpretation is deterministic in the
+#: kernel sources, so repeated lint invocations in one process (tests,
+#: bench preflight after the lint leg) reuse the run.
+_CACHE: "dict" = {}
+
+
+def _source_state(root):
+    sig = []
+    for rel in DEFAULT_FILES:
+        p = os.path.join(root, rel)
+        try:
+            st = os.stat(p)
+            sig.append((rel, st.st_mtime_ns, st.st_size))
+        except OSError:
+            sig.append((rel, None, None))
+    return tuple(sig)
+
+
+def _install(transfers):
+    def go(ns):
+        for k, v in transfers.items():
+            if k in ns:
+                ns[k] = v
+    return go
+
+
+def _run(root: str):
+    from tools.ranges import engine as eng_mod
+    from tools.ranges.engine import ANALYZED, Engine
+    from tools.ranges.roots import COVER_EXEMPT, ROOTS
+
+    install_operators()
+    fields = load_field_params(root)
+    bls, ed = fields
+    recorder = Recorder()
+    eng = Engine(root, fields, recorder)
+    transfers = {
+        "limbs": make_field_transfers(bls),
+        "ed25519": make_field_transfers(ed),
+        "curve": make_curve_transfers(bls),
+    }
+    eng.loader.installers = {k: _install(v) for k, v in transfers.items()}
+
+    root_errors = []
+    prev_engine = eng_mod.CURRENT
+    prev_prof = sys.getprofile()
+
+    def prof(frame, event, arg):
+        if event == "call":
+            rel = eng.analyzed_paths.get(frame.f_code.co_filename)
+            if rel is not None:
+                eng.visited.add((rel, frame.f_code.co_name))
+
+    eng_mod.CURRENT = eng
+    sys.setprofile(prof)
+    try:
+        mods = {}
+        for name in ANALYZED:
+            try:
+                mods[name] = eng.loader.load(name)
+            except Exception as exc:  # noqa: BLE001 — surface as finding
+                root_errors.append(
+                    (f"load:{name}", f"{type(exc).__name__}: {exc}"))
+        for rname, fn in ROOTS:
+            eng.current_root = rname
+            try:
+                fn(eng, mods)
+            except AnalysisError as exc:
+                root_errors.append((rname, str(exc)))
+            except Exception as exc:  # noqa: BLE001 — engine gap
+                root_errors.append(
+                    (rname, f"{type(exc).__name__}: {exc}"))
+            finally:
+                eng.current_root = None
+    finally:
+        sys.setprofile(prev_prof)
+        eng_mod.CURRENT = prev_engine
+
+    # coverage: every top-level function of an analyzed module must be
+    # visited, an installed atomic transfer, or explicitly host-exempt.
+    import ast
+
+    atomic = {
+        "limbs": set(transfers["limbs"]),
+        "ed25519": set(transfers["ed25519"]),
+        "curve": set(transfers["curve"]),
+    }
+    uncovered = []
+    if not root_errors:
+        for name in ANALYZED:
+            rel = f"grandine_tpu/tpu/{name}.py"
+            try:
+                with open(os.path.join(root, rel), encoding="utf-8") as fh:
+                    tree = ast.parse(fh.read(), filename=rel)
+            except (OSError, SyntaxError):
+                continue
+            visited = {f for (r, f) in eng.visited if r == rel}
+            skip = COVER_EXEMPT.get(name, set()) | atomic.get(name, set())
+            for node in tree.body:
+                if not isinstance(node, ast.FunctionDef):
+                    continue
+                if node.name in skip or node.name in visited:
+                    continue
+                uncovered.append((rel, node.name, node.lineno))
+    return Analysis(fields, recorder, root_errors, sorted(uncovered))
+
+
+def _raw_findings(analysis: Analysis):
+    out = []
+    for rname, msg in analysis.root_errors:
+        out.append(Finding(
+            RULE, "tools/ranges/roots.py", 1,
+            f"analysis root {rname} failed: {msg}",
+            key=f"{RULE}:roots:{rname}:failed",
+        ))
+    for rel, func, line in analysis.uncovered:
+        out.append(Finding(
+            RULE, rel, line,
+            f"function {func} is not covered by any analysis root "
+            f"(add a root in tools/ranges/roots.py or a COVER_EXEMPT "
+            f"entry)",
+            key=f"{RULE}:{rel}:uncovered:{func}",
+        ))
+    for r in analysis.rows:
+        if not r["violations"]:
+            continue
+        if r["path"].startswith("(root) "):
+            fpath, fline = "tools/ranges/roots.py", 1
+        else:
+            fpath, fline = r["path"], r["line"]
+        for v in sorted(r["violations"]):
+            m = _THEOREM_RE.search(v)
+            theorem = m.group(1) if m else "x"
+            out.append(Finding(
+                RULE, fpath, fline,
+                f"{r['func']}: {v}",
+                key=f"{RULE}:{r['sitekey']}:{theorem}",
+            ))
+    return out
+
+
+def analyze(
+    ctx: "Context | None" = None,
+    files=None,
+    check_cert: bool = True,
+    cert_path: str = CERT_PATH,
+):
+    """Run (or reuse) the whole-program analysis; return
+    ``(findings, analysis)``.  ``files`` restricts which files' findings
+    are reported (the lint adapter's fixture mode); cert staleness is
+    only checked on full runs."""
+    if ctx is None:
+        root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        ctx = Context(root)
+    state = ("v1", ctx.root, _source_state(ctx.root))
+    cached = _CACHE.get("run")
+    if cached is not None and cached[0] == state:
+        analysis, raw = cached[1], cached[2]
+    else:
+        analysis = _run(ctx.root)
+        raw = _raw_findings(analysis)
+        _CACHE["run"] = (state, analysis, raw)
+
+    if files is not None:
+        allowed = set(files)
+        findings = [
+            f for f in raw
+            if f.path in allowed or f.path == "tools/ranges/roots.py"
+        ]
+    else:
+        findings = list(raw)
+
+    if check_cert:
+        want = analysis.cert_text()
+        have = ctx.source(cert_path)
+        if have is None:
+            findings.append(Finding(
+                RULE, cert_path, 1,
+                "limb-range certificate missing — run "
+                "`python -m tools.ranges --write-cert`",
+                key=f"{RULE}:{cert_path}:missing",
+            ))
+        elif have != want:
+            findings.append(Finding(
+                RULE, cert_path, 1,
+                "limb-range certificate is stale vs. the code — run "
+                "`python -m tools.ranges --write-cert`",
+                key=f"{RULE}:{cert_path}:stale",
+            ))
+    return findings, analysis

@@ -1,0 +1,79 @@
+"""CLI: `python -m tools.ranges` proves the limb-range theorems at
+every kernel call site, exit 1 on any finding; `--write-cert`
+regenerates tools/ranges/bounds.txt.
+
+Suppressions use the lint framework's comments (`# lint:
+disable=limb-range`), so a deliberately out-of-envelope site is
+silenced at the site, visibly, not by editing the analyzer.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from tools.lint.core import Context
+from tools.ranges import CERT_PATH, analyze
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    parser = argparse.ArgumentParser(prog="python -m tools.ranges")
+    parser.add_argument(
+        "--root", default=None,
+        help="repo root (default: two levels above this package)",
+    )
+    parser.add_argument(
+        "--write-cert", action="store_true",
+        help="regenerate the bound certificate instead of checking it",
+    )
+    parser.add_argument(
+        "--out", default=None,
+        help="with --write-cert: write to this path instead of the "
+             "checked-in certificate",
+    )
+    parser.add_argument(
+        "--cert", default=CERT_PATH,
+        help="certificate path to check against (repo-relative)",
+    )
+    parser.add_argument(
+        "--list", action="store_true", dest="list_cert",
+        help="print the derived certificate text and exit",
+    )
+    args = parser.parse_args(argv)
+
+    root = args.root or os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    ctx = Context(root)
+    findings, analysis = analyze(
+        ctx=ctx,
+        check_cert=not (args.write_cert or args.list_cert),
+        cert_path=args.cert,
+    )
+    findings = [f for f in findings if not ctx.suppressed(f)]
+
+    if args.list_cert:
+        sys.stdout.write(analysis.cert_text())
+        return 0
+    if args.write_cert:
+        out = args.out or ctx.abspath(CERT_PATH)
+        with open(out, "w", encoding="utf-8") as fh:
+            fh.write(analysis.cert_text())
+        print(f"wrote {out}")
+
+    for f in sorted(findings, key=lambda f: (f.path, f.line)):
+        print(f"FAIL: {f.render()}", file=sys.stderr)
+    n_sites = len(analysis.rows)
+    n_mont = sum(1 for r in analysis.rows if r["prim"] == "montmul")
+    status = "FAIL" if findings else "OK"
+    print(
+        f"{status}: limb-range sites={n_sites} montmul_sites={n_mont} "
+        f"roots_failed={len(analysis.root_errors)} "
+        f"findings={len(findings)}"
+    )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,513 @@
+"""Abstract value domain for the limb-range interpreter (tools/ranges).
+
+A device value in the analyzed kernels is one of:
+
+  * ``LimbVal`` — a limb-decomposed field element: per-digit magnitude
+    bounds (body digits vs the unsplit signed top digit), a whole-value
+    bound expressed as an *affine form* in units of p, and digit-layout
+    flags (``canonical``: every digit in [0, MASK]; ``nonneg``: the
+    represented integer is provably ≥ 0).
+  * ``Opaque`` — any other device array (masks, indices, byte rows,
+    extracted digit planes): shape + dtype only, no range information.
+  * plain numpy arrays / Python scalars — concrete host values; module
+    level code and index plumbing run natively on them.
+
+Affine forms are the load-bearing design choice: every Montgomery
+product introduces *fresh* noise symbols (the reduced product and the
+m·p folding term), so Karatsuba-style recombinations like
+``c1 = r2 − r0 − r1`` see the correlated difference of the m-terms
+(width < 3p) instead of the naive sum of three independent intervals.
+Without that cancellation the Fp6/Fp12 combination layers diverge; with
+it the Miller-loop fixpoint closes inside the 20p montmul precondition.
+
+Joins (control-flow merges, scan-carry fixpoints) hull both operands
+into a fresh single-symbol form; fixpoint equality therefore compares
+concretized hulls, not symbol identity.  Widening quantizes hulls
+outward on a coarsening grid so loop fixpoints terminate.
+"""
+
+from __future__ import annotations
+
+from fractions import Fraction
+
+import numpy as np
+
+#: hard cap on a value hull (in units of p): beyond this the fixpoint is
+#: declared divergent (a real kernel bound is < 20).
+HULL_CAP = Fraction(1 << 20)
+
+#: widening schedule (fixpoint iteration -> hull quantization grid).
+WIDEN_GRID_1 = 8  # quantize hulls to 1/16 p
+WIDEN_GRID_2 = 20  # quantize hulls to 1 p
+WIDEN_LADDER = 32  # jump hulls outward on a power ladder
+MAX_FIX_ITERS = 64
+
+
+class AnalysisError(Exception):
+    """The interpreter hit a construct it cannot soundly model."""
+
+
+class Divergence(AnalysisError):
+    """A loop fixpoint failed to close below the hull cap."""
+
+
+#: denominator grid for fresh symbol ranges.  Exact rationals compound
+#: multiplicatively through ladder fixpoints (p² → p⁴ → …) and turn
+#: Fraction gcds into the bottleneck; snapping every fresh range OUTWARD
+#: onto this grid is sound and caps denominators for good.
+_SNAP_Q = 1 << 24
+
+
+def _snap_down(f: Fraction) -> Fraction:
+    return Fraction((f.numerator * _SNAP_Q) // f.denominator, _SNAP_Q)
+
+
+def _snap_up(f: Fraction) -> Fraction:
+    return Fraction(-((-f.numerator * _SNAP_Q) // f.denominator), _SNAP_Q)
+
+
+class SymTab:
+    """Global table of noise symbols: id -> (lo, hi) in units of p."""
+
+    def __init__(self):
+        self.ranges: list[tuple[Fraction, Fraction]] = []
+
+    def fresh(self, lo: Fraction, hi: Fraction) -> int:
+        self.ranges.append((_snap_down(Fraction(lo)),
+                            _snap_up(Fraction(hi))))
+        return len(self.ranges) - 1
+
+
+class Aff:
+    """Affine form ``const + Σ coef_i · sym_i`` in units of p."""
+
+    __slots__ = ("const", "terms")
+
+    def __init__(self, const=0, terms=None):
+        self.const = Fraction(const)
+        self.terms: dict[int, Fraction] = terms or {}
+
+    @staticmethod
+    def of_const(c) -> "Aff":
+        return Aff(Fraction(c))
+
+    @staticmethod
+    def of_sym(sym: int, coef=1) -> "Aff":
+        return Aff(0, {sym: Fraction(coef)})
+
+    def __add__(self, other: "Aff") -> "Aff":
+        t = dict(self.terms)
+        for s, c in other.terms.items():
+            t[s] = t.get(s, Fraction(0)) + c
+            if t[s] == 0:
+                del t[s]
+        return Aff(self.const + other.const, t)
+
+    def __sub__(self, other: "Aff") -> "Aff":
+        return self + other.scale(-1)
+
+    def scale(self, k) -> "Aff":
+        k = Fraction(k)
+        if k == 0:
+            return Aff(0)
+        return Aff(self.const * k, {s: c * k for s, c in self.terms.items()})
+
+    def hull(self, tab: SymTab) -> tuple[Fraction, Fraction]:
+        lo = hi = self.const
+        for s, c in self.terms.items():
+            slo, shi = tab.ranges[s]
+            if c >= 0:
+                lo += c * slo
+                hi += c * shi
+            else:
+                lo += c * shi
+                hi += c * slo
+        return lo, hi
+
+    def mag(self, tab: SymTab) -> Fraction:
+        lo, hi = self.hull(tab)
+        return max(abs(lo), abs(hi))
+
+
+class Opaque:
+    """A device array about which nothing is tracked but shape/dtype."""
+
+    __slots__ = ("shape", "dtype")
+    #: keep numpy from consuming us in `ndarray OP Opaque`: returning
+    #: NotImplemented makes Python fall through to our reflected dunder.
+    __array_ufunc__ = None
+
+    def __init__(self, shape, dtype=np.int32):
+        self.shape = tuple(int(d) for d in shape)
+        self.dtype = np.dtype(dtype)
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    def astype(self, dt):
+        return Opaque(self.shape, np.dtype(bool) if dt is bool else dt)
+
+    def reshape(self, *shape):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return Opaque(_reshape_shape(self.shape, shape), self.dtype)
+
+    def key(self):
+        return ("opaque", self.shape, str(self.dtype))
+
+    def __repr__(self):
+        return f"Opaque{self.shape}:{self.dtype}"
+
+    # -- arithmetic / comparison: shape-only propagation ---------------
+    def _bin(self, other, bool_out=False):
+        oshape = getattr(other, "shape", ())
+        shape = np.broadcast_shapes(self.shape, tuple(oshape))
+        return Opaque(shape, np.dtype(bool) if bool_out else self.dtype)
+
+    __add__ = __radd__ = __sub__ = __rsub__ = __mul__ = __rmul__ = _bin
+    __floordiv__ = __rfloordiv__ = __mod__ = __rmod__ = _bin
+    __lshift__ = __rshift__ = _bin
+
+    def __and__(self, other):
+        return self._bin(other, bool_out=self.dtype == np.dtype(bool))
+
+    __rand__ = __or__ = __ror__ = __xor__ = __rxor__ = __and__
+
+    def __neg__(self):
+        return Opaque(self.shape, self.dtype)
+
+    def __invert__(self):
+        return Opaque(self.shape, self.dtype)
+
+    def _cmp(self, other):
+        return self._bin(other, bool_out=True)
+
+    __lt__ = __le__ = __gt__ = __ge__ = __eq__ = __ne__ = _cmp
+    __hash__ = object.__hash__
+
+    def __bool__(self):
+        raise AnalysisError(
+            "data-dependent Python branch on an abstract device value"
+        )
+
+    def __getitem__(self, key):
+        return Opaque(_index_shape(self.shape, key), self.dtype)
+
+    @property
+    def T(self):
+        return Opaque(tuple(reversed(self.shape)), self.dtype)
+
+
+class LimbVal:
+    """Abstract limb-decomposed field element.
+
+    ``shape`` is the full array shape; ``limb_axis`` locates the axis of
+    length ``fp.nlimbs`` that carries the digits (leading on device,
+    trailing in REST layout).  ``dmag``/``tmag`` bound |digit| for the
+    body digits and the unsplit top digit; ``val`` is the whole-value
+    affine form in units of p.
+    """
+
+    __slots__ = (
+        "fp", "shape", "limb_axis", "dmag", "tmag",
+        "nonneg", "canonical", "val",
+    )
+
+    def __init__(self, fp, shape, limb_axis, dmag, tmag, nonneg, canonical,
+                 val):
+        self.fp = fp
+        self.shape = tuple(int(d) for d in shape)
+        self.limb_axis = int(limb_axis) % max(len(self.shape), 1)
+        self.dmag = int(dmag)
+        self.tmag = int(tmag)
+        self.nonneg = bool(nonneg)
+        self.canonical = bool(canonical)
+        self.val = val
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(np.int32)
+
+    def batch_shape(self):
+        s = list(self.shape)
+        s.pop(self.limb_axis)
+        return tuple(s)
+
+    def with_layout(self, shape, limb_axis):
+        return LimbVal(self.fp, shape, limb_axis, self.dmag, self.tmag,
+                       self.nonneg, self.canonical, self.val)
+
+    def key(self, tab: SymTab):
+        lo, hi = self.val.hull(tab)
+        return ("limb", self.fp.name, self.shape, self.limb_axis,
+                self.dmag, self.tmag, self.nonneg, self.canonical, lo, hi)
+
+    def __repr__(self):
+        return (f"LimbVal<{self.fp.name} shape={self.shape}"
+                f" ax={self.limb_axis} d={self.dmag} t={self.tmag}"
+                f" canon={self.canonical}>")
+
+    __hash__ = object.__hash__
+
+    def __bool__(self):
+        raise AnalysisError("Python branch on an abstract limb value")
+
+    # Arithmetic operators are installed by tools.ranges.primitives so
+    # that raw digit arithmetic at composite call sites is recorded
+    # against the int32 theorem.
+
+
+# --- shape helpers ----------------------------------------------------------
+
+
+def _index_shape(shape, key):
+    """Result shape of ``zeros(shape)[key]`` under numpy semantics (any
+    abstract arrays inside the key are replaced with int dummies)."""
+    return _dummy_index(np.zeros(shape, np.int8), key).shape
+
+
+def _clean_key(key):
+    if isinstance(key, Opaque):
+        return np.zeros(key.shape, np.intp)
+    if isinstance(key, tuple):
+        return tuple(_clean_key(k) for k in key)
+    return key
+
+
+def _dummy_index(arr, key):
+    return arr[_clean_key(key)]
+
+
+def _reshape_shape(shape, new):
+    return np.zeros(shape, np.int8).reshape(new).shape
+
+
+def limb_dummy(lv: LimbVal) -> np.ndarray:
+    """Digit-index dummy of ``lv``: digit i along the limb axis,
+    broadcast over the batch axes — the tracer layout ops run on."""
+    n = lv.fp.nlimbs
+    idx = np.arange(n, dtype=np.int32)
+    view = idx.reshape(
+        (1,) * lv.limb_axis + (n,) + (1,) * (lv.ndim - lv.limb_axis - 1)
+    )
+    return np.broadcast_to(view, lv.shape)
+
+
+def locate_limb_axis(out: np.ndarray, n: int, prefer: int):
+    """Find the (unique) axis of ``out`` still carrying the full 0..n-1
+    digit-index pattern; None if the op destroyed it."""
+    want = np.arange(n, dtype=np.int32)
+    axes = []
+    for ax in range(out.ndim):
+        if out.shape[ax] != n:
+            continue
+        moved = np.moveaxis(out, ax, 0)
+        ref = want.reshape((n,) + (1,) * (moved.ndim - 1))
+        if np.array_equal(moved, np.broadcast_to(ref, moved.shape)):
+            axes.append(ax)
+    if len(axes) == 1:
+        return axes[0]
+    if not axes:
+        return None
+    # several size-n axes match (can only happen for degenerate batch
+    # sizes equal to nlimbs with constant digit patterns): keep the
+    # axis closest to the original position.
+    return min(axes, key=lambda a: abs(a - prefer))
+
+
+def track_limb_axis(lv: LimbVal, fn):
+    """Apply the layout op ``fn`` to a digit-index dummy of ``lv`` and
+    find where (if anywhere) the full limb axis survives.
+
+    Returns ``(shape, limb_axis)`` with ``limb_axis=None`` when the op
+    destroyed the digit axis (sliced it, reduced it, mixed it into a
+    reshape) — the result is then a digit plane, not a field element.
+    """
+    out = np.asarray(fn(limb_dummy(lv)))
+    return out.shape, locate_limb_axis(out, lv.fp.nlimbs, lv.limb_axis)
+
+
+# --- join / widen -----------------------------------------------------------
+
+
+def hull_join(a: Aff, b: Aff, tab: SymTab) -> Aff:
+    alo, ahi = a.hull(tab)
+    blo, bhi = b.hull(tab)
+    lo, hi = min(alo, blo), max(ahi, bhi)
+    if lo == hi:
+        return Aff.of_const(lo)
+    return Aff.of_sym(tab.fresh(lo, hi))
+
+
+def join_limb(a: LimbVal, b: LimbVal, tab: SymTab) -> LimbVal:
+    if a.fp is not b.fp:
+        raise AnalysisError("join of limb values from different fields")
+    shape = np.broadcast_shapes(a.shape, b.shape)
+    # after broadcasting, axes align from the right
+    ax_a = a.limb_axis + (len(shape) - a.ndim)
+    ax_b = b.limb_axis + (len(shape) - b.ndim)
+    if ax_a != ax_b:
+        raise AnalysisError("join of limb values with mismatched limb axes")
+    return LimbVal(
+        a.fp, shape, ax_a,
+        max(a.dmag, b.dmag), max(a.tmag, b.tmag),
+        a.nonneg and b.nonneg, a.canonical and b.canonical,
+        hull_join(a.val, b.val, tab),
+    )
+
+
+def _is_concrete(x):
+    return isinstance(x, (np.ndarray, np.generic, int, float, bool))
+
+
+def join(a, b, tab: SymTab, lift=None):
+    """Join two abstract/concrete values (the transfer function of
+    ``where``/``select``/``cond`` and of scan-carry merges).
+
+    ``lift`` converts a concrete limb-shaped array into a LimbVal when
+    the other side is one (supplied by the primitives layer).
+    """
+    if a is None and b is None:
+        return None
+    if isinstance(a, LimbVal) or isinstance(b, LimbVal):
+        if _is_concrete(a) and lift is not None:
+            a = lift(a, b)
+        if _is_concrete(b) and lift is not None:
+            b = lift(b, a)
+        if isinstance(a, LimbVal) and isinstance(b, LimbVal):
+            return join_limb(a, b, tab)
+        # mixed limb/opaque: degrade to opaque
+        sa = getattr(a, "shape", ())
+        sb = getattr(b, "shape", ())
+        return Opaque(np.broadcast_shapes(tuple(sa), tuple(sb)))
+    if _is_concrete(a) and _is_concrete(b):
+        an, bn = np.asarray(a), np.asarray(b)
+        if an.shape == bn.shape and np.array_equal(an, bn):
+            return a
+        shape = np.broadcast_shapes(an.shape, bn.shape)
+        return Opaque(shape, an.dtype)
+    sa = getattr(a, "shape", ())
+    sb = getattr(b, "shape", ())
+    da = getattr(a, "dtype", None) or getattr(b, "dtype", np.int32)
+    return Opaque(np.broadcast_shapes(tuple(sa), tuple(sb)), da)
+
+
+def _quantize_frac(x: Fraction, grid: Fraction, up: bool) -> Fraction:
+    q = x / grid
+    n = -((-q.numerator) // q.denominator) if up else (
+        q.numerator // q.denominator)
+    return grid * n
+
+
+_LADDER = [Fraction(x) for x in (1, 2, 4, 8, 16, 24, 32, 64, 256, 4096)]
+
+
+def _ladder_up(x: Fraction) -> Fraction:
+    for v in _LADDER:
+        if x <= v:
+            return v
+    return HULL_CAP * 2
+
+
+def _digit_up(m: int, fp) -> int:
+    """Round a digit bound up onto the plane's natural grid.  MASK
+    (canonical) and LMAX (relax/montmul output) are the fixed points the
+    kernels are engineered around — rounding 32 871 up to the next power
+    of two (65 536) instead would manufacture digit products ≥ 2³¹ that
+    the real dataflow never exhibits."""
+    if m <= fp.mask:
+        return fp.mask
+    if m <= fp.lmax:
+        return fp.lmax
+    if m <= 2 * fp.lmax:
+        return 2 * fp.lmax
+    return 1 << max(m - 1, 0).bit_length()
+
+
+def widen_limb(v: LimbVal, iteration: int, tab: SymTab) -> LimbVal:
+    if iteration < WIDEN_GRID_1:
+        return v
+    # digit plane first: body digits round onto the mask/LMAX grid; the
+    # top digit (bounded via the value, usually a few hundred) rounds to
+    # the next power of two so the digit-implied value cap stays tight.
+    dmag = _digit_up(v.dmag, v.fp)
+    tmag = 1 << max(v.tmag - 1, 0).bit_length()
+    if max(dmag, tmag) >= 1 << 31:
+        raise Divergence("digit bound widened past int32")
+    # value plane: quantize outward, then intersect with the bound the
+    # digits imply — THE step that gives every loop a finite fixpoint.
+    lo, hi = v.val.hull(tab)
+    if iteration >= WIDEN_LADDER:
+        lo = -_ladder_up(-lo) if lo < 0 else Fraction(0)
+        hi = _ladder_up(hi) if hi > 0 else Fraction(0)
+    elif iteration >= WIDEN_GRID_2:
+        lo = _quantize_frac(lo, Fraction(1), up=False)
+        hi = _quantize_frac(hi, Fraction(1), up=True)
+    else:
+        lo = _quantize_frac(lo, Fraction(1, 16), up=False)
+        hi = _quantize_frac(hi, Fraction(1, 16), up=True)
+    cap = _quantize_frac(v.fp.val_cap(dmag, tmag), Fraction(1, 16), up=True)
+    lo, hi = max(lo, -cap), min(hi, cap)
+    if max(abs(lo), abs(hi)) > HULL_CAP:
+        raise Divergence(
+            f"value hull widened past {HULL_CAP}p — fixpoint divergent"
+        )
+    form = Aff.of_const(lo) if lo == hi else Aff.of_sym(tab.fresh(lo, hi))
+    return LimbVal(v.fp, v.shape, v.limb_axis, dmag, tmag,
+                   v.nonneg, v.canonical, form)
+
+
+# --- pytree utilities (mirrors jax.tree over tuple/list/dict; None and
+# --- abstract/concrete arrays are leaves; None maps to None) ---------------
+
+
+def tree_map(f, tree, *rest):
+    if isinstance(tree, (tuple, list)):
+        mapped = [tree_map(f, t, *(r[i] for r in rest))
+                  for i, t in enumerate(tree)]
+        return type(tree)(mapped)
+    if isinstance(tree, dict):
+        return {k: tree_map(f, v, *(r[k] for r in rest))
+                for k, v in tree.items()}
+    if tree is None:
+        return None
+    return f(tree, *rest)
+
+
+def tree_leaves(tree):
+    out = []
+
+    def walk(t):
+        if isinstance(t, (tuple, list)):
+            for x in t:
+                walk(x)
+        elif isinstance(t, dict):
+            for k in t:
+                walk(t[k])
+        elif t is None:
+            pass
+        else:
+            out.append(t)
+
+    walk(tree)
+    return out
+
+
+def tree_key(tree, tab: SymTab):
+    if isinstance(tree, (tuple, list)):
+        return tuple(tree_key(t, tab) for t in tree)
+    if isinstance(tree, dict):
+        return tuple(sorted((k, tree_key(v, tab)) for k, v in tree.items()))
+    if tree is None:
+        return None
+    if isinstance(tree, LimbVal):
+        return tree.key(tab)
+    if isinstance(tree, Opaque):
+        return tree.key()
+    arr = np.asarray(tree)
+    return ("concrete", arr.shape, str(arr.dtype), arr.tobytes())

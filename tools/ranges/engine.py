@@ -1,0 +1,633 @@
+"""Execution engine of the limb-range abstract interpreter.
+
+The analyzed kernel modules are *executed for real*: each module's
+source is compiled with its true filename and exec'd in a namespace
+whose ``__import__`` is intercepted — ``jax``/``jax.numpy``/``jax.lax``
+resolve to shim objects that propagate abstract values, sibling kernel
+modules resolve to recursively abstract-loaded modules, and everything
+else (numpy, crypto, tracing, stdlib) imports for real.  Module-level
+host code (constant tables, Frobenius coefficients, segment asserts)
+therefore runs natively and exactly; only device dataflow is abstract.
+Real jax is never imported, which keeps the lint-time cost of the
+analysis in pure-Python territory.
+
+Closures, generator expressions, ``zip``/``iter`` plumbing, dataclass
+op tables and nested comprehensions all work for free because the real
+Python code runs; stack frames carry real file/line info, which is how
+transfer functions attribute their theorem checks to call sites.
+
+Control flow: ``lax.scan``/``lax.fori_loop`` run their bodies to a
+join/widen fixpoint over the carry (with exact unrolling for small
+concrete trip counts); ``lax.cond``/``jnp.where`` join both branches.
+"""
+
+from __future__ import annotations
+
+import builtins
+import os
+import sys
+import types
+
+import numpy as np
+
+from tools.ranges import domain
+from tools.ranges.domain import (
+    MAX_FIX_ITERS, WIDEN_GRID_1, AnalysisError, Divergence, LimbVal, Opaque,
+    SymTab, join, track_limb_axis, tree_key, tree_map, widen_limb,
+)
+
+#: modules under analysis, keyed by short name (grandine_tpu/tpu/<name>.py)
+ANALYZED = ("limbs", "field", "curve", "pairing", "msm", "ed25519", "spans")
+
+#: the active Engine — the interpreter is single-threaded and transfer
+#: functions/shims reach their context through this module global.
+CURRENT: "Engine" = None
+
+
+class Engine:
+    def __init__(self, root: str, fields, recorder):
+        self.root = root
+        self.tab = SymTab()
+        self.recorder = recorder
+        self.fields = fields  # (bls, ed)
+        self.analyzed_paths = {}
+        for name in ANALYZED:
+            path = os.path.join(root, "grandine_tpu", "tpu", name + ".py")
+            self.analyzed_paths[os.path.abspath(path)] = (
+                f"grandine_tpu/tpu/{name}.py"
+            )
+        self.current_root = None
+        self.visited = set()  # (abspath, qualname) of entered functions
+        self.loader = Loader(self)
+
+    # -- site attribution ------------------------------------------------
+    def site(self):
+        from tools.ranges.primitives import SKIP_FUNCS, SKIP_WHOLE
+        f = sys._getframe(1)
+        while f is not None:
+            code = f.f_code
+            rel = self.analyzed_paths.get(code.co_filename)
+            if rel is not None and rel not in SKIP_WHOLE:
+                qual = getattr(code, "co_qualname", code.co_name)
+                if qual.split(".")[0] not in SKIP_FUNCS.get(rel, ()):
+                    return rel, qual, f.f_lineno
+            f = f.f_back
+        name = self.current_root or "?"
+        return f"(root) {name}", name, 0
+
+    # -- value plumbing --------------------------------------------------
+    def joinv(self, a, b):
+        return join(a, b, self.tab, lift=self._lift_for_join)
+
+    def _lift_for_join(self, concrete, like):
+        if isinstance(like, LimbVal):
+            try:
+                return self.lift(concrete, like)
+            except AnalysisError:
+                return concrete
+        return concrete
+
+    def lift(self, arr, like: LimbVal) -> LimbVal:
+        """Concrete digit array → exact LimbVal (layout taken from the
+        abstract operand it is combined with)."""
+        if isinstance(arr, LimbVal):
+            return arr
+        from tools.ranges import primitives
+        a = np.asarray(arr)
+        if a.ndim == 0:
+            return primitives._scalar_limb(int(a), like)
+        return primitives.lift_concrete(a, like.fp, like=like)
+
+    # -- fixpoint driver -------------------------------------------------
+    def fixpoint(self, f, init, what="loop"):
+        """Iterate to a join/widen fixpoint.  The recorder is muted for
+        every iteration here: transient iterates over-shoot reachable
+        states and would record spurious violations.  Callers re-run the
+        body once on the returned (converged) carry to record."""
+        carry = init
+        self.recorder.muted += 1
+        try:
+            for i in range(MAX_FIX_ITERS):
+                out = f(carry)
+                new = tree_map(lambda a, b: self.joinv(a, b), carry, out)
+                if i >= WIDEN_GRID_1:
+                    new = tree_map(
+                        lambda v: widen_limb(v, i, self.tab)
+                        if isinstance(v, LimbVal) else v,
+                        new,
+                    )
+                if tree_key(new, self.tab) == tree_key(carry, self.tab):
+                    return carry
+                carry = new
+        finally:
+            self.recorder.muted -= 1
+        raise Divergence(f"{what} fixpoint did not close in "
+                         f"{MAX_FIX_ITERS} iterations")
+
+
+# --- layout helpers ---------------------------------------------------------
+
+
+def _relayout(x: LimbVal, fn, on_digit_plane=None):
+    shape, ax = track_limb_axis(x, fn)
+    if ax is None:
+        if on_digit_plane is not None:
+            on_digit_plane(x)
+        elif not x.canonical and CURRENT is not None:
+            CURRENT.recorder.digit_plane(x)
+        return Opaque(shape, np.int32)
+    out = x.with_layout(shape, ax)
+    # Decorrelate: slices/gathers of one tensor must not share affine
+    # symbols, or a later cross-slice subtraction would claim false
+    # cancellation (fp2_mul_many's r0/r1/r2 are DIFFERENT products).
+    if out.val.terms and CURRENT is not None:
+        lo, hi = out.val.hull(CURRENT.tab)
+        if lo != hi:
+            out = LimbVal(out.fp, out.shape, out.limb_axis, out.dmag,
+                          out.tmag, out.nonneg, out.canonical,
+                          domain.Aff.of_sym(CURRENT.tab.fresh(lo, hi)))
+    return out
+
+
+def _shape_of(x):
+    return tuple(getattr(x, "shape", ()))
+
+
+def _is_abstract(x):
+    return isinstance(x, (LimbVal, Opaque))
+
+
+def _dummy(x):
+    """Concrete stand-in for shape computations."""
+    if _is_abstract(x):
+        return np.zeros(x.shape, np.int8)
+    return np.asarray(x)
+
+
+def _opaque_like(shape, *vals):
+    for v in vals:
+        dt = getattr(v, "dtype", None)
+        if dt is not None:
+            return Opaque(shape, dt)
+    return Opaque(shape)
+
+
+# --- jnp shim ---------------------------------------------------------------
+
+
+def _norm_dtype(dt):
+    return np.dtype(bool) if dt is bool else np.dtype(dt)
+
+
+def _make_jnp():
+    m = types.ModuleType("tools.ranges.jnp_shim")
+    m.int32 = np.int32
+    m.uint32 = np.uint32
+    m.uint8 = np.uint8
+    m.int8 = np.int8
+    m.bool_ = np.bool_
+    m.float32 = np.float32
+    m.ndarray = np.ndarray
+
+    def asarray(x, dtype=None):
+        if isinstance(x, LimbVal):
+            return x
+        if isinstance(x, Opaque):
+            return x.astype(dtype) if dtype is not None else x
+        return np.asarray(x, dtype)
+
+    def array(x, dtype=None):
+        return asarray(x, dtype)
+
+    def zeros(shape, dtype=np.int32):
+        if isinstance(shape, int):
+            shape = (shape,)
+        return np.zeros(shape, _norm_dtype(dtype))
+
+    def ones(shape, dtype=np.int32):
+        if isinstance(shape, int):
+            shape = (shape,)
+        return np.ones(shape, _norm_dtype(dtype))
+
+    def full(shape, v, dtype=None):
+        if isinstance(shape, int):
+            shape = (shape,)
+        return np.full(shape, v, _norm_dtype(dtype) if dtype else None)
+
+    def zeros_like(x):
+        if isinstance(x, LimbVal):
+            from tools.ranges import primitives
+            return primitives.zero_like_limb(x)
+        if isinstance(x, Opaque):
+            return np.zeros(x.shape, x.dtype)
+        return np.zeros_like(x)
+
+    def ones_like(x):
+        if _is_abstract(x):
+            return np.ones(_shape_of(x),
+                           getattr(x, "dtype", np.dtype(np.int32)))
+        return np.ones_like(x)
+
+    def arange(*a, **k):
+        return np.arange(*a, **k)
+
+    def where(c, a, b):
+        if isinstance(a, LimbVal) or isinstance(b, LimbVal):
+            joined = CURRENT.joinv(a, b)
+            cshape = _shape_of(c)
+            if isinstance(joined, LimbVal):
+                shape = np.broadcast_shapes(joined.shape, cshape)
+                ax = joined.limb_axis + (len(shape) - joined.ndim)
+                return joined.with_layout(shape, ax)
+            return _opaque_like(
+                np.broadcast_shapes(joined.shape, cshape), joined)
+        if not _is_abstract(c) and not _is_abstract(a) \
+                and not _is_abstract(b):
+            return np.where(c, a, b)
+        shape = np.broadcast_shapes(
+            _shape_of(c), _shape_of(a), _shape_of(b))
+        return _opaque_like(shape, a, b)
+
+    def _seq_join(arrays, fn, axis):
+        """stack/concatenate over a mix of abstract/concrete arrays.
+
+        LimbVal elements are NOT joined via broadcasting (their batch
+        shapes legitimately differ along the concat axis) — the result's
+        per-digit/value state is the pointwise union of the elements',
+        and the output layout is traced on digit-index dummies."""
+        limbs = [x for x in arrays if isinstance(x, LimbVal)]
+        if limbs:
+            fpp = limbs[0].fp
+            vals = []
+            for x in arrays:
+                if isinstance(x, LimbVal):
+                    if x.fp is not fpp:
+                        raise AnalysisError(
+                            "stack/concat mixes limb planes")
+                    vals.append(x)
+                elif isinstance(x, Opaque):
+                    vals.append(None)  # digit plane: degrade
+                else:
+                    try:
+                        vals.append(CURRENT.lift(x, limbs[0]))
+                    except AnalysisError:
+                        vals.append(None)
+            if any(v is None for v in vals):
+                shape = fn([_dummy(x) for x in arrays], axis).shape
+                return Opaque(shape, np.int32)
+            out = np.asarray(fn([domain.limb_dummy(v) for v in vals],
+                                axis))
+            ax = domain.locate_limb_axis(
+                out, fpp.nlimbs, vals[0].limb_axis)
+            if ax is None:
+                return Opaque(out.shape, np.int32)
+            hulls = [v.val.hull(CURRENT.tab) for v in vals]
+            lo = min(h[0] for h in hulls)
+            hi = max(h[1] for h in hulls)
+            form = (domain.Aff.of_const(lo) if lo == hi
+                    else domain.Aff.of_sym(CURRENT.tab.fresh(lo, hi)))
+            return LimbVal(
+                fpp, out.shape, ax,
+                max(v.dmag for v in vals), max(v.tmag for v in vals),
+                all(v.nonneg for v in vals),
+                all(v.canonical for v in vals), form,
+            )
+        if any(isinstance(x, Opaque) for x in arrays):
+            shape = fn([_dummy(x) for x in arrays], axis).shape
+            dt = next(x.dtype for x in arrays if isinstance(x, Opaque))
+            return Opaque(shape, dt)
+        return fn(arrays, axis)
+
+    def stack(arrays, axis=0):
+        return _seq_join(list(arrays), lambda ds, ax: np.stack(ds, ax),
+                         axis)
+
+    def concatenate(arrays, axis=0):
+        return _seq_join(
+            list(arrays), lambda ds, ax: np.concatenate(ds, ax), axis)
+
+    def moveaxis(a, src, dst):
+        if isinstance(a, LimbVal):
+            return _relayout(a, lambda d: np.moveaxis(d, src, dst))
+        if isinstance(a, Opaque):
+            return Opaque(np.moveaxis(_dummy(a), src, dst).shape, a.dtype)
+        return np.moveaxis(a, src, dst)
+
+    def transpose(a, axes=None):
+        if isinstance(a, LimbVal):
+            return _relayout(a, lambda d: np.transpose(d, axes))
+        if isinstance(a, Opaque):
+            return Opaque(np.transpose(_dummy(a), axes).shape, a.dtype)
+        return np.transpose(a, axes)
+
+    def broadcast_to(a, shape):
+        shape = tuple(int(s) for s in shape)
+        if isinstance(a, LimbVal):
+            return _relayout(a, lambda d: np.broadcast_to(d, shape))
+        if isinstance(a, Opaque):
+            return Opaque(shape, a.dtype)
+        return np.broadcast_to(a, shape)
+
+    def take(a, idx, axis=None):
+        cidx = domain._clean_key(idx)
+        if isinstance(a, LimbVal):
+            out = _relayout(a, lambda d: np.take(d, cidx, axis=axis))
+            if _is_abstract(idx) and isinstance(out, LimbVal):
+                # gathered along a batch axis by a traced index — the
+                # per-element state is the join of the whole batch, which
+                # is what the LimbVal already denotes.
+                return out
+            return out
+        if isinstance(a, Opaque):
+            return Opaque(np.take(_dummy(a), cidx, axis=axis).shape,
+                          a.dtype)
+        if _is_abstract(idx):
+            return Opaque(np.take(np.asarray(a), cidx, axis=axis).shape,
+                          np.asarray(a).dtype)
+        return np.take(a, idx, axis=axis)
+
+    def roll(a, shift, axis=None):
+        concrete_shift = not _is_abstract(shift)
+        if isinstance(a, LimbVal):
+            ax = axis if axis is None or axis >= 0 else a.ndim + axis
+            if ax is not None and ax == a.limb_axis and not (
+                    concrete_shift and int(shift) % a.fp.nlimbs == 0):
+                raise AnalysisError("roll along the limb axis")
+            return a  # batch roll: per-element state unchanged
+        if isinstance(a, Opaque):
+            return a
+        if concrete_shift:
+            return np.roll(a, shift, axis=axis)
+        return Opaque(np.asarray(a).shape, np.asarray(a).dtype)
+
+    def _reduce(npfn, a, axis=None, dtype=None, **kw):
+        if _is_abstract(a):
+            shape = npfn(_dummy(a), axis=axis).shape
+            if npfn in (np.all, np.any):
+                return Opaque(shape, np.bool_)
+            return Opaque(shape, dtype or a.dtype)
+        out = npfn(a, axis=axis, **({"dtype": dtype} if dtype else {}))
+        return out
+
+    def all_(a, axis=None):
+        return _reduce(np.all, a, axis)
+
+    def any_(a, axis=None):
+        return _reduce(np.any, a, axis)
+
+    def sum_(a, axis=None, dtype=None):
+        return _reduce(np.sum, a, axis, dtype)
+
+    def _elemwise2(npfn, a, b, bool_out=False):
+        if _is_abstract(a) or _is_abstract(b):
+            shape = np.broadcast_shapes(_shape_of(a), _shape_of(b))
+            if bool_out:
+                return Opaque(shape, np.bool_)
+            return _opaque_like(shape, a, b)
+        return npfn(a, b)
+
+    def logical_and(a, b):
+        return _elemwise2(np.logical_and, a, b, bool_out=True)
+
+    def logical_or(a, b):
+        return _elemwise2(np.logical_or, a, b, bool_out=True)
+
+    def logical_not(a):
+        if _is_abstract(a):
+            return Opaque(_shape_of(a), np.bool_)
+        return np.logical_not(a)
+
+    def minimum(a, b):
+        return _elemwise2(np.minimum, a, b)
+
+    def maximum(a, b):
+        return _elemwise2(np.maximum, a, b)
+
+    def reshape(a, shape):
+        if isinstance(a, LimbVal):
+            return _relayout(a, lambda d: d.reshape(shape))
+        if isinstance(a, Opaque):
+            return a.reshape(shape)
+        return np.reshape(a, shape)
+
+    def expand_dims(a, axis):
+        if isinstance(a, LimbVal):
+            return _relayout(a, lambda d: np.expand_dims(d, axis))
+        if isinstance(a, Opaque):
+            return Opaque(np.expand_dims(_dummy(a), axis).shape, a.dtype)
+        return np.expand_dims(a, axis)
+
+    m.asarray = asarray
+    m.array = array
+    m.zeros = zeros
+    m.ones = ones
+    m.full = full
+    m.zeros_like = zeros_like
+    m.ones_like = ones_like
+    m.arange = arange
+    m.where = where
+    m.stack = stack
+    m.concatenate = concatenate
+    m.moveaxis = moveaxis
+    m.transpose = transpose
+    m.broadcast_to = broadcast_to
+    m.broadcast_shapes = np.broadcast_shapes
+    m.take = take
+    m.roll = roll
+    m.all = all_
+    m.any = any_
+    m.sum = sum_
+    m.logical_and = logical_and
+    m.logical_or = logical_or
+    m.logical_not = logical_not
+    m.minimum = minimum
+    m.maximum = maximum
+    m.reshape = reshape
+    m.expand_dims = expand_dims
+    return m
+
+
+# --- lax shim ---------------------------------------------------------------
+
+
+def _scan_element(leaf):
+    if isinstance(leaf, LimbVal):
+        return _relayout(leaf, lambda d: d[0])
+    if isinstance(leaf, Opaque):
+        return Opaque(leaf.shape[1:], leaf.dtype)
+    arr = np.asarray(leaf)
+    if arr.shape[0] == 0:
+        raise AnalysisError("scan over an empty axis")
+    if np.all(arr == arr[:1]):
+        return arr[0]
+    return Opaque(arr.shape[1:], arr.dtype)
+
+
+def _scan_length(xs, length):
+    if xs is None:
+        return int(length)
+    leaves = domain.tree_leaves(xs)
+    if not leaves:
+        return int(length)
+    return int(_shape_of(leaves[0])[0])
+
+
+def _prepend_axis(leaf, t):
+    if leaf is None:
+        return None
+    if isinstance(leaf, LimbVal):
+        return leaf.with_layout((t,) + leaf.shape, leaf.limb_axis + 1)
+    if isinstance(leaf, Opaque):
+        return Opaque((t,) + leaf.shape, leaf.dtype)
+    arr = np.asarray(leaf)
+    return np.broadcast_to(arr, (t,) + arr.shape).copy()
+
+
+def _make_lax():
+    m = types.ModuleType("tools.ranges.lax_shim")
+
+    def scan(f, init, xs=None, length=None, reverse=False, unroll=1):
+        t = _scan_length(xs, length)
+        x_elem = (tree_map(_scan_element, xs) if xs is not None else None)
+        carry = CURRENT.fixpoint(
+            lambda c: f(c, x_elem)[0], init, what="lax.scan")
+        _, y = f(carry, x_elem)
+        ys = tree_map(lambda leaf: _prepend_axis(leaf, t), y)
+        return carry, ys
+
+    def fori_loop(lo, hi, body, init):
+        concrete = not (_is_abstract(lo) or _is_abstract(hi))
+        if concrete and int(hi) - int(lo) <= 64:
+            val = init
+            for i in range(int(lo), int(hi)):
+                val = body(np.int32(i), val)
+            return val
+        val = CURRENT.fixpoint(
+            lambda v: body(Opaque((), np.int32), v), init,
+            what="lax.fori_loop")
+        # one unmuted pass at the converged carry records call sites
+        body(Opaque((), np.int32), val)
+        return val
+
+    def cond(pred, true_fun, false_fun, *operands):
+        if not _is_abstract(pred):
+            branch = true_fun if bool(np.asarray(pred)) else false_fun
+            return branch(*operands)
+        tv = true_fun(*operands)
+        fv = false_fun(*operands)
+        return tree_map(lambda a, b: CURRENT.joinv(a, b), tv, fv)
+
+    def select(pred, on_true, on_false):
+        return _make_jnp_cached().where(pred, on_true, on_false)
+
+    m.scan = scan
+    m.fori_loop = fori_loop
+    m.cond = cond
+    m.select = select
+    return m
+
+
+_JNP = None
+_LAX = None
+_JAX = None
+
+
+def _make_jnp_cached():
+    global _JNP
+    if _JNP is None:
+        _JNP = _make_jnp()
+    return _JNP
+
+
+def shim_jax():
+    """The top-level ``jax`` shim module (lazily built, shared)."""
+    global _JAX, _LAX
+    if _JAX is not None:
+        return _JAX
+    jnp = _make_jnp_cached()
+    _LAX = _make_lax()
+    jax = types.ModuleType("tools.ranges.jax_shim")
+    jax.numpy = jnp
+    jax.lax = _LAX
+
+    tree = types.SimpleNamespace()
+    tree.map = lambda f, *trees, **kw: tree_map(f, *trees)
+    tree.leaves = lambda t, **kw: domain.tree_leaves(t)
+    jax.tree = tree
+
+    def jit(fun=None, **kw):
+        if fun is None:
+            return lambda f: f
+        return fun
+
+    jax.jit = jit
+    _JAX = jax
+    return jax
+
+
+# --- module loader ----------------------------------------------------------
+
+
+class _Pkg:
+    """Fake ``grandine_tpu.tpu`` package: analyzed modules resolve to
+    abstract-loaded twins; anything else is an analysis error (it would
+    drag real jax in)."""
+
+    def __init__(self, loader):
+        self._loader = loader
+
+    def __getattr__(self, name):
+        if name in ANALYZED:
+            return self._loader.load(name)
+        raise AnalysisError(
+            f"abstract module imported grandine_tpu.tpu.{name}, which is "
+            f"not in the analyzed set"
+        )
+
+
+class Loader:
+    def __init__(self, engine: Engine):
+        self.engine = engine
+        self.cache = {}
+        self.installers = {}
+        self._real_import = builtins.__import__
+
+    def load(self, name: str):
+        if name in self.cache:
+            return self.cache[name]
+        path = os.path.abspath(os.path.join(
+            self.engine.root, "grandine_tpu", "tpu", name + ".py"))
+        with open(path, "r", encoding="utf-8") as fh:
+            src = fh.read()
+        code = compile(src, path, "exec")
+        # the module must be findable via sys.modules[its __name__]:
+        # dataclasses (py3.10 _is_type) dereferences that unguarded.
+        mod = types.ModuleType(f"tools.ranges.abstract.{name}")
+        mod.__file__ = path
+        sys.modules[mod.__name__] = mod
+        bt = dict(vars(builtins))
+        bt["__import__"] = self._import
+        mod.__dict__["__builtins__"] = bt
+        self.cache[name] = mod
+        exec(code, mod.__dict__)
+        installer = self.installers.get(name)
+        if installer is not None:
+            installer(mod.__dict__)
+        return mod
+
+    def _import(self, name, globals=None, locals=None, fromlist=(),
+                level=0):
+        if name == "jax" or name.startswith("jax."):
+            return shim_jax()
+        if name == "grandine_tpu.tpu" or name.startswith(
+                "grandine_tpu.tpu."):
+            if name == "grandine_tpu.tpu":
+                return _Pkg(self)
+            leaf = name.rsplit(".", 1)[1]
+            if leaf in ANALYZED:
+                return self.load(leaf)
+            raise AnalysisError(
+                f"abstract module imported {name}, which is not in the "
+                f"analyzed set"
+            )
+        return self._real_import(name, globals, locals, fromlist, level)
