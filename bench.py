@@ -1963,6 +1963,313 @@ def bench_mainnet() -> None:
         raise SystemExit(1)
 
 
+def bench_overload() -> None:
+    """`--overload` / BENCH_OVERLOAD=1: brownout-ladder overload soak.
+
+    Drives the verify scheduler's HIGH lanes at mainnet-derived rates
+    and a sheddable LOW lane at BENCH_OVERLOAD_ARRIVAL_X (default 4x)
+    times its derived mainnet arrival — deliberately past the synthetic
+    device's service rate — with a live BrownoutController, then gates
+    on the overload-control contract:
+
+      * the ladder walks NORMAL→…→CRITICAL under load and back to
+        NORMAL after the burst stops, with ZERO flap (exactly one
+        up-walk followed by exactly one down-walk),
+      * HIGH-lane p95 enqueue→settle stays within its SLO budget
+        (× BENCH_OVERLOAD_SLO_SCALE) THROUGH the overload — the point
+        of shedding LOW traffic is that HIGH traffic never degrades,
+      * every shed on the flight timeline is attributed: cause
+        "expired" (deadline budget ran out before dispatch) or
+        "brownout" (overload-control drop), and both kinds occur,
+      * ZERO post-warmup recompiles — no overload actuator (queue
+        shrink, host routing, door shedding) may touch the shape
+        ledger.
+
+    The device is the synthetic model from the mainnet soak (fixed call
+    latency + per-signature cost) plus a synthetic HOST twin so the B3
+    route-to-host leg costs host-shaped time instead of running real
+    BLS on bench bytes. A side probe submits already-expired HIGH-lane
+    tickets to pin the deadline-budget path: each must shed with
+    cause="expired" before any dispatch. Emits ONE ledger-gated JSON
+    line (metric `verify_overload_soak`: worst HIGH-lane p95 ms); gate
+    failures exit 1 unless BENCH_OVERLOAD_STRICT=0."""
+    _lint_preflight()
+    import threading
+
+    from grandine_tpu.metrics import Metrics
+    from grandine_tpu.runtime.brownout import LEVELS, BrownoutController
+    from grandine_tpu.runtime.flight import (
+        DEFAULT_SLO_BUDGETS,
+        FlightRecorder,
+    )
+    from grandine_tpu.runtime.isolation import AdmissionController
+    from grandine_tpu.runtime.verify_scheduler import (
+        VerifyItem,
+        VerifyScheduler,
+    )
+    from grandine_tpu.tpu import bls as B
+    from grandine_tpu.tpu.registry import MAINNET_CAPACITY
+
+    soak_s = float(os.environ.get("BENCH_OVERLOAD_SECONDS", "8"))
+    arrival_x = float(os.environ.get("BENCH_OVERLOAD_ARRIVAL_X", "4"))
+    slot_s = float(os.environ.get("BENCH_OVERLOAD_SLOT_S", "1.2"))
+    slo_scale = float(os.environ.get("BENCH_OVERLOAD_SLO_SCALE", "1"))
+    recovery_s = float(os.environ.get("BENCH_OVERLOAD_RECOVERY_S", "0.6"))
+    strict = os.environ.get("BENCH_OVERLOAD_STRICT", "1") == "1"
+    _enable_compilation_cache()
+
+    compress = MAINNET_SECONDS_PER_SLOT / slot_s
+    rates_mainnet = derive_mainnet_rates(MAINNET_CAPACITY)
+
+    # no kernels are dispatched here (the device is the synthetic model
+    # below) — sealing the EMPTY shape ledger turns the zero-recompile
+    # gate into "the overload plane itself never triggers a compile"
+    B.reset_shape_tracking()
+    B.declare_warmup_complete()
+
+    call_latency_s = float(
+        os.environ.get("BENCH_OVERLOAD_CALL_MS", "20")) / 1e3
+    per_sig_s = float(
+        os.environ.get("BENCH_OVERLOAD_SIG_US", "1500")) / 1e6
+    host_sig_s = float(
+        os.environ.get("BENCH_OVERLOAD_HOST_SIG_US", "200")) / 1e6
+
+    metrics = Metrics()
+    flight = FlightRecorder(capacity=1 << 16, metrics=metrics)
+
+    class _ModelOverloadScheduler(VerifyScheduler):
+        """The mainnet soak's synthetic device model plus a synthetic
+        host twin — B3 routing must cost host-shaped time, not run
+        real BLS on bench bytes."""
+
+        def _device_dispatch(self, lane, items):
+            n = len(items)
+
+            def settle() -> bool:
+                time.sleep(call_latency_s + per_sig_s * n)
+                return True
+
+            return settle
+
+        def _host_check_all(self, lane, items):
+            time.sleep(host_sig_s * len(items))
+            return [True] * len(items)
+
+    sched = _ModelOverloadScheduler(
+        use_device=True, flight=flight, metrics=metrics,
+        merge_window_s=0.005,
+    )
+    admission = AdmissionController()
+    ctrl = BrownoutController(
+        sched,
+        flight=flight,
+        admission=admission,
+        metrics=metrics,
+        interval_s=0.1,
+        recovery_window_s=recovery_s,
+    )
+
+    item = VerifyItem(b"\x11" * 32, b"\x22" * 96, public_keys=("bench",))
+    high_lanes = ("block", "blob_header")
+    burst_lane = "sync_message"
+    tickets: "dict[str, list]" = {ln: [] for ln in high_lanes + (burst_lane,)}
+    tickets_lock = threading.Lock()
+    stop_evt = threading.Event()   # whole soak
+    burst_evt = threading.Event()  # overload phase only
+    expired_probes = [0]
+
+    def lane_producer(lane: str, rate_per_s: float, until) -> None:
+        interval = 1.0 / rate_per_s
+        mine = []
+        nxt = time.time()
+        budget_s = DEFAULT_SLO_BUDGETS[lane] * slo_scale
+        while not until.is_set():
+            # every ticket carries its end-to-end deadline budget,
+            # stamped at submit — expiry (not just queue overflow) is a
+            # live shedding path during the burst
+            mine.append(
+                sched.submit(lane, [item], deadline_s=4.0 * budget_s)
+            )
+            nxt += interval
+            delay = nxt - time.time()
+            if delay > 0:
+                until.wait(delay)
+        with tickets_lock:
+            tickets[lane].extend(mine)
+
+    def expired_probe() -> None:
+        # already-expired HIGH-lane tickets: each must shed with
+        # cause="expired" BEFORE any dispatch — the deadline budget
+        # applies even on lanes brownout shedding never touches
+        while not burst_evt.is_set():
+            sched.submit("blob_header", [item], deadline_s=0.0)
+            expired_probes[0] += 1
+            burst_evt.wait(0.25)
+
+    threads = [
+        threading.Thread(
+            target=lane_producer,
+            args=(ln, rates_mainnet[ln] * compress * arrival_x, stop_evt),
+            name=f"lane-{ln}",
+        )
+        for ln in high_lanes
+    ] + [
+        threading.Thread(
+            target=lane_producer,
+            args=(
+                burst_lane,
+                rates_mainnet[burst_lane] * compress * arrival_x,
+                burst_evt,
+            ),
+            name=f"lane-{burst_lane}",
+        ),
+        threading.Thread(target=expired_probe, name="expired-probe"),
+    ]
+
+    t0 = time.time()
+    t0_mono = time.monotonic()  # transition stamps use the ctrl clock
+    ctrl.start()
+    for t in threads:
+        t.start()
+    # phase A: the burst runs for half the soak; phase B: drain + the
+    # hysteretic walk back to NORMAL (bounded, not assumed — the gate
+    # fails if recovery never lands)
+    time.sleep(soak_s / 2.0)
+    burst_evt.set()
+    recovered_by = t0 + soak_s * 3.0
+    while time.time() < recovered_by and ctrl.level != LEVELS[0]:
+        time.sleep(0.05)
+    time.sleep(2 * ctrl.interval_s)  # a couple of clean ticks at NORMAL
+    stop_evt.set()
+    for t in threads:
+        t.join()
+    sched.flush(60.0)
+    wall_s = time.time() - t0
+
+    end_level = ctrl.level
+    transitions = ctrl.transitions()
+    ctrl.stop()
+    sched.stop()
+
+    # ---- HIGH-lane latency vs SLO (LOW-lane latency rides along,
+    # reported but ungated: shedding it is the design)
+    def q(xs, frac):
+        xs = sorted(xs)
+        return xs[min(len(xs) - 1, int(frac * len(xs)))]
+
+    lanes_report: "dict[str, dict]" = {}
+    for ln in high_lanes + (burst_lane,):
+        lat = [
+            t.settled_at - t.enqueued_at
+            for t in tickets[ln]
+            if t.settled_at is not None and not t.dropped
+        ]
+        if not lat:
+            continue
+        budget_s = DEFAULT_SLO_BUDGETS[ln] * slo_scale
+        p95 = q(lat, 0.95)
+        lanes_report[ln] = {
+            "jobs": len(lat),
+            "dropped": sum(1 for t in tickets[ln] if t.dropped),
+            "p50_ms": round(q(lat, 0.50) * 1e3, 2),
+            "p95_ms": round(p95 * 1e3, 2),
+            "slo_ms": round(budget_s * 1e3, 1),
+            "ok": bool(p95 <= budget_s),
+        }
+    high_ok = all(
+        lanes_report[ln]["ok"] for ln in high_lanes if ln in lanes_report
+    ) and all(ln in lanes_report for ln in high_lanes)
+    worst_p95_ms = max(
+        (lanes_report[ln]["p95_ms"] for ln in high_lanes
+         if ln in lanes_report),
+        default=float("inf"),
+    )
+
+    # ---- ladder shape: one clean up-walk, one clean down-walk
+    idx = {lv: i for i, lv in enumerate(LEVELS)}
+    steps = [idx[to] - idx[frm] for _, frm, to in transitions]
+    n_up = len(LEVELS) - 1
+    reached_critical = any(to == LEVELS[-1] for _, _, to in transitions)
+    recovered = end_level == LEVELS[0]
+    zero_flap = (
+        len(steps) == 2 * n_up
+        and all(s == 1 for s in steps[:n_up])
+        and all(s == -1 for s in steps[n_up:])
+    )
+
+    # ---- shed attribution on the flight timeline
+    shed_recs = [r for r in flight.snapshot() if r.note == "shed"]
+    shed_causes = {r.slo_cause for r in shed_recs}
+    shed_jobs = sum(
+        st.get("shed", 0) for st in sched.stats.values()
+    )
+    misses = flight.slo_misses()
+    expired_n = sum(c.get("expired", 0) for c in misses.values())
+    brownout_n = sum(c.get("brownout", 0) for c in misses.values())
+    sheds_attributed = (
+        bool(shed_recs)
+        and shed_causes <= {"expired", "brownout"}
+        and "expired" in shed_causes
+        and "brownout" in shed_causes
+    )
+
+    recompiles = B.post_warmup_recompiles()
+    gates = {
+        "reached_critical": bool(reached_critical),
+        "recovered_normal": bool(recovered),
+        "zero_flap": bool(zero_flap),
+        "high_lanes_slo": bool(high_ok),
+        "sheds_attributed": bool(sheds_attributed),
+        "zero_recompiles": recompiles == 0,
+    }
+    ok = all(gates.values())
+
+    emit_bench_line({
+        "metric": "verify_overload_soak",
+        "unit": "ms",
+        "value": worst_p95_ms,
+        "ok": ok,
+        "gates": gates,
+        "arrival_x": arrival_x,
+        "time_compression": round(compress, 2),
+        "soak_s": round(wall_s, 2),
+        "lanes": lanes_report,
+        "ladder": [
+            [round(ts - t0_mono, 2), frm, to]
+            for ts, frm, to in transitions
+        ],
+        "end_level": end_level,
+        "sheds": {
+            "jobs": shed_jobs,
+            "records": len(shed_recs),
+            "expired": expired_n,
+            "brownout": brownout_n,
+            "expired_probes": expired_probes[0],
+        },
+        "recompiles_post_warmup": recompiles,
+    }, config={"arrival_x": arrival_x, "seconds": soak_s,
+               "recovery_s": recovery_s})
+    print(
+        f"# overload soak: {arrival_x:.0f}x burst for {soak_s / 2:.1f}s, "
+        f"{wall_s:.1f}s wall; ladder "
+        + " ".join(f"{frm}->{to}" for _, frm, to in transitions)
+        + f"; HIGH worst p95 {worst_p95_ms:.0f}ms; "
+        f"sheds {shed_jobs} (expired {expired_n}, brownout {brownout_n}); "
+        f"recompiles={recompiles}; " + ("OK" if ok else "FAILED"),
+        file=sys.stderr,
+    )
+    emit_bench_line(
+        {
+            "metric": "verify_flight_summary",
+            "value": flight.summary(),
+        },
+        stream=sys.stderr,
+        ledger=False,
+    )
+    if strict and not ok:
+        raise SystemExit(1)
+
+
 def bench_multichip_child(n_devices: int) -> None:
     """One `--devices` sweep point, run by bench_multichip in a FRESH
     process: on the CPU platform the virtual device count comes from
@@ -2942,6 +3249,8 @@ if __name__ == "__main__":
         bench_replay()
     elif "--mainnet" in sys.argv or os.environ.get("BENCH_MAINNET") == "1":
         bench_mainnet()
+    elif "--overload" in sys.argv or os.environ.get("BENCH_OVERLOAD") == "1":
+        bench_overload()
     elif "--schemes" in sys.argv or os.environ.get("BENCH_SCHEMES") == "1":
         bench_schemes()
     elif (

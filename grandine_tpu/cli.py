@@ -70,6 +70,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="consecutive clean quarantine batches before a suspect "
              "origin exits quarantine (default 3)")
     parser.add_argument(
+        "--brownout", action=argparse.BooleanOptionalAction, default=True,
+        help="adaptive overload control: a hysteretic brownout ladder "
+             "sheds batching latency, admission headroom, and finally "
+             "bulk work when the verify plane misses its SLOs "
+             "(runtime/brownout.py; --no-brownout disables)")
+    parser.add_argument(
         "--admission-max-share", type=float, default=None, metavar="F",
         help="fair-share admission cap: one gossip origin may hold at "
              "most this fraction of the verify plane's sliding window "
@@ -261,6 +267,7 @@ def _node_once(args, cfg) -> int:
         metrics=metrics, tracer=tracer,
         mesh=mesh,
         use_isolation=not getattr(args, "no_isolation", False),
+        use_brownout=getattr(args, "brownout", True),
         database=db,
     )
     if getattr(args, "quarantine_exit_clean", None):

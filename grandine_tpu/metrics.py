@@ -615,8 +615,8 @@ class Metrics:
         self.verify_slo_miss = LabeledCounter(
             "verify_slo_miss_total",
             "verify batches that blew their lane's deadline budget, by "
-            "lane and dominant cause "
-            "(queue_wait/device/bisection/breaker_open)",
+            "lane and dominant cause (queue_wait/device/bisection/"
+            "breaker_open/expired/brownout)",
             ("lane", "cause"),
         )
         self.verify_bucket_fill = LabeledHistogram(
@@ -657,6 +657,28 @@ class Metrics:
             "verify_admission_rejected_total",
             "verify submissions rejected by per-origin fair-share "
             "admission control, by lane",
+            ("lane",),
+        )
+        # brownout overload-control plane (runtime/brownout.py): the
+        # current ladder level, every transition by endpoint pair (the
+        # from/to labels are the CLOSED brownout.LEVELS enum — lint-
+        # enforced like SLO causes), and deadline-budget expiries by
+        # lane (the shed-before-dispatch path)
+        self.verify_brownout_level = Gauge(
+            "verify_brownout_level",
+            "current brownout ladder level as an index into "
+            "brownout.LEVELS (0=normal .. 4=critical)",
+        )
+        self.verify_brownout_transitions = LabeledCounter(
+            "verify_brownout_transitions_total",
+            "brownout ladder transitions, by from/to level (closed "
+            "enum: normal/b1/b2/b3/critical)",
+            ("from", "to"),
+        )
+        self.verify_expired = LabeledCounter(
+            "verify_expired_total",
+            "tickets shed because their absolute deadline passed "
+            "before dispatch (the budget-expiry path), by lane",
             ("lane",),
         )
         self.verify_device_duty_cycle = Gauge(

@@ -65,8 +65,13 @@ RECORD_KINDS = (BATCH, CANARY, BREAKER)
 
 #: the CLOSED cause enum on verify_slo_miss_total — the metrics-
 #: cardinality lint rule parses this tuple and rejects any literal
-#: `cause` outside it, and `_slo_cause` below can only return members
-SLO_CAUSES = ("queue_wait", "device", "bisection", "breaker_open")
+#: `cause` outside it, and `_slo_cause` below can only return members.
+#: `expired` = a ticket's absolute deadline passed before dispatch and
+#: it was shed un-dispatched; `brownout` = the overload controller
+#: (runtime/brownout.py) shed it — shed-oldest overflow, or a
+#: CRITICAL-level submit refusal.
+SLO_CAUSES = ("queue_wait", "device", "bisection", "breaker_open",
+              "expired", "brownout")
 
 #: per-lane deadline budgets (seconds, enqueue→settle). HIGH scheduler
 #: lanes sit on the block-import path; the attestation budget is the
@@ -127,7 +132,7 @@ class BatchRecord:
         "queue_wait_s", "device_s", "host_s", "bisect_s", "verdict",
         "fault", "retries", "bisect_depth", "breaker_state", "recompile",
         "slo_miss", "slo_cause", "origin", "note", "devices",
-        "quarantined",
+        "quarantined", "brownout",
     )
 
     def __init__(self, kind: str, lane: str) -> None:
@@ -159,6 +164,10 @@ class BatchRecord:
         #: True for quarantine-lane batches (suspect-origin traffic
         #: isolated from honest batches — runtime/isolation.py)
         self.quarantined = False
+        #: the brownout level (runtime/brownout.py LEVELS) in force when
+        #: the record committed — every shed reads its causing level
+        #: straight off the timeline
+        self.brownout = "normal"
 
     def total_s(self) -> float:
         return self.queue_wait_s + self.device_s + self.host_s + self.bisect_s
@@ -190,6 +199,7 @@ class BatchRecord:
             "note": self.note,
             "devices": self.devices,
             "quarantined": self.quarantined,
+            "brownout": self.brownout,
         }
 
 
@@ -326,6 +336,10 @@ class FlightRecorder:
             )
         self.default_budget_s = float(default_budget_s)
         self.origins = OriginTable(origin_top_k)
+        #: the brownout level stamped on every committed record — poked
+        #: by the BrownoutController on each transition (a torn read
+        #: only mis-stamps one record's level by one tick)
+        self.brownout_level = "normal"
         #: runtime.profiler.KernelProfiler hook: every committed record
         #: carrying a kernel feeds its dispatch→settle device seconds to
         #: the profiler's always-on estimator (node.py wires the node's
@@ -458,6 +472,27 @@ class FlightRecorder:
     def note_origin_failure(self, origin: str, count: int = 1) -> None:
         self.origins.note_failure(origin, count)
 
+    def record_shed(self, lane: str, items: int, cause: str) -> None:
+        """One shed event: jobs that never reached a device dispatch —
+        a deadline expiry (`cause="expired"`) or an overload-control
+        drop (`cause="brownout"`). The record joins the timeline with
+        the brownout level stamped on, so every shed is attributable,
+        and feeds the SLO-miss aggregates (the brownout controller's
+        own escalation feed) — but not the dispatched-batch count."""
+        rec = BatchRecord(BATCH, lane)
+        rec.items = int(items)
+        rec.verdict = False
+        rec.slo_miss = True
+        rec.slo_cause = cause if cause in SLO_CAUSES else "brownout"
+        rec.note = "shed"
+        m = self.metrics
+        if m is not None:
+            m.verify_slo_miss.inc(rec.lane, rec.slo_cause)
+        with self._lock:
+            key = (rec.lane, rec.slo_cause)
+            self._slo_miss[key] = self._slo_miss.get(key, 0) + 1
+            self._append_locked(rec)
+
     # -------------------------------------------------- duty cycle gauges
 
     def device_enter(self) -> None:
@@ -526,6 +561,7 @@ class FlightRecorder:
     def _append_locked(self, rec: BatchRecord) -> None:
         rec.seq = self._seq
         rec.t = self.clock() - self._t0
+        rec.brownout = self.brownout_level
         self._ring[self._seq % self.capacity] = rec
         self._seq += 1
 

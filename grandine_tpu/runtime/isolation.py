@@ -556,11 +556,23 @@ class AdmissionController:
         self.reputation = reputation
         self.trust_failure_rate = float(trust_failure_rate)
         self._lock = threading.Lock()
+        #: 0.0..1.0 quota squeeze applied under brownout (B2+): every
+        #: clamped origin's quota shrinks toward min_quota by this
+        #: fraction. Set via set_brownout_pressure() by the
+        #: BrownoutController; reverts to 0.0 on recovery.
+        self.brownout_pressure = 0.0
         #: origin -> list[(t, items)] (window entries, oldest first)
         self._windows: "dict[str, list]" = {}
         #: origin -> current window sum (kept in lockstep with _windows)
         self._totals: "dict[str, int]" = {}
         self._global_total = 0
+
+    def set_brownout_pressure(self, pressure: float) -> None:
+        """Squeeze every clamped origin's quota toward ``min_quota``
+        by ``pressure`` (0.0 = no squeeze, 1.0 = floor). Called by the
+        brownout controller at B2 and reverted on recovery."""
+        with self._lock:
+            self.brownout_pressure = min(1.0, max(0.0, float(pressure)))
 
     def _prune(self, now: float) -> None:
         horizon = now - self.window_s
@@ -609,6 +621,16 @@ class AdmissionController:
                 # distrusted: quota shrinks toward the floor as the
                 # attributed failure rate climbs
                 quota = max(self.min_quota, int(quota * (1.0 - rate)))
+            if self.brownout_pressure > 0.0:
+                # brownout squeeze (B2+): shrink everyone's headroom
+                # above the floor, trusted origins included — overload
+                # is a node-wide condition, not a per-origin verdict,
+                # so the reputation exemption is suspended too
+                quota = max(
+                    self.min_quota,
+                    int(quota * (1.0 - self.brownout_pressure)),
+                )
+                clamped = True
             used = self._totals.get(origin, 0)
             if clamped and used + items > quota:
                 rejected = True

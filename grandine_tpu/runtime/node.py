@@ -38,6 +38,7 @@ class InProcessNode:
         tracer=None,
         mesh=None,
         use_isolation: bool = True,
+        use_brownout: bool = True,
         database=None,
     ) -> None:
         from grandine_tpu.consensus.verifier import MultiVerifier
@@ -144,6 +145,22 @@ class InProcessNode:
             self.verify_scheduler.registry = (
                 self.attestation_verifier.registry
             )
+        #: ONE brownout controller for the whole node: watches the
+        #: shared flight recorder's SLO-miss stream and the scheduler's
+        #: lane depths, and walks the NORMAL→…→CRITICAL ladder across
+        #: the verify plane + admission quotas (runtime/brownout.py).
+        #: Only meaningful when a scheduler exists to actuate on.
+        self.brownout = None
+        if use_brownout and self.verify_scheduler is not None:
+            from grandine_tpu.runtime.brownout import BrownoutController
+
+            self.brownout = BrownoutController(
+                self.verify_scheduler,
+                flight=self.flight,
+                admission=self.admission,
+                metrics=metrics,
+            )
+            self.brownout.start()
         self.clock = SlotClock(
             int(genesis_state.genesis_time), cfg.seconds_per_slot
         )
@@ -295,6 +312,11 @@ class InProcessNode:
                 self.reputation.save(self.database)
             except Exception:
                 pass  # shutdown persistence is best-effort
+        # the controller stops FIRST so it reverts every brownout
+        # actuation (lane configs, admission pressure) before the
+        # scheduler drains
+        if self.brownout is not None:
+            self.brownout.stop()
         self.attestation_verifier.stop()
         if self.verify_scheduler is not None:
             self.verify_scheduler.stop()

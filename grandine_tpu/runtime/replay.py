@@ -37,6 +37,7 @@ name the offending signature.
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from collections import deque
 from typing import Optional, Sequence
@@ -160,6 +161,11 @@ class BulkReplayPipeline:
         self.window_size = max(1, int(window_size))
         self.pipeline_depth = max(1, int(pipeline_depth))
         self.slasher = slasher
+        #: brownout gate: cleared by the BrownoutController at B3 to
+        #: pause bulk replay between windows (live duties outrank
+        #: catch-up); set again on recovery. Starts open.
+        self.run_gate = threading.Event()
+        self.run_gate.set()
         self.metrics = metrics
         self.tracer = tracer or NULL_TRACER
         self.state_root_policy = state_root_policy
@@ -182,6 +188,9 @@ class BulkReplayPipeline:
         kernel = "multi_verify" if device else "host"
         try:
             for w0 in range(0, len(blocks), self.window_size):
+                # brownout B3 pauses catch-up at window granularity —
+                # in-flight windows still settle, new ones wait here
+                self.run_gate.wait()
                 chunk = blocks[w0 : w0 + self.window_size]
                 window, state = self._transition_and_collect(
                     state, chunk, w0

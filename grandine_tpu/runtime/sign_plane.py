@@ -189,10 +189,16 @@ class SignTicket:
     shutdown/overload (the caller degrades to its own host path)."""
 
     __slots__ = ("lane", "enqueued_at", "settled_at", "dropped",
-                 "_sig", "_event", "_callbacks", "_lock")
+                 "deadline", "_sig", "_event", "_callbacks", "_lock")
 
-    def __init__(self, lane: str) -> None:
+    def __init__(self, lane: str,
+                 deadline: "Optional[float]" = None) -> None:
         self.lane = lane
+        #: absolute monotonic deadline (the duty's proposal/attestation
+        #: window, stamped at submit): past it the job skips device
+        #: batching and degrades straight to the host anchor — the duty
+        #: is still produced, the device dispatch is not wasted
+        self.deadline = deadline
         self.enqueued_at = time.monotonic()
         self.settled_at: "Optional[float]" = None
         self.dropped = False
@@ -278,6 +284,7 @@ class SigningPlane:
         interlock: "Optional[SignInterlock]" = None,
         db=None,
         release_gate: bool = True,
+        deadline_margin_s: float = 0.05,
     ) -> None:
         self.metrics = metrics
         self.use_device = bool(use_device)
@@ -302,6 +309,10 @@ class SigningPlane:
         self.interlock = (
             interlock if interlock is not None else SignInterlock(db=db)
         )
+        #: safety margin subtracted from a ticket's absolute deadline
+        #: when computing its effective flush due-time — a near-deadline
+        #: head flushes early enough to dispatch AND settle in-window
+        self.deadline_margin_s = float(deadline_margin_s)
         self._injected_backend = backend
         self._backend_lock = threading.Lock()
         self._backends: "dict[str, object]" = {}
@@ -325,7 +336,7 @@ class SigningPlane:
                 "submitted": 0, "batches": 0, "signed": 0, "refused": 0,
                 "dropped": 0, "device_batches": 0, "degraded": 0,
                 "host_batches": 0, "breaker_skips": 0, "device_faults": 0,
-                "gate_failures": 0, "max_batch_items": 0,
+                "gate_failures": 0, "max_batch_items": 0, "expired": 0,
             }
             for name in self.lanes
         }
@@ -383,13 +394,22 @@ class SigningPlane:
         duty_kind: str = "other",
         public_key=None,
         index: "Optional[int]" = None,
+        deadline: "Optional[float]" = None,
+        deadline_s: "Optional[float]" = None,
     ) -> SignTicket:
         """Enqueue one signing request; returns a SignTicket future.
 
         `index` is the duty's slot (block) or target epoch
         (attestation): the slashing interlock refuses a request that
         does not strictly advance the pubkey's watermark, raising
-        SignRefused BEFORE anything reaches a kernel."""
+        SignRefused BEFORE anything reaches a kernel.
+
+        `deadline` (absolute monotonic) or `deadline_s` (relative to
+        now) stamps the duty's window — the slot's proposal window for
+        a block, the attestation broadcast window for attestations. A
+        request overtaken by its window degrades to the host anchor
+        (the duty is STILL produced) instead of riding a device batch
+        it can no longer benefit from."""
         public_key = self._public_key_for(secret_key, public_key)
         reason = self.interlock.check_and_advance(
             public_key.to_bytes(), duty_kind, index
@@ -401,7 +421,9 @@ class SigningPlane:
             with self._stats_lock:
                 self._stats[lane.name]["refused"] += 1
             raise SignRefused(reason, duty_kind, index)
-        ticket = SignTicket(lane.name)
+        if deadline is None and deadline_s is not None:
+            deadline = time.monotonic() + float(deadline_s)
+        ticket = SignTicket(lane.name, deadline=deadline)
         job = _SignJob(signing_root, secret_key, public_key, duty_kind,
                        ticket)
         shed_job = None
@@ -463,6 +485,17 @@ class SigningPlane:
 
     # --------------------------------------------------------- scheduling
 
+    def _effective_due(self, ticket: SignTicket,
+                       lane: SignLaneConfig) -> float:
+        """When a lane's head must flush: the lane's max_wait, or —
+        when the ticket carries a duty-window deadline — early enough
+        (deadline minus the dispatch/settle margin) that a near-
+        deadline head preempts coalescing."""
+        due = ticket.enqueued_at + lane.max_wait_s
+        if ticket.deadline is not None:
+            due = min(due, ticket.deadline - self.deadline_margin_s)
+        return due
+
     def _pick_lane(self) -> "Optional[SignLaneConfig]":
         """Called under _lock: a lane that is full or overdue — HIGH
         priority first, then the most-overdue head."""
@@ -473,7 +506,7 @@ class SigningPlane:
             q = self._queues[lane.name]
             if not q:
                 continue
-            overdue = now - q[0].ticket.enqueued_at - lane.max_wait_s
+            overdue = now - self._effective_due(q[0].ticket, lane)
             if len(q) >= lane.max_batch or overdue >= 0.0:
                 key = (lane.priority != Priority.HIGH, -overdue)
                 if best is None or key < best_key:
@@ -489,7 +522,7 @@ class SigningPlane:
             q = self._queues[lane.name]
             if not q:
                 continue
-            due = q[0].ticket.enqueued_at + lane.max_wait_s - now
+            due = self._effective_due(q[0].ticket, lane) - now
             if nearest is None or due < nearest:
                 nearest = due
         return nearest
@@ -612,10 +645,46 @@ class SigningPlane:
             for job in jobs
         ]
 
+    def _shed_expired(self, lane: SignLaneConfig, signing,
+                      jobs: "list[_SignJob]") -> None:
+        """Deadline-budget expiry on the sign side: the duty's window
+        closed while the job sat in the lane, so it skips the device
+        batch entirely — but the duty is STILL produced, on the host
+        anchor (a late signature beats a missed one). The shed lands on
+        the flight timeline with cause="expired"."""
+        with self._stats_lock:
+            self._stats[lane.name]["expired"] += len(jobs)
+        if self.metrics is not None:
+            for _ in jobs:
+                self.metrics.verify_expired.inc(lane.label)
+        self.flight.record_shed(lane.name, len(jobs), "expired")
+        if signing is None:
+            for job in jobs:
+                job.ticket._resolve(None, dropped=True)
+            return
+        for job in jobs:
+            job.ticket._resolve(
+                signing.host_sign(job.signing_root, job.secret_key)
+            )
+
     def _process_batch(self, lane: SignLaneConfig,
                        jobs: "list[_SignJob]") -> None:
         signing = _schemes.get(lane.scheme).signing
         now = time.monotonic()
+        # deadline-budget gate: window-expired jobs resolve on the host
+        # anchor here, before the batch spends a device dispatch — the
+        # worker's _pending accounting still covers them (they remain
+        # part of this handoff)
+        live: "list[_SignJob]" = []
+        expired: "list[_SignJob]" = []
+        for job in jobs:
+            t = job.ticket.deadline
+            (expired if (t is not None and now >= t) else live).append(job)
+        if expired:
+            self._shed_expired(lane, signing, expired)
+            if not live:
+                return
+            jobs = live
         queue_wait = max(
             0.0, now - min(job.ticket.enqueued_at for job in jobs)
         )

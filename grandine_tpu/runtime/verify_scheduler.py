@@ -183,15 +183,20 @@ class VerifyTicket:
     "ignore" rather than a "reject")."""
 
     __slots__ = ("lane", "origin", "enqueued_at", "settled_at", "dropped",
-                 "_ok", "_event", "_callbacks", "_lock")
+                 "deadline", "_ok", "_event", "_callbacks", "_lock")
 
-    def __init__(self, lane: str, origin: "Optional[str]" = None) -> None:
+    def __init__(self, lane: str, origin: "Optional[str]" = None,
+                 deadline: "Optional[float]" = None) -> None:
         self.lane = lane
         #: gossip peer / validator attribution ("peer:<id>",
         #: "validator:<index>", …) — a rejected job files it into the
         #: flight recorder's bounded top-K failing-origin table (the
         #: quarantine lane's feed); NEVER a Prometheus label value
         self.origin = origin
+        #: absolute monotonic deadline (end-to-end budget, stamped at
+        #: submit): past it the ticket sheds BEFORE any device dispatch
+        #: is spent on it; None = only the lane's max_wait governs
+        self.deadline = deadline
         self.enqueued_at = time.monotonic()
         self.settled_at: "Optional[float]" = None
         self.dropped = False
@@ -273,6 +278,7 @@ class VerifyScheduler:
         use_isolation: bool = True,
         merge_window_s: float = 0.0,
         merge_max_items: int = 128,
+        deadline_margin_s: float = 0.05,
     ) -> None:
         from grandine_tpu.tpu.mesh import mesh_or_none
 
@@ -291,6 +297,17 @@ class VerifyScheduler:
         #: cap on a merged dispatch's total items, keeping merged
         #: batches inside the pow-2 buckets the warmup manifest compiled
         self.merge_max_items = int(merge_max_items)
+        #: brownout plane (runtime/brownout.py pokes these, always as
+        #: whole-object frozenset swaps — a torn read sees either the
+        #: old or the new set): lanes routed to the host twin at B3 so
+        #: the device serves HIGH only, and lanes whose submits resolve
+        #: dropped at the door under CRITICAL
+        self.brownout_route_host: "frozenset[str]" = frozenset()
+        self.brownout_shed_lanes: "frozenset[str]" = frozenset()
+        #: safety margin subtracted from a ticket's absolute deadline
+        #: when computing its effective flush due-time, so a near-
+        #: deadline head still has a chance to dispatch AND settle
+        self.deadline_margin_s = float(deadline_margin_s)
         #: injected VerifyMesh (tpu/mesh.py) threaded into every per-lane
         #: backend; None / 1-device collapses to the single-chip plane
         self.mesh = mesh_or_none(mesh)
@@ -375,12 +392,19 @@ class VerifyScheduler:
     # ------------------------------------------------------------ submit
 
     def submit(self, lane_name: str, items: "Sequence[VerifyItem]",
-               callback=None, origin: "Optional[str]" = None) -> VerifyTicket:
+               callback=None, origin: "Optional[str]" = None,
+               deadline: "Optional[float]" = None,
+               deadline_s: "Optional[float]" = None) -> VerifyTicket:
         """Queue one job (all `items` must verify for the ticket to
         resolve True). Returns immediately; LOW lanes shed oldest-first
         at capacity, HIGH lanes block the caller until there is room.
         `origin` attributes a rejected job to its gossip peer/validator
         in the flight recorder's failing-origin table.
+
+        `deadline` (absolute monotonic) or `deadline_s` (relative to
+        now) stamps an end-to-end budget on the ticket: past it the job
+        sheds before any device dispatch is spent on it, and a near-
+        deadline head preempts max_wait/merge-window batching.
 
         A quarantined origin's SHEDDABLE traffic is rerouted into the
         small-batch quarantine lane so it never shares a batch (nor a
@@ -398,9 +422,21 @@ class VerifyScheduler:
         ):
             lane_name = "quarantine"
             lane = self.lanes[lane_name]
-        ticket = VerifyTicket(lane_name, origin=origin)
+        if deadline is None and deadline_s is not None:
+            deadline = time.monotonic() + float(deadline_s)
+        ticket = VerifyTicket(lane_name, origin=origin, deadline=deadline)
         if callback is not None:
             ticket.add_callback(callback)
+        if lane.shed and lane_name in self.brownout_shed_lanes:
+            # CRITICAL brownout: sheddable lanes drop at the door, with
+            # full accounting — HIGH lanes (shed=False) never take this
+            # path, the device keeps serving them
+            with self._stats_lock:
+                self.stats[lane_name]["submitted"] += 1
+            self._count_shed(lane_name)
+            self.flight.record_shed(lane_name, len(items), "brownout")
+            ticket._resolve(False, dropped=True)
+            return ticket
         job = _Job(items, ticket)
         shed: "list[_Job]" = []
         with self._cond:
@@ -429,6 +465,10 @@ class VerifyScheduler:
             self._cond.notify_all()
         for old in shed:
             self._count_shed(lane_name)
+            # shed-oldest is the overload-control valve: the timeline
+            # attributes it to the brownout plane at whatever level is
+            # in force (level "normal" = plain pre-controller overflow)
+            self.flight.record_shed(lane_name, len(old.items), "brownout")
             old.ticket._resolve(False, dropped=True)
         return ticket
 
@@ -443,16 +483,27 @@ class VerifyScheduler:
 
     # -------------------------------------------------------- dispatcher
 
+    def _effective_due(self, ticket: VerifyTicket,
+                       lane: LaneConfig) -> float:
+        """When a lane's head must flush: the lane's max_wait, or —
+        when the ticket carries an absolute deadline budget — early
+        enough (deadline minus the dispatch/settle margin) that a
+        near-deadline head preempts max_wait/merge-window batching."""
+        due = ticket.enqueued_at + lane.max_wait_s
+        if ticket.deadline is not None:
+            due = min(due, ticket.deadline - self.deadline_margin_s)
+        return due
+
     def _pick_lane(self, now: float) -> "Optional[str]":
         """The due lane to flush next: full (max_batch) or overdue
-        (max_wait since its oldest job); HIGH priority wins, then the
-        most-overdue lane."""
+        (past its head's effective due-time); HIGH priority wins, then
+        the most-overdue lane."""
         best, best_key = None, None
         for name, lane in self.lanes.items():
             q = self._queues[name]
             if not q:
                 continue
-            overdue = now - q[0].ticket.enqueued_at - lane.max_wait_s
+            overdue = now - self._effective_due(q[0].ticket, lane)
             if self._item_counts[name] >= lane.max_batch or overdue >= 0:
                 key = (int(lane.priority), -overdue)
                 if best_key is None or key < best_key:
@@ -465,7 +516,7 @@ class VerifyScheduler:
             q = self._queues[name]
             if not q:
                 continue
-            wait = q[0].ticket.enqueued_at + lane.max_wait_s - now
+            wait = self._effective_due(q[0].ticket, lane) - now
             if soonest is None or wait < soonest:
                 soonest = wait
         if soonest is None:
@@ -516,10 +567,14 @@ class VerifyScheduler:
             # dispatch) — only same-scheme lanes share a device pass
             if lane.scheme != primary.scheme:
                 continue
+            # a brownout-routed lane runs on the host twin: merging it
+            # into a device dispatch would defeat the routing
+            if name in self.brownout_route_host:
+                continue
             q = self._queues[name]
             if not q:
                 continue
-            deadline = q[0].ticket.enqueued_at + lane.max_wait_s
+            deadline = self._effective_due(q[0].ticket, lane)
             if deadline > now + self.merge_window_s:
                 continue
             jobs = self._pop_batch(lane, cap=room, allow_oversize=False)
@@ -696,9 +751,42 @@ class VerifyScheduler:
             if fl is not None:
                 fl.note_device(time.perf_counter() - t0)
 
+    def _shed_expired(self, lane: LaneConfig, jobs: "list[_Job]") -> None:
+        """Deadline-budget enforcement: jobs whose absolute deadline
+        already passed resolve dropped BEFORE the batch spends a device
+        dispatch on them; the shed lands on the flight timeline with
+        cause="expired" and the in-force brownout level stamped on."""
+        n_items = sum(len(j.items) for j in jobs)
+        for job in jobs:
+            self._count_shed(lane.name)
+            if self.metrics is not None:
+                self.metrics.verify_expired.inc(lane.name)
+            job.ticket._resolve(False, dropped=True)
+        self.flight.record_shed(lane.name, n_items, "expired")
+        with self._cond:
+            self._pending -= len(jobs)
+            self._cond.notify_all()
+
     def _flush(self, lane: LaneConfig, jobs: "list[_Job]",
                merged: "list[tuple]" = ()) -> None:
         now = time.monotonic()
+        # deadline-budget gate: already-expired jobs shed here, before
+        # the batch spends a device dispatch (or a host pass) on them.
+        # Merged lanes are same-scheme, so any surviving segment can be
+        # promoted to primary when the original primary fully expired.
+        live_pairs: "list[tuple]" = []
+        for seg_lane, seg_jobs in [(lane, jobs)] + list(merged):
+            live, expired = [], []
+            for j in seg_jobs:
+                t = j.ticket.deadline
+                (expired if (t is not None and now >= t) else live).append(j)
+            if expired:
+                self._shed_expired(seg_lane, expired)
+            if live:
+                live_pairs.append((seg_lane, live))
+        if not live_pairs:
+            return
+        (lane, jobs), merged = live_pairs[0], live_pairs[1:]
         # segments: the primary lane's batch first, then any merged
         # lanes' batches. Each keeps its own flight record so per-lane
         # SLO/failure attribution survives the shared device pass.
@@ -744,8 +832,12 @@ class VerifyScheduler:
             {"lane": lane.name, "jobs": len(jobs), "items": len(items)},
         ):
             if self.use_device:
-                device_allowed = self.health.allow_device()
-                if not device_allowed:
+                if lane.name in self.brownout_route_host:
+                    # B3 brownout routing: this lane runs on the host
+                    # twin so the device serves HIGH lanes only — this
+                    # is policy, not a fault, so no breaker accounting
+                    pass
+                elif not (device_allowed := self.health.allow_device()):
                     # breaker OPEN: no per-batch device fault tax —
                     # straight to the host path, zero dispatch attempts
                     with self._stats_lock:
@@ -765,13 +857,16 @@ class VerifyScheduler:
                         # re-dispatch before paying a full host pass
                         settle = self._retry_dispatch(lane, items, fl)
             if settle is None:
-                # graceful degradation: breaker-open, no device/async
-                # seam, or a faulted dispatch → the eager host path
+                # graceful degradation: brownout host routing, breaker-
+                # open, no device/async seam, or a faulted dispatch →
+                # the eager host path
                 if self.use_device:
+                    routed = lane.name in self.brownout_route_host
                     for seg_lane, _, _, _ in segments:
                         self._count_batch(
                             seg_lane,
-                            "degraded" if device_allowed else "breaker_open",
+                            "degraded" if device_allowed
+                            else ("brownout" if routed else "breaker_open"),
                         )
                 t0 = time.perf_counter()
                 verdicts = self._host_check_all(lane, items)
@@ -1063,6 +1158,17 @@ class VerifyScheduler:
         CLOSED) — lets gossip shed accounting (p2p/network.py) tell
         overload-under-degradation from plain overload."""
         return self.use_device and self.health.state != _health.CLOSED
+
+    def lane_pressure(self) -> "dict[str, float]":
+        """Queue fullness per lane (queued jobs over max_queue) — the
+        brownout controller's depth feed, read under _cond so the
+        snapshot is coherent with in-flight shed decisions."""
+        with self._cond:
+            return {
+                n: (len(self._queues[n]) / lane.max_queue
+                    if lane.max_queue else 0.0)
+                for n, lane in self.lanes.items()
+            }
 
     def flush(self, timeout: float = 30.0) -> None:
         """Test barrier: wait until every submitted job has settled.

@@ -104,8 +104,18 @@ class ChaosBackend:
     `__getattr__`, so the wrapper is transparent to registry/tracer
     plumbing."""
 
+    #: seams the wrapper can inject into; the inner backend only needs
+    #: to implement the ones its scheme actually dispatches (the BLS
+    #: pair from REQUIRED_SEAM_METHODS, ed25519's verify_batch_async,
+    #: blob_kzg's verify_blobs_async, or the sign-side batch_sign)
+    KNOWN_SEAMS = REQUIRED_SEAM_METHODS + (
+        "verify_batch_async",
+        "verify_blobs_async",
+        "batch_sign",
+    )
+
     def __init__(self, inner, plan: FaultPlan, slow_s: float = 0.05) -> None:
-        assert all(hasattr(inner, m) for m in REQUIRED_SEAM_METHODS)
+        assert any(hasattr(inner, m) for m in self.KNOWN_SEAMS)
         self.inner = inner
         self.plan = plan
         self.slow_s = float(slow_s)
@@ -182,6 +192,19 @@ class ChaosBackend:
             (messages, signatures, member_keys, groups),
         )
 
+    # ------------------------------------------- non-BLS verify seams
+
+    def verify_batch_async(self, prep):
+        """ed25519 lane seam: scalar verdict, wrong_verdict inverts it
+        — the silently-corrupt-accelerator mode the ed25519 lane's
+        host-twin canary and quarantine path must catch."""
+        return self._wrap("verify_batch_async", lambda v: not v, (prep,))
+
+    def verify_blobs_async(self, prep):
+        """blob_kzg lane seam: scalar verdict over the whole sidecar
+        batch, wrong_verdict inverts it."""
+        return self._wrap("verify_blobs_async", lambda v: not v, (prep,))
+
     # ---------------------------------------------------- sign-side seam
 
     def batch_sign(self, messages, secret_keys):
@@ -228,6 +251,22 @@ class KnownAnswerBackend:
     def g2_subgroup_check_batch_async(self, points):
         n = len(points)
         return lambda: np.ones((n,), dtype=bool)
+
+    # ------------------------------------- ed25519 / blob_kzg seams
+    # (scheme dispatch calls prepare() first, then the async seam; the
+    # "prep" here is just the message bytes so verdicts stay keyed by
+    # the same truth table as the BLS seam)
+
+    def prepare(self, items):
+        return "ok", [bytes(it.message) for it in items]
+
+    def verify_batch_async(self, prep):
+        self.batches.append(len(prep))
+        return lambda: all(self.truth.get(m, False) for m in prep)
+
+    def verify_blobs_async(self, prep):
+        self.batches.append(len(prep))
+        return lambda: all(self.truth.get(m, False) for m in prep)
 
     def fast_aggregate_verify_batch_async(self, messages, signatures, keys):
         self.batches.append(len(messages))

@@ -724,6 +724,118 @@ def scenario_cached_pubkey(seed: int, **kw) -> dict:
     return _invariant(res, "cached_pubkey", bad)
 
 
+def scenario_brownout_ladder(seed: int, **kw) -> dict:
+    """BrownoutController ladder walk under concurrent evaluate() calls:
+    pressure feeders push SLO misses into a stub flight recorder while
+    several workers tick the controller. The ladder must only ever move
+    one adjacent step per transition, the engaged-actuator set must
+    match the level exactly (a torn _shift would strand a shrunk lane
+    config at NORMAL or skip an engage on the way up), and replaying
+    the transition log from NORMAL must land on the final level."""
+    import grandine_tpu.runtime.brownout as bo
+    from grandine_tpu.runtime.thread_pool import Priority
+
+    class _StubLane:
+        def __init__(self, priority, shed):
+            self.priority = priority
+            self.shed = shed
+            self.max_wait_s = 1.0
+            self.max_queue = 64
+
+    class _StubSched:
+        def __init__(self):
+            self.merge_window_s = 0.5
+            self.lanes = {
+                "high": _StubLane(Priority.HIGH, False),
+                "low": _StubLane(Priority.LOW, True),
+            }
+            self.brownout_route_host = frozenset()
+            self.brownout_shed_lanes = frozenset()
+            self.depth = 0.0
+
+        def lane_pressure(self):
+            return {"low": self.depth}
+
+    class _StubFlight:
+        def __init__(self):
+            self.miss = 0
+            self.brownout_level = "normal"
+
+        def slo_misses(self):
+            return {"low": {"queue_wait": self.miss}}
+
+        def duty_cycle(self):
+            return 0.0
+
+    sched = _StubSched()
+    flight = _StubFlight()
+    ctrl = bo.BrownoutController(
+        sched, flight=flight, clock=_TickClock(),
+        recovery_window_s=3e-4, escalate_dwell_s=0.0,
+    )
+    fz = ScheduleFuzzer(seed, watched=[bo.__file__], **kw)
+    ctrl._lock = fz.lock("brownout._lock")
+
+    def pressurize() -> None:
+        for _ in range(5):
+            flight.miss += 1  # harness code: atomic w.r.t. the schedule
+            ctrl.evaluate()
+
+    def cooldown() -> None:
+        for _ in range(6):
+            ctrl.evaluate()
+
+    fz.add_worker("pressure_a", pressurize)
+    fz.add_worker("pressure_b", pressurize)
+    fz.add_worker("cooler", cooldown)
+    res = fz.run()
+
+    bad: "list[str]" = []
+    final = ctrl._idx
+    if not 0 <= final < len(bo.LEVELS):
+        bad.append(f"level index {final} outside the ladder")
+    replay_idx = 0
+    for _t, frm, to in ctrl._transitions:
+        if frm != bo.LEVELS[replay_idx]:
+            bad.append(
+                f"transition {frm}->{to} does not chain from "
+                f"{bo.LEVELS[replay_idx]} — a torn _shift"
+            )
+            break
+        step = bo.LEVELS.index(to) - bo.LEVELS.index(frm)
+        if abs(step) != 1:
+            bad.append(f"non-adjacent transition {frm}->{to}")
+            break
+        replay_idx = bo.LEVELS.index(to)
+    else:
+        if replay_idx != final:
+            bad.append(
+                f"transition log replays to {bo.LEVELS[replay_idx]} "
+                f"but controller sits at {bo.LEVELS[final]}"
+            )
+    want_engaged = sorted(
+        lvl for lvl in (bo.B1, bo.B2)
+        if final >= bo.LEVELS.index(lvl)
+    )
+    if sorted(ctrl._baselines) != want_engaged:
+        bad.append(
+            f"engaged baselines {sorted(ctrl._baselines)} != "
+            f"{want_engaged} for level {bo.LEVELS[final]}"
+        )
+    if final < 1 and sched.merge_window_s != 0.5:
+        bad.append("merge_window_s not restored at NORMAL")
+    if (final >= 3) != bool(sched.brownout_route_host):
+        bad.append("brownout_route_host inconsistent with level")
+    if (final >= 4) != bool(sched.brownout_shed_lanes):
+        bad.append("brownout_shed_lanes inconsistent with level")
+    if flight.brownout_level != bo.LEVELS[final] and ctrl._transitions:
+        bad.append(
+            f"flight stamp {flight.brownout_level!r} lags level "
+            f"{bo.LEVELS[final]!r}"
+        )
+    return _invariant(res, "brownout_ladder", bad)
+
+
 SCENARIOS: "dict[str, Callable[..., dict]]" = {
     "ticket_verdict": scenario_ticket_verdict,
     "sign_ticket": scenario_sign_ticket,
@@ -731,6 +843,7 @@ SCENARIOS: "dict[str, Callable[..., dict]]" = {
     "breaker_walk": scenario_breaker_walk,
     "registry_lifecycle": scenario_registry_lifecycle,
     "cached_pubkey": scenario_cached_pubkey,
+    "brownout_ladder": scenario_brownout_ladder,
 }
 
 #: every `# lint: atomic=<attr>:` annotation in the runtime sources maps

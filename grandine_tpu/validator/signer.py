@@ -25,12 +25,19 @@ class Signer:
     _REMOTE_WORKERS = 8
 
     def __init__(self, use_device: bool = False, backend=None,
-                 web3signer: "Optional[Callable]" = None) -> None:
+                 web3signer: "Optional[Callable]" = None,
+                 sign_plane=None) -> None:
         self._keys: "dict[bytes, A.SecretKey]" = {}
         self._remote: "set[bytes]" = set()
         self._use_device = use_device
         self._backend = backend
         self._web3signer = web3signer
+        #: optional SigningPlane (runtime/sign_plane.py): when wired,
+        #: sign_triples' local leg rides the plane's scheduled batches
+        #: (release gate + slashing interlock included) instead of a
+        #: private device batch; a shed/dropped ticket falls back to the
+        #: signer's own host anchor so the duty is still produced
+        self._sign_plane = sign_plane
         self._remote_pool = None  # lazy; see _remote_executor
 
     def _remote_executor(self):
@@ -123,7 +130,12 @@ class Signer:
         local keys as ONE device batch (or host loop), remote keys fanned
         out CONCURRENTLY to the Web3Signer client (the reference fans
         remote signings into futures alongside the local batch);
-        results keep input order."""
+        results keep input order.
+
+        With a `sign_plane` wired, the local leg is submitted as plane
+        tickets that batch/settle WHILE the remote fan-out is in
+        flight; a ticket the plane sheds (overload, shutdown) degrades
+        to the signer's own host signing so no duty is lost."""
         local_idx, local_sks, out = [], [], [None] * len(items)
         remote_idx = []
         for i, (pubkey, root) in enumerate(items):
@@ -146,6 +158,25 @@ class Signer:
                 for i in remote_idx
             ]
         try:
+            if self._sign_plane is not None and local_idx:
+                # plane tickets enqueue first so the device batch forms
+                # while the Web3Signer round-trips overlap it
+                plane_tickets = [
+                    (i, sk, self._sign_plane.submit(
+                        bytes(items[i][1]), sk
+                    ))
+                    for i, sk in zip(local_idx, local_sks)
+                ]
+                for i, future in remote_futures:
+                    out[i] = future.result()
+                for i, sk, tk in plane_tickets:
+                    try:
+                        out[i] = tk.result()
+                    except RuntimeError:
+                        # shed at overload/shutdown: the signer's own
+                        # host anchor still produces the duty
+                        out[i] = sk.sign(bytes(items[i][1])).to_bytes()
+                return out
             if self._use_device and len(local_idx) > 1:
                 backend = self._backend
                 if backend is None:

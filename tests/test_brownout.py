@@ -1,0 +1,356 @@
+"""Brownout controller + end-to-end deadline budgets
+(runtime/brownout.py, and the deadline plumbing in
+runtime/verify_scheduler.py / runtime/sign_plane.py).
+
+Ladder tests drive `evaluate()` directly with an injected fake clock —
+no controller thread, no sleeps — against stub feeds, so escalation,
+hysteretic recovery, and actuator engage/revert are all deterministic.
+Deadline tests use already-expired absolute deadlines (monotonic now
+minus one) so no clock mocking is needed to hit the expiry paths.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from grandine_tpu.metrics import Metrics
+from grandine_tpu.runtime import brownout as bo
+from grandine_tpu.runtime import verify_scheduler as vs
+from grandine_tpu.runtime.brownout import (
+    B1,
+    B2,
+    B3,
+    CRITICAL,
+    LEVELS,
+    NORMAL,
+    BrownoutController,
+)
+from grandine_tpu.runtime.isolation import AdmissionController
+from grandine_tpu.runtime.sign_plane import SignLaneConfig, SigningPlane
+from grandine_tpu.runtime.thread_pool import Priority
+from grandine_tpu.runtime.verify_scheduler import (
+    LaneConfig,
+    VerifyItem,
+    VerifyScheduler,
+)
+
+
+class _FakeClock:
+    def __init__(self, t: float = 100.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+class _StubLane:
+    def __init__(self, priority, shed, max_wait=1.0, max_queue=64):
+        self.priority = priority
+        self.shed = shed
+        self.max_wait_s = max_wait
+        self.max_queue = max_queue
+
+
+class _StubSched:
+    def __init__(self):
+        self.merge_window_s = 0.5
+        self.lanes = {
+            "block": _StubLane(Priority.HIGH, False),
+            "sync_message": _StubLane(Priority.LOW, True),
+            "quarantine": _StubLane(Priority.LOW, True),
+        }
+        self.brownout_route_host = frozenset()
+        self.brownout_shed_lanes = frozenset()
+        self.depth = 0.0
+
+    def lane_pressure(self):
+        return {"sync_message": self.depth}
+
+
+class _StubFlight:
+    def __init__(self):
+        self.miss = 0
+        self.brownout_level = "normal"
+
+    def slo_misses(self):
+        return {"sync_message": {"queue_wait": self.miss}}
+
+    def duty_cycle(self):
+        return 0.25
+
+
+class _StubReplay:
+    def __init__(self):
+        self.run_gate = threading.Event()
+        self.run_gate.set()
+
+
+def _controller(**kw):
+    clock = kw.pop("clock", _FakeClock())
+    sched = kw.pop("scheduler", _StubSched())
+    flight = kw.pop("flight", _StubFlight())
+    ctrl = BrownoutController(
+        sched, flight=flight, clock=clock,
+        recovery_window_s=5.0, **kw
+    )
+    return ctrl, sched, flight, clock
+
+
+# ------------------------------------------------------------- ladder
+
+
+def test_escalates_one_level_per_tick_to_critical():
+    ctrl, sched, flight, clock = _controller()
+    seen = []
+    for _ in range(6):
+        flight.miss += 1
+        seen.append(ctrl.evaluate(clock.advance(1.0)))
+    assert seen == [B1, B2, B3, CRITICAL, CRITICAL, CRITICAL]
+    # every transition is one adjacent step
+    for _t, frm, to in ctrl.transitions():
+        assert abs(LEVELS.index(to) - LEVELS.index(frm)) == 1
+
+
+def test_depth_pressure_escalates_without_misses():
+    ctrl, sched, flight, clock = _controller(depth_high_water=0.5)
+    sched.depth = 0.9
+    assert ctrl.evaluate(clock.advance(1.0)) == B1
+    sched.depth = 0.0
+    # clean but inside the hot window: stays put
+    assert ctrl.evaluate(clock.advance(1.0)) == B1
+
+
+def test_recovery_needs_sustained_clean_window_per_level():
+    """The anti-flap hysteresis: one step DOWN per sustained clean
+    recovery window, re-armed at every level — and a mid-recovery miss
+    re-arms the whole window without escalating past where it was."""
+    ctrl, sched, flight, clock = _controller()
+    flight.miss += 1
+    ctrl.evaluate(clock.advance(1.0))
+    flight.miss += 1
+    ctrl.evaluate(clock.advance(1.0))
+    assert ctrl.level == B2
+    # clean ticks inside the 5 s window: no recovery yet
+    assert ctrl.evaluate(clock.advance(2.0)) == B2
+    assert ctrl.evaluate(clock.advance(2.0)) == B2
+    # window elapsed: exactly ONE step down
+    assert ctrl.evaluate(clock.advance(2.0)) == B1
+    # the next step needs its OWN sustained window
+    assert ctrl.evaluate(clock.advance(2.0)) == B1
+    assert ctrl.evaluate(clock.advance(4.0)) == NORMAL
+    # full walk down recorded, no flapping (each level visited once
+    # on the way up and once on the way down)
+    ups = [(f, t) for _x, f, t in ctrl.transitions()
+           if LEVELS.index(t) > LEVELS.index(f)]
+    downs = [(f, t) for _x, f, t in ctrl.transitions()
+             if LEVELS.index(t) < LEVELS.index(f)]
+    assert len(ups) == 2 and len(downs) == 2
+
+
+def test_hot_tick_rearms_recovery_window():
+    ctrl, sched, flight, clock = _controller()
+    flight.miss += 1
+    ctrl.evaluate(clock.advance(1.0))
+    assert ctrl.level == B1
+    clock.advance(4.0)
+    flight.miss += 1
+    ctrl.evaluate(clock.t)  # hot again: escalates to B2, re-arms
+    assert ctrl.level == B2
+    # 4 s later (inside the re-armed window): still B2
+    assert ctrl.evaluate(clock.advance(4.0)) == B2
+    assert ctrl.evaluate(clock.advance(2.0)) == B1
+
+
+def test_actuators_engage_and_revert_in_level_order():
+    admission = AdmissionController()
+    replay = _StubReplay()
+    clock = _FakeClock()
+    sched = _StubSched()
+    flight = _StubFlight()
+    ctrl = BrownoutController(
+        sched, flight=flight, admission=admission, replay=replay,
+        clock=clock, recovery_window_s=5.0,
+        b1_wait_factor=0.25, b2_queue_factor=0.25,
+        b2_admission_pressure=0.75,
+    )
+    low = sched.lanes["sync_message"]
+    high = sched.lanes["block"]
+    for _ in range(4):
+        flight.miss += 1
+        ctrl.evaluate(clock.advance(1.0))
+    assert ctrl.level == CRITICAL
+    # B1: merge window zeroed, sheddable waits shrunk, HIGH untouched
+    assert sched.merge_window_s == 0.0
+    assert low.max_wait_s == pytest.approx(0.25)
+    assert high.max_wait_s == 1.0
+    # B2: sheddable non-quarantine queues shrunk + admission squeezed
+    assert low.max_queue == 16
+    assert sched.lanes["quarantine"].max_queue == 64
+    assert admission.brownout_pressure == pytest.approx(0.75)
+    # B3: replay paused, LOW lanes routed to the host twin
+    assert not replay.run_gate.is_set()
+    assert sched.brownout_route_host == {"sync_message", "quarantine"}
+    # CRITICAL: sheddable lanes dropped at the door
+    assert sched.brownout_shed_lanes == {"sync_message", "quarantine"}
+    assert flight.brownout_level == CRITICAL
+
+    # walk all the way back down: everything restored
+    for _ in range(4):
+        clock.advance(6.0)
+        ctrl.evaluate(clock.t)
+    assert ctrl.level == NORMAL
+    assert sched.merge_window_s == 0.5
+    assert low.max_wait_s == 1.0
+    assert low.max_queue == 64
+    assert admission.brownout_pressure == 0.0
+    assert replay.run_gate.is_set()
+    assert sched.brownout_route_host == frozenset()
+    assert sched.brownout_shed_lanes == frozenset()
+    assert flight.brownout_level == NORMAL
+
+
+def test_stop_reverts_every_engaged_level():
+    ctrl, sched, flight, clock = _controller()
+    for _ in range(3):
+        flight.miss += 1
+        ctrl.evaluate(clock.advance(1.0))
+    assert ctrl.level == B3
+    ctrl.stop()
+    assert ctrl.level == NORMAL
+    assert sched.merge_window_s == 0.5
+    assert sched.lanes["sync_message"].max_wait_s == 1.0
+    assert sched.brownout_route_host == frozenset()
+
+
+def test_transitions_metric_labels_stay_in_enum():
+    m = Metrics()
+    ctrl, sched, flight, clock = _controller(metrics=m)
+    flight.miss += 1
+    ctrl.evaluate(clock.advance(1.0))
+    clock.advance(6.0)
+    ctrl.evaluate(clock.t)
+    text = m.expose()
+    assert 'verify_brownout_transitions_total{from="normal",to="b1"} 1' \
+        in text
+    assert 'verify_brownout_transitions_total{from="b1",to="normal"} 1' \
+        in text
+    assert "verify_brownout_level 0" in text
+
+
+def test_admission_squeeze_toward_min_quota():
+    adm = AdmissionController(max_share=0.5, min_quota=8)
+    # build up window traffic so quotas are share-derived: 4 origins x
+    # 10 jobs -> global 40, per-origin quota max(8, 0.5*40) = 20
+    for i in range(40):
+        assert adm.admit(f"origin-{i % 4}", items=1)
+    assert adm._totals.get("origin-0", 0) == 10
+    adm.set_brownout_pressure(1.0)
+    # full squeeze: quota collapses to the min_quota floor (8), so a
+    # submission that fit under the fair share no longer does
+    assert not adm.admit("origin-0", items=9)
+    adm.set_brownout_pressure(0.0)
+    assert adm.admit("origin-0", items=9)
+
+
+# -------------------------------------------------- deadline budgets
+
+
+def test_expired_verify_ticket_sheds_before_any_check(monkeypatch):
+    """An already-expired ticket resolves dropped without spending a
+    single host (or device) check, lands an `expired` flight record,
+    and bumps verify_expired_total for its lane."""
+    checks = []
+    monkeypatch.setattr(
+        vs, "host_check_item", lambda it: checks.append(it) or True
+    )
+    m = Metrics()
+    lanes = (LaneConfig("low", Priority.LOW, 1000, 5.0, 100, shed=True),)
+    s = VerifyScheduler(lanes=lanes, use_device=False, metrics=m)
+    try:
+        item = VerifyItem(b"x" * 32, b"y" * 96, public_keys=("stub",))
+        tk = s.submit("low", [item], deadline=time.monotonic() - 1.0)
+        assert tk.result(10.0) is False
+        assert tk.dropped
+        assert checks == [], "expired work must never reach a check"
+        recs = [r for r in s.flight.snapshot() if r.note == "shed"]
+        assert recs and recs[-1].slo_cause == "expired"
+        assert recs[-1].brownout == "normal"
+        assert 'verify_expired_total{lane="low"} 1' in m.expose()
+    finally:
+        s.stop()
+
+
+def test_near_deadline_ticket_preempts_lane_max_wait(monkeypatch):
+    """A ticket whose deadline lands before the lane's max_wait flushes
+    at the deadline margin, not at max_wait — the merge window never
+    pads a duty past its budget."""
+    monkeypatch.setattr(vs, "host_check_item", lambda it: True)
+    lanes = (LaneConfig("low", Priority.LOW, 1000, 5.0, 100, shed=True),)
+    s = VerifyScheduler(lanes=lanes, use_device=False)
+    try:
+        t0 = time.monotonic()
+        item = VerifyItem(b"x" * 32, b"y" * 96, public_keys=("stub",))
+        tk = s.submit("low", [item], deadline_s=0.25)
+        assert tk.result(10.0) is True
+        assert not tk.dropped
+        elapsed = time.monotonic() - t0
+        assert elapsed < 2.0, (
+            f"flushed at {elapsed:.2f}s — waited for max_wait instead "
+            f"of the deadline budget"
+        )
+    finally:
+        s.stop()
+
+
+class _CountingSignBackend:
+    def __init__(self):
+        self.sign_calls = 0
+
+    def batch_sign(self, messages, secret_keys):
+        self.sign_calls += 1
+        return [sk.sign(bytes(m)) for sk, m in zip(secret_keys, messages)]
+
+    def multi_verify(self, messages, signatures, public_keys):
+        return True
+
+
+def test_expired_sign_job_host_signs_without_device_batch():
+    """Sign-side expiry semantics: a window-expired duty is NOT dropped
+    — it degrades to the host anchor (the duty is still produced) and
+    the device batch is never dispatched for it."""
+    from grandine_tpu.crypto import bls as A
+
+    sk = A.SecretKey(0x7E57_BEEF)
+    root = b"\x42" * 32
+    backend = _CountingSignBackend()
+    lanes = (
+        SignLaneConfig("attestation", Priority.HIGH, 8, 0.002, 64,
+                       shed=False),
+        SignLaneConfig("block", Priority.HIGH, 1, 0.001, 8, shed=False),
+        SignLaneConfig("other", Priority.LOW, 8, 0.002, 64, shed=True),
+    )
+    m = Metrics()
+    plane = SigningPlane(backend=backend, lanes=lanes, metrics=m)
+    try:
+        tk = plane.submit(root, sk, duty_kind="attestation",
+                          deadline=time.monotonic() - 1.0)
+        sig = tk.result(10.0)
+        assert sig == sk.sign(root).to_bytes(), (
+            "the duty must still be produced, on the host anchor"
+        )
+        assert not tk.dropped
+        assert backend.sign_calls == 0, (
+            "an expired job must never ride a device batch"
+        )
+        assert plane.stats()["attestation"]["expired"] == 1
+        assert 'verify_expired_total{lane="sign_attestation"} 1' \
+            in m.expose()
+    finally:
+        plane.stop()

@@ -507,3 +507,72 @@ def test_watchdog_abandons_hung_settle():
     assert outcome.status == _health.TIMEOUT
     assert time.monotonic() - t0 < 2.0
     release.set()
+
+
+# --------------------------------------------------- non-BLS lane chaos
+
+
+def _patch_nonbls_host_twin(monkeypatch, truth):
+    """Both non-BLS schemes answer host checks from the truth table, so
+    fault-free expectations stay exact on the ed25519/blob_kzg lanes."""
+    from grandine_tpu.tpu import schemes as _schemes
+
+    for name in ("ed25519", "blob_kzg"):
+        monkeypatch.setattr(
+            _schemes.get(name), "host_check",
+            lambda item, _t=truth: _t.get(bytes(item.message), False),
+        )
+
+
+@pytest.mark.parametrize("lane", ["ed25519", "blob_kzg"])
+def test_wrong_verdict_on_nonbls_lane_host_twin_corrects(monkeypatch, lane):
+    """A silently-corrupt device on the ed25519/blob_kzg lanes: the
+    scripted wrong_verdict flips the batch verdict, bisection descends
+    to the scheme's OWN host twin at the leaf, and the ticket settles
+    with the fault-free verdict while the breaker books a verdict
+    fault."""
+    msg = b"nonbls-valid" + b"\x00" * 20
+    truth = {msg: True}
+    plan = FaultPlan(script=["wrong_verdict"])
+    chaos, sup, sched = _make_plane(truth, plan, monkeypatch)
+    _patch_nonbls_host_twin(monkeypatch, truth)
+    try:
+        tk = sched.submit(lane, [_item(msg)])
+        sched.flush(30.0)
+        assert tk.done() and not tk.dropped
+        assert tk.ok is True, (
+            "host twin must correct the inverted device verdict"
+        )
+        assert plan.injected["wrong_verdict"] == 1
+        assert sched.stats[lane]["accepted"] == 1
+    finally:
+        sched.stop()
+        chaos.release_hangs()
+
+
+@pytest.mark.parametrize("lane", ["ed25519", "blob_kzg"])
+def test_nonbls_lane_failures_quarantine_origin(monkeypatch, lane):
+    """Per-lane origin quarantine: an origin whose ed25519/blob_kzg
+    submissions fail is attributed through the shared reputation table,
+    and its NEXT sheddable submission reroutes into the quarantine
+    lane (never sharing a batch with clean traffic again)."""
+    bad = b"nonbls-forged" + b"\x00" * 19
+    truth = {}  # bad absent -> host twin says False
+    plan = FaultPlan(script=[])  # no injected faults: real rejections
+    chaos, sup, sched = _make_plane(truth, plan, monkeypatch)
+    _patch_nonbls_host_twin(monkeypatch, truth)
+    try:
+        tk = sched.submit(lane, [_item(bad)], origin="peer-evil")
+        sched.flush(30.0)
+        assert tk.done() and tk.ok is False
+        assert sched.reputation.is_quarantined("peer-evil")
+        tk2 = sched.submit(lane, [_item(bad)], origin="peer-evil")
+        sched.flush(30.0)
+        assert tk2.done() and tk2.ok is False
+        assert sched.stats["quarantine"]["submitted"] >= 1, (
+            "quarantined origin's traffic must reroute to the "
+            "quarantine lane"
+        )
+    finally:
+        sched.stop()
+        chaos.release_hangs()

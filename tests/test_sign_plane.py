@@ -454,3 +454,46 @@ def test_plane_device_round_and_chaos_gate():
         assert plane.health.state != "closed" or st["degraded"] >= 1
     finally:
         plane.stop()
+
+
+def test_sign_triples_local_leg_rides_plane_with_remote_overlap():
+    """With a sign_plane wired, sign_triples' local keys batch through
+    the plane while the Web3Signer fan-out is in flight — results keep
+    input order and byte-match the anchors."""
+    remote_calls = []
+
+    def web3signer(pk_hex, root_hex):
+        remote_calls.append(pk_hex)
+        return SKS[2].sign(bytes.fromhex(root_hex)).to_bytes().hex()
+
+    plane = SigningPlane(use_device=False, lanes=_tiny_lanes())
+    signer = Signer(web3signer=web3signer, sign_plane=plane)
+    try:
+        pk0 = signer.add_key(SKS[0])
+        pk1 = signer.add_key(SKS[1])
+        remote_pk = PKS[2].to_bytes()
+        signer.add_remote_key(remote_pk)
+        out = signer.sign_triples(
+            [(pk0, ROOTS[0]), (remote_pk, ROOTS[2]), (pk1, ROOTS[1])]
+        )
+        assert out == [
+            ANCHORS[0], SKS[2].sign(ROOTS[2]).to_bytes(), ANCHORS[1],
+        ]
+        assert len(remote_calls) == 1
+        assert plane.stats()["other"]["signed"] == 2
+    finally:
+        signer.close()
+        plane.stop()
+
+
+def test_sign_triples_dropped_plane_ticket_falls_back_to_signer():
+    """A plane that sheds the ticket (stopped plane: every submit
+    resolves dropped) must not lose the duty — the signer's own host
+    anchor produces the signature."""
+    plane = SigningPlane(use_device=False, lanes=_tiny_lanes())
+    plane.stop()  # every subsequent submit resolves dropped
+    signer = Signer(sign_plane=plane)
+    pk0 = signer.add_key(SKS[0])
+    out = signer.sign_triples([(pk0, ROOTS[0])])
+    assert out == [ANCHORS[0]]
+    signer.close()

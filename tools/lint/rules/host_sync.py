@@ -57,6 +57,7 @@ class HostSyncRule(Rule):
         "grandine_tpu/runtime/attestation_verifier.py",
         "grandine_tpu/runtime/verify_scheduler.py",
         "grandine_tpu/runtime/sign_plane.py",
+        "grandine_tpu/runtime/brownout.py",
         "grandine_tpu/runtime/health.py",
         "grandine_tpu/runtime/replay.py",
         "grandine_tpu/runtime/isolation.py",

@@ -85,6 +85,7 @@ class LockOrderRule(Rule):
     default_paths = (
         "grandine_tpu/runtime/verify_scheduler.py",
         "grandine_tpu/runtime/sign_plane.py",
+        "grandine_tpu/runtime/brownout.py",
         "grandine_tpu/runtime/thread_pool.py",
         "grandine_tpu/runtime/replay.py",
         "grandine_tpu/runtime/flight.py",

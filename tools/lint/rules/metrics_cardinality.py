@@ -59,15 +59,24 @@ _IDENTITY_LABELS = {
     # session-id label would grow one series per start()
     "session", "session_id", "sid",
 }
-#: family attr -> (label name, canonical module, enum constant name):
-#: literal values of that label must be members of the tuple constant.
-#: The constant is parsed from the canonical module and, so fixtures
-#: are self-contained, from each scanned file (last parse wins).
+#: family attr -> (label name(s), canonical module, enum constant name):
+#: literal values of those labels must be members of the tuple constant.
+#: The first element may be one label name or a tuple of them sharing
+#: the same enum (e.g. a transition counter's from/to pair). The
+#: constant is parsed from the canonical module and, so fixtures are
+#: self-contained, from each scanned file (last parse wins).
 _ENUM_LABELS = {
     "verify_slo_miss": (
         "cause", "grandine_tpu/runtime/flight.py", "SLO_CAUSES"
     ),
+    "verify_brownout_transitions": (
+        ("from", "to"), "grandine_tpu/runtime/brownout.py", "LEVELS"
+    ),
 }
+
+
+def _enum_label_tuple(labels) -> "tuple[str, ...]":
+    return (labels,) if isinstance(labels, str) else tuple(labels)
 
 
 class _Family:
@@ -256,11 +265,11 @@ class MetricsCardinalityRule(Rule):
             tree = ctx.tree(path)
             if tree is not None:
                 enum_consts.update(_parse_enum_consts(tree, wanted))
-        enums: "dict[str, tuple[str, frozenset[str]]]" = {}
-        for attr, (label, _src, const) in _ENUM_LABELS.items():
+        enums: "dict[str, tuple[tuple[str, ...], frozenset[str]]]" = {}
+        for attr, (labels, _src, const) in _ENUM_LABELS.items():
             allowed = enum_consts.get(const)
             if allowed:
-                enums[attr] = (label, allowed)
+                enums[attr] = (_enum_label_tuple(labels), allowed)
 
         out: "list[Finding]" = []
         decl_paths = [DECLARATIONS] + [p for p in files
@@ -392,26 +401,27 @@ class MetricsCardinalityRule(Rule):
         # ---- closed-enum labels: literal values must be members
         enum = enums.get(owner.attr)
         if enum is not None:
-            label, allowed = enum
-            value_node = None
-            if op == "labels" and label_kwargs:
-                for kw in label_kwargs:
-                    if kw.arg == label:
-                        value_node = kw.value
-            elif label in fam.labelnames:
-                i = fam.labelnames.index(label)
-                if i < len(label_args):
-                    value_node = label_args[i]
-            if (
-                isinstance(value_node, ast.Constant)
-                and isinstance(value_node.value, str)
-                and value_node.value not in allowed
-            ):
-                yield Finding(
-                    self.name, path, value_node.lineno,
-                    f"{fam.name}.{op}() passes "
-                    f"{label}={value_node.value!r} — not a member of "
-                    f"the closed enum {sorted(allowed)}",
-                    key=(f"{self.name}:{path}:{fam.name}:enum:"
-                         f"{value_node.value}"),
-                )
+            labels, allowed = enum
+            for label in labels:
+                value_node = None
+                if op == "labels" and label_kwargs:
+                    for kw in label_kwargs:
+                        if kw.arg == label:
+                            value_node = kw.value
+                elif label in fam.labelnames:
+                    i = fam.labelnames.index(label)
+                    if i < len(label_args):
+                        value_node = label_args[i]
+                if (
+                    isinstance(value_node, ast.Constant)
+                    and isinstance(value_node.value, str)
+                    and value_node.value not in allowed
+                ):
+                    yield Finding(
+                        self.name, path, value_node.lineno,
+                        f"{fam.name}.{op}() passes "
+                        f"{label}={value_node.value!r} — not a member "
+                        f"of the closed enum {sorted(allowed)}",
+                        key=(f"{self.name}:{path}:{fam.name}:enum:"
+                             f"{label}:{value_node.value}"),
+                    )

@@ -47,6 +47,7 @@ class ThreadAffinityRule(Rule):
     default_paths = (
         "grandine_tpu/runtime/verify_scheduler.py",
         "grandine_tpu/runtime/sign_plane.py",
+        "grandine_tpu/runtime/brownout.py",
         "grandine_tpu/runtime/attestation_verifier.py",
         "grandine_tpu/runtime/health.py",
         "grandine_tpu/runtime/flight.py",

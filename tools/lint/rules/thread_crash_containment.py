@@ -87,6 +87,7 @@ class ThreadCrashContainmentRule(Rule):
     default_paths = (
         "grandine_tpu/runtime/verify_scheduler.py",
         "grandine_tpu/runtime/sign_plane.py",
+        "grandine_tpu/runtime/brownout.py",
         "grandine_tpu/runtime/attestation_verifier.py",
         "grandine_tpu/runtime/thread_pool.py",
         "grandine_tpu/runtime/controller.py",
